@@ -1,0 +1,38 @@
+"""kubeml_tpu — a TPU-native data-parallel training framework.
+
+Capability parity with the KubeML reference system (serverless K-step
+local-SGD training on Kubernetes; see SURVEY.md), re-architected for TPU:
+the N serverless function replicas + RedisAI weight blackboard collapse
+into a single jit-compiled JAX program over a `jax.sharding.Mesh`, with
+the merge barrier expressed as a masked `lax.psum` weight average.
+
+Public API mirrors the reference's `python/kubeml` pip package
+(reference: python/kubeml/kubeml/__init__.py):
+
+    from kubeml_tpu import KubeModel, KubeDataset
+"""
+
+from kubeml_tpu.version import __version__
+from kubeml_tpu.models.base import KubeModel, KubeDataset
+from kubeml_tpu.api.errors import (
+    KubeMLException,
+    MergeError,
+    DataError,
+    InvalidFormatError,
+    StorageError,
+    DatasetNotFoundError,
+    InvalidArgsError,
+)
+
+__all__ = [
+    "__version__",
+    "KubeModel",
+    "KubeDataset",
+    "KubeMLException",
+    "MergeError",
+    "DataError",
+    "InvalidFormatError",
+    "StorageError",
+    "DatasetNotFoundError",
+    "InvalidArgsError",
+]

@@ -1,0 +1,3 @@
+from kubeml_tpu.api import const, errors, types
+
+__all__ = ["const", "errors", "types"]

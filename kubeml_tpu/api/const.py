@@ -1,0 +1,46 @@
+"""System constants.
+
+Parity with the reference's hardcoded constants (ml/pkg/api/const.go:4-30 and
+the performance-bounding constants catalogued in BASELINE.md), re-homed for a
+single-host / multi-host TPU deployment: service addresses default to
+localhost ports instead of Kubernetes cluster DNS.
+"""
+
+import os
+
+# --- parallelism (ml/pkg/api/const.go:16,25) -------------------------------
+DEFAULT_PARALLELISM = 5
+DEBUG_PARALLELISM = 2
+
+# --- storage granularity (ml/pkg/controller/storageApi.go:20,
+#     python/kubeml/kubeml/util.py:10, python/storage/api.py:135) -----------
+STORAGE_SUBSET_SIZE = 64
+
+# --- CLI validation bound (ml/pkg/kubeml-cli/cmd/train.go:15) --------------
+MAX_BATCH_SIZE = 1024
+
+# --- scheduler throughput policy thresholds (ml/pkg/scheduler/policy.go:9-12)
+POLICY_UPPER_BOUND = 1.2   # epoch slowed >= 20%  -> parallelism -1
+POLICY_LOWER_BOUND = 1.05  # epoch within 5%      -> parallelism +1
+
+# --- service ports (reference uses k8s DNS, ml/pkg/api/const.go:4-14;
+#     we use localhost ports, overridable via env) --------------------------
+CONTROLLER_PORT = int(os.environ.get("KUBEML_CONTROLLER_PORT", "9673"))
+SCHEDULER_PORT = int(os.environ.get("KUBEML_SCHEDULER_PORT", "9674"))
+PS_PORT = int(os.environ.get("KUBEML_PS_PORT", "9675"))
+STORAGE_PORT = int(os.environ.get("KUBEML_STORAGE_PORT", "9676"))
+METRICS_PORT = int(os.environ.get("KUBEML_METRICS_PORT", "9677"))
+
+CONTROLLER_URL = os.environ.get("KUBEML_CONTROLLER_URL", f"http://127.0.0.1:{CONTROLLER_PORT}")
+SCHEDULER_URL = os.environ.get("KUBEML_SCHEDULER_URL", f"http://127.0.0.1:{SCHEDULER_PORT}")
+PS_URL = os.environ.get("KUBEML_PS_URL", f"http://127.0.0.1:{PS_PORT}")
+STORAGE_URL = os.environ.get("KUBEML_STORAGE_URL", f"http://127.0.0.1:{STORAGE_PORT}")
+
+
+def kubeml_home() -> str:
+    """Root directory for the on-disk data/model/history planes.
+
+    Replaces the reference's MongoDB + RedisAI deployments (SURVEY.md L0) with
+    a host-filesystem layout suitable for TPU VM hosts.
+    """
+    return os.environ.get("KUBEML_TPU_HOME", os.path.expanduser("~/.kubeml_tpu"))

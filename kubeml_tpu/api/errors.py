@@ -1,0 +1,86 @@
+"""Shared JSON error envelope + exception hierarchy.
+
+Parity: the reference shares a `{code, error}` JSON envelope between its Go
+components (ml/pkg/error/error.go:13-87) and Python functions
+(python/kubeml/kubeml/exceptions.py:1-48). We keep the same wire shape and
+exception names so user code and clients translate directly.
+"""
+
+from __future__ import annotations
+
+import json
+
+
+class KubeMLException(Exception):
+    """Base exception carrying an HTTP-style status code.
+
+    Mirrors python/kubeml/kubeml/exceptions.py:5-17.
+    """
+
+    def __init__(self, message: str, status_code: int = 500):
+        super().__init__(message)
+        self.message = message
+        self.status_code = status_code
+
+    def to_dict(self) -> dict:
+        return {"code": self.status_code, "error": self.message}
+
+    def to_json(self) -> str:
+        return json.dumps(self.to_dict())
+
+
+class MergeError(KubeMLException):
+    def __init__(self, message: str = "Error merging model"):
+        super().__init__(message, 500)
+
+
+class DataError(KubeMLException):
+    def __init__(self, message: str = "Error loading data"):
+        super().__init__(message, 500)
+
+
+class InvalidFormatError(KubeMLException):
+    def __init__(self, message: str = "Invalid request format"):
+        super().__init__(message, 400)
+
+
+class StorageError(KubeMLException):
+    def __init__(self, message: str = "Error accessing storage"):
+        super().__init__(message, 500)
+
+
+class DatasetNotFoundError(KubeMLException):
+    def __init__(self, name: str = ""):
+        super().__init__(f"Dataset not found{': ' + name if name else ''}", 404)
+
+
+class InvalidArgsError(KubeMLException):
+    def __init__(self, message: str = "Invalid arguments"):
+        super().__init__(message, 400)
+
+
+class JobNotFoundError(KubeMLException):
+    def __init__(self, job_id: str = ""):
+        super().__init__(f"Job not found{': ' + job_id if job_id else ''}", 404)
+
+
+class FunctionNotFoundError(KubeMLException):
+    def __init__(self, name: str = ""):
+        super().__init__(f"Function not found{': ' + name if name else ''}", 404)
+
+
+def check_error(status_code: int, body: bytes) -> None:
+    """Raise a KubeMLException from an error-envelope HTTP response.
+
+    Parity with CheckFunctionError (ml/pkg/error/error.go:36-59): parse the
+    `{code, error}` envelope if present, otherwise synthesize from status.
+    """
+    if status_code < 400:
+        return
+    try:
+        payload = json.loads(body.decode("utf-8"))
+        raise KubeMLException(payload.get("error", "unknown error"),
+                              payload.get("code", status_code))
+    except (ValueError, AttributeError, UnicodeDecodeError):
+        raise KubeMLException(body.decode("utf-8", "replace") or "unknown error",
+                              status_code) from None

@@ -1,0 +1,218 @@
+"""Wire types shared by every component.
+
+Parity with ml/pkg/api/types.go:9-112 — same field set, same JSON key names
+(snake/camel kept as the reference serializes them), so histories and train
+requests are drop-in compatible for users of the reference system.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+
+def _asdict(obj) -> dict:
+    return dataclasses.asdict(obj)
+
+
+@dataclass
+class TrainOptions:
+    """Tunable training options (ml/pkg/api/types.go:24-34)."""
+
+    default_parallelism: int = 5
+    static_parallelism: bool = False
+    validate_every: int = 1
+    k: int = 1                     # K-step local SGD period; -1 => once per epoch
+    goal_accuracy: float = 100.0   # early-stop accuracy target (percent)
+
+    def to_dict(self) -> dict:
+        return {
+            "default_parallelism": self.default_parallelism,
+            "static_parallelism": self.static_parallelism,
+            "validate_every": self.validate_every,
+            "K": self.k,
+            "goal_accuracy": self.goal_accuracy,
+        }
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "TrainOptions":
+        return cls(
+            default_parallelism=d.get("default_parallelism", 5),
+            static_parallelism=d.get("static_parallelism", False),
+            validate_every=d.get("validate_every", 1),
+            k=d.get("K", d.get("k", 1)),
+            goal_accuracy=d.get("goal_accuracy", 100.0),
+        )
+
+
+@dataclass
+class TrainRequest:
+    """A train submission (ml/pkg/api/types.go:9-22)."""
+
+    model_type: str        # registered function/model name
+    batch_size: int
+    epochs: int
+    dataset: str
+    lr: float
+    function_name: str = ""
+    options: TrainOptions = field(default_factory=TrainOptions)
+
+    def to_dict(self) -> dict:
+        return {
+            "model_type": self.model_type,
+            "batch_size": self.batch_size,
+            "epochs": self.epochs,
+            "dataset": self.dataset,
+            "lr": self.lr,
+            "function_name": self.function_name or self.model_type,
+            "options": self.options.to_dict(),
+        }
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "TrainRequest":
+        return cls(
+            model_type=d.get("model_type", d.get("function_name", "")),
+            batch_size=int(d["batch_size"]),
+            epochs=int(d["epochs"]),
+            dataset=d["dataset"],
+            lr=float(d["lr"]),
+            function_name=d.get("function_name", ""),
+            options=TrainOptions.from_dict(d.get("options", {})),
+        )
+
+
+@dataclass
+class TrainTask:
+    """A scheduled job (ml/pkg/api/types.go:44-58)."""
+
+    job_id: str
+    parameters: TrainRequest
+    parallelism: int = 0
+    elapsed_time_s: float = -1.0   # last epoch duration fed back to the policy
+    state: str = "queued"          # queued | starting | running | finished | failed | stopped
+
+    def to_dict(self) -> dict:
+        return {
+            "job_id": self.job_id,
+            "parameters": self.parameters.to_dict(),
+            "parallelism": self.parallelism,
+            "elapsed_time_s": self.elapsed_time_s,
+            "state": self.state,
+        }
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "TrainTask":
+        return cls(
+            job_id=d["job_id"],
+            parameters=TrainRequest.from_dict(d["parameters"]),
+            parallelism=d.get("parallelism", 0),
+            elapsed_time_s=d.get("elapsed_time_s", -1.0),
+            state=d.get("state", "queued"),
+        )
+
+
+@dataclass
+class JobHistory:
+    """Per-epoch metric arrays (ml/pkg/api/types.go:75-81)."""
+
+    validation_loss: List[float] = field(default_factory=list)
+    accuracy: List[float] = field(default_factory=list)
+    train_loss: List[float] = field(default_factory=list)
+    parallelism: List[int] = field(default_factory=list)
+    epoch_duration: List[float] = field(default_factory=list)
+
+    def to_dict(self) -> dict:
+        return _asdict(self)
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "JobHistory":
+        return cls(
+            validation_loss=list(d.get("validation_loss", [])),
+            accuracy=list(d.get("accuracy", [])),
+            train_loss=list(d.get("train_loss", [])),
+            parallelism=list(d.get("parallelism", [])),
+            epoch_duration=list(d.get("epoch_duration", [])),
+        )
+
+
+@dataclass
+class History:
+    """A persisted training history record (ml/pkg/api/types.go:84-100)."""
+
+    id: str
+    task: TrainRequest
+    data: JobHistory
+
+    def to_dict(self) -> dict:
+        return {"_id": self.id, "task": self.task.to_dict(), "data": self.data.to_dict()}
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "History":
+        return cls(
+            id=d.get("_id", d.get("id", "")),
+            task=TrainRequest.from_dict(d["task"]),
+            data=JobHistory.from_dict(d["data"]),
+        )
+
+
+@dataclass
+class MetricUpdate:
+    """A per-epoch metric push from a job to the PS (ml/pkg/api/types.go:103-112)."""
+
+    job_id: str
+    validation_loss: float
+    accuracy: float
+    train_loss: float
+    parallelism: int
+    epoch_duration: float
+
+    def to_dict(self) -> dict:
+        return _asdict(self)
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "MetricUpdate":
+        return cls(**{k: d[k] for k in
+                      ("job_id", "validation_loss", "accuracy", "train_loss",
+                       "parallelism", "epoch_duration")})
+
+
+@dataclass
+class InferRequest:
+    """Inference request (ml/pkg/api/types.go:37-41)."""
+
+    model_id: str          # jobId of the trained model
+    data: Any = None       # opaque JSON payload handed to the user's infer()
+
+    def to_dict(self) -> dict:
+        return {"model_id": self.model_id, "data": self.data}
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "InferRequest":
+        return cls(model_id=d["model_id"], data=d.get("data"))
+
+
+@dataclass
+class DatasetSummary:
+    """Dataset listing entry (ml/pkg/api/types.go:66-72)."""
+
+    name: str
+    train_set_size: int
+    test_set_size: int
+
+    def to_dict(self) -> dict:
+        return _asdict(self)
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "DatasetSummary":
+        return cls(name=d["name"],
+                   train_set_size=d.get("train_set_size", 0),
+                   test_set_size=d.get("test_set_size", 0))
+
+
+def dumps(obj) -> str:
+    """Serialize any wire type (or list of them) to JSON."""
+    if isinstance(obj, list):
+        return json.dumps([o.to_dict() if hasattr(o, "to_dict") else o for o in obj])
+    return json.dumps(obj.to_dict() if hasattr(obj, "to_dict") else obj)

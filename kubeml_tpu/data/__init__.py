@@ -1,0 +1,17 @@
+from kubeml_tpu.data.sharding import (
+    split_minibatches,
+    get_subset_period,
+    plan_epoch,
+    EpochPlan,
+    RoundPlan,
+    WorkerChunk,
+)
+
+__all__ = [
+    "split_minibatches",
+    "get_subset_period",
+    "plan_epoch",
+    "EpochPlan",
+    "RoundPlan",
+    "WorkerChunk",
+]

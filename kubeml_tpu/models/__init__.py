@@ -1,0 +1,42 @@
+"""Built-in model zoo + user-model base classes.
+
+The reference ships example "functions" (user model files) for LeNet/MNIST,
+ResNet-34/CIFAR-10, VGG-11, ResNet-32 (ml/experiments/kubeml/*.py). Here the
+equivalents are first-class built-ins, plus the BASELINE.json configs
+(ResNet-18, ResNet-50, 2-layer LSTM, BERT-tiny).
+"""
+
+from kubeml_tpu.models.base import KubeModel, KubeDataset
+
+_BUILTIN = {}
+
+
+def register_model(name):
+    def deco(cls):
+        _BUILTIN[name] = cls
+        return cls
+    return deco
+
+
+def _load_zoo():
+    import importlib
+    for mod in ("lenet", "resnet", "vgg", "lstm", "bert", "mlp"):
+        try:
+            importlib.import_module(f"kubeml_tpu.models.{mod}")
+        except ModuleNotFoundError:
+            pass
+
+
+def get_builtin(name):
+    """Resolve a built-in model class by name (lazy import of the zoo)."""
+    _load_zoo()
+    return _BUILTIN.get(name)
+
+
+def builtin_names():
+    _load_zoo()
+    return sorted(_BUILTIN)
+
+
+__all__ = ["KubeModel", "KubeDataset", "register_model", "get_builtin",
+           "builtin_names"]

@@ -1,0 +1,153 @@
+"""User-facing model/dataset abstractions.
+
+Parity with the reference's `KubeModel`/`KubeDataset`
+(python/kubeml/kubeml/network.py:463-476, dataset.py:81-227), translated to
+functional JAX. The reference's imperative hooks map as:
+
+    reference KubeModel.init(model)        -> KubeModel.init_variables (or
+                                              the default flax init)
+    reference KubeModel.train(batch, idx)  -> KubeModel.loss (pure: returns
+                                              per-example loss; the engine
+                                              differentiates and steps)
+    reference KubeModel.validate(batch)    -> KubeModel.metrics (pure,
+                                              per-example values; engine does
+                                              the datapoint-weighted average,
+                                              ml/pkg/train/util.go:100-122)
+    reference KubeModel.infer(data)        -> KubeModel.infer
+    reference configure_optimizers(...)    -> same name, returns an optax
+                                              GradientTransformation; called
+                                              with (lr, epoch) every sync
+                                              round (the reference resets
+                                              optimizer state each round —
+                                              network.py:208-217 — so a fresh
+                                              transform per round is exact)
+
+Models carry a flax `nn.Module`; variables are the flax variable dict
+({'params': ..., 'batch_stats': ...}). All computation must be jit-safe.
+"""
+
+from __future__ import annotations
+
+import abc
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+PyTree = Any
+
+
+class KubeModel(abc.ABC):
+    """Base class a user model subclasses (or a built-in provides)."""
+
+    #: name under which the model registers (for CLI `fn`/train lookup)
+    name: str = ""
+
+    @abc.abstractmethod
+    def build(self):
+        """Return the flax nn.Module."""
+
+    @property
+    def module(self):
+        if not hasattr(self, "_module") or self._module is None:
+            self._module = self.build()
+        return self._module
+
+    # ------------------------------------------------------------- lifecycle
+
+    def init_variables(self, rng: jax.Array, sample_batch: PyTree) -> PyTree:
+        """Initialize the flax variable dict from one example batch.
+
+        Default assumes classification-style batches {'x': ..., 'y': ...}.
+        """
+        return self.module.init(rng, sample_batch["x"], train=False)
+
+    # ------------------------------------------------------------- training
+
+    @abc.abstractmethod
+    def loss(self, variables: PyTree, batch: PyTree, rng: jax.Array,
+             sample_mask: jax.Array) -> Tuple[jax.Array, PyTree]:
+        """Per-example loss [B] + updated mutable collections (may be {}).
+
+        sample_mask [B] marks padded examples (0.0); implementations that
+        update batch statistics may use it to exclude padding.
+        """
+
+    @abc.abstractmethod
+    def metrics(self, variables: PyTree, batch: PyTree) -> Dict[str, jax.Array]:
+        """Per-example metric values, each [B]; must include 'loss' and
+        'accuracy' for history parity."""
+
+    def configure_optimizers(self, lr: jax.Array, epoch: jax.Array
+                             ) -> optax.GradientTransformation:
+        """Default: plain SGD, the reference examples' optimizer."""
+        return optax.sgd(lr)
+
+    # ------------------------------------------------------------ inference
+
+    def infer(self, variables: PyTree, data: np.ndarray) -> np.ndarray:
+        """Default classification inference: argmax of logits."""
+        logits = self.module.apply(variables, jnp.asarray(data), train=False)
+        if isinstance(logits, tuple):
+            logits = logits[0]
+        return np.asarray(jnp.argmax(logits, axis=-1))
+
+
+class ClassifierModel(KubeModel):
+    """Convenience base for softmax classifiers over {'x','y'} batches.
+
+    Mirrors what every reference example function hand-writes
+    (ml/experiments/kubeml/function_lenet.py etc.: cross-entropy forward/
+    backward + accuracy validation) as reusable pure functions.
+    """
+
+    def apply_train(self, variables, x, rng):
+        """Apply in train mode, returning (logits, new_model_state)."""
+        mutable = [k for k in variables if k != "params"]
+        if mutable:
+            logits, new_state = self.module.apply(
+                variables, x, train=True, mutable=mutable,
+                rngs={"dropout": rng})
+            return logits, dict(new_state)
+        logits = self.module.apply(variables, x, train=True,
+                                   rngs={"dropout": rng})
+        return logits, {}
+
+    def loss(self, variables, batch, rng, sample_mask):
+        logits, new_state = self.apply_train(variables, batch["x"], rng)
+        per_ex = optax.softmax_cross_entropy_with_integer_labels(
+            logits, batch["y"])
+        return per_ex, new_state
+
+    def metrics(self, variables, batch):
+        logits = self.module.apply(variables, batch["x"], train=False)
+        per_ex_loss = optax.softmax_cross_entropy_with_integer_labels(
+            logits, batch["y"])
+        acc = (jnp.argmax(logits, axis=-1) == batch["y"]).astype(jnp.float32)
+        return {"loss": per_ex_loss, "accuracy": acc}
+
+
+class KubeDataset(abc.ABC):
+    """Dataset-side user hooks.
+
+    The reference KubeDataset pulls pickled 64-sample docs from MongoDB
+    (dataset.py:184-223) and lets the user apply transforms per split. Here
+    the storage plane is the on-disk registry (kubeml_tpu.data.registry);
+    subclasses override the transforms. Transforms run on host numpy arrays,
+    once per sync-round chunk, before device upload.
+    """
+
+    #: registry dataset name this model trains on
+    dataset: str = ""
+
+    def __init__(self, dataset_name: Optional[str] = None):
+        if dataset_name:
+            self.dataset = dataset_name
+
+    def transform_train(self, data: np.ndarray, labels: np.ndarray) -> Dict[str, np.ndarray]:
+        return {"x": data, "y": labels}
+
+    def transform_test(self, data: np.ndarray, labels: np.ndarray) -> Dict[str, np.ndarray]:
+        return {"x": data, "y": labels}

@@ -1,0 +1,48 @@
+"""LeNet-5 for MNIST.
+
+Capability parity with the reference example function
+ml/experiments/kubeml/function_lenet.py (conv 6/16 + fc 120/84/10, SGD),
+expressed as a flax module. Runs in bfloat16 compute / float32 params so the
+convs land on the MXU.
+"""
+
+import flax.linen as nn
+import jax.numpy as jnp
+import optax
+
+from kubeml_tpu.models import register_model
+from kubeml_tpu.models.base import ClassifierModel
+
+
+class LeNetModule(nn.Module):
+    num_classes: int = 10
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        x = x.astype(self.dtype)
+        if x.ndim == 3:
+            x = x[..., None]  # [B, 28, 28] -> NHWC
+        x = nn.Conv(6, (5, 5), padding="SAME", dtype=self.dtype)(x)
+        x = nn.relu(x)
+        x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        x = nn.Conv(16, (5, 5), padding="VALID", dtype=self.dtype)(x)
+        x = nn.relu(x)
+        x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        x = x.reshape((x.shape[0], -1))
+        x = nn.relu(nn.Dense(120, dtype=self.dtype)(x))
+        x = nn.relu(nn.Dense(84, dtype=self.dtype)(x))
+        x = nn.Dense(self.num_classes, dtype=self.dtype)(x)
+        return x.astype(jnp.float32)
+
+
+@register_model("lenet")
+class LeNet(ClassifierModel):
+    name = "lenet"
+
+    def build(self):
+        return LeNetModule()
+
+    def configure_optimizers(self, lr, epoch):
+        # reference function_lenet.py uses SGD with momentum-free lr
+        return optax.sgd(lr)

@@ -1,0 +1,4 @@
+from kubeml_tpu.parallel.mesh import make_mesh, data_axis_size
+from kubeml_tpu.parallel.kavg import KAvgEngine, RoundStats
+
+__all__ = ["make_mesh", "data_axis_size", "KAvgEngine", "RoundStats"]

@@ -1,0 +1,50 @@
+"""Device-mesh construction.
+
+The reference's "cluster" is a fleet of Fission function pods coordinated
+over HTTP (SURVEY.md §2b). Here the cluster is a `jax.sharding.Mesh`:
+the `data` axis carries the data-parallel lanes that replace function
+replicas, and an optional `model` axis carries tensor/sequence parallelism
+(net-new relative to the reference, which has none — SURVEY.md §2a).
+
+Collectives ride ICI within a slice; multi-slice meshes extend over DCN via
+jax.distributed (same code path — the mesh abstracts the transport).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+DATA_AXIS = "data"
+MODEL_AXIS = "model"
+
+
+def make_mesh(n_data: Optional[int] = None, n_model: int = 1,
+              devices: Optional[Sequence] = None) -> Mesh:
+    """Create a (data, model) mesh.
+
+    n_data defaults to `len(devices) // n_model`. A 1-sized model axis is
+    always present so the same PartitionSpecs work for pure-DP and DP x TP
+    programs without recompiling call sites.
+    """
+    if devices is None:
+        devices = jax.devices()
+    devices = list(devices)
+    if n_data is None:
+        if len(devices) % n_model:
+            raise ValueError(
+                f"{len(devices)} devices not divisible by model axis {n_model}")
+        n_data = len(devices) // n_model
+    need = n_data * n_model
+    if need > len(devices):
+        raise ValueError(f"mesh {n_data}x{n_model} needs {need} devices, "
+                         f"have {len(devices)}")
+    arr = np.array(devices[:need]).reshape(n_data, n_model)
+    return Mesh(arr, (DATA_AXIS, MODEL_AXIS))
+
+
+def data_axis_size(mesh: Mesh) -> int:
+    return mesh.shape[DATA_AXIS]

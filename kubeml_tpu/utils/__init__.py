@@ -1,0 +1,4 @@
+from kubeml_tpu.utils.ids import make_job_id
+from kubeml_tpu.utils.env import is_debug_env, limit_parallelism, find_free_port
+
+__all__ = ["make_job_id", "is_debug_env", "limit_parallelism", "find_free_port"]

@@ -1,0 +1,24 @@
+"""Environment toggles + small host utilities.
+
+Parity with ml/pkg/util/utils.go:10-50: DEBUG_ENV, LIMIT_PARALLELISM, and a
+free-port finder.
+"""
+
+import os
+import socket
+
+
+def is_debug_env() -> bool:
+    return os.environ.get("DEBUG_ENV", "").lower() in ("1", "true", "yes")
+
+
+def limit_parallelism() -> bool:
+    """When set, jobs ignore scheduler parallelism updates
+    (reference gate: ml/pkg/train/job.go:210-213)."""
+    return os.environ.get("LIMIT_PARALLELISM", "").lower() in ("1", "true", "yes")
+
+
+def find_free_port() -> int:
+    with socket.socket(socket.AF_INET, socket.SOCK_STREAM) as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]

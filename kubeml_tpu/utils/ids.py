@@ -1,0 +1,7 @@
+"""Job id generation — 8-char uuid prefix, parity with ml/pkg/scheduler/util.go:8-10."""
+
+import uuid
+
+
+def make_job_id() -> str:
+    return uuid.uuid4().hex[:8]

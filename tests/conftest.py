@@ -1,0 +1,44 @@
+"""Test bootstrap: force an 8-device virtual CPU mesh.
+
+The container's sitecustomize registers a TPU backend and eagerly
+initializes JAX at interpreter start — before conftest runs — so plain env
+vars are too late. Instead we clear the initialized backends and retarget
+JAX at 8 virtual CPU devices, which is the supported path for testing
+multi-chip sharding without hardware.
+"""
+
+import os
+import sys
+
+# repo root on sys.path so `import kubeml_tpu` works without installation
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax  # noqa: E402
+import jax.extend.backend  # noqa: E402
+
+if len(jax.devices()) != 8 or jax.devices()[0].platform != "cpu":
+    jax.extend.backend.clear_backends()
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_num_cpu_devices", 8)
+    assert len(jax.devices()) == 8, "failed to create 8 virtual CPU devices"
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def mesh8():
+    from kubeml_tpu.parallel.mesh import make_mesh
+    return make_mesh(n_data=8)
+
+
+@pytest.fixture(scope="session")
+def mesh4x2():
+    from kubeml_tpu.parallel.mesh import make_mesh
+    return make_mesh(n_data=4, n_model=2)
+
+
+@pytest.fixture()
+def tmp_home(tmp_path, monkeypatch):
+    """Isolated KUBEML_TPU_HOME per test."""
+    monkeypatch.setenv("KUBEML_TPU_HOME", str(tmp_path / "kubeml_home"))
+    return tmp_path / "kubeml_home"

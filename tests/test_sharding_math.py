@@ -1,0 +1,87 @@
+"""Unit tests for shard-assignment math — parity checked against the
+reference semantics (python/kubeml/kubeml/util.py:46-81)."""
+
+import math
+
+import pytest
+
+from kubeml_tpu.data.sharding import (
+    split_minibatches, get_subset_period, plan_epoch)
+
+
+class TestSplitMinibatches:
+    def test_even_split(self):
+        parts = split_minibatches(range(12), 4)
+        assert parts == [range(0, 3), range(3, 6), range(6, 9), range(9, 12)]
+
+    def test_uneven_split_first_workers_get_extra(self):
+        parts = split_minibatches(range(10), 4)
+        assert [len(p) for p in parts] == [3, 3, 2, 2]
+        assert parts[0] == range(0, 3)
+        assert parts[-1] == range(8, 10)
+
+    def test_more_workers_than_docs(self):
+        parts = split_minibatches(range(2), 5)
+        assert [len(p) for p in parts] == [1, 1, 0, 0, 0]
+
+    def test_covers_all_docs_disjoint(self):
+        for n_docs in (1, 7, 64, 100):
+            for n in (1, 2, 3, 5, 8):
+                parts = split_minibatches(range(n_docs), n)
+                flat = [i for p in parts for i in p]
+                assert flat == list(range(n_docs))
+
+
+class TestSubsetPeriod:
+    def test_sparse_avg_whole_shard(self):
+        assert get_subset_period(-1, 128, range(5, 25)) == 20
+
+    def test_k_batches_to_docs(self):
+        # K=8 batches of 128 samples = 1024 samples = 16 docs of 64
+        assert get_subset_period(8, 128, range(0, 100)) == 16
+        # ceil: 3 batches of 50 = 150 samples -> ceil(150/64) = 3 docs
+        assert get_subset_period(3, 50, range(0, 100)) == 3
+
+
+class TestPlanEpoch:
+    def test_single_worker_sparse(self):
+        plan = plan_epoch(num_samples=640, n_workers=1, k=-1, batch_size=64)
+        assert len(plan.rounds) == 1
+        c = plan.rounds[0].chunks[0]
+        assert (c.doc_start, c.doc_end) == (0, 10)
+        assert c.num_samples == 640 and c.num_steps == 10
+
+    def test_total_samples_conserved(self):
+        for n_samples in (640, 1000, 50000):
+            for n in (1, 2, 5, 8):
+                for k in (-1, 4, 16):
+                    plan = plan_epoch(n_samples, n, k, 32)
+                    assert plan.total_samples == n_samples, (n_samples, n, k)
+
+    def test_ragged_workers_masked(self):
+        # 10 docs over 4 workers: shards of 3,3,2,2 docs; K=1 batch of 64
+        # => period 1 doc => worker 0/1 have 3 rounds, workers 2/3 have 2
+        plan = plan_epoch(640, 4, 1, 64)
+        assert len(plan.rounds) == 3
+        last = plan.rounds[2]
+        assert [c.active for c in last.chunks] == [True, True, False, False]
+        assert last.active_workers == 2
+
+    def test_partial_final_batch(self):
+        # 100 samples, 1 worker, batch 64 -> 2 docs (64 + 36), 2 steps
+        plan = plan_epoch(100, 1, -1, 64)
+        c = plan.rounds[0].chunks[0]
+        assert c.num_samples == 100 and c.num_steps == 2
+
+    def test_steps_match_reference_loader_counts(self):
+        # reference: per chunk, DataLoader(len=ceil(chunk_samples/batch))
+        plan = plan_epoch(1000, 3, 2, 32)
+        for r in plan.rounds:
+            for c in r.chunks:
+                assert c.num_steps == math.ceil(c.num_samples / 32)
+
+    def test_invalid_args(self):
+        with pytest.raises(ValueError):
+            plan_epoch(100, 0, 1, 32)
+        with pytest.raises(ValueError):
+            plan_epoch(100, 1, 1, 0)
