@@ -6,6 +6,9 @@ from kubeml_tpu.data.sharding import (
     RoundPlan,
     WorkerChunk,
 )
+from kubeml_tpu.data.registry import DatasetRegistry, DatasetHandle
+from kubeml_tpu.data.ingest import ingest_files, load_array_file
+from kubeml_tpu.data.loader import RoundLoader, RoundBatch
 
 __all__ = [
     "split_minibatches",
@@ -14,4 +17,10 @@ __all__ = [
     "EpochPlan",
     "RoundPlan",
     "WorkerChunk",
+    "DatasetRegistry",
+    "DatasetHandle",
+    "ingest_files",
+    "load_array_file",
+    "RoundLoader",
+    "RoundBatch",
 ]

@@ -1,0 +1,51 @@
+"""Dataset ingest — file formats and validation.
+
+Parity with the storage service upload path (python/storage/api.py:58-142):
+accepts the same four files (x-train / y-train / x-test / y-test) in .npy or
+.pkl format, validates, and registers. The reference splits into 64-sample
+Mongo docs (utils.py:6-11); here the registry keeps contiguous arrays with
+the same 64-sample doc addressing (see registry.py).
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+from typing import Optional
+
+import numpy as np
+
+from kubeml_tpu.api.errors import InvalidFormatError
+from kubeml_tpu.data.registry import DatasetHandle, DatasetRegistry
+
+
+def load_array_file(path: str) -> np.ndarray:
+    """Load a .npy or .pkl array file (the two formats the reference
+    accepts — python/storage/api.py:93-103)."""
+    ext = os.path.splitext(path)[1].lower()
+    if ext == ".npy":
+        return np.load(path, allow_pickle=False)
+    if ext in (".pkl", ".pickle"):
+        with open(path, "rb") as f:
+            obj = pickle.load(f)
+        arr = np.asarray(obj)
+        if arr.dtype == object:
+            raise InvalidFormatError(f"{path}: pickled object is not an array")
+        return arr
+    raise InvalidFormatError(
+        f"Unsupported dataset file extension {ext!r} (want .npy or .pkl)")
+
+
+def ingest_files(name: str, x_train: str, y_train: str,
+                 x_test: str, y_test: str,
+                 registry: Optional[DatasetRegistry] = None) -> DatasetHandle:
+    """Ingest the four dataset files into the registry."""
+    registry = registry or DatasetRegistry()
+    arrays = {}
+    for key, path in (("x_train", x_train), ("y_train", y_train),
+                      ("x_test", x_test), ("y_test", y_test)):
+        if not os.path.isfile(path):
+            raise InvalidFormatError(f"{key} file not found: {path}")
+        arrays[key] = load_array_file(path)
+    return registry.create(name, arrays["x_train"], arrays["y_train"],
+                           arrays["x_test"], arrays["y_test"])

@@ -1,0 +1,219 @@
+"""Host-side input pipeline: EpochPlan -> dense masked round tensors.
+
+This replaces the reference's per-function Mongo fetch + torch DataLoader
+loop (python/kubeml/kubeml/dataset.py:184-223 + network.py:278-295) with a
+host-side assembly of one dense [W, S, B, ...] tensor per sync round, which
+is what a jit-compiled TPU program wants: a single static-shape transfer per
+round instead of per-batch host round-trips.
+
+Ragged edges are encoded as masks (see data/sharding.py). Padded slots are
+filled by cycling the chunk's real samples so masked compute stays
+in-distribution; masks guarantee they never affect weights, losses, or
+metrics.
+
+The reference does NOT shuffle training data (DataLoader is constructed
+without shuffle=True — network.py:283); we default to the same behavior and
+offer opt-in per-epoch doc shuffling.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, Iterator, Optional, Tuple
+
+import numpy as np
+
+from kubeml_tpu.api.errors import DataError
+from kubeml_tpu.data.registry import DatasetHandle
+from kubeml_tpu.data.sharding import EpochPlan, RoundPlan, plan_epoch
+from kubeml_tpu.models.base import KubeDataset
+
+
+@dataclasses.dataclass
+class RoundBatch:
+    """Everything KAvgEngine.train_round needs for one sync round."""
+
+    batch: Dict[str, np.ndarray]   # leaves [W, S, B, ...]
+    sample_mask: np.ndarray        # [W, S, B]
+    step_mask: np.ndarray          # [W, S]
+    worker_mask: np.ndarray        # [W]
+    rngs: np.ndarray               # [W, S, 2] uint32
+    round_index: int
+    num_rounds: int
+
+
+def _pad_workers(n_workers: int, n_lanes: int) -> int:
+    """W = n_workers padded to a multiple of the mesh data-axis size."""
+    return ((n_workers + n_lanes - 1) // n_lanes) * n_lanes
+
+
+def _pad_steps(xs: np.ndarray, ys: np.ndarray, smask: np.ndarray, S: int
+               ) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """Zero-pad [steps, B, ...] chunk tensors up to the round-wide S."""
+    steps, B = smask.shape
+    if steps < S:
+        xs = np.concatenate(
+            [xs, np.zeros((S - steps,) + xs.shape[1:], xs.dtype)])
+        ys = np.concatenate(
+            [ys, np.zeros((S - steps,) + ys.shape[1:], ys.dtype)])
+        smask = np.concatenate([smask, np.zeros((S - steps, B), np.float32)])
+    return xs, ys, smask
+
+
+def _fill_missing_workers(xs_all, ys_all, W):
+    """Materialize zero tensors for inactive chunks + lane-padding workers."""
+    x_tmpl = next(x for x in xs_all if x is not None)
+    y_tmpl = next(y for y in ys_all if y is not None)
+    xs = [x if x is not None else np.zeros(x_tmpl.shape, x_tmpl.dtype)
+          for x in xs_all]
+    ys = [y if y is not None else np.zeros(y_tmpl.shape, y_tmpl.dtype)
+          for y in ys_all]
+    while len(xs) < W:
+        xs.append(np.zeros(x_tmpl.shape, x_tmpl.dtype))
+        ys.append(np.zeros(y_tmpl.shape, y_tmpl.dtype))
+    return np.stack(xs), np.stack(ys)
+
+
+def _fill_chunk(xs: np.ndarray, ys: np.ndarray, steps: int, batch: int
+                ) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """Cycle-pad a chunk's samples to [steps*batch] and reshape to
+    [steps, batch, ...]; returns (x, y, sample_mask)."""
+    n = len(xs)
+    need = steps * batch
+    mask = np.zeros(need, dtype=np.float32)
+    mask[:n] = 1.0
+    if n == 0:
+        x_pad = np.zeros((need,) + xs.shape[1:], dtype=xs.dtype)
+        y_pad = np.zeros((need,) + ys.shape[1:], dtype=ys.dtype)
+    else:
+        reps = -(-need // n)  # ceil
+        x_pad = np.concatenate([xs] * reps)[:need]
+        y_pad = np.concatenate([ys] * reps)[:need]
+    return (x_pad.reshape((steps, batch) + xs.shape[1:]),
+            y_pad.reshape((steps, batch) + ys.shape[1:]),
+            mask.reshape(steps, batch))
+
+
+class RoundLoader:
+    """Materializes train/eval round tensors for one job."""
+
+    def __init__(self, handle: DatasetHandle, dataset: KubeDataset,
+                 n_lanes: int, seed: int = 0, shuffle: bool = False):
+        self.handle = handle
+        self.dataset = dataset
+        self.n_lanes = n_lanes
+        self.shuffle = shuffle
+        self._root_rng = np.random.SeedSequence(seed)
+
+    # ------------------------------------------------------------- training
+
+    def plan(self, n_workers: int, k: int, batch_size: int) -> EpochPlan:
+        return plan_epoch(self.handle.train_samples, n_workers, k, batch_size,
+                          self.handle.subset_size)
+
+    def epoch_rounds(self, plan: EpochPlan, epoch: int
+                     ) -> Iterator[RoundBatch]:
+        """Yield one RoundBatch per sync round of the epoch.
+
+        All rounds share the same [W, S_max, B] shape so the engine compiles
+        once per (parallelism, K, batch) configuration.
+        """
+        W = _pad_workers(plan.num_workers, self.n_lanes)
+        S = max((r.max_steps for r in plan.rounds), default=0)
+        B = plan.batch_size
+        x_mm, y_mm = self.handle.train_arrays()
+        perm = None
+        if self.shuffle:
+            # Permute only the FULL docs: the plan sizes chunks from the
+            # contiguous layout where only the globally-last doc is short, so
+            # that doc must stay in place or chunks sized for 52 samples
+            # would receive 64 and silently truncate.
+            ss = np.random.SeedSequence([self._root_rng.entropy, epoch])
+            n_docs = self.handle.num_train_docs
+            n_full = (self.handle.train_samples // self.handle.subset_size)
+            perm = np.arange(n_docs)
+            perm[:n_full] = np.random.default_rng(ss).permutation(n_full)
+        key_rng = np.random.default_rng(
+            np.random.SeedSequence([self._root_rng.entropy, epoch, 7]))
+
+        for rp in plan.rounds:
+            xs_all, ys_all = [], []
+            sample_mask = np.zeros((W, S, B), dtype=np.float32)
+            step_mask = np.zeros((W, S), dtype=np.float32)
+            worker_mask = np.zeros(W, dtype=np.float32)
+            for c in rp.chunks:
+                if c.active:
+                    data, labels = self._chunk_samples(x_mm, y_mm, c.doc_start,
+                                                       c.doc_end, perm)
+                    tb = self.dataset.transform_train(data, labels)
+                    xs, ys, smask = _fill_chunk(tb["x"], tb["y"],
+                                                c.num_steps, B)
+                    xs, ys, smask = _pad_steps(xs, ys, smask, S)
+                    sample_mask[c.worker] = smask
+                    step_mask[c.worker, :c.num_steps] = 1.0
+                    worker_mask[c.worker] = 1.0
+                    xs_all.append(xs)
+                    ys_all.append(ys)
+                else:
+                    xs_all.append(None)
+                    ys_all.append(None)
+
+            x_stack, y_stack = _fill_missing_workers(xs_all, ys_all, W)
+            rngs = key_rng.integers(0, 2**32, size=(W, S, 2),
+                                    dtype=np.uint32)
+            yield RoundBatch(
+                batch={"x": x_stack, "y": y_stack},
+                sample_mask=sample_mask, step_mask=step_mask,
+                worker_mask=worker_mask, rngs=rngs,
+                round_index=rp.index, num_rounds=len(plan.rounds))
+
+    def _chunk_samples(self, x_mm, y_mm, doc_start, doc_end, perm):
+        ss = self.handle.subset_size
+        if perm is None:
+            lo = doc_start * ss
+            hi = min(doc_end * ss, len(x_mm))
+            return np.asarray(x_mm[lo:hi]), np.asarray(y_mm[lo:hi])
+        parts_x, parts_y = [], []
+        for d in range(doc_start, doc_end):
+            pd = perm[d]
+            lo, hi = pd * ss, min((pd + 1) * ss, len(x_mm))
+            parts_x.append(x_mm[lo:hi])
+            parts_y.append(y_mm[lo:hi])
+        return np.concatenate(parts_x), np.concatenate(parts_y)
+
+    # ----------------------------------------------------------- validation
+
+    def eval_batches(self, n_workers: int, batch_size: int
+                     ) -> Tuple[Dict[str, np.ndarray], np.ndarray]:
+        """Shard the test split over workers, one dense [W, S, B] tensor.
+
+        Mirrors the reference's validation fan-out over the same N with
+        datapoint-weighted aggregation (ml/pkg/train/function.go:135-165).
+        """
+        if self.handle.test_samples == 0:
+            raise DataError(
+                f"dataset {self.handle.name} has no test samples")
+        plan = plan_epoch(self.handle.test_samples, n_workers, -1, batch_size,
+                          self.handle.subset_size)
+        W = _pad_workers(n_workers, self.n_lanes)
+        S = plan.rounds[0].max_steps
+        B = batch_size
+        x_mm, y_mm = self.handle.test_arrays()
+        xs_all, ys_all = [], []
+        sample_mask = np.zeros((W, S, B), dtype=np.float32)
+        for c in plan.rounds[0].chunks:
+            if c.active:
+                lo = c.doc_start * self.handle.subset_size
+                hi = min(c.doc_end * self.handle.subset_size, len(x_mm))
+                tb = self.dataset.transform_test(np.asarray(x_mm[lo:hi]),
+                                                 np.asarray(y_mm[lo:hi]))
+                xs, ys, smask = _fill_chunk(tb["x"], tb["y"], c.num_steps, B)
+                xs, ys, smask = _pad_steps(xs, ys, smask, S)
+                sample_mask[c.worker] = smask
+                xs_all.append(xs)
+                ys_all.append(ys)
+            else:
+                xs_all.append(None)
+                ys_all.append(None)
+        x_stack, y_stack = _fill_missing_workers(xs_all, ys_all, W)
+        return ({"x": x_stack, "y": y_stack}, sample_mask)

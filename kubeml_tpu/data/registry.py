@@ -1,0 +1,172 @@
+"""On-disk dataset registry — the TPU-host replacement for the MongoDB
+dataset plane.
+
+The reference stores each dataset as one Mongo database with `train`/`test`
+collections, one document per 64-sample batch ({_id, data, labels} —
+python/storage/utils.py:6-25). Doc `_id`-range queries drive sharding
+(python/kubeml/kubeml/dataset.py:199-203).
+
+Here a dataset is a directory of contiguous, memory-mappable .npy arrays:
+
+    $KUBEML_TPU_HOME/datasets/<name>/
+        manifest.json          {name, subset_size, train_samples, test_samples,
+                                dtypes, shapes, created}
+        train_data.npy  train_labels.npy
+        test_data.npy   test_labels.npy
+
+"Doc d" is the window samples [d*64, (d+1)*64) of the contiguous array, so
+the reference's `_id ∈ [start, end)` range semantics are preserved exactly
+while host-side slicing stays a zero-copy mmap view — which is what the
+infeed pipeline wants on a TPU host.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import os
+import shutil
+import time
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from kubeml_tpu.api.const import STORAGE_SUBSET_SIZE, kubeml_home
+from kubeml_tpu.api.errors import DatasetNotFoundError, StorageError
+from kubeml_tpu.api.types import DatasetSummary
+from kubeml_tpu.utils.names import check_name
+
+
+def _datasets_root() -> str:
+    return os.path.join(kubeml_home(), "datasets")
+
+
+@dataclass
+class DatasetHandle:
+    """Open handle to a registered dataset (mmap-backed)."""
+
+    name: str
+    subset_size: int
+    train_samples: int
+    test_samples: int
+    path: str
+
+    @property
+    def num_train_docs(self) -> int:
+        return math.ceil(self.train_samples / self.subset_size)
+
+    @property
+    def num_test_docs(self) -> int:
+        return math.ceil(self.test_samples / self.subset_size)
+
+    def _load(self, split: str, which: str) -> np.ndarray:
+        return np.load(os.path.join(self.path, f"{split}_{which}.npy"),
+                       mmap_mode="r")
+
+    def train_arrays(self) -> Tuple[np.ndarray, np.ndarray]:
+        return self._load("train", "data"), self._load("train", "labels")
+
+    def test_arrays(self) -> Tuple[np.ndarray, np.ndarray]:
+        return self._load("test", "data"), self._load("test", "labels")
+
+    def doc_range(self, split: str, start: int, end: int
+                  ) -> Tuple[np.ndarray, np.ndarray]:
+        """Samples of docs [start, end) — the reference's ranged `_id` query
+        (dataset.py:199-203)."""
+        data = self._load(split, "data")
+        labels = self._load(split, "labels")
+        lo = start * self.subset_size
+        hi = min(end * self.subset_size, len(data))
+        return data[lo:hi], labels[lo:hi]
+
+    def summary(self) -> DatasetSummary:
+        return DatasetSummary(name=self.name,
+                              train_set_size=self.train_samples,
+                              test_set_size=self.test_samples)
+
+
+class DatasetRegistry:
+    """CRUD over the on-disk dataset store.
+
+    API parity with the storage service (python/storage/api.py:43-51):
+    create (rejecting duplicates, api.py:69-73), delete (drops everything,
+    api.py:145-156), list, exists.
+    """
+
+    def __init__(self, root: Optional[str] = None):
+        self.root = root or _datasets_root()
+
+    def _dir(self, name: str) -> str:
+        return os.path.join(self.root, check_name(name, "dataset"))
+
+    def exists(self, name: str) -> bool:
+        return os.path.isfile(os.path.join(self._dir(name), "manifest.json"))
+
+    def create(self, name: str,
+               x_train: np.ndarray, y_train: np.ndarray,
+               x_test: np.ndarray, y_test: np.ndarray,
+               subset_size: int = STORAGE_SUBSET_SIZE) -> DatasetHandle:
+        if self.exists(name):
+            raise StorageError(f"Dataset {name} already exists")
+        if len(x_train) != len(y_train):
+            raise StorageError(
+                f"train data/labels length mismatch: {len(x_train)} vs {len(y_train)}")
+        if len(x_test) != len(y_test):
+            raise StorageError(
+                f"test data/labels length mismatch: {len(x_test)} vs {len(y_test)}")
+        d = self._dir(name)
+        tmp = d + ".tmp"
+        if os.path.isdir(tmp):
+            shutil.rmtree(tmp)
+        os.makedirs(tmp)
+        try:
+            np.save(os.path.join(tmp, "train_data.npy"),
+                    np.ascontiguousarray(x_train))
+            np.save(os.path.join(tmp, "train_labels.npy"),
+                    np.ascontiguousarray(y_train))
+            np.save(os.path.join(tmp, "test_data.npy"),
+                    np.ascontiguousarray(x_test))
+            np.save(os.path.join(tmp, "test_labels.npy"),
+                    np.ascontiguousarray(y_test))
+            manifest = {
+                "name": name,
+                "subset_size": subset_size,
+                "train_samples": int(len(x_train)),
+                "test_samples": int(len(x_test)),
+                "data_shape": list(x_train.shape[1:]),
+                "data_dtype": str(x_train.dtype),
+                "label_dtype": str(y_train.dtype),
+                "created": time.time(),
+            }
+            with open(os.path.join(tmp, "manifest.json"), "w") as f:
+                json.dump(manifest, f)
+            os.rename(tmp, d)  # atomic publish; races fail loudly
+        except Exception:
+            shutil.rmtree(tmp, ignore_errors=True)
+            raise
+        return self.get(name)
+
+    def get(self, name: str) -> DatasetHandle:
+        if not self.exists(name):
+            raise DatasetNotFoundError(name)
+        with open(os.path.join(self._dir(name), "manifest.json")) as f:
+            m = json.load(f)
+        return DatasetHandle(name=name, subset_size=m["subset_size"],
+                             train_samples=m["train_samples"],
+                             test_samples=m["test_samples"],
+                             path=self._dir(name))
+
+    def delete(self, name: str) -> None:
+        if not self.exists(name):
+            raise DatasetNotFoundError(name)
+        shutil.rmtree(self._dir(name))
+
+    def list(self) -> List[DatasetSummary]:
+        if not os.path.isdir(self.root):
+            return []
+        out = []
+        for name in sorted(os.listdir(self.root)):
+            if self.exists(name):
+                out.append(self.get(name).summary())
+        return out

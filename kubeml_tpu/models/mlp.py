@@ -1,0 +1,32 @@
+"""Small MLP classifier — test workhorse + simplest user-model template."""
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from kubeml_tpu.models import register_model
+from kubeml_tpu.models.base import ClassifierModel
+
+
+class MLPModule(nn.Module):
+    hidden: int = 32
+    num_classes: int = 10
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        x = x.reshape((x.shape[0], -1)).astype(self.dtype)
+        x = nn.relu(nn.Dense(self.hidden, dtype=self.dtype)(x))
+        x = nn.Dense(self.num_classes, dtype=self.dtype)(x)
+        return x.astype(jnp.float32)
+
+
+@register_model("mlp")
+class MLP(ClassifierModel):
+    name = "mlp"
+
+    def __init__(self, hidden: int = 32, num_classes: int = 10):
+        self.hidden = hidden
+        self.num_classes = num_classes
+
+    def build(self):
+        return MLPModule(hidden=self.hidden, num_classes=self.num_classes)

@@ -163,11 +163,12 @@ class KAvgEngine:
                     contrib, new_vars)
                 loss_sums.append(loss_sum * wm)
 
-            count = jnp.maximum(lax.psum(worker_mask.sum(), DATA_AXIS), 1.0)
+            raw_count = lax.psum(worker_mask.sum(), DATA_AXIS)
+            count = jnp.maximum(raw_count, 1.0)  # guard 0-contributor divide
             avg = jax.tree_util.tree_map(
                 lambda c, ref: (lax.psum(c, DATA_AXIS) / count).astype(ref.dtype),
                 contrib, variables)
-            return avg, jnp.stack(loss_sums), count
+            return avg, jnp.stack(loss_sums), raw_count
 
         sharded = jax.shard_map(
             lane_fn, mesh=mesh,

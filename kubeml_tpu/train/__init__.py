@@ -1,0 +1,6 @@
+from kubeml_tpu.train.job import TrainJob, JobCallbacks
+from kubeml_tpu.train.history import HistoryStore
+from kubeml_tpu.train.checkpoint import save_checkpoint, load_checkpoint
+
+__all__ = ["TrainJob", "JobCallbacks", "HistoryStore",
+           "save_checkpoint", "load_checkpoint"]

@@ -1,0 +1,235 @@
+"""TrainJob — the per-job training loop.
+
+Parity with the reference TrainJob (ml/pkg/train/job.go:156-265), which is
+the per-job parameter server: epoch loop, merge coordination, dynamic
+parallelism, validation cadence, goal-accuracy early stop, stop signal,
+history persistence. The architectural difference: the reference fans out N
+HTTP function invocations and merges their weights through RedisAI; here an
+epoch is a sequence of jitted sync rounds on the device mesh (KAvgEngine),
+so merge cost is one XLA collective instead of O(N) full-model transfers
+through Redis (SURVEY.md §2b).
+
+Behavior preserved:
+  - per-epoch flow: train -> ask scheduler for new parallelism (unless
+    static) -> validate every `validate_every` epochs -> stop / goal
+    accuracy checks (job.go:186-246);
+  - zero usable contributions in a round aborts the job (job.go:188-193,
+    merge proceeds with survivors otherwise);
+  - epoch train loss = sum(per-step losses)/steps per worker, averaged over
+    reporting workers (function aggregation, ml/pkg/train/util.go:82-122);
+  - validation metrics are datapoint-weighted (util.go:100-122);
+  - final validation + history save on completion (job.go:250-260);
+  - metric updates pushed after every epoch (util.go:19-50).
+
+Upgrades (flagged by SURVEY.md §5/§7): the final model is checkpointed
+instead of deleted, so inference works after the job ends.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import threading
+import time
+from typing import Callable, Optional
+
+import jax
+import numpy as np
+
+from kubeml_tpu.api.errors import KubeMLException, MergeError
+from kubeml_tpu.api.types import (History, JobHistory, MetricUpdate,
+                                  TrainTask)
+from kubeml_tpu.data.loader import RoundLoader
+from kubeml_tpu.data.registry import DatasetRegistry
+from kubeml_tpu.models.base import KubeDataset, KubeModel
+from kubeml_tpu.parallel.kavg import KAvgEngine
+from kubeml_tpu.parallel.mesh import data_axis_size
+from kubeml_tpu.train.checkpoint import save_checkpoint
+from kubeml_tpu.train.history import HistoryStore
+from kubeml_tpu.utils.env import limit_parallelism
+
+logger = logging.getLogger("kubeml_tpu.train")
+
+
+@dataclasses.dataclass
+class JobCallbacks:
+    """Control-plane hooks, injected so the job has no HTTP dependency.
+
+    In the full deployment the PS wires these to the scheduler REST API —
+    the reference equivalent of job.go:196-215 (UpdateJob) and
+    util.go:19-50 (metric push). Defaults are no-ops for standalone use.
+    """
+
+    request_parallelism: Callable[[TrainTask], Optional[int]] = \
+        lambda task: None
+    publish_metrics: Callable[[MetricUpdate], None] = lambda m: None
+    on_finish: Callable[[str, Optional[str]], None] = lambda job_id, err: None
+
+
+class TrainJob:
+    def __init__(self, task: TrainTask, model: KubeModel,
+                 dataset: KubeDataset, mesh,
+                 registry: Optional[DatasetRegistry] = None,
+                 history_store: Optional[HistoryStore] = None,
+                 callbacks: Optional[JobCallbacks] = None,
+                 seed: int = 0, checkpoint: bool = True):
+        self.task = task
+        self.req = task.parameters
+        self.model = model
+        self.dataset = dataset
+        self.mesh = mesh
+        self.registry = registry or DatasetRegistry()
+        self.history_store = history_store
+        self.callbacks = callbacks or JobCallbacks()
+        self.seed = seed
+        self.checkpoint = checkpoint
+        self.stop_event = threading.Event()
+        self.history = JobHistory()
+        self.exit_err: Optional[str] = None
+        self.variables = None
+
+    # ------------------------------------------------------------------ api
+
+    def stop(self):
+        """`kubeml task stop` path (train/api.go:129-134 -> stopChan)."""
+        self.stop_event.set()
+
+    # ----------------------------------------------------------------- main
+
+    def train(self) -> History:
+        """Run the job to completion. Returns the saved History record."""
+        job_id = self.task.job_id
+        try:
+            self._init_model()
+            parallelism = self.task.parallelism or \
+                self.req.options.default_parallelism
+            epochs = self.req.epochs
+            opts = self.req.options
+
+            for epoch in range(epochs):
+                t0 = time.time()
+                used_parallelism = parallelism
+                train_loss = self._train_epoch(parallelism, epoch)
+                elapsed = time.time() - t0
+                self.task.elapsed_time_s = elapsed
+                self.task.parallelism = parallelism
+
+                # dynamic parallelism: ask the scheduler between epochs
+                # (job.go:196-215), gated by LIMIT_PARALLELISM like the
+                # reference (job.go:210-213)
+                if not opts.static_parallelism and epoch < epochs - 1:
+                    new_p = self.callbacks.request_parallelism(self.task)
+                    if new_p and not limit_parallelism():
+                        parallelism = max(1, int(new_p))
+
+                val_loss, accuracy = float("nan"), float("nan")
+                if opts.validate_every > 0 and \
+                        (epoch + 1) % opts.validate_every == 0:
+                    val_loss, accuracy = self._validate(parallelism)
+
+                self.history.train_loss.append(train_loss)
+                self.history.validation_loss.append(val_loss)
+                self.history.accuracy.append(accuracy)
+                self.history.parallelism.append(used_parallelism)
+                self.history.epoch_duration.append(elapsed)
+                self.callbacks.publish_metrics(MetricUpdate(
+                    job_id=job_id, validation_loss=val_loss,
+                    accuracy=accuracy, train_loss=train_loss,
+                    parallelism=used_parallelism, epoch_duration=elapsed))
+                logger.info("job %s epoch %d/%d loss=%.4f val=%.4f acc=%.2f "
+                            "N=%d %.2fs", job_id, epoch + 1, epochs,
+                            train_loss, val_loss, accuracy, used_parallelism,
+                            elapsed)
+
+                if self.stop_event.is_set():
+                    logger.info("job %s stopped by request", job_id)
+                    break
+                if accuracy == accuracy and \
+                        accuracy >= opts.goal_accuracy:
+                    # goal-accuracy early stop (job.go:354-359, 240-244)
+                    logger.info("job %s reached goal accuracy %.2f", job_id,
+                                accuracy)
+                    break
+
+            # final validation if the last epoch didn't run one
+            # (job.go:250-253)
+            if not self.history.accuracy or \
+                    self.history.accuracy[-1] != self.history.accuracy[-1]:
+                val_loss, accuracy = self._validate(parallelism)
+                if self.history.accuracy:
+                    self.history.validation_loss[-1] = val_loss
+                    self.history.accuracy[-1] = accuracy
+
+            if self.checkpoint:
+                save_checkpoint(job_id, self.variables, {
+                    "model": self.req.model_type,
+                    "function": self.req.function_name or self.req.model_type,
+                    "dataset": self.req.dataset,
+                })
+            record = History(id=job_id, task=self.req, data=self.history)
+            if self.history_store is not None:
+                self.history_store.save(record)
+            self.task.state = "finished"
+            self.callbacks.on_finish(job_id, None)
+            return record
+        except Exception as e:  # job abort reports exitErr to the PS
+            self.exit_err = str(e)
+            self.task.state = "failed"
+            logger.exception("job %s failed", job_id)
+            self.callbacks.on_finish(job_id, self.exit_err)
+            raise
+
+    # ------------------------------------------------------------ internals
+
+    def _init_model(self):
+        handle = self.registry.get(self.req.dataset)
+        self._handle = handle
+        self._loader = RoundLoader(handle, self.dataset,
+                                   n_lanes=data_axis_size(self.mesh),
+                                   seed=self.seed)
+        self._engine = KAvgEngine(self.mesh, self.model.loss,
+                                  self.model.metrics,
+                                  self.model.configure_optimizers)
+        # init from one real batch, like the reference's init function
+        # (network.py:174-189 runs user init then saves the state dict)
+        x, y = handle.doc_range("train", 0, 1)
+        sample = self.dataset.transform_train(
+            np.asarray(x[: self.req.batch_size]),
+            np.asarray(y[: self.req.batch_size]))
+        self.variables = self.model.init_variables(
+            jax.random.PRNGKey(self.seed), sample)
+
+    def _train_epoch(self, parallelism: int, epoch: int) -> float:
+        plan = self._loader.plan(parallelism, self.req.options.k,
+                                 self.req.batch_size)
+        loss_sums = np.zeros(0)
+        step_counts = np.zeros(0)
+        for rb in self._loader.epoch_rounds(plan, epoch):
+            self.variables, stats = self._engine.train_round(
+                self.variables, rb.batch, rb.sample_mask, rb.step_mask,
+                rb.worker_mask, rb.rngs, lr=self.req.lr, epoch=epoch)
+            if stats.contributors < 1 or rb.worker_mask.sum() < 1:
+                # all workers lost: abort like job.go:188-193
+                raise MergeError(
+                    f"round {rb.round_index}: no workers contributed")
+            if loss_sums.size == 0:
+                loss_sums = np.zeros(len(stats.loss_sum))
+                step_counts = np.zeros(len(stats.loss_sum))
+            loss_sums += stats.loss_sum
+            step_counts += stats.step_count
+        # per-worker epoch loss, then unweighted mean over workers that ran
+        # (reference aggregation ml/pkg/train/util.go:82-98)
+        ran = step_counts > 0
+        if not ran.any():
+            raise MergeError("epoch produced no training steps")
+        per_worker = loss_sums[ran] / step_counts[ran]
+        return float(per_worker.mean())
+
+    def _validate(self, parallelism: int):
+        if self._handle.test_samples == 0:
+            return float("nan"), float("nan")
+        batch, sample_mask = self._loader.eval_batches(
+            parallelism, self.req.batch_size)
+        out = self._engine.eval_round(self.variables, batch, sample_mask)
+        # reference reports accuracy in percent (network.py:320-360)
+        return float(out["loss"]), float(out["accuracy"]) * 100.0

@@ -1,4 +1,6 @@
 from kubeml_tpu.utils.ids import make_job_id
 from kubeml_tpu.utils.env import is_debug_env, limit_parallelism, find_free_port
+from kubeml_tpu.utils.names import check_name
 
-__all__ = ["make_job_id", "is_debug_env", "limit_parallelism", "find_free_port"]
+__all__ = ["make_job_id", "is_debug_env", "limit_parallelism",
+           "find_free_port", "check_name"]

@@ -1,0 +1,21 @@
+"""Resource-name validation.
+
+Dataset/function/job names become filesystem paths under KUBEML_TPU_HOME and
+arrive over the REST surface, so they must never contain path separators or
+dot-traversal. The reference gets this for free from Mongo/Fission naming;
+here it's an explicit gate.
+"""
+
+import re
+
+from kubeml_tpu.api.errors import InvalidArgsError
+
+_NAME_RE = re.compile(r"^[A-Za-z0-9][A-Za-z0-9._-]{0,127}$")
+
+
+def check_name(name: str, kind: str = "resource") -> str:
+    if not isinstance(name, str) or not _NAME_RE.match(name) or ".." in name:
+        raise InvalidArgsError(
+            f"invalid {kind} name {name!r}: must match "
+            "[A-Za-z0-9][A-Za-z0-9._-]* with no '..'")
+    return name

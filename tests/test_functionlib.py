@@ -1,0 +1,76 @@
+"""Function registry: deploy-by-file parity with `kubeml fn create`."""
+
+import numpy as np
+import pytest
+
+from kubeml_tpu.api.errors import FunctionNotFoundError, InvalidArgsError
+from kubeml_tpu.train.functionlib import FunctionRegistry
+
+USER_FN = '''
+import flax.linen as nn
+import jax.numpy as jnp
+from kubeml_tpu.models.base import ClassifierModel, KubeDataset
+
+
+class TinyModule(nn.Module):
+    @nn.compact
+    def __call__(self, x, train=False):
+        return nn.Dense(3)(x.reshape((x.shape[0], -1)))
+
+
+class TinyModel(ClassifierModel):
+    name = "tiny"
+
+    def build(self):
+        return TinyModule()
+
+
+class TinyData(KubeDataset):
+    dataset = "toy"
+
+    def transform_train(self, data, labels):
+        return {"x": data * 2.0, "y": labels}
+'''
+
+
+def test_create_resolve_delete(tmp_path, tmp_home):
+    reg = FunctionRegistry()
+    src = tmp_path / "fn.py"
+    src.write_text(USER_FN)
+    reg.create("tiny", str(src))
+    assert reg.list() == ["tiny"]
+    model_cls, dataset_cls = reg.resolve("tiny")
+    assert model_cls.name == "tiny"
+    ds = dataset_cls()
+    out = ds.transform_train(np.ones((2, 2)), np.zeros(2))
+    np.testing.assert_array_equal(out["x"], 2 * np.ones((2, 2)))
+    reg.delete("tiny")
+    with pytest.raises(FunctionNotFoundError):
+        reg.resolve("tiny")
+
+
+def test_builtin_fallback(tmp_home):
+    reg = FunctionRegistry()
+    model_cls, _ = reg.resolve("mlp")
+    assert model_cls.name == "mlp"
+
+
+def test_rejects_non_model_file(tmp_path, tmp_home):
+    reg = FunctionRegistry()
+    src = tmp_path / "bad.py"
+    src.write_text("x = 1\n")
+    with pytest.raises(InvalidArgsError):
+        reg.create("bad", str(src))
+
+
+def test_rejects_duplicate_and_oversize(tmp_path, tmp_home):
+    reg = FunctionRegistry()
+    src = tmp_path / "fn.py"
+    src.write_text(USER_FN)
+    reg.create("tiny", str(src))
+    with pytest.raises(InvalidArgsError):
+        reg.create("tiny", str(src))
+    big = tmp_path / "big.py"
+    big.write_text(USER_FN + "#" + "x" * 300_000)
+    with pytest.raises(InvalidArgsError):
+        reg.create("big", str(big))

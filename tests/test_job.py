@@ -1,0 +1,136 @@
+"""TrainJob end-to-end: epoch loop, history, checkpoint, callbacks,
+dynamic parallelism, goal accuracy, stop."""
+
+import numpy as np
+import pytest
+
+from kubeml_tpu.api.types import TrainOptions, TrainRequest, TrainTask
+from kubeml_tpu.data.registry import DatasetRegistry
+from kubeml_tpu.models import get_builtin
+from kubeml_tpu.models.base import KubeDataset
+from kubeml_tpu.train.checkpoint import load_checkpoint
+from kubeml_tpu.train.history import HistoryStore
+from kubeml_tpu.train.job import JobCallbacks, TrainJob
+
+
+class ToyDataset(KubeDataset):
+    dataset = "blobs"
+
+
+def make_blobs(reg, n_train=800, n_test=200, dim=8, classes=4, seed=0):
+    """Linearly separable blobs: class c centered at one-hot(c)*3."""
+    rng = np.random.RandomState(seed)
+
+    def split(n):
+        y = rng.randint(0, classes, n).astype(np.int32)
+        # noisy enough that accuracy stays < 100% for a few epochs (the
+        # default goal_accuracy=100 early-stop is reference parity)
+        x = rng.randn(n, dim).astype(np.float32) * 2.0
+        x[np.arange(n), y % dim] += 3.0
+        return x, y
+
+    xtr, ytr = split(n_train)
+    xte, yte = split(n_test)
+    return reg.create("blobs", xtr, ytr, xte, yte)
+
+
+def make_task(job_id="testjob1", epochs=3, parallelism=2, k=2, batch=32,
+              lr=0.1, static=True, validate_every=1, goal=100.0):
+    req = TrainRequest(
+        model_type="mlp", batch_size=batch, epochs=epochs, dataset="blobs",
+        lr=lr, options=TrainOptions(
+            default_parallelism=parallelism, static_parallelism=static,
+            validate_every=validate_every, k=k, goal_accuracy=goal))
+    return TrainTask(job_id=job_id, parameters=req, parallelism=parallelism)
+
+
+@pytest.fixture()
+def setup(tmp_path, tmp_home, mesh8):
+    reg = DatasetRegistry()
+    make_blobs(reg)
+    store = HistoryStore()
+    model = get_builtin("mlp")(hidden=16, num_classes=4)
+    return reg, store, model, mesh8
+
+
+def test_job_trains_and_persists(setup):
+    reg, store, model, mesh = setup
+    job = TrainJob(make_task(), model, ToyDataset(), mesh,
+                   registry=reg, history_store=store)
+    record = job.train()
+    assert len(record.data.train_loss) == 3
+    assert record.data.train_loss[-1] < record.data.train_loss[0]
+    assert record.data.accuracy[-1] > 60.0
+    assert record.data.parallelism == [2, 2, 2]
+    # history persisted
+    assert store.get("testjob1").data.accuracy == record.data.accuracy
+    # checkpoint persisted and loadable
+    variables, manifest = load_checkpoint("testjob1")
+    assert manifest["model"] == "mlp"
+    preds = model.infer(variables, np.zeros((4, 8), np.float32))
+    assert preds.shape == (4,)
+
+
+def test_goal_accuracy_early_stop(setup):
+    reg, store, model, mesh = setup
+    job = TrainJob(make_task(epochs=20, goal=50.0), model, ToyDataset(),
+                   mesh, registry=reg, history_store=store)
+    record = job.train()
+    assert len(record.data.train_loss) < 20  # stopped early
+
+
+def test_stop_signal(setup):
+    reg, store, model, mesh = setup
+    task = make_task(epochs=50)
+    job = TrainJob(task, model, ToyDataset(), mesh, registry=reg,
+                   history_store=store)
+    calls = []
+
+    def publish(m):
+        calls.append(m)
+        if len(calls) == 2:
+            job.stop()
+
+    job.callbacks = JobCallbacks(publish_metrics=publish)
+    record = job.train()
+    assert len(record.data.train_loss) == 2
+
+
+def test_dynamic_parallelism_callback(setup):
+    reg, store, model, mesh = setup
+    asked = []
+
+    def request_parallelism(task):
+        asked.append(task.parallelism)
+        return task.parallelism + 1  # scheduler scales up every epoch
+
+    job = TrainJob(make_task(epochs=3, static=False), model, ToyDataset(),
+                   mesh, registry=reg,
+                   callbacks=JobCallbacks(request_parallelism=request_parallelism))
+    record = job.train()
+    assert record.data.parallelism == [2, 3, 4]
+    assert asked == [2, 3]  # not asked after final epoch
+
+
+def test_validate_every_cadence(setup):
+    reg, store, model, mesh = setup
+    job = TrainJob(make_task(epochs=4, validate_every=2), model,
+                   ToyDataset(), mesh, registry=reg)
+    record = job.train()
+    acc = record.data.accuracy
+    assert np.isnan(acc[0]) and not np.isnan(acc[1])
+    assert not np.isnan(acc[3])
+
+
+def test_failure_reports_exit_err(setup):
+    reg, store, model, mesh = setup
+    task = make_task()
+    task.parameters.dataset = "missing"
+    finished = []
+    job = TrainJob(task, model, ToyDataset(), mesh, registry=reg,
+                   callbacks=JobCallbacks(
+                       on_finish=lambda jid, err: finished.append((jid, err))))
+    with pytest.raises(Exception):
+        job.train()
+    assert finished and finished[0][1] is not None
+    assert task.state == "failed"

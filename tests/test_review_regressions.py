@@ -1,0 +1,58 @@
+"""Regression tests for code-review findings."""
+
+import numpy as np
+import pytest
+
+from kubeml_tpu.api.errors import DataError, InvalidArgsError
+from kubeml_tpu.data.loader import RoundLoader
+from kubeml_tpu.data.registry import DatasetRegistry
+from kubeml_tpu.models.base import KubeDataset
+from kubeml_tpu.train.checkpoint import (load_checkpoint, save_checkpoint)
+
+
+class DS(KubeDataset):
+    dataset = "toy"
+
+
+def test_shuffle_short_doc_no_sample_drop(tmp_path):
+    """batch sizes where ceil(52/B)*B < 64 used to drop samples when the
+    permutation handed a full doc to a chunk planned for the short doc."""
+    reg = DatasetRegistry(str(tmp_path / "ds"))
+    rng = np.random.RandomState(0)
+    h = reg.create("toy", rng.rand(500, 4).astype(np.float32),
+                   rng.randint(0, 2, 500).astype(np.int32),
+                   rng.rand(64, 4).astype(np.float32),
+                   rng.randint(0, 2, 64).astype(np.int32))
+    loader = RoundLoader(h, DS(), n_lanes=2, shuffle=True)
+    plan = loader.plan(n_workers=2, k=1, batch_size=13)
+    for epoch in range(3):
+        seen = sum(int(rb.sample_mask.sum())
+                   for rb in loader.epoch_rounds(plan, epoch))
+        assert seen == 500, f"epoch {epoch} dropped samples: {seen}"
+
+
+def test_empty_test_split_clean_error(tmp_path):
+    reg = DatasetRegistry(str(tmp_path / "ds"))
+    h = reg.create("toy", np.zeros((100, 2), np.float32),
+                   np.zeros(100, np.int32),
+                   np.zeros((0, 2), np.float32), np.zeros(0, np.int32))
+    loader = RoundLoader(h, DS(), n_lanes=2)
+    with pytest.raises(DataError):
+        loader.eval_batches(2, 16)
+
+
+@pytest.mark.parametrize("bad", ["../evil", "a/b", "/abs", ".hidden", ""])
+def test_path_traversal_names_rejected(tmp_path, bad):
+    reg = DatasetRegistry(str(tmp_path / "ds"))
+    with pytest.raises(InvalidArgsError):
+        reg.exists(bad)
+
+
+def test_checkpoint_replace_keeps_old_on_overwrite(tmp_path):
+    root = str(tmp_path / "models")
+    save_checkpoint("j1", {"params": {"w": np.ones(3)}}, {"model": "m"},
+                    root=root)
+    save_checkpoint("j1", {"params": {"w": np.zeros(3)}}, {"model": "m"},
+                    root=root)
+    variables, _ = load_checkpoint("j1", root=root)
+    np.testing.assert_array_equal(variables["params"]["w"], np.zeros(3))
