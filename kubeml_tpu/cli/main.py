@@ -1,0 +1,318 @@
+"""kubeml CLI — same verb surface as the reference cobra CLI.
+
+Parity with ml/pkg/kubeml-cli/ (cmd/root.go:8-12 + cmd/*.go):
+    kubeml train -f FN -d DS -e N -b N --lr F [--validate-every N]
+                 [-p N] [--static] [-K N] [--sparse-avg] [--goal-accuracy F]
+    kubeml infer -n JOBID --datafile FILE
+    kubeml dataset create|delete|list
+    kubeml fn create|delete|list
+    kubeml task list|stop|prune
+    kubeml history get|delete|list|prune
+    kubeml logs --id JOBID [-f]
+    kubeml serve              (net-new: boot the control plane on this host,
+                               the reference deploys via Helm instead)
+
+Request validation parity (cmd/train.go:87-148): batch <= 1024, dataset and
+function existence checked before submission.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+from kubeml_tpu.api.const import MAX_BATCH_SIZE, kubeml_home
+from kubeml_tpu.api.errors import KubeMLException
+from kubeml_tpu.api.types import TrainOptions, TrainRequest
+from kubeml_tpu.control.client import KubemlClient
+
+
+def _client(args) -> KubemlClient:
+    return KubemlClient(args.controller or None)
+
+
+def _fail(msg: str, code: int = 1):
+    print(f"error: {msg}", file=sys.stderr)
+    sys.exit(code)
+
+
+# --------------------------------------------------------------------- train
+
+def cmd_train(args):
+    if args.batch <= 0 or args.batch > MAX_BATCH_SIZE:
+        _fail(f"batch size must be in (0, {MAX_BATCH_SIZE}]")
+    if args.epochs <= 0:
+        _fail("epochs must be positive")
+    k = -1 if args.sparse_avg else args.K
+    client = _client(args)
+    # pre-validation (cmd/train.go:89-148): dataset + function must exist
+    try:
+        client.v1().datasets().get(args.dataset)
+    except KubeMLException as e:
+        _fail(f"dataset {args.dataset!r}: {e.message}")
+    try:
+        client.v1().functions().get(args.function)
+    except KubeMLException as e:
+        _fail(f"function {args.function!r}: {e.message}")
+    req = TrainRequest(
+        model_type=args.function, batch_size=args.batch, epochs=args.epochs,
+        dataset=args.dataset, lr=args.lr, function_name=args.function,
+        options=TrainOptions(
+            default_parallelism=args.parallelism,
+            static_parallelism=args.static,
+            validate_every=args.validate_every, k=k,
+            goal_accuracy=args.goal_accuracy))
+    job_id = client.v1().networks().train(req)
+    print(job_id)
+
+
+def cmd_infer(args):
+    ext = os.path.splitext(args.datafile)[1].lower()
+    if ext == ".npy":
+        data = np.load(args.datafile).tolist()
+    else:
+        with open(args.datafile) as f:
+            data = json.load(f)
+    preds = _client(args).v1().networks().infer(args.network, data)
+    print(json.dumps(preds))
+
+
+# ------------------------------------------------------------------- dataset
+
+def cmd_dataset_create(args):
+    s = _client(args).v1().datasets().create(
+        args.name, args.traindata, args.trainlabels, args.testdata,
+        args.testlabels)
+    print(f"created dataset {s.name} "
+          f"(train={s.train_set_size}, test={s.test_set_size})")
+
+
+def cmd_dataset_delete(args):
+    _client(args).v1().datasets().delete(args.name)
+    print(f"deleted dataset {args.name}")
+
+
+def cmd_dataset_list(args):
+    rows = _client(args).v1().datasets().list()
+    print(f"{'NAME':<20}{'TRAIN':>10}{'TEST':>10}")
+    for s in rows:
+        print(f"{s.name:<20}{s.train_set_size:>10}{s.test_set_size:>10}")
+
+
+# ------------------------------------------------------------------ function
+
+def cmd_fn_create(args):
+    _client(args).v1().functions().create(args.name, args.code)
+    print(f"created function {args.name}")
+
+
+def cmd_fn_delete(args):
+    _client(args).v1().functions().delete(args.name)
+    print(f"deleted function {args.name}")
+
+
+def cmd_fn_list(args):
+    print(f"{'NAME':<24}{'KIND':<10}")
+    for fn in _client(args).v1().functions().list():
+        print(f"{fn['name']:<24}{fn['kind']:<10}")
+
+
+# ---------------------------------------------------------------------- task
+
+def cmd_task_list(args):
+    tasks = _client(args).v1().tasks().list()
+    print(f"{'ID':<12}{'FUNCTION':<18}{'DATASET':<14}{'STATE':<12}{'N':>4}")
+    for t in tasks:
+        print(f"{t.job_id:<12}{t.parameters.function_name:<18}"
+              f"{t.parameters.dataset:<14}{t.state:<12}{t.parallelism:>4}")
+
+
+def cmd_task_stop(args):
+    _client(args).v1().tasks().stop(args.id)
+    print(f"stop requested for {args.id}")
+
+
+def cmd_task_prune(args):
+    # parity: cmd/task.go:63-119 deletes leftover job pods/services; here
+    # leftover per-job artifacts are log files of jobs that are neither
+    # running nor recorded in history
+    logs_dir = os.path.join(kubeml_home(), "logs")
+    from kubeml_tpu.train.history import HistoryStore
+    keep = {h.id for h in HistoryStore().list()}
+    try:
+        keep |= {t.job_id for t in _client(args).v1().tasks().list()}
+    except KubeMLException:
+        pass  # control plane down: history is the only liveness source
+    removed = 0
+    if os.path.isdir(logs_dir):
+        for f in os.listdir(logs_dir):
+            if f.endswith(".log") and f[:-4] not in keep:
+                os.remove(os.path.join(logs_dir, f))
+                removed += 1
+    print(f"pruned {removed} orphaned job artifacts")
+
+
+# ------------------------------------------------------------------- history
+
+def cmd_history_get(args):
+    h = _client(args).v1().histories().get(args.id)
+    print(json.dumps(h.to_dict(), indent=2))
+
+
+def cmd_history_delete(args):
+    _client(args).v1().histories().delete(args.id)
+    print(f"deleted history {args.id}")
+
+
+def cmd_history_list(args):
+    rows = _client(args).v1().histories().list()
+    print(f"{'ID':<12}{'FUNCTION':<18}{'DATASET':<14}{'EPOCHS':>7}"
+          f"{'BEST_ACC':>10}")
+    for h in rows:
+        accs = [a for a in h.data.accuracy if a == a]
+        best = f"{max(accs):.2f}" if accs else "-"
+        print(f"{h.id:<12}{h.task.function_name or h.task.model_type:<18}"
+              f"{h.task.dataset:<14}{len(h.data.train_loss):>7}{best:>10}")
+
+
+def cmd_history_prune(args):
+    n = _client(args).v1().histories().prune()
+    print(f"pruned {n} histories")
+
+
+# ---------------------------------------------------------------------- logs
+
+def cmd_logs(args):
+    path = os.path.join(kubeml_home(), "logs", f"{args.id}.log")
+    if not os.path.isfile(path):
+        _fail(f"no logs for job {args.id}")
+    with open(path) as f:
+        print(f.read(), end="")
+        if args.follow:
+            try:
+                while True:
+                    line = f.readline()
+                    if line:
+                        print(line, end="", flush=True)
+                    else:
+                        time.sleep(0.5)
+            except KeyboardInterrupt:
+                pass
+
+
+# --------------------------------------------------------------------- serve
+
+def cmd_serve(args):
+    from kubeml_tpu.control.deployment import start_deployment
+    from kubeml_tpu.parallel.mesh import make_mesh
+    mesh = make_mesh(n_data=args.mesh_data) if args.mesh_data else None
+    dep = start_deployment(mesh=mesh, use_default_ports=not args.free_ports)
+    print(f"controller: {dep.controller.url}")
+    print(f"scheduler:  {dep.scheduler.url}")
+    print(f"ps:         {dep.ps.url}  (metrics at {dep.ps.url}/metrics)")
+    print(f"storage:    {dep.storage.url}")
+    try:
+        while True:
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        dep.stop()
+
+
+# ---------------------------------------------------------------------- main
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="kubeml", description="TPU-native KubeML CLI")
+    p.add_argument("--controller", default=os.environ.get(
+        "KUBEML_CONTROLLER_URL", ""), help="controller URL")
+    sub = p.add_subparsers(dest="cmd", required=True)
+
+    t = sub.add_parser("train", help="submit a train job")
+    t.add_argument("-f", "--function", required=True)
+    t.add_argument("-d", "--dataset", required=True)
+    t.add_argument("-e", "--epochs", type=int, required=True)
+    t.add_argument("-b", "--batch", type=int, default=64)
+    t.add_argument("--lr", type=float, required=True)
+    t.add_argument("--validate-every", type=int, default=1)
+    t.add_argument("-p", "--parallelism", type=int, default=2)
+    t.add_argument("--static", action="store_true")
+    t.add_argument("-K", type=int, default=1)
+    t.add_argument("--sparse-avg", action="store_true",
+                   help="average once per epoch (K=-1)")
+    t.add_argument("--goal-accuracy", type=float, default=100.0)
+    t.set_defaults(fn=cmd_train)
+
+    i = sub.add_parser("infer", help="run inference on a trained model")
+    i.add_argument("-n", "--network", required=True, help="job id")
+    i.add_argument("--datafile", required=True, help=".json or .npy input")
+    i.set_defaults(fn=cmd_infer)
+
+    d = sub.add_parser("dataset").add_subparsers(dest="sub", required=True)
+    dc = d.add_parser("create")
+    dc.add_argument("-n", "--name", required=True)
+    dc.add_argument("--traindata", required=True)
+    dc.add_argument("--trainlabels", required=True)
+    dc.add_argument("--testdata", required=True)
+    dc.add_argument("--testlabels", required=True)
+    dc.set_defaults(fn=cmd_dataset_create)
+    dd = d.add_parser("delete")
+    dd.add_argument("-n", "--name", required=True)
+    dd.set_defaults(fn=cmd_dataset_delete)
+    d.add_parser("list").set_defaults(fn=cmd_dataset_list)
+
+    f = sub.add_parser("fn").add_subparsers(dest="sub", required=True)
+    fc = f.add_parser("create")
+    fc.add_argument("-n", "--name", required=True)
+    fc.add_argument("--code", required=True, help="python file with a "
+                    "KubeModel subclass")
+    fc.set_defaults(fn=cmd_fn_create)
+    fd = f.add_parser("delete")
+    fd.add_argument("-n", "--name", required=True)
+    fd.set_defaults(fn=cmd_fn_delete)
+    f.add_parser("list").set_defaults(fn=cmd_fn_list)
+
+    k = sub.add_parser("task").add_subparsers(dest="sub", required=True)
+    k.add_parser("list").set_defaults(fn=cmd_task_list)
+    ks = k.add_parser("stop")
+    ks.add_argument("--id", required=True)
+    ks.set_defaults(fn=cmd_task_stop)
+    k.add_parser("prune").set_defaults(fn=cmd_task_prune)
+
+    h = sub.add_parser("history").add_subparsers(dest="sub", required=True)
+    hg = h.add_parser("get")
+    hg.add_argument("--id", required=True)
+    hg.set_defaults(fn=cmd_history_get)
+    hd = h.add_parser("delete")
+    hd.add_argument("--id", required=True)
+    hd.set_defaults(fn=cmd_history_delete)
+    h.add_parser("list").set_defaults(fn=cmd_history_list)
+    h.add_parser("prune").set_defaults(fn=cmd_history_prune)
+
+    lg = sub.add_parser("logs")
+    lg.add_argument("--id", required=True)
+    lg.add_argument("-f", "--follow", action="store_true")
+    lg.set_defaults(fn=cmd_logs)
+
+    s = sub.add_parser("serve", help="start the control plane on this host")
+    s.add_argument("--mesh-data", type=int, default=0,
+                   help="data-axis size (default: all devices)")
+    s.add_argument("--free-ports", action="store_true")
+    s.set_defaults(fn=cmd_serve)
+    return p
+
+
+def main(argv=None):
+    args = build_parser().parse_args(argv)
+    try:
+        args.fn(args)
+    except KubeMLException as e:
+        _fail(e.message)
+
+
+if __name__ == "__main__":
+    main()

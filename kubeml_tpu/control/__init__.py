@@ -1,0 +1,11 @@
+from kubeml_tpu.control.policy import ThroughputBasedPolicy
+from kubeml_tpu.control.scheduler import Scheduler, SchedulerQueue
+from kubeml_tpu.control.ps import ParameterServer
+from kubeml_tpu.control.controller import Controller
+from kubeml_tpu.control.storage import StorageService
+from kubeml_tpu.control.client import KubemlClient
+from kubeml_tpu.control.deployment import Deployment, start_deployment
+
+__all__ = ["ThroughputBasedPolicy", "Scheduler", "SchedulerQueue",
+           "ParameterServer", "Controller", "StorageService", "KubemlClient",
+           "Deployment", "start_deployment"]

@@ -1,0 +1,155 @@
+"""Python client SDK for the controller API.
+
+Parity with the Go client SDK (ml/pkg/controller/client/v1/v1.go:5-38):
+`KubemlClient.v1()` exposes Networks / Datasets / Histories / Tasks resource
+clients with the same operations (Train/Infer, Create/Delete/List,
+Get/Delete/List/Prune, List/Stop).
+"""
+
+from __future__ import annotations
+
+import os
+import uuid
+from typing import List, Optional
+
+from kubeml_tpu.api.const import CONTROLLER_URL
+from kubeml_tpu.api.types import (DatasetSummary, History, InferRequest,
+                                  TrainRequest, TrainTask)
+from kubeml_tpu.control.httpd import http_json
+
+
+def _multipart_body(files: dict) -> tuple:
+    """Build a multipart/form-data body: {field: (filename, bytes)}."""
+    boundary = uuid.uuid4().hex
+    parts = []
+    for field, (filename, payload) in files.items():
+        parts.append(
+            (f"--{boundary}\r\n"
+             f'Content-Disposition: form-data; name="{field}"; '
+             f'filename="{filename}"\r\n'
+             f"Content-Type: application/octet-stream\r\n\r\n").encode()
+            + payload + b"\r\n")
+    parts.append(f"--{boundary}--\r\n".encode())
+    return b"".join(parts), f"multipart/form-data; boundary={boundary}"
+
+
+class NetworksClient:
+    def __init__(self, base: str):
+        self.base = base
+
+    def train(self, req: TrainRequest) -> str:
+        out = http_json("POST", f"{self.base}/train", req.to_dict())
+        return out["id"]
+
+    def infer(self, model_id: str, data) -> list:
+        out = http_json("POST", f"{self.base}/infer",
+                        InferRequest(model_id=model_id, data=data).to_dict())
+        return out["predictions"]
+
+
+class DatasetsClient:
+    def __init__(self, base: str):
+        self.base = base
+
+    def create(self, name: str, train_data: str, train_labels: str,
+               test_data: str, test_labels: str) -> DatasetSummary:
+        """Multipart upload of the four files, same field names as the Go
+        client (v1/dataset.go:50-106)."""
+        files = {}
+        for field, path in (("x-train", train_data), ("y-train", train_labels),
+                            ("x-test", test_data), ("y-test", test_labels)):
+            with open(path, "rb") as f:
+                files[field] = (os.path.basename(path), f.read())
+        body, ctype = _multipart_body(files)
+        out = http_json("POST", f"{self.base}/dataset/{name}", raw_body=body,
+                        content_type=ctype, timeout=600)
+        return DatasetSummary.from_dict(out)
+
+    def delete(self, name: str) -> None:
+        http_json("DELETE", f"{self.base}/dataset/{name}")
+
+    def get(self, name: str) -> DatasetSummary:
+        return DatasetSummary.from_dict(
+            http_json("GET", f"{self.base}/dataset/{name}"))
+
+    def list(self) -> List[DatasetSummary]:
+        return [DatasetSummary.from_dict(d)
+                for d in http_json("GET", f"{self.base}/dataset")]
+
+
+class FunctionsClient:
+    def __init__(self, base: str):
+        self.base = base
+
+    def create(self, name: str, code_path: str) -> None:
+        with open(code_path, "rb") as f:
+            http_json("POST", f"{self.base}/functions/{name}",
+                      raw_body=f.read(), content_type="text/x-python")
+
+    def get(self, name: str) -> dict:
+        return http_json("GET", f"{self.base}/functions/{name}")
+
+    def delete(self, name: str) -> None:
+        http_json("DELETE", f"{self.base}/functions/{name}")
+
+    def list(self) -> List[dict]:
+        return http_json("GET", f"{self.base}/functions")
+
+
+class HistoriesClient:
+    def __init__(self, base: str):
+        self.base = base
+
+    def get(self, task_id: str) -> History:
+        return History.from_dict(
+            http_json("GET", f"{self.base}/history/{task_id}"))
+
+    def delete(self, task_id: str) -> None:
+        http_json("DELETE", f"{self.base}/history/{task_id}")
+
+    def list(self) -> List[History]:
+        return [History.from_dict(d)
+                for d in http_json("GET", f"{self.base}/history")]
+
+    def prune(self) -> int:
+        return http_json("DELETE", f"{self.base}/history")["deleted"]
+
+
+class TasksClient:
+    def __init__(self, base: str):
+        self.base = base
+
+    def list(self) -> List[TrainTask]:
+        return [TrainTask.from_dict(d)
+                for d in http_json("GET", f"{self.base}/tasks")]
+
+    def stop(self, job_id: str) -> None:
+        http_json("DELETE", f"{self.base}/tasks/{job_id}")
+
+
+class V1:
+    def __init__(self, base: str):
+        self._base = base
+
+    def networks(self) -> NetworksClient:
+        return NetworksClient(self._base)
+
+    def datasets(self) -> DatasetsClient:
+        return DatasetsClient(self._base)
+
+    def functions(self) -> FunctionsClient:
+        return FunctionsClient(self._base)
+
+    def histories(self) -> HistoriesClient:
+        return HistoriesClient(self._base)
+
+    def tasks(self) -> TasksClient:
+        return TasksClient(self._base)
+
+
+class KubemlClient:
+    def __init__(self, controller_url: Optional[str] = None):
+        self.controller_url = controller_url or CONTROLLER_URL
+
+    def v1(self) -> V1:
+        return V1(self.controller_url)

@@ -1,0 +1,70 @@
+"""Single-host deployment: boot the whole control plane in one process.
+
+The reference ships one binary whose role is chosen by flag
+(ml/cmd/ml/main.go:60-156) and an in-process integration mode
+(ml/tests/integration.go:14-36). On a TPU host the natural deployment is all
+roles in one process sharing the device mesh; each service still binds its
+own port and talks HTTP, so any role can be split out to another host
+unchanged.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+from kubeml_tpu.api import const
+from kubeml_tpu.control.controller import Controller
+from kubeml_tpu.control.ps import ParameterServer
+from kubeml_tpu.control.scheduler import Scheduler
+from kubeml_tpu.control.storage import StorageService
+
+
+@dataclasses.dataclass
+class Deployment:
+    controller: Controller
+    scheduler: Scheduler
+    ps: ParameterServer
+    storage: StorageService
+
+    @property
+    def controller_url(self) -> str:
+        return self.controller.url
+
+    def stop(self):
+        for svc in (self.controller, self.scheduler, self.ps, self.storage):
+            svc.stop()
+
+
+def start_deployment(mesh=None, controller_port: int = 0,
+                     scheduler_port: int = 0, ps_port: int = 0,
+                     storage_port: int = 0,
+                     use_default_ports: bool = False) -> Deployment:
+    """Start storage, PS, scheduler, controller wired together.
+
+    Port 0 picks a free port (tests); use_default_ports uses the configured
+    service ports (const.py) for a long-running host deployment.
+    """
+    if use_default_ports:
+        controller_port = controller_port or const.CONTROLLER_PORT
+        scheduler_port = scheduler_port or const.SCHEDULER_PORT
+        ps_port = ps_port or const.PS_PORT
+        storage_port = storage_port or const.STORAGE_PORT
+
+    storage = StorageService(port=storage_port)
+    storage.start()
+
+    ps = ParameterServer(mesh=mesh, port=ps_port)
+    ps.start()
+
+    scheduler = Scheduler(ps_url=ps.url, port=scheduler_port)
+    scheduler.start()
+    ps.scheduler_url = scheduler.url
+
+    controller = Controller(scheduler_url=scheduler.url, ps_url=ps.url,
+                            storage_url=storage.url, port=controller_port,
+                            registry=ps.ds_registry,
+                            history_store=ps.history_store)
+    controller.start()
+    return Deployment(controller=controller, scheduler=scheduler, ps=ps,
+                      storage=storage)

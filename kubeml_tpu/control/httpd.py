@@ -1,0 +1,201 @@
+"""Minimal JSON-over-HTTP service framework (stdlib only).
+
+The reference's control plane is JSON/HTTP via gorilla-mux (SURVEY.md §2b);
+this is the equivalent on a TPU host: a ThreadingHTTPServer with pattern
+routes, the shared error envelope (ml/pkg/error/error.go), and JSON helpers.
+Kept deliberately tiny — the control plane was never the hot path.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import re
+import threading
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from kubeml_tpu.api.errors import KubeMLException, check_error
+
+logger = logging.getLogger("kubeml_tpu.http")
+
+
+class Raw:
+    """Non-JSON response (e.g. Prometheus text exposition)."""
+
+    def __init__(self, payload: bytes, content_type: str = "text/plain",
+                 status: int = 200):
+        self.payload = payload
+        self.content_type = content_type
+        self.status = status
+
+
+class Route:
+    def __init__(self, method: str, pattern: str, handler: Callable):
+        self.method = method
+        # '/train/{jobId}' -> ^/train/(?P<jobId>[^/]+)$
+        regex = re.sub(r"\{(\w+)\}", r"(?P<\1>[^/]+)", pattern)
+        self.regex = re.compile(f"^{regex}$")
+        self.handler = handler
+
+
+class JsonService:
+    """Base class: subclasses call .route() then .start()."""
+
+    name = "service"
+
+    def __init__(self, port: int = 0, host: str = "127.0.0.1"):
+        self._routes: List[Route] = []
+        self._host = host
+        self._port = port
+        self._server: Optional[ThreadingHTTPServer] = None
+        self._thread: Optional[threading.Thread] = None
+        self.route("GET", "/health", lambda req: {"ok": True})
+
+    def route(self, method: str, pattern: str, handler: Callable):
+        self._routes.append(Route(method, pattern, handler))
+
+    # ------------------------------------------------------------ lifecycle
+
+    def start(self) -> int:
+        service = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, fmt, *args):
+                logger.debug("%s %s", service.name, fmt % args)
+
+            def _dispatch(self, method):
+                path = self.path.split("?")[0]
+                query = {}
+                if "?" in self.path:
+                    from urllib.parse import parse_qsl
+                    query = dict(parse_qsl(self.path.split("?", 1)[1]))
+                body = None
+                length = int(self.headers.get("Content-Length") or 0)
+                raw = self.rfile.read(length) if length else b""
+                if raw:
+                    try:
+                        body = json.loads(raw)
+                    except ValueError:
+                        body = raw
+                for r in service._routes:
+                    if r.method != method:
+                        continue
+                    m = r.regex.match(path)
+                    if not m:
+                        continue
+                    try:
+                        req = Request(path=path, params=m.groupdict(),
+                                      query=query, body=body, raw=raw,
+                                      headers=dict(self.headers))
+                        out = r.handler(req)
+                        if isinstance(out, Raw):
+                            self._reply(out.status, out.payload,
+                                        out.content_type)
+                        else:
+                            payload = json.dumps(out if out is not None
+                                                 else {}).encode()
+                            self._reply(200, payload)
+                    except KubeMLException as e:
+                        self._reply(e.status_code, e.to_json().encode())
+                    except Exception as e:  # 500 envelope
+                        logger.exception("%s %s %s failed", service.name,
+                                         method, path)
+                        self._reply(500, json.dumps(
+                            {"code": 500, "error": str(e)}).encode())
+                    return
+                self._reply(404, json.dumps(
+                    {"code": 404, "error": f"no route {method} {path}"}
+                ).encode())
+
+            def _reply(self, code, payload: bytes,
+                       content_type: str = "application/json"):
+                self.send_response(code)
+                self.send_header("Content-Type", content_type)
+                self.send_header("Content-Length", str(len(payload)))
+                self.end_headers()
+                self.wfile.write(payload)
+
+            def do_GET(self):
+                self._dispatch("GET")
+
+            def do_POST(self):
+                self._dispatch("POST")
+
+            def do_DELETE(self):
+                self._dispatch("DELETE")
+
+            def do_PUT(self):
+                self._dispatch("PUT")
+
+        self._server = ThreadingHTTPServer((self._host, self._port), Handler)
+        self._server.daemon_threads = True
+        self._port = self._server.server_address[1]
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, name=f"{self.name}-http",
+            daemon=True)
+        self._thread.start()
+        logger.info("%s listening on %s:%d", self.name, self._host,
+                    self._port)
+        return self._port
+
+    def stop(self):
+        if self._server:
+            self._server.shutdown()
+            self._server.server_close()
+            self._server = None
+
+    @property
+    def url(self) -> str:
+        return f"http://{self._host}:{self._port}"
+
+    @property
+    def port(self) -> int:
+        return self._port
+
+
+class Request:
+    def __init__(self, path: str, params: Dict[str, str],
+                 query: Dict[str, str], body: Any, raw: bytes,
+                 headers: Optional[Dict[str, str]] = None):
+        self.path = path
+        self.params = params
+        self.query = query
+        self.body = body
+        self.raw = raw
+        self.headers = headers or {}
+
+
+# ------------------------------------------------------------------ client
+
+def http_json(method: str, url: str, body: Any = None,
+              timeout: float = 300.0, raw_body: Optional[bytes] = None,
+              content_type: Optional[str] = None) -> Any:
+    """JSON request helper with the shared error envelope.
+
+    Pass raw_body/content_type instead of body for opaque payloads (e.g.
+    multipart uploads); the response is still parsed as JSON.
+    """
+    headers = {}
+    if raw_body is not None:
+        data = raw_body
+        if content_type:
+            headers["Content-Type"] = content_type
+    elif body is not None:
+        data = json.dumps(body).encode()
+        headers["Content-Type"] = "application/json"
+    else:
+        data = None
+    req = urllib.request.Request(url, data=data, method=method,
+                                 headers=headers)
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            payload = resp.read()
+            return json.loads(payload) if payload else None
+    except urllib.error.HTTPError as e:
+        check_error(e.code, e.read())
+    except urllib.error.URLError as e:
+        raise KubeMLException(f"cannot reach {url}: {e.reason}", 503)

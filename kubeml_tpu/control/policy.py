@@ -1,0 +1,67 @@
+"""Scheduler policies — dynamic (elastic) parallelism.
+
+Parity with ml/pkg/scheduler/policy.go:18-102:
+  - `SchedulerPolicy` interface: calculate_parallelism + task_finished;
+  - `ThroughputBasedPolicy`, matching the reference's exact state machine:
+      1st call (no cache entry): cache 0, return the task's OWN requested
+          parallelism (policy.go:63 returns Options.DefaultParallelism from
+          the request — not the global constant), op=CreateTask;
+      2nd call (cached 0): always parallelism+1, cache the elapsed time;
+      later: elapsed <= 1.05 x cached -> +1, refresh cache;
+             elapsed >= 1.20 x cached -> -1, refresh cache;
+             in between              -> unchanged, cache NOT refreshed
+             (the reference keeps the old reference time on the
+             keep-parallelism branch, policy.go:91-93).
+"""
+
+from __future__ import annotations
+
+import abc
+import threading
+from typing import Dict, Tuple
+
+from kubeml_tpu.api.const import POLICY_LOWER_BOUND, POLICY_UPPER_BOUND
+from kubeml_tpu.api.types import TrainTask
+
+
+class SchedulerPolicy(abc.ABC):
+    @abc.abstractmethod
+    def calculate_parallelism(self, task: TrainTask) -> Tuple[int, bool]:
+        """Return (parallelism, is_new_task)."""
+
+    @abc.abstractmethod
+    def task_finished(self, job_id: str) -> None:
+        """Drop per-job policy state (ml/pkg/scheduler/scheduler.go cleanup)."""
+
+
+class ThroughputBasedPolicy(SchedulerPolicy):
+    def __init__(self, upper: float = POLICY_UPPER_BOUND,
+                 lower: float = POLICY_LOWER_BOUND):
+        self.upper = upper
+        self.lower = lower
+        self._time_cache: Dict[str, float] = {}
+        self._lock = threading.Lock()
+
+    def calculate_parallelism(self, task: TrainTask) -> Tuple[int, bool]:
+        with self._lock:
+            prev = self._time_cache.get(task.job_id)
+            if prev is None:
+                self._time_cache[task.job_id] = 0.0
+                return task.parameters.options.default_parallelism, True
+            if prev == 0.0:
+                # no reference time yet: scale up and record one
+                self._time_cache[task.job_id] = task.elapsed_time_s
+                return task.parallelism + 1, False
+            if task.elapsed_time_s <= prev * self.lower:
+                self._time_cache[task.job_id] = task.elapsed_time_s
+                return task.parallelism + 1, False
+            if task.elapsed_time_s >= prev * self.upper:
+                self._time_cache[task.job_id] = task.elapsed_time_s
+                # clamped at 1 (the reference does not clamp; a 0 would
+                # deadlock our mesh scheduling, so floor it here)
+                return max(1, task.parallelism - 1), False
+            return task.parallelism, False
+
+    def task_finished(self, job_id: str) -> None:
+        with self._lock:
+            self._time_cache.pop(job_id, None)

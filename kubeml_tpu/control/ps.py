@@ -1,0 +1,230 @@
+"""Parameter Server manager — per-job lifecycle + metrics.
+
+Parity with ml/pkg/ps/ (parameter_server.go, api.go): tracks a job index,
+starts jobs, relays scheduler updates, receives metric updates and finish
+signals, exports Prometheus gauges, serves the task list.
+
+REST surface (ml/pkg/ps/api.go:335-345):
+    POST   /start            start a task (body: TrainTask)
+    POST   /update/{jobId}   apply a new parallelism for the next epoch
+    POST   /metrics/{jobId}  metric update push (body: MetricUpdate)
+    POST   /finish/{jobId}   job finished notification
+    DELETE /stop/{jobId}     stop a running job
+    GET    /tasks            running-task list
+    GET    /metrics          Prometheus exposition (metrics.go:19)
+    POST   /infer            run inference on a checkpointed model (our
+                             addition: the reference scheduler invokes the
+                             live function instead — scheduler/api.go:140 —
+                             which only works while the job's tensors exist;
+                             checkpoints fix that, SURVEY.md §3.3)
+
+Jobs run as threads in this process — the reference's "threaded mode"
+(STANDALONE_JOBS=false, ml/pkg/ps/api.go:211-217). The pod-per-job mode
+maps to process-per-job on a TPU host and can be layered on later; the mesh
+is shared either way since all chips belong to this host's slice.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from typing import Dict, Optional
+
+import numpy as np
+
+from kubeml_tpu.api.errors import (InvalidArgsError, JobNotFoundError,
+                                   KubeMLException)
+from kubeml_tpu.api.types import MetricUpdate, TrainTask
+from kubeml_tpu.control.httpd import JsonService, Raw, Request, http_json
+from kubeml_tpu.data.registry import DatasetRegistry
+from kubeml_tpu.metrics.prom import MetricsRegistry
+from kubeml_tpu.models.base import KubeDataset
+from kubeml_tpu.parallel.mesh import make_mesh
+from kubeml_tpu.train.checkpoint import load_checkpoint
+from kubeml_tpu.train.functionlib import FunctionRegistry
+from kubeml_tpu.train.history import HistoryStore
+from kubeml_tpu.train.job import JobCallbacks, TrainJob
+
+logger = logging.getLogger("kubeml_tpu.ps")
+
+
+class _JobRecord:
+    def __init__(self, task: TrainTask, job: TrainJob,
+                 thread: threading.Thread):
+        self.task = task
+        self.job = job
+        self.thread = thread
+        self.next_parallelism: Optional[int] = None
+        self.update_event = threading.Event()
+
+
+class ParameterServer(JsonService):
+    name = "ps"
+
+    def __init__(self, mesh=None, port: int = 0,
+                 scheduler_url: Optional[str] = None):
+        super().__init__(port=port)
+        self.mesh = mesh if mesh is not None else make_mesh()
+        self.scheduler_url = scheduler_url
+        self.jobs: Dict[str, _JobRecord] = {}
+        self._jobs_lock = threading.RLock()
+        self.metrics = MetricsRegistry()
+        self.fn_registry = FunctionRegistry()
+        self.ds_registry = DatasetRegistry()
+        self.history_store = HistoryStore()
+
+        self.route("POST", "/start", self._h_start)
+        self.route("POST", "/update/{jobId}", self._h_update)
+        self.route("POST", "/metrics/{jobId}", self._h_metrics)
+        self.route("POST", "/finish/{jobId}", self._h_finish)
+        self.route("DELETE", "/stop/{jobId}", self._h_stop)
+        self.route("GET", "/tasks", self._h_tasks)
+        self.route("GET", "/metrics", self._h_prom)
+        self.route("POST", "/infer", self._h_infer)
+
+    # ------------------------------------------------------------- handlers
+
+    def _h_start(self, req: Request):
+        task = TrainTask.from_dict(req.body)
+        self.start_task(task)
+        return {"job_id": task.job_id}
+
+    def _h_update(self, req: Request):
+        job_id = req.params["jobId"]
+        with self._jobs_lock:
+            rec = self.jobs.get(job_id)
+        if rec is None:
+            raise JobNotFoundError(job_id)
+        rec.next_parallelism = int(req.body["parallelism"])
+        rec.update_event.set()
+        return {"ok": True}
+
+    def _h_metrics(self, req: Request):
+        self.metrics.update_job(MetricUpdate.from_dict(req.body))
+        return {"ok": True}
+
+    def _h_finish(self, req: Request):
+        self._finish(req.params["jobId"], req.body.get("error")
+                     if isinstance(req.body, dict) else None)
+        return {"ok": True}
+
+    def _h_stop(self, req: Request):
+        job_id = req.params["jobId"]
+        with self._jobs_lock:
+            rec = self.jobs.get(job_id)
+        if rec is None:
+            raise JobNotFoundError(job_id)
+        rec.job.stop()
+        rec.task.state = "stopping"
+        return {"ok": True}
+
+    def _h_tasks(self, req: Request):
+        with self._jobs_lock:
+            return [r.task.to_dict() for r in self.jobs.values()]
+
+    def _h_prom(self, req: Request):
+        return Raw(self.metrics.exposition().encode(),
+                   "text/plain; version=0.0.4")
+
+    def _h_infer(self, req: Request):
+        model_id = req.body.get("model_id")
+        if not model_id:
+            raise InvalidArgsError("model_id required")
+        variables, manifest = load_checkpoint(model_id)
+        model_cls, _ = self.fn_registry.resolve(
+            manifest.get("function") or manifest.get("model"))
+        model = model_cls()
+        preds = model.infer(variables, np.asarray(req.body.get("data")))
+        return {"predictions": np.asarray(preds).tolist()}
+
+    # ------------------------------------------------------------- job mgmt
+
+    def start_task(self, task: TrainTask) -> None:
+        """Instantiate model/dataset from the function registry and launch
+        the job thread (ps/api.go:139-222 without the pod machinery)."""
+        fn_name = task.parameters.function_name or task.parameters.model_type
+        model_cls, dataset_cls = self.fn_registry.resolve(fn_name)
+        model = model_cls()
+        dataset = (dataset_cls(task.parameters.dataset) if dataset_cls
+                   else KubeDataset(task.parameters.dataset))
+
+        from kubeml_tpu.api.const import kubeml_home
+        import os
+        job = TrainJob(task, model, dataset, self.mesh,
+                       registry=self.ds_registry,
+                       history_store=self.history_store,
+                       callbacks=JobCallbacks(
+                           request_parallelism=self._request_parallelism,
+                           publish_metrics=self._publish_metrics,
+                           on_finish=self._finish),
+                       log_file=os.path.join(kubeml_home(), "logs",
+                                             f"{task.job_id}.log"))
+        thread = threading.Thread(target=self._run_job, args=(job,),
+                                  name=f"job-{task.job_id}", daemon=True)
+        with self._jobs_lock:
+            if task.job_id in self.jobs:
+                raise InvalidArgsError(f"job {task.job_id} already exists")
+            self.jobs[task.job_id] = _JobRecord(task, job, thread)
+        self.metrics.running_total.inc("train")
+        task.state = "running"
+        thread.start()
+
+    def _run_job(self, job: TrainJob):
+        try:
+            job.train()
+        except Exception:
+            logger.exception("job %s thread failed", job.task.job_id)
+
+    def _request_parallelism(self, task: TrainTask) -> Optional[int]:
+        """Between-epoch parallelism negotiation (job.go:196-215)."""
+        if self.scheduler_url is None:
+            return None
+        try:
+            http_json("POST", f"{self.scheduler_url}/job", task.to_dict())
+        except KubeMLException as e:
+            logger.warning("scheduler unreachable for %s: %s", task.job_id,
+                           e.message)
+            return None
+        # reference-shaped async path: the scheduler processes the request
+        # from its queue and pushes POST /update/{jobId} to us
+        with self._jobs_lock:
+            rec = self.jobs.get(task.job_id)
+        if rec is None:
+            return None
+        if not rec.update_event.wait(timeout=60.0):
+            logger.warning("no parallelism update for %s within 60s",
+                           task.job_id)
+            return None
+        rec.update_event.clear()
+        return rec.next_parallelism
+
+    def _publish_metrics(self, m: MetricUpdate):
+        self.metrics.update_job(m)
+
+    def _finish(self, job_id: str, error: Optional[str] = None):
+        """Clear per-job series + notify the scheduler
+        (ps/api.go:266-327)."""
+        with self._jobs_lock:
+            rec = self.jobs.pop(job_id, None)
+        if rec is None:
+            return
+        self.metrics.clear_job(job_id)
+        self.metrics.running_total.inc("train", -1.0)
+        if error:
+            logger.warning("job %s exited with error: %s", job_id, error)
+        if self.scheduler_url is not None:
+            try:
+                http_json("DELETE", f"{self.scheduler_url}/finish/{job_id}")
+            except KubeMLException as e:
+                logger.warning("could not notify scheduler finish: %s",
+                               e.message)
+
+    def wait_for_job(self, job_id: str, timeout: Optional[float] = None
+                     ) -> bool:
+        """Test/experiment helper: join a job thread."""
+        with self._jobs_lock:
+            rec = self.jobs.get(job_id)
+        if rec is None:
+            return True
+        rec.thread.join(timeout)
+        return not rec.thread.is_alive()

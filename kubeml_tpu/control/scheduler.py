@@ -1,0 +1,144 @@
+"""Scheduler — task queue + parallelism policy.
+
+Parity with ml/pkg/scheduler/ (scheduler.go, api.go, queue.go):
+  - POST /train: accept a TrainRequest, mint an 8-char job id
+    (util.go:8-10), enqueue;
+  - a scheduling loop pops tasks, asks the policy for parallelism, and
+    calls PS /start (first decision) or PS /update/{jobId} (re-parallelize)
+    — scheduler.go:48-89. The reference busy-polls every 10ms; we use a
+    condition-variable queue (same ordering, no spin);
+  - POST /job: a running job asks for its next-epoch parallelism
+    (api.go:47-75) — enqueued and answered through PS /update/{jobId};
+  - POST /infer: inference relay (api.go:119-162; the reference invokes the
+    Fission function directly — here the PS runs it from the checkpoint);
+  - DELETE /finish/{taskId}: drop policy state (api.go:165-181).
+"""
+
+from __future__ import annotations
+
+import collections
+import logging
+import threading
+from typing import Deque, Optional
+
+from kubeml_tpu.api.errors import InvalidArgsError, KubeMLException
+from kubeml_tpu.api.types import TrainRequest, TrainTask
+from kubeml_tpu.control.httpd import JsonService, Request, http_json
+from kubeml_tpu.control.policy import SchedulerPolicy, ThroughputBasedPolicy
+from kubeml_tpu.utils.ids import make_job_id
+
+logger = logging.getLogger("kubeml_tpu.scheduler")
+
+
+class SchedulerQueue:
+    """FIFO with blocking pop (queue.go:15-83; the unused waitQ dropped)."""
+
+    def __init__(self):
+        self._q: Deque[TrainTask] = collections.deque()
+        self._cv = threading.Condition()
+
+    def push(self, task: TrainTask):
+        with self._cv:
+            self._q.append(task)
+            self._cv.notify()
+
+    def pop(self, timeout: Optional[float] = None) -> Optional[TrainTask]:
+        with self._cv:
+            if not self._q:
+                self._cv.wait(timeout)
+            return self._q.popleft() if self._q else None
+
+    def __len__(self):
+        with self._cv:
+            return len(self._q)
+
+
+class Scheduler(JsonService):
+    name = "scheduler"
+
+    def __init__(self, ps_url: Optional[str] = None, port: int = 0,
+                 policy: Optional[SchedulerPolicy] = None):
+        super().__init__(port=port)
+        self.ps_url = ps_url
+        self.policy = policy or ThroughputBasedPolicy()
+        self.queue = SchedulerQueue()
+        self._stop = threading.Event()
+        self._loop_thread: Optional[threading.Thread] = None
+
+        self.route("POST", "/train", self._h_train)
+        self.route("POST", "/job", self._h_job)
+        self.route("POST", "/infer", self._h_infer)
+        self.route("DELETE", "/finish/{taskId}", self._h_finish)
+
+    # ------------------------------------------------------------ lifecycle
+
+    def start(self) -> int:
+        port = super().start()
+        self._loop_thread = threading.Thread(target=self._schedule_loop,
+                                             name="scheduler-loop",
+                                             daemon=True)
+        self._loop_thread.start()
+        return port
+
+    def stop(self):
+        self._stop.set()
+        with self.queue._cv:
+            self.queue._cv.notify_all()
+        super().stop()
+
+    # ------------------------------------------------------------- handlers
+
+    def _h_train(self, req: Request):
+        try:
+            train_req = TrainRequest.from_dict(req.body)
+        except (KeyError, TypeError, ValueError) as e:
+            raise InvalidArgsError(f"bad train request: {e}")
+        task = TrainTask(job_id=make_job_id(), parameters=train_req)
+        self.queue.push(task)
+        logger.info("queued train task %s (%s on %s)", task.job_id,
+                    train_req.model_type, train_req.dataset)
+        return {"id": task.job_id}
+
+    def _h_job(self, req: Request):
+        """A running job requests re-parallelization; answered via PS
+        /update/{jobId} from the scheduling loop (api.go:47-75)."""
+        task = TrainTask.from_dict(req.body)
+        self.queue.push(task)
+        return {"ok": True}
+
+    def _h_infer(self, req: Request):
+        if self.ps_url is None:
+            raise KubeMLException("no parameter server configured", 503)
+        return http_json("POST", f"{self.ps_url}/infer", req.body)
+
+    def _h_finish(self, req: Request):
+        self.policy.task_finished(req.params["taskId"])
+        return {"ok": True}
+
+    # ----------------------------------------------------------------- loop
+
+    def _schedule_loop(self):
+        while not self._stop.is_set():
+            task = self.queue.pop(timeout=0.5)
+            if task is None:
+                continue
+            try:
+                self._schedule(task)
+            except Exception:
+                logger.exception("scheduling task %s failed", task.job_id)
+
+    def _schedule(self, task: TrainTask):
+        parallelism, is_new = self.policy.calculate_parallelism(task)
+        task.parallelism = parallelism
+        if self.ps_url is None:
+            logger.warning("no PS configured; dropping task %s", task.job_id)
+            return
+        if is_new:
+            logger.info("starting task %s with parallelism %d", task.job_id,
+                        parallelism)
+            http_json("POST", f"{self.ps_url}/start", task.to_dict())
+        else:
+            logger.info("updating task %s to parallelism %d", task.job_id,
+                        parallelism)
+            http_json("POST", f"{self.ps_url}/update/{task.job_id}",
+                      {"parallelism": parallelism})

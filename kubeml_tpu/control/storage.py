@@ -1,0 +1,82 @@
+"""Storage service — dataset ingest over HTTP.
+
+Parity with python/storage/api.py:43-156: POST /dataset/{name} accepts a
+multipart form with four file fields (x-train, y-train, x-test, y-test, the
+field names the Go client sends — ml/pkg/controller/client/v1/dataset.go:
+50-106), rejects duplicates, splits into 64-sample addressable subsets (via
+the registry's contiguous layout), DELETE drops the dataset, GET lists.
+"""
+
+from __future__ import annotations
+
+import email.parser
+import email.policy
+import logging
+import os
+import tempfile
+from typing import Dict, Optional
+
+from kubeml_tpu.api.errors import InvalidFormatError
+from kubeml_tpu.control.httpd import JsonService, Request
+from kubeml_tpu.data.ingest import ingest_files
+from kubeml_tpu.data.registry import DatasetRegistry
+
+logger = logging.getLogger("kubeml_tpu.storage")
+
+FIELDS = ("x-train", "y-train", "x-test", "y-test")
+
+
+def parse_multipart(content_type: str, raw: bytes) -> Dict[str, tuple]:
+    """Parse multipart/form-data into {field: (filename, bytes)}."""
+    if "multipart/form-data" not in (content_type or ""):
+        raise InvalidFormatError("expected multipart/form-data")
+    msg = email.parser.BytesParser(policy=email.policy.default).parsebytes(
+        b"Content-Type: " + content_type.encode() + b"\r\n\r\n" + raw)
+    out = {}
+    for part in msg.iter_parts():
+        name = part.get_param("name", header="content-disposition")
+        filename = part.get_filename() or ""
+        if name:
+            out[name] = (filename, part.get_payload(decode=True))
+    return out
+
+
+class StorageService(JsonService):
+    name = "storage"
+
+    def __init__(self, port: int = 0,
+                 registry: Optional[DatasetRegistry] = None):
+        super().__init__(port=port)
+        self.registry = registry or DatasetRegistry()
+        self.route("POST", "/dataset/{name}", self._h_create)
+        self.route("DELETE", "/dataset/{name}", self._h_delete)
+        self.route("GET", "/dataset", self._h_list)
+
+    def _h_create(self, req: Request):
+        name = req.params["name"]
+        parts = parse_multipart(req.headers.get("Content-Type", ""), req.raw)
+        missing = [f for f in FIELDS if f not in parts]
+        if missing:
+            raise InvalidFormatError(f"missing form files: {missing}")
+        with tempfile.TemporaryDirectory() as tmp:
+            paths = {}
+            for field in FIELDS:
+                filename, payload = parts[field]
+                ext = os.path.splitext(filename)[1] or ".npy"
+                p = os.path.join(tmp, field + ext)
+                with open(p, "wb") as f:
+                    f.write(payload)
+                paths[field] = p
+            handle = ingest_files(name, paths["x-train"], paths["y-train"],
+                                  paths["x-test"], paths["y-test"],
+                                  registry=self.registry)
+        logger.info("ingested dataset %s (%d train / %d test)", name,
+                    handle.train_samples, handle.test_samples)
+        return handle.summary().to_dict()
+
+    def _h_delete(self, req: Request):
+        self.registry.delete(req.params["name"])
+        return {"ok": True}
+
+    def _h_list(self, req: Request):
+        return [s.to_dict() for s in self.registry.list()]

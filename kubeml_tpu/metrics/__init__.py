@@ -1,0 +1,3 @@
+from kubeml_tpu.metrics.prom import Gauge, MetricsRegistry
+
+__all__ = ["Gauge", "MetricsRegistry"]

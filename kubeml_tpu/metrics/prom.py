@@ -1,0 +1,92 @@
+"""Prometheus text-format metrics (stdlib-only exposition).
+
+Parity with ml/pkg/ps/metrics.go:33-81: the same gauge family names and
+`jobid` label so existing dashboards (ml/dashboard/KubeML.json) work
+unchanged against our /metrics endpoint:
+
+    kubeml_job_validation_loss{jobid=...}
+    kubeml_job_validation_accuracy{jobid=...}
+    kubeml_job_train_loss{jobid=...}
+    kubeml_job_parallelism{jobid=...}
+    kubeml_job_epoch_duration_seconds{jobid=...}
+    kubeml_job_running_total{type=...}
+
+Per-job series are cleared when a job finishes (metrics.go:90-106).
+"""
+
+from __future__ import annotations
+
+import math
+import threading
+from typing import Dict, Tuple
+
+
+class Gauge:
+    def __init__(self, name: str, help_: str, label: str):
+        self.name = name
+        self.help = help_
+        self.label = label
+        self._values: Dict[str, float] = {}
+        self._lock = threading.Lock()
+
+    def set(self, label_value: str, value: float):
+        with self._lock:
+            self._values[label_value] = value
+
+    def inc(self, label_value: str, delta: float = 1.0):
+        with self._lock:
+            self._values[label_value] = self._values.get(label_value, 0.0) + delta
+
+    def clear(self, label_value: str):
+        with self._lock:
+            self._values.pop(label_value, None)
+
+    def collect(self) -> str:
+        lines = [f"# HELP {self.name} {self.help}",
+                 f"# TYPE {self.name} gauge"]
+        with self._lock:
+            for lv, v in sorted(self._values.items()):
+                if isinstance(v, float) and math.isnan(v):
+                    v = "NaN"
+                lines.append(f'{self.name}{{{self.label}="{lv}"}} {v}')
+        return "\n".join(lines)
+
+
+class MetricsRegistry:
+    """The PS metric set (ml/pkg/ps/metrics.go)."""
+
+    def __init__(self):
+        self.validation_loss = Gauge(
+            "kubeml_job_validation_loss", "Validation loss of a job", "jobid")
+        self.validation_accuracy = Gauge(
+            "kubeml_job_validation_accuracy", "Validation accuracy of a job",
+            "jobid")
+        self.train_loss = Gauge(
+            "kubeml_job_train_loss", "Train loss of a job", "jobid")
+        self.parallelism = Gauge(
+            "kubeml_job_parallelism", "Parallelism of a job", "jobid")
+        self.epoch_duration = Gauge(
+            "kubeml_job_epoch_duration_seconds", "Epoch duration of a job",
+            "jobid")
+        self.running_total = Gauge(
+            "kubeml_job_running_total", "Number of running tasks by type",
+            "type")
+        self._job_gauges = [self.validation_loss, self.validation_accuracy,
+                            self.train_loss, self.parallelism,
+                            self.epoch_duration]
+
+    def update_job(self, m) -> None:
+        """Apply a MetricUpdate (ml/pkg/ps/metrics.go:90-99)."""
+        self.validation_loss.set(m.job_id, m.validation_loss)
+        self.validation_accuracy.set(m.job_id, m.accuracy)
+        self.train_loss.set(m.job_id, m.train_loss)
+        self.parallelism.set(m.job_id, m.parallelism)
+        self.epoch_duration.set(m.job_id, m.epoch_duration)
+
+    def clear_job(self, job_id: str) -> None:
+        for g in self._job_gauges:
+            g.clear(job_id)
+
+    def exposition(self) -> str:
+        gauges = self._job_gauges + [self.running_total]
+        return "\n".join(g.collect() for g in gauges) + "\n"

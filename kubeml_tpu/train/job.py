@@ -72,8 +72,12 @@ class TrainJob:
                  registry: Optional[DatasetRegistry] = None,
                  history_store: Optional[HistoryStore] = None,
                  callbacks: Optional[JobCallbacks] = None,
-                 seed: int = 0, checkpoint: bool = True):
+                 seed: int = 0, checkpoint: bool = True,
+                 log_file: Optional[str] = None):
         self.task = task
+        self.log_file = log_file
+        self._file_logger = None
+        self._file_handler = None
         self.req = task.parameters
         self.model = model
         self.dataset = dataset
@@ -94,11 +98,44 @@ class TrainJob:
         """`kubeml task stop` path (train/api.go:129-134 -> stopChan)."""
         self.stop_event.set()
 
+    def _log(self, msg, *args, exc=False):
+        """Log to the module logger (honors app logging config) AND the
+        per-job log file (the `kubeml logs --id` stream — the reference's
+        equivalent is the job pod's kubectl logs, cmd/log.go:28-64)."""
+        (logger.exception if exc else logger.info)(msg, *args)
+        if self._file_logger is not None:
+            (self._file_logger.exception if exc
+             else self._file_logger.info)(msg, *args)
+
+    def _open_log_file(self):
+        if not self.log_file:
+            return
+        import os as _os
+        _os.makedirs(_os.path.dirname(self.log_file), exist_ok=True)
+        self._file_handler = logging.FileHandler(self.log_file)
+        self._file_handler.setFormatter(logging.Formatter(
+            "%(asctime)s %(levelname)s %(message)s"))
+        # isolated, non-propagating logger: the file always gets the full
+        # job stream without overriding the application's logging levels
+        self._file_logger = logging.getLogger(
+            f"kubeml_tpu.joblog.{self.task.job_id}.{id(self)}")
+        self._file_logger.setLevel(logging.INFO)
+        self._file_logger.propagate = False
+        self._file_logger.addHandler(self._file_handler)
+
+    def _close_log_file(self):
+        if self._file_handler is not None:
+            self._file_logger.removeHandler(self._file_handler)
+            self._file_handler.close()
+            self._file_handler = None
+            self._file_logger = None
+
     # ----------------------------------------------------------------- main
 
     def train(self) -> History:
         """Run the job to completion. Returns the saved History record."""
         job_id = self.task.job_id
+        self._open_log_file()
         try:
             self._init_model()
             parallelism = self.task.parallelism or \
@@ -136,18 +173,18 @@ class TrainJob:
                     job_id=job_id, validation_loss=val_loss,
                     accuracy=accuracy, train_loss=train_loss,
                     parallelism=used_parallelism, epoch_duration=elapsed))
-                logger.info("job %s epoch %d/%d loss=%.4f val=%.4f acc=%.2f "
+                self._log("job %s epoch %d/%d loss=%.4f val=%.4f acc=%.2f "
                             "N=%d %.2fs", job_id, epoch + 1, epochs,
                             train_loss, val_loss, accuracy, used_parallelism,
                             elapsed)
 
                 if self.stop_event.is_set():
-                    logger.info("job %s stopped by request", job_id)
+                    self._log("job %s stopped by request", job_id)
                     break
                 if accuracy == accuracy and \
                         accuracy >= opts.goal_accuracy:
                     # goal-accuracy early stop (job.go:354-359, 240-244)
-                    logger.info("job %s reached goal accuracy %.2f", job_id,
+                    self._log("job %s reached goal accuracy %.2f", job_id,
                                 accuracy)
                     break
 
@@ -175,9 +212,11 @@ class TrainJob:
         except Exception as e:  # job abort reports exitErr to the PS
             self.exit_err = str(e)
             self.task.state = "failed"
-            logger.exception("job %s failed", job_id)
+            self._log("job %s failed", job_id, exc=True)
             self.callbacks.on_finish(job_id, self.exit_err)
             raise
+        finally:
+            self._close_log_file()
 
     # ------------------------------------------------------------ internals
 

@@ -1,0 +1,100 @@
+"""CLI tests against a live in-process deployment."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from kubeml_tpu.cli.main import main
+from kubeml_tpu.control.deployment import start_deployment
+
+
+@pytest.fixture()
+def stack(tmp_path, tmp_home, mesh8, monkeypatch):
+    dep = start_deployment(mesh=mesh8)
+    monkeypatch.setenv("KUBEML_CONTROLLER_URL", dep.controller_url)
+    rng = np.random.RandomState(0)
+    y = rng.randint(0, 3, 600).astype(np.int32)
+    x = rng.randn(600, 8).astype(np.float32) * 1.5
+    x[np.arange(600), y * 2] += 3.0
+    paths = {}
+    for name, arr in (("xtr", x), ("ytr", y), ("xte", x[:100]),
+                      ("yte", y[:100])):
+        p = tmp_path / f"{name}.npy"
+        np.save(p, arr)
+        paths[name] = str(p)
+    yield dep, paths, tmp_path
+    dep.stop()
+
+
+def run_cli(dep, *argv):
+    return main(["--controller", dep.controller_url] + list(argv))
+
+
+def test_cli_full_flow(stack, capsys):
+    dep, paths, tmp_path = stack
+    run_cli(dep, "dataset", "create", "-n", "blobs",
+            "--traindata", paths["xtr"], "--trainlabels", paths["ytr"],
+            "--testdata", paths["xte"], "--testlabels", paths["yte"])
+    assert "created dataset blobs" in capsys.readouterr().out
+
+    run_cli(dep, "dataset", "list")
+    assert "blobs" in capsys.readouterr().out
+
+    run_cli(dep, "fn", "list")
+    assert "mlp" in capsys.readouterr().out
+
+    run_cli(dep, "train", "-f", "mlp", "-d", "blobs", "-e", "2", "-b", "32",
+            "--lr", "0.1", "-p", "2", "--static")
+    job_id = capsys.readouterr().out.strip()
+    assert len(job_id) == 8
+
+    # job start is async through the scheduler queue: wait for the history
+    import time
+    from kubeml_tpu.train.history import HistoryStore
+    deadline = time.time() + 120
+    while time.time() < deadline:
+        if any(h.id == job_id for h in HistoryStore().list()):
+            break
+        time.sleep(0.3)
+
+    run_cli(dep, "history", "list")
+    assert job_id in capsys.readouterr().out
+
+    run_cli(dep, "history", "get", "--id", job_id)
+    h = json.loads(capsys.readouterr().out)
+    assert len(h["data"]["train_loss"]) == 2
+
+    # infer from a datafile
+    df = tmp_path / "in.npy"
+    np.save(df, np.zeros((3, 8), np.float32))
+    run_cli(dep, "infer", "-n", job_id, "--datafile", str(df))
+    preds = json.loads(capsys.readouterr().out)
+    assert len(preds) == 3
+
+    # logs exist and mention the epochs
+    run_cli(dep, "logs", "--id", job_id)
+    out = capsys.readouterr().out
+    assert "epoch 1/2" in out and "epoch 2/2" in out
+
+    run_cli(dep, "history", "delete", "--id", job_id)
+    capsys.readouterr()
+    run_cli(dep, "task", "prune")
+    assert "pruned 1 orphaned" in capsys.readouterr().out
+
+
+def test_cli_validation_errors(stack, capsys):
+    dep, paths, _ = stack
+    with pytest.raises(SystemExit):
+        run_cli(dep, "train", "-f", "mlp", "-d", "nope", "-e", "1",
+                "--lr", "0.1")
+    assert "nope" in capsys.readouterr().err
+    with pytest.raises(SystemExit):
+        run_cli(dep, "train", "-f", "nope", "-d", "blobs", "-e", "1",
+                "--lr", "0.1")
+    with pytest.raises(SystemExit):
+        run_cli(dep, "train", "-f", "mlp", "-d", "blobs", "-e", "1",
+                "-b", "4096", "--lr", "0.1")
+    err = capsys.readouterr().err
+    assert "batch" in err

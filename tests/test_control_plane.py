@@ -1,0 +1,159 @@
+"""Full-stack control-plane test: client -> controller -> scheduler -> PS
+-> job -> history/metrics/infer, all over real HTTP on localhost."""
+
+import time
+
+import numpy as np
+import pytest
+import urllib.request
+
+from kubeml_tpu.api.errors import KubeMLException
+from kubeml_tpu.api.types import TrainOptions, TrainRequest
+from kubeml_tpu.control.client import KubemlClient
+from kubeml_tpu.control.deployment import start_deployment
+
+
+@pytest.fixture()
+def stack(tmp_path, tmp_home, mesh8):
+    dep = start_deployment(mesh=mesh8)
+    client = KubemlClient(dep.controller_url)
+    yield dep, client, tmp_path
+    dep.stop()
+
+
+def write_blob_files(tmp_path, n_train=600, n_test=120, dim=8, classes=3):
+    rng = np.random.RandomState(0)
+
+    def split(n):
+        y = rng.randint(0, classes, n).astype(np.int32)
+        x = rng.randn(n, dim).astype(np.float32) * 1.5
+        x[np.arange(n), y * 2] += 3.0
+        return x, y
+
+    paths = {}
+    for name, arr in zip(("xtr", "ytr", "xte", "yte"),
+                         [a for s in (split(n_train), split(n_test))
+                          for a in s]):
+        p = tmp_path / f"{name}.npy"
+        np.save(p, arr)
+        paths[name] = str(p)
+    return paths
+
+
+def wait_history(client, job_id, timeout=120):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        try:
+            return client.v1().histories().get(job_id)
+        except KubeMLException:
+            time.sleep(0.3)
+    raise TimeoutError(f"no history for {job_id}")
+
+
+def test_end_to_end_train_infer(stack):
+    dep, client, tmp_path = stack
+    paths = write_blob_files(tmp_path)
+
+    # dataset upload through the controller (multipart proxy)
+    summary = client.v1().datasets().create(
+        "blobs", paths["xtr"], paths["ytr"], paths["xte"], paths["yte"])
+    assert summary.train_set_size == 600
+    assert [s.name for s in client.v1().datasets().list()] == ["blobs"]
+
+    # train via the public API
+    req = TrainRequest(model_type="mlp", batch_size=32, epochs=3,
+                       dataset="blobs", lr=0.1,
+                       options=TrainOptions(default_parallelism=2,
+                                            static_parallelism=True, k=2))
+    job_id = client.v1().networks().train(req)
+    assert len(job_id) == 8
+
+    history = wait_history(client, job_id)
+    assert len(history.data.train_loss) == 3
+    assert history.data.parallelism == [2, 2, 2]
+
+    # inference on the checkpointed model through the public API
+    x = np.load(paths["xte"])[:5]
+    preds = client.v1().networks().infer(job_id, x.tolist())
+    assert len(preds) == 5
+
+    # task list empty after completion
+    assert client.v1().tasks().list() == []
+
+
+def test_dynamic_parallelism_through_scheduler(stack):
+    dep, client, tmp_path = stack
+    paths = write_blob_files(tmp_path)
+    client.v1().datasets().create(
+        "blobs", paths["xtr"], paths["ytr"], paths["xte"], paths["yte"])
+    req = TrainRequest(model_type="mlp", batch_size=32, epochs=4,
+                       dataset="blobs", lr=0.1,
+                       options=TrainOptions(default_parallelism=2,
+                                            static_parallelism=False, k=-1))
+    job_id = client.v1().networks().train(req)
+    history = wait_history(client, job_id)
+    # epoch 2 must ask the scheduler: second policy call always +1
+    assert history.data.parallelism[0] == 2
+    assert history.data.parallelism[1] == 3
+
+
+def test_metrics_exposition_and_clearing(stack):
+    dep, client, tmp_path = stack
+    paths = write_blob_files(tmp_path)
+    client.v1().datasets().create(
+        "blobs", paths["xtr"], paths["ytr"], paths["xte"], paths["yte"])
+    # enough epochs that per-job gauges stay visible for several seconds
+    # between the first publish and the finish-time clear
+    req = TrainRequest(model_type="mlp", batch_size=16, epochs=10,
+                       dataset="blobs", lr=0.1,
+                       options=TrainOptions(default_parallelism=2,
+                                            static_parallelism=True, k=1))
+    job_id = client.v1().networks().train(req)
+    # during the run, gauges should appear
+    seen_series = False
+    for _ in range(100):
+        text = urllib.request.urlopen(dep.ps.url + "/metrics").read().decode()
+        if f'kubeml_job_train_loss{{jobid="{job_id}"}}' in text:
+            seen_series = True
+            break
+        time.sleep(0.2)
+    wait_history(client, job_id)
+    assert seen_series, "per-job gauges never appeared on /metrics"
+    dep.ps.wait_for_job(job_id)
+    text = urllib.request.urlopen(dep.ps.url + "/metrics").read().decode()
+    assert f'jobid="{job_id}"' not in text  # cleared at finish
+
+
+def test_task_stop_via_controller(stack):
+    dep, client, tmp_path = stack
+    paths = write_blob_files(tmp_path, n_train=6000)
+    client.v1().datasets().create(
+        "blobs", paths["xtr"], paths["ytr"], paths["xte"], paths["yte"])
+    req = TrainRequest(model_type="mlp", batch_size=16, epochs=50,
+                       dataset="blobs", lr=0.01,
+                       options=TrainOptions(default_parallelism=2,
+                                            static_parallelism=True, k=1))
+    job_id = client.v1().networks().train(req)
+    # wait until running then stop
+    for _ in range(100):
+        tasks = client.v1().tasks().list()
+        if any(t.job_id == job_id for t in tasks):
+            break
+        time.sleep(0.2)
+    client.v1().tasks().stop(job_id)
+    history = wait_history(client, job_id)
+    assert len(history.data.train_loss) < 50
+
+
+def test_error_envelope_on_bad_requests(stack):
+    dep, client, tmp_path = stack
+    # missing dataset -> scheduler accepts, job fails; infer on unknown model
+    with pytest.raises(KubeMLException) as ei:
+        client.v1().networks().infer("nonexist1", [[1.0]])
+    assert ei.value.status_code == 404
+    with pytest.raises(KubeMLException) as ei:
+        client.v1().histories().get("nonexist1")
+    assert ei.value.status_code == 404
+    with pytest.raises(KubeMLException) as ei:
+        client.v1().datasets().delete("nonexist1")
+    assert ei.value.status_code == 404

@@ -1,0 +1,68 @@
+"""Throughput policy: exact parity with ml/pkg/scheduler/policy.go."""
+
+from kubeml_tpu.api.types import TrainOptions, TrainRequest, TrainTask
+from kubeml_tpu.control.policy import ThroughputBasedPolicy
+
+
+def make_task(parallelism, elapsed, requested=5):
+    req = TrainRequest("m", 32, 5, "d", 0.1,
+                       options=TrainOptions(default_parallelism=requested))
+    return TrainTask(job_id="job1", parameters=req, parallelism=parallelism,
+                     elapsed_time_s=elapsed)
+
+
+def test_first_call_returns_requested_parallelism():
+    pol = ThroughputBasedPolicy()
+    p, is_new = pol.calculate_parallelism(make_task(0, -1, requested=3))
+    assert (p, is_new) == (3, True)
+
+
+def test_second_call_always_scales_up():
+    pol = ThroughputBasedPolicy()
+    pol.calculate_parallelism(make_task(0, -1))
+    p, is_new = pol.calculate_parallelism(make_task(5, 100.0))
+    assert (p, is_new) == (6, False)
+
+
+def test_faster_epoch_scales_up():
+    pol = ThroughputBasedPolicy()
+    pol.calculate_parallelism(make_task(0, -1))
+    pol.calculate_parallelism(make_task(5, 100.0))   # sets ref time 100
+    p, _ = pol.calculate_parallelism(make_task(6, 104.0))  # <= 105
+    assert p == 7
+
+
+def test_much_slower_epoch_scales_down():
+    pol = ThroughputBasedPolicy()
+    pol.calculate_parallelism(make_task(0, -1))
+    pol.calculate_parallelism(make_task(5, 100.0))
+    p, _ = pol.calculate_parallelism(make_task(6, 121.0))  # >= 120
+    assert p == 5
+
+
+def test_between_thresholds_keeps_parallelism_and_reference_time():
+    pol = ThroughputBasedPolicy()
+    pol.calculate_parallelism(make_task(0, -1))
+    pol.calculate_parallelism(make_task(5, 100.0))
+    p, _ = pol.calculate_parallelism(make_task(6, 110.0))  # in between
+    assert p == 6
+    # the reference time must STILL be 100 (not refreshed on keep):
+    # 104 <= 100*1.05 -> scale up
+    p, _ = pol.calculate_parallelism(make_task(6, 104.0))
+    assert p == 7
+
+
+def test_scale_down_clamped_at_one():
+    pol = ThroughputBasedPolicy()
+    pol.calculate_parallelism(make_task(0, -1))
+    pol.calculate_parallelism(make_task(1, 100.0))
+    p, _ = pol.calculate_parallelism(make_task(1, 500.0))
+    assert p == 1
+
+
+def test_task_finished_clears_state():
+    pol = ThroughputBasedPolicy()
+    pol.calculate_parallelism(make_task(0, -1, requested=4))
+    pol.task_finished("job1")
+    p, is_new = pol.calculate_parallelism(make_task(0, -1, requested=4))
+    assert (p, is_new) == (4, True)
