@@ -179,6 +179,13 @@ class ParameterServer(JsonService):
         """Between-epoch parallelism negotiation (job.go:196-215)."""
         if self.scheduler_url is None:
             return None
+        with self._jobs_lock:
+            rec = self.jobs.get(task.job_id)
+        if rec is None:
+            return None
+        # drop any stale answer from a previous timed-out round so the wait
+        # below only observes the response to THIS request
+        rec.update_event.clear()
         try:
             http_json("POST", f"{self.scheduler_url}/job", task.to_dict())
         except KubeMLException as e:
@@ -187,10 +194,6 @@ class ParameterServer(JsonService):
             return None
         # reference-shaped async path: the scheduler processes the request
         # from its queue and pushes POST /update/{jobId} to us
-        with self._jobs_lock:
-            rec = self.jobs.get(task.job_id)
-        if rec is None:
-            return None
         if not rec.update_event.wait(timeout=60.0):
             logger.warning("no parallelism update for %s within 60s",
                            task.job_id)

@@ -1,0 +1,183 @@
+"""ResNet family — CIFAR and ImageNet variants.
+
+Capability parity with the reference example functions
+ml/experiments/kubeml/function_resnet34.py (torchvision ResNet-34 on
+CIFAR-10, SGD + epoch-stepped LR at lines 51-60) and
+ml/experiments/kubeml/resnet32.py:186-198 (CIFAR-style ResNet-32), plus the
+BASELINE.json configs ResNet-18/CIFAR-10 and ResNet-50/Imagenette.
+
+TPU-first choices (not a port of torchvision):
+  - NHWC layout end-to-end (XLA's native conv layout on TPU);
+  - bfloat16 compute, float32 params and batch statistics — convs/matmuls
+    hit the MXU at full tile rate, statistics stay numerically safe;
+  - BatchNorm via flax with `batch_stats` as a mutable collection; the
+    K-avg engine averages the statistics across workers exactly like the
+    reference averages them through RedisAI (ml/pkg/model/parallelSGD.go:
+    40-52 handles the int64 num_batches_tracked the same way our engine
+    truncates integer leaves);
+  - a `cifar_stem` switch (3x3/stride-1, no max-pool) so 32x32 inputs keep
+    spatial resolution — what the reference gets implicitly by feeding
+    CIFAR through torchvision's 7x7 stem at reduced fidelity, done right
+    here.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Sequence, Type
+
+import flax.linen as nn
+import jax.numpy as jnp
+import optax
+
+from kubeml_tpu.models import register_model
+from kubeml_tpu.models.base import ClassifierModel
+
+ModuleDef = Type[nn.Module]
+
+
+class BasicBlock(nn.Module):
+    filters: int
+    strides: int
+    conv: ModuleDef
+    norm: ModuleDef
+
+    @nn.compact
+    def __call__(self, x):
+        residual = x
+        y = self.conv(self.filters, (3, 3), (self.strides, self.strides))(x)
+        y = self.norm()(y)
+        y = nn.relu(y)
+        y = self.conv(self.filters, (3, 3), (1, 1))(y)
+        y = self.norm(scale_init=nn.initializers.zeros)(y)
+        if residual.shape != y.shape:
+            residual = self.conv(self.filters, (1, 1),
+                                 (self.strides, self.strides),
+                                 name="proj")(residual)
+            residual = self.norm(name="proj_norm")(residual)
+        return nn.relu(y + residual)
+
+
+class BottleneckBlock(nn.Module):
+    filters: int
+    strides: int
+    conv: ModuleDef
+    norm: ModuleDef
+
+    @nn.compact
+    def __call__(self, x):
+        residual = x
+        y = self.conv(self.filters, (1, 1), (1, 1))(x)
+        y = self.norm()(y)
+        y = nn.relu(y)
+        y = self.conv(self.filters, (3, 3), (self.strides, self.strides))(y)
+        y = self.norm()(y)
+        y = nn.relu(y)
+        y = self.conv(self.filters * 4, (1, 1), (1, 1))(y)
+        y = self.norm(scale_init=nn.initializers.zeros)(y)
+        if residual.shape != y.shape:
+            residual = self.conv(self.filters * 4, (1, 1),
+                                 (self.strides, self.strides),
+                                 name="proj")(residual)
+            residual = self.norm(name="proj_norm")(residual)
+        return nn.relu(y + residual)
+
+
+class ResNetModule(nn.Module):
+    """Stage-configurable ResNet over NHWC inputs."""
+
+    stage_sizes: Sequence[int]
+    block: Type[nn.Module] = BasicBlock
+    num_classes: int = 10
+    width: int = 64
+    cifar_stem: bool = True
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        conv = partial(nn.Conv, use_bias=False, padding="SAME",
+                       dtype=self.dtype)
+        norm = partial(nn.BatchNorm, use_running_average=not train,
+                       momentum=0.9, epsilon=1e-5, dtype=self.dtype,
+                       param_dtype=jnp.float32)
+        x = x.astype(self.dtype)
+        if self.cifar_stem:
+            x = conv(self.width, (3, 3), (1, 1), name="stem")(x)
+        else:
+            x = conv(self.width, (7, 7), (2, 2), name="stem")(x)
+        x = norm(name="stem_norm")(x)
+        x = nn.relu(x)
+        if not self.cifar_stem:
+            x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")
+        for i, n_blocks in enumerate(self.stage_sizes):
+            filters = self.width * (2 ** i)
+            for j in range(n_blocks):
+                strides = 2 if i > 0 and j == 0 else 1
+                x = self.block(filters, strides, conv, norm)(x)
+        x = jnp.mean(x, axis=(1, 2))
+        x = nn.Dense(self.num_classes, dtype=self.dtype)(x)
+        return x.astype(jnp.float32)
+
+
+class _ResNetBase(ClassifierModel):
+    """Shared training recipe: SGD + momentum with epoch-stepped LR decay —
+    the reference's ResNet recipe (function_resnet34.py lines 51-60 steps
+    the LR off self.epoch). Epoch is traced, so the schedule is a where()."""
+
+    lr_decay_epochs = (15, 25)
+    lr_decay_factor = 0.1
+    weight_decay = 5e-4
+
+    def configure_optimizers(self, lr, epoch):
+        factor = jnp.float32(1.0)
+        for boundary in self.lr_decay_epochs:
+            factor = factor * jnp.where(epoch >= boundary,
+                                        self.lr_decay_factor, 1.0)
+        return optax.chain(
+            optax.add_decayed_weights(self.weight_decay),
+            optax.sgd(lr * factor, momentum=0.9),
+        )
+
+
+@register_model("resnet18")
+class ResNet18(_ResNetBase):
+    name = "resnet18"
+    num_classes = 10
+
+    def build(self):
+        return ResNetModule(stage_sizes=(2, 2, 2, 2), block=BasicBlock,
+                            num_classes=self.num_classes)
+
+
+@register_model("resnet34")
+class ResNet34(_ResNetBase):
+    name = "resnet34"
+    num_classes = 10
+
+    def build(self):
+        return ResNetModule(stage_sizes=(3, 4, 6, 3), block=BasicBlock,
+                            num_classes=self.num_classes)
+
+
+@register_model("resnet50")
+class ResNet50(_ResNetBase):
+    name = "resnet50"
+    # Imagenette = 10-class ImageNet subset (BASELINE config 3)
+    num_classes = 10
+
+    def build(self):
+        return ResNetModule(stage_sizes=(3, 4, 6, 3), block=BottleneckBlock,
+                            num_classes=self.num_classes, cifar_stem=False)
+
+
+@register_model("resnet32")
+class ResNet32(_ResNetBase):
+    """Classic CIFAR ResNet-32 (He et al. section 4.2): 3 stages of 5
+    blocks, 16/32/64 channels (reference resnet32.py:186-198)."""
+
+    name = "resnet32"
+    num_classes = 10
+
+    def build(self):
+        return ResNetModule(stage_sizes=(5, 5, 5), block=BasicBlock,
+                            num_classes=self.num_classes, width=16)

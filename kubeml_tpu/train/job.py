@@ -116,9 +116,11 @@ class TrainJob:
         self._file_handler.setFormatter(logging.Formatter(
             "%(asctime)s %(levelname)s %(message)s"))
         # isolated, non-propagating logger: the file always gets the full
-        # job stream without overriding the application's logging levels
-        self._file_logger = logging.getLogger(
-            f"kubeml_tpu.joblog.{self.task.job_id}.{id(self)}")
+        # job stream without overriding the application's logging levels.
+        # Constructed directly (not via getLogger) so it is garbage-collected
+        # with the job instead of living forever in the logging manager.
+        self._file_logger = logging.Logger(
+            f"kubeml_tpu.joblog.{self.task.job_id}")
         self._file_logger.setLevel(logging.INFO)
         self._file_logger.propagate = False
         self._file_logger.addHandler(self._file_handler)
