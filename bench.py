@@ -1,0 +1,80 @@
+"""Headline benchmark: ResNet-18/CIFAR-10 training throughput per chip.
+
+Runs the REAL product path — the jitted K-avg sync round (KAvgEngine), not
+a stripped-down step — on whatever accelerator is attached, with synthetic
+CIFAR-shaped data resident on device. Prints ONE JSON line:
+
+    {"metric": ..., "value": N, "unit": "samples/sec/chip", "vs_baseline": N}
+
+Baseline: the reference publishes no numeric table (BASELINE.md — results
+exist only as figures), so `vs_baseline` is computed against a documented
+nominal proxy for the reference's setup: KubeML-class eager PyTorch
+ResNet-18/CIFAR-10 on a single datacenter GPU ≈ 2000 samples/sec
+(BASELINE.md "Targets": beat KubeML-on-GPU epoch wall-clock).
+"""
+
+import json
+import time
+
+GPU_BASELINE_SAMPLES_PER_SEC = 2000.0
+
+BATCH = 256        # per-step batch per worker
+STEPS_PER_ROUND = 8   # K local steps per sync round
+WARMUP_ROUNDS = 2
+TIMED_ROUNDS = 5
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from kubeml_tpu.models import get_builtin
+    from kubeml_tpu.parallel.kavg import KAvgEngine
+    from kubeml_tpu.parallel.mesh import make_mesh
+
+    n_chips = len(jax.devices())
+    mesh = make_mesh(n_data=n_chips)
+    model = get_builtin("resnet18")()
+
+    rng = np.random.RandomState(0)
+    W, S, B = n_chips, STEPS_PER_ROUND, BATCH
+    x = rng.rand(W, S, B, 32, 32, 3).astype(np.float32)
+    y = rng.randint(0, 10, size=(W, S, B)).astype(np.int32)
+    batch = {"x": jnp.asarray(x), "y": jnp.asarray(y)}
+    masks = dict(sample_mask=np.ones((W, S, B), np.float32),
+                 step_mask=np.ones((W, S), np.float32),
+                 worker_mask=np.ones(W, np.float32))
+
+    variables = model.init_variables(
+        jax.random.PRNGKey(0), {"x": jnp.asarray(x[0, 0])})
+    engine = KAvgEngine(mesh, model.loss, model.metrics,
+                        model.configure_optimizers)
+
+    def round_(variables, epoch):
+        rngs = rng.randint(0, 2**31, size=(W, S, 2)).astype(np.uint32)
+        return engine.train_round(variables, batch, rngs=rngs, lr=0.1,
+                                  epoch=epoch, **masks)
+
+    for i in range(WARMUP_ROUNDS):
+        variables, _ = round_(variables, i)
+    jax.block_until_ready(variables)
+
+    t0 = time.perf_counter()
+    for i in range(TIMED_ROUNDS):
+        variables, _ = round_(variables, i)
+    jax.block_until_ready(variables)
+    elapsed = time.perf_counter() - t0
+
+    samples = TIMED_ROUNDS * W * S * B
+    per_chip = samples / elapsed / n_chips
+    print(json.dumps({
+        "metric": "resnet18_cifar10_train_throughput",
+        "value": round(per_chip, 1),
+        "unit": "samples/sec/chip",
+        "vs_baseline": round(per_chip / GPU_BASELINE_SAMPLES_PER_SEC, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

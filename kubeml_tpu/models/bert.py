@@ -1,0 +1,105 @@
+"""BERT-tiny encoder for sequence classification (SST-2 — BASELINE config 5).
+
+Net-new relative to the reference (no transformer exists there; SURVEY.md
+§2a lists transformer workloads as absent). Geometry follows the public
+"BERT-tiny" point: 2 layers, hidden 128, 2 heads, FFN 512.
+
+TPU-first:
+  - attention goes through ops.multi_head_attention (bf16 matmuls, f32
+    softmax) so the same model can run the pallas flash kernel or the
+    ring-attention sequence-parallel path by swapping that one primitive;
+  - LayerNorm params stay float32; all matmuls bfloat16 (MXU);
+  - padding handled as an additive bias, so shapes are static for jit.
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax.numpy as jnp
+import optax
+
+from kubeml_tpu.models import register_model
+from kubeml_tpu.models.base import ClassifierModel
+from kubeml_tpu.ops.attention import multi_head_attention, padding_bias
+
+PAD_ID = 0
+
+
+class EncoderBlock(nn.Module):
+    hidden: int
+    heads: int
+    ffn: int
+    dropout: float
+    dtype: jnp.dtype
+
+    @nn.compact
+    def __call__(self, h, bias, train: bool):
+        head_dim = self.hidden // self.heads
+        x = nn.LayerNorm(dtype=jnp.float32)(h)
+        q = nn.DenseGeneral((self.heads, head_dim), dtype=self.dtype,
+                            name="q")(x)
+        k = nn.DenseGeneral((self.heads, head_dim), dtype=self.dtype,
+                            name="k")(x)
+        v = nn.DenseGeneral((self.heads, head_dim), dtype=self.dtype,
+                            name="v")(x)
+        attn = multi_head_attention(q, k, v, bias)
+        attn = nn.DenseGeneral(self.hidden, axis=(-2, -1), dtype=self.dtype,
+                               name="out")(attn)
+        attn = nn.Dropout(self.dropout, deterministic=not train)(attn)
+        h = h + attn
+        x = nn.LayerNorm(dtype=jnp.float32)(h)
+        x = nn.Dense(self.ffn, dtype=self.dtype)(x)
+        x = nn.gelu(x)
+        x = nn.Dense(self.hidden, dtype=self.dtype)(x)
+        x = nn.Dropout(self.dropout, deterministic=not train)(x)
+        return h + x
+
+
+class BertModule(nn.Module):
+    vocab_size: int = 30522
+    max_len: int = 128
+    hidden: int = 128
+    layers: int = 2
+    heads: int = 2
+    ffn: int = 512
+    num_classes: int = 2
+    dropout: float = 0.1
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        # x: int32 token ids [B, T], T <= max_len, pad id 0
+        B, T = x.shape
+        if T > self.max_len:  # static shape: trace-time guard, not lax.cond
+            raise ValueError(
+                f"sequence length {T} exceeds max_len {self.max_len}")
+        pad_mask = (x != PAD_ID).astype(jnp.float32)
+        h = nn.Embed(self.vocab_size, self.hidden, dtype=self.dtype,
+                     name="tok_embed")(x)
+        pos = nn.Embed(self.max_len, self.hidden, dtype=self.dtype,
+                       name="pos_embed")(jnp.arange(T)[None, :])
+        h = h + pos
+        h = nn.Dropout(self.dropout, deterministic=not train)(h)
+        bias = padding_bias(pad_mask)
+        for i in range(self.layers):
+            h = EncoderBlock(self.hidden, self.heads, self.ffn, self.dropout,
+                             self.dtype, name=f"layer_{i}")(h, bias, train)
+        h = nn.LayerNorm(dtype=jnp.float32)(h)
+        # masked mean-pool (robust without a trained [CLS])
+        pooled = (h * pad_mask[..., None]).sum(axis=1) / \
+            jnp.maximum(pad_mask.sum(axis=1), 1.0)[..., None]
+        out = nn.Dense(self.num_classes, dtype=self.dtype,
+                       name="classifier")(pooled.astype(self.dtype))
+        return out.astype(jnp.float32)
+
+
+@register_model("bert-tiny")
+class BertTiny(ClassifierModel):
+    name = "bert-tiny"
+    num_classes = 2
+
+    def build(self):
+        return BertModule(num_classes=self.num_classes)
+
+    def configure_optimizers(self, lr, epoch):
+        return optax.adamw(lr, weight_decay=0.01)

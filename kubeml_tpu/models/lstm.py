@@ -1,0 +1,62 @@
+"""2-layer LSTM text classifier (AG-News — BASELINE.json config 4).
+
+The reference never ran a recurrent model (SURVEY.md §5: "long-context /
+sequence parallelism: absent entirely"); this is the BASELINE config
+"2-layer LSTM on AG-News (recurrent step under jit, sync PS)".
+
+TPU-first: the recurrence is a `lax.scan` over time (via flax nn.RNN), so
+the whole unrolled sequence is ONE compiled loop with static shapes — no
+Python-level time stepping. Embedding/gate matmuls run in bfloat16 on the
+MXU; padding (token id 0) is masked out of the mean-pool so ragged
+sequences batch with static shapes.
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax.numpy as jnp
+import optax
+
+from kubeml_tpu.models import register_model
+from kubeml_tpu.models.base import ClassifierModel
+
+PAD_ID = 0
+
+
+class LSTMClassifierModule(nn.Module):
+    vocab_size: int = 32000
+    embed_dim: int = 128
+    hidden_dim: int = 256
+    num_layers: int = 2
+    num_classes: int = 4
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        # x: int32 token ids [B, T]. Mask/lengths stay float32/int32 —
+        # bf16 can't count past 256 exactly (8-bit mantissa).
+        mask = (x != PAD_ID).astype(jnp.float32)  # [B, T]
+        h = nn.Embed(self.vocab_size, self.embed_dim,
+                     dtype=self.dtype)(x)
+        lengths = jnp.maximum(mask.sum(axis=1).astype(jnp.int32), 1)
+        for i in range(self.num_layers):
+            h = nn.RNN(nn.OptimizedLSTMCell(self.hidden_dim,
+                                            dtype=self.dtype),
+                       name=f"lstm_{i}")(h, seq_lengths=lengths)
+        # masked mean-pool over real tokens
+        pooled = (h * mask[..., None].astype(h.dtype)).sum(axis=1) / \
+            jnp.maximum(mask.sum(axis=1), 1.0)[..., None].astype(h.dtype)
+        out = nn.Dense(self.num_classes, dtype=self.dtype)(pooled)
+        return out.astype(jnp.float32)
+
+
+@register_model("lstm")
+class LSTMClassifier(ClassifierModel):
+    name = "lstm"
+    num_classes = 4
+
+    def build(self):
+        return LSTMClassifierModule(num_classes=self.num_classes)
+
+    def configure_optimizers(self, lr, epoch):
+        return optax.adam(lr)

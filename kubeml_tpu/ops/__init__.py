@@ -1,0 +1,9 @@
+"""TPU compute primitives (jnp reference implementations + pallas kernels).
+
+The reference has no custom-op layer at all — every op is torch eager
+(SURVEY.md §2). Here the hot ops get explicit TPU-aware implementations so
+models, the ring-attention sequence-parallel path, and pallas kernels share
+one numerically-pinned primitive.
+"""
+
+from kubeml_tpu.ops.attention import multi_head_attention  # noqa: F401

@@ -1,0 +1,49 @@
+"""Multi-head attention primitives.
+
+One numerically-pinned attention core shared by the transformer models
+(models/bert.py), the sequence-parallel ring attention
+(parallel/ring_attention.py), and the pallas flash kernel (ops/pallas/).
+
+Design notes (TPU):
+  - the [B, H, T, T] score tensor is materialized only in the reference
+    path; the pallas kernel and ring attention both stream KV blocks so
+    HBM never holds O(T^2);
+  - computation in bfloat16 with float32 softmax accumulation (MXU
+    matmuls, VPU-safe normalization);
+  - additive mask convention: `bias` is added to the logits pre-softmax
+    (0 = attend, large negative = masked), which composes padding masks,
+    causal masks, and block masks with one add.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+NEG_INF = -1e9  # large-negative instead of -inf: keeps softmax NaN-free
+               # for rows that are fully masked (all-pad sequences)
+
+
+def padding_bias(pad_mask: jax.Array, dtype=jnp.float32) -> jax.Array:
+    """[B, T] 1/0 keep-mask -> [B, 1, 1, T] additive attention bias."""
+    return ((1.0 - pad_mask.astype(dtype)) * NEG_INF)[:, None, None, :]
+
+
+def multi_head_attention(q: jax.Array, k: jax.Array, v: jax.Array,
+                         bias: Optional[jax.Array] = None) -> jax.Array:
+    """Scaled dot-product attention over [B, T, H, D] tensors.
+
+    bias: additive logits bias broadcastable to [B, H, Tq, Tk].
+    Returns [B, Tq, H, D] in q.dtype. Softmax runs in float32.
+    """
+    d = q.shape[-1]
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                        preferred_element_type=jnp.float32)
+    scores = scores * (1.0 / jnp.sqrt(jnp.float32(d)))
+    if bias is not None:
+        scores = scores + bias.astype(jnp.float32)
+    weights = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum("bhqk,bkhd->bqhd", weights.astype(q.dtype), v)
+    return out
