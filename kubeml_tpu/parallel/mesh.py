@@ -20,30 +20,35 @@ from jax.sharding import Mesh
 
 DATA_AXIS = "data"
 MODEL_AXIS = "model"
+SEQ_AXIS = "seq"
 
 
 def make_mesh(n_data: Optional[int] = None, n_model: int = 1,
-              devices: Optional[Sequence] = None) -> Mesh:
-    """Create a (data, model) mesh.
+              n_seq: int = 1, devices: Optional[Sequence] = None) -> Mesh:
+    """Create a (data, model, seq) mesh.
 
-    n_data defaults to `len(devices) // n_model`. A 1-sized model axis is
-    always present so the same PartitionSpecs work for pure-DP and DP x TP
-    programs without recompiling call sites.
+    n_data defaults to `len(devices) // (n_model * n_seq)`. The model and
+    seq axes are always present (size 1 when unused) so the same
+    PartitionSpecs work for pure-DP, DP x TP, and DP x SP programs without
+    recompiling call sites. Axis order puts `data` outermost: on real
+    slices, adjacent devices (fast ICI hops) land on the model/seq axes,
+    which carry the latency-sensitive TP/ring collectives.
     """
     if devices is None:
         devices = jax.devices()
     devices = list(devices)
+    inner = n_model * n_seq
     if n_data is None:
-        if len(devices) % n_model:
+        if len(devices) % inner:
             raise ValueError(
-                f"{len(devices)} devices not divisible by model axis {n_model}")
-        n_data = len(devices) // n_model
-    need = n_data * n_model
+                f"{len(devices)} devices not divisible by {n_model}x{n_seq}")
+        n_data = len(devices) // inner
+    need = n_data * inner
     if need > len(devices):
-        raise ValueError(f"mesh {n_data}x{n_model} needs {need} devices, "
-                         f"have {len(devices)}")
-    arr = np.array(devices[:need]).reshape(n_data, n_model)
-    return Mesh(arr, (DATA_AXIS, MODEL_AXIS))
+        raise ValueError(f"mesh {n_data}x{n_model}x{n_seq} needs {need} "
+                         f"devices, have {len(devices)}")
+    arr = np.array(devices[:need]).reshape(n_data, n_model, n_seq)
+    return Mesh(arr, (DATA_AXIS, MODEL_AXIS, SEQ_AXIS))
 
 
 def data_axis_size(mesh: Mesh) -> int:

@@ -1,0 +1,85 @@
+"""Tensor parallelism — GSPMD sharding rules over the mesh `model` axis.
+
+Net-new relative to the reference (SURVEY.md §2a: "Absent: tensor
+parallelism..."). The idiomatic TPU mechanism is NOT manual collectives:
+parameters get `NamedSharding` annotations (Megatron-style column/row
+split per transformer block) and XLA's SPMD partitioner inserts the
+all-reduces on ICI. One rule table drives both placement
+(`shard_variables`) and jit constraints.
+
+Rule format: (path_regex, PartitionSpec). First match wins; default is
+full replication. Paths are '/'-joined flax param paths, e.g.
+"layer_0/q/kernel".
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Any, List, Sequence, Tuple
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from kubeml_tpu.parallel.mesh import MODEL_AXIS
+
+PyTree = Any
+Rules = Sequence[Tuple[str, P]]
+
+# Megatron split for the BERT encoder (models/bert.py param tree):
+#   q/k/v DenseGeneral kernels [hidden, heads, head_dim] -> shard heads;
+#   attention out DenseGeneral  [heads, head_dim, hidden] -> shard heads
+#     (row-parallel: XLA inserts one psum after it);
+#   FFN Dense_0 [hidden, ffn] -> column split; Dense_1 [ffn, hidden] ->
+#     row split (again one psum);
+#   token/position embeddings -> vocab/hidden kept replicated (tiny at
+#     BERT scale; shard via an extra rule when they dominate).
+BERT_TP_RULES: List[Tuple[str, P]] = [
+    (r".*/(q|k|v)/kernel$", P(None, MODEL_AXIS, None)),
+    (r".*/(q|k|v)/bias$", P(MODEL_AXIS, None)),
+    (r".*/out/kernel$", P(MODEL_AXIS, None, None)),
+    (r".*/Dense_0/kernel$", P(None, MODEL_AXIS)),
+    (r".*/Dense_0/bias$", P(MODEL_AXIS)),
+    (r".*/Dense_1/kernel$", P(MODEL_AXIS, None)),
+]
+
+
+def spec_for(path: str, rules: Rules) -> P:
+    for pattern, spec in rules:
+        if re.match(pattern, path):
+            return spec
+    return P()
+
+
+def _paths(tree: PyTree):
+    return jax.tree_util.tree_map_with_path(
+        lambda kp, x: "/".join(
+            str(getattr(k, "key", getattr(k, "idx", k))) for k in kp),
+        tree)
+
+
+def tree_specs(tree: PyTree, rules: Rules) -> PyTree:
+    """PartitionSpec pytree matching `tree` under `rules`."""
+    return jax.tree_util.tree_map(
+        lambda path: spec_for(path, rules), _paths(tree))
+
+
+def shard_variables(variables: PyTree, mesh: Mesh, rules: Rules) -> PyTree:
+    """Place a variable pytree onto the mesh per the rule table.
+
+    Unmatched leaves are replicated. Leaves whose matched spec doesn't
+    divide the dimension fall back to replication (e.g. 2 heads on a
+    4-way model axis) — a warning-free degradation matching GSPMD's
+    behavior of preferring correctness over forced sharding.
+    """
+    specs = tree_specs(variables, rules)
+
+    def place(x, spec):
+        for dim, name in enumerate(spec):
+            if name is None:
+                continue
+            if dim >= x.ndim or x.shape[dim] % mesh.shape[name]:
+                spec = P()
+                break
+        return jax.device_put(x, NamedSharding(mesh, spec))
+
+    return jax.tree_util.tree_map(place, variables, specs)
