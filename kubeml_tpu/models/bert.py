@@ -5,11 +5,14 @@ Net-new relative to the reference (no transformer exists there; SURVEY.md
 "BERT-tiny" point: 2 layers, hidden 128, 2 heads, FFN 512.
 
 TPU-first:
-  - attention goes through ops.multi_head_attention (bf16 matmuls, f32
-    softmax) so the same model can run the pallas flash kernel or the
-    ring-attention sequence-parallel path by swapping that one primitive;
+  - attention goes through ops.masked_attention (bf16 matmuls, f32
+    softmax), which auto-dispatches to the pallas flash kernel on TPU and
+    the jnp reference path elsewhere; the ring-attention sequence-parallel
+    path swaps in at the same primitive;
   - LayerNorm params stay float32; all matmuls bfloat16 (MXU);
-  - padding handled as an additive bias, so shapes are static for jit.
+  - padding flows as a [B, T] keep-mask with static shapes; each
+    implementation composes its own additive bias from it
+    (ops.attention.composed_bias is the semantics definition).
 """
 
 from __future__ import annotations
@@ -20,7 +23,7 @@ import optax
 
 from kubeml_tpu.models import register_model
 from kubeml_tpu.models.base import ClassifierModel
-from kubeml_tpu.ops.attention import multi_head_attention, padding_bias
+from kubeml_tpu.ops.attention import masked_attention
 
 PAD_ID = 0
 
@@ -33,7 +36,7 @@ class EncoderBlock(nn.Module):
     dtype: jnp.dtype
 
     @nn.compact
-    def __call__(self, h, bias, train: bool):
+    def __call__(self, h, pad_mask, train: bool):
         head_dim = self.hidden // self.heads
         x = nn.LayerNorm(dtype=jnp.float32)(h)
         q = nn.DenseGeneral((self.heads, head_dim), dtype=self.dtype,
@@ -42,7 +45,8 @@ class EncoderBlock(nn.Module):
                             name="k")(x)
         v = nn.DenseGeneral((self.heads, head_dim), dtype=self.dtype,
                             name="v")(x)
-        attn = multi_head_attention(q, k, v, bias)
+        # auto-dispatch: pallas flash kernel on TPU, jnp reference on CPU
+        attn = masked_attention(q, k, v, pad_mask)
         attn = nn.DenseGeneral(self.hidden, axis=(-2, -1), dtype=self.dtype,
                                name="out")(attn)
         attn = nn.Dropout(self.dropout, deterministic=not train)(attn)
@@ -80,10 +84,10 @@ class BertModule(nn.Module):
                        name="pos_embed")(jnp.arange(T)[None, :])
         h = h + pos
         h = nn.Dropout(self.dropout, deterministic=not train)(h)
-        bias = padding_bias(pad_mask)
         for i in range(self.layers):
             h = EncoderBlock(self.hidden, self.heads, self.ffn, self.dropout,
-                             self.dtype, name=f"layer_{i}")(h, bias, train)
+                             self.dtype, name=f"layer_{i}")(h, pad_mask,
+                                                            train)
         h = nn.LayerNorm(dtype=jnp.float32)(h)
         # masked mean-pool (robust without a trained [CLS])
         pooled = (h * pad_mask[..., None]).sum(axis=1) / \

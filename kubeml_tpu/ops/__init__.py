@@ -6,4 +6,5 @@ models, the ring-attention sequence-parallel path, and pallas kernels share
 one numerically-pinned primitive.
 """
 
-from kubeml_tpu.ops.attention import multi_head_attention  # noqa: F401
+from kubeml_tpu.ops.attention import (masked_attention,  # noqa: F401
+                                      multi_head_attention)

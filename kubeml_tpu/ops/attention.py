@@ -47,3 +47,36 @@ def multi_head_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     weights = jax.nn.softmax(scores, axis=-1)
     out = jnp.einsum("bhqk,bkhd->bqhd", weights.astype(q.dtype), v)
     return out
+
+
+def composed_bias(pad_mask: jax.Array, causal: bool, T: int) -> jax.Array:
+    """Additive [B, 1|H, Tq, Tk]-broadcastable bias for a [B, T] keep-mask
+    plus optional causality — THE mask-semantics definition shared by the
+    reference path, the pallas flash kernel's backward, and tests."""
+    bias = padding_bias(pad_mask)
+    if causal:
+        bias = bias + jnp.where(
+            jnp.arange(T)[:, None] >= jnp.arange(T)[None, :], 0.0,
+            NEG_INF)[None, None]
+    return bias
+
+
+def masked_attention(q: jax.Array, k: jax.Array, v: jax.Array,
+                     pad_mask: jax.Array, causal: bool = False,
+                     impl: str = "auto") -> jax.Array:
+    """Self-attention with a [B, T] keep-mask — implementation dispatch.
+
+    impl='auto' picks the pallas flash kernel on TPU when the sequence
+    tiles cleanly (T a multiple of 128, or a single sublane-aligned block
+    T <= 128 with T % 8 == 0), else the jnp reference path;
+    'flash'/'reference' force a path.
+    """
+    T = q.shape[1]
+    if impl == "auto":
+        on_tpu = jax.default_backend() == "tpu"
+        tiles = T % 128 == 0 or (T <= 128 and T % 8 == 0)
+        impl = "flash" if on_tpu and tiles else "reference"
+    if impl == "flash":
+        from kubeml_tpu.ops.pallas.flash_attention import flash_attention
+        return flash_attention(q, k, v, pad_mask, causal)
+    return multi_head_attention(q, k, v, composed_bias(pad_mask, causal, T))

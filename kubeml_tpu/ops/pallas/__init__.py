@@ -1,0 +1,3 @@
+"""Pallas TPU kernels for the hot ops."""
+
+from kubeml_tpu.ops.pallas.flash_attention import flash_attention  # noqa: F401

@@ -1,0 +1,170 @@
+"""Flash attention — pallas TPU kernel for the transformer hot path.
+
+The reference has no custom kernels at all (torch eager end to end,
+SURVEY.md §2); this is the TPU-native treatment of the one op where naive
+lowering hurts most: attention's [T, T] score matrix. The kernel streams
+KV blocks through VMEM with the online-softmax recurrence, so HBM traffic
+is O(T·D) instead of O(T²) and the two matmuls per block run back-to-back
+on the MXU from VMEM.
+
+Layout: q/k/v are [B, T, H, D] (the models' layout); the kernel runs on a
+(B·H, Tq-blocks) grid over [BH, T, D] views. Masking follows the same
+convention as ops.attention / parallel.ring_attention: a [B, T] keep-mask
+plus an optional causal flag — composed inside the kernel as additive
+NEG_INF terms, so results match the jnp reference exactly (softmax over
+fully-masked rows degrades to uniform, never NaN).
+
+Backward: jax.custom_vjp with a rematerialized jnp backward (recompute
+attention from saved q/k/v — standard flash practice of trading FLOPs for
+memory; a dedicated pallas backward kernel is a later optimization).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from kubeml_tpu.ops.attention import (NEG_INF, composed_bias,
+                                      multi_head_attention)
+
+DEFAULT_BLOCK_Q = 128
+DEFAULT_BLOCK_K = 128
+
+
+def _fa_kernel(mask_ref, q_ref, k_ref, v_ref, out_ref, *, block_k: int,
+               causal: bool, scale: float):
+    """One Q block (grid point) against all KV blocks.
+
+    q_ref [1, BQ, D]; k_ref/v_ref [1, T, D]; mask_ref [1, 1, T] float 1/0;
+    out_ref [1, BQ, D].
+    """
+    iq = pl.program_id(1)
+    bq = q_ref.shape[1]
+    t = k_ref.shape[1]
+    d = q_ref.shape[2]
+    n_k = t // block_k
+
+    q = q_ref[0].astype(jnp.float32) * scale          # [BQ, D]
+    q_pos = jax.lax.broadcasted_iota(jnp.int32, (bq, block_k), 0) + iq * bq
+
+    def body(jk, carry):
+        acc, m, l = carry
+        k_blk = k_ref[0, pl.ds(jk * block_k, block_k), :]  # [BK, D]
+        v_blk = v_ref[0, pl.ds(jk * block_k, block_k), :]
+        s = jax.lax.dot_general(
+            q, k_blk.astype(jnp.float32),
+            dimension_numbers=(((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)            # [BQ, BK]
+        keep = mask_ref[0, 0, pl.ds(jk * block_k, block_k)]  # [BK]
+        s = s + (1.0 - keep.astype(jnp.float32))[None, :] * NEG_INF
+        if causal:
+            k_pos = jax.lax.broadcasted_iota(
+                jnp.int32, (bq, block_k), 1) + jk * block_k
+            s = s + jnp.where(q_pos >= k_pos, 0.0, NEG_INF)
+        m_blk = s.max(axis=-1, keepdims=True)              # [BQ, 1]
+        new_m = jnp.maximum(m, m_blk)
+        p = jnp.exp(s - new_m)                             # [BQ, BK]
+        scale_old = jnp.exp(m - new_m)
+        l = l * scale_old + p.sum(axis=-1, keepdims=True)
+        acc = acc * scale_old + jax.lax.dot_general(
+            p.astype(v_blk.dtype), v_blk,
+            dimension_numbers=(((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        return acc, new_m, l
+
+    acc0 = jnp.zeros((bq, d), jnp.float32)
+    m0 = jnp.full((bq, 1), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((bq, 1), jnp.float32)
+    if causal:
+        # blocks strictly above the diagonal contribute nothing: iterate
+        # only up to (and including) the q block's diagonal band
+        n_iter = jnp.minimum(((iq + 1) * bq + block_k - 1) // block_k, n_k)
+    else:
+        n_iter = n_k
+    acc, m, l = jax.lax.fori_loop(0, n_iter, body, (acc0, m0, l0))
+    out_ref[0] = (acc / jnp.maximum(l, 1e-30)).astype(out_ref.dtype)
+
+
+def _fa_forward(q, k, v, pad_mask, causal: bool, block_q: int, block_k: int,
+                interpret: bool):
+    B, T, H, D = q.shape
+    scale = 1.0 / float(D) ** 0.5
+    bq = min(block_q, T)
+    bk = min(block_k, T)
+    if T % bq or T % bk:
+        raise ValueError(f"T={T} must divide by blocks ({bq}, {bk})")
+
+    # [B, T, H, D] -> [B*H, T, D]
+    def to_bh(x):
+        return x.transpose(0, 2, 1, 3).reshape(B * H, T, D)
+
+    # [B, 1, T]: the singleton middle dim keeps the VMEM block's last two
+    # dims equal to the array dims (TPU tiling requirement for B > 1)
+    mask = jnp.broadcast_to(pad_mask.astype(jnp.float32), (B, T))[:, None, :]
+
+    grid = (B * H, T // bq)
+    out = pl.pallas_call(
+        functools.partial(_fa_kernel, block_k=bk, causal=causal,
+                          scale=scale),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, 1, T), lambda bh, iq: (bh // H, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, bq, D), lambda bh, iq: (bh, iq, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, T, D), lambda bh, iq: (bh, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, T, D), lambda bh, iq: (bh, 0, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((1, bq, D), lambda bh, iq: (bh, iq, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((B * H, T, D), q.dtype),
+        interpret=interpret,
+    )(mask, to_bh(q), to_bh(k), to_bh(v))
+    return out.reshape(B, H, T, D).transpose(0, 2, 1, 3)
+
+
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7))
+def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
+                    pad_mask: jax.Array, causal: bool = False,
+                    block_q: int = DEFAULT_BLOCK_Q,
+                    block_k: int = DEFAULT_BLOCK_K,
+                    interpret: bool = False) -> jax.Array:
+    """Fused attention over [B, T, H, D] with a [B, T] keep-mask.
+
+    Equals multi_head_attention(q, k, v, padding_bias(pad_mask) [+ causal
+    bias]) to float32 accuracy. `interpret=True` runs the kernel in the
+    pallas interpreter (CPU tests).
+    """
+    return _fa_forward(q, k, v, pad_mask, causal, block_q, block_k,
+                       interpret)
+
+
+def _fa_fwd(q, k, v, pad_mask, causal, block_q, block_k, interpret):
+    out = _fa_forward(q, k, v, pad_mask, causal, block_q, block_k,
+                      interpret)
+    return out, (q, k, v, pad_mask)
+
+
+def _fa_bwd(causal, block_q, block_k, interpret, res, g):
+    q, k, v, pad_mask = res
+    T = q.shape[1]
+
+    def ref(q, k, v):
+        return multi_head_attention(
+            q, k, v, composed_bias(pad_mask, causal, T))
+
+    _, vjp = jax.vjp(ref, q, k, v)
+    dq, dk, dv = vjp(g)
+    return dq, dk, dv, jnp.zeros_like(pad_mask)
+
+
+flash_attention.defvjp(_fa_fwd, _fa_bwd)
