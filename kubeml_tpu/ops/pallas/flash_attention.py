@@ -36,58 +36,78 @@ DEFAULT_BLOCK_Q = 128
 DEFAULT_BLOCK_K = 128
 
 
-def _fa_kernel(mask_ref, q_ref, k_ref, v_ref, out_ref, *, block_k: int,
-               causal: bool, scale: float):
-    """One Q block (grid point) against all KV blocks.
+# Lane width of the m/l scratch rows (TPU vector lane count).
+_LANES = 128
 
-    q_ref [1, BQ, D]; k_ref/v_ref [1, T, D]; mask_ref [1, 1, T] float 1/0;
-    out_ref [1, BQ, D].
+
+def _fa_kernel(mask_ref, q_ref, k_ref, v_ref, out_ref, acc_ref, m_ref,
+               l_ref, *, causal: bool, scale: float, n_k: int):
+    """One (Q block, KV block) grid point of the online softmax.
+
+    The KV loop is the LAST grid dimension, which pallas iterates
+    sequentially per core: the running (acc, m, l) state lives in VMEM
+    scratch across those iterations, so only one [BK, D] K block and V
+    block are resident at a time — O(block) VMEM, with the pallas
+    pipeline double-buffering the next block's HBM fetch behind the
+    current block's MXU work.
+
+    q_ref [1, BQ, D]; k_ref/v_ref [1, BK, D]; mask_ref [1, 1, BK];
+    out_ref [1, BQ, D]; acc_ref [BQ, D] f32; m_ref/l_ref [BQ, LANES] f32
+    (row stats broadcast along lanes — lane-1 slices have no TPU layout).
     """
     iq = pl.program_id(1)
+    jk = pl.program_id(2)
     bq = q_ref.shape[1]
-    t = k_ref.shape[1]
-    d = q_ref.shape[2]
-    n_k = t // block_k
+    bk = k_ref.shape[1]
 
-    q = q_ref[0].astype(jnp.float32) * scale          # [BQ, D]
-    q_pos = jax.lax.broadcasted_iota(jnp.int32, (bq, block_k), 0) + iq * bq
+    @pl.when(jk == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+        m_ref[...] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
 
-    def body(jk, carry):
-        acc, m, l = carry
-        k_blk = k_ref[0, pl.ds(jk * block_k, block_k), :]  # [BK, D]
-        v_blk = v_ref[0, pl.ds(jk * block_k, block_k), :]
+    # Causal: the KV block starting at jk*bk overlaps the allowed band of
+    # this Q block iff jk*bk <= iq*bq + bq - 1. Blocks fully above the
+    # diagonal are skipped — no HBM cost either, since their loads are
+    # dead and the compute is predicated off.
+    run = (jk * bk < (iq + 1) * bq) if causal else (jk >= 0)
+
+    @pl.when(run)
+    def _compute():
+        q = q_ref[0].astype(jnp.float32) * scale           # [BQ, D]
+        k_blk = k_ref[0]
+        v_blk = v_ref[0]
         s = jax.lax.dot_general(
             q, k_blk.astype(jnp.float32),
             dimension_numbers=(((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)            # [BQ, BK]
-        keep = mask_ref[0, 0, pl.ds(jk * block_k, block_k)]  # [BK]
+        keep = mask_ref[0, 0]                              # [BK]
         s = s + (1.0 - keep.astype(jnp.float32))[None, :] * NEG_INF
         if causal:
+            q_pos = jax.lax.broadcasted_iota(
+                jnp.int32, (bq, bk), 0) + iq * bq
             k_pos = jax.lax.broadcasted_iota(
-                jnp.int32, (bq, block_k), 1) + jk * block_k
+                jnp.int32, (bq, bk), 1) + jk * bk
             s = s + jnp.where(q_pos >= k_pos, 0.0, NEG_INF)
-        m_blk = s.max(axis=-1, keepdims=True)              # [BQ, 1]
-        new_m = jnp.maximum(m, m_blk)
+        m_prev = m_ref[...][:, :1]                         # [BQ, 1]
+        l_prev = l_ref[...][:, :1]
+        m_blk = s.max(axis=-1, keepdims=True)
+        new_m = jnp.maximum(m_prev, m_blk)
         p = jnp.exp(s - new_m)                             # [BQ, BK]
-        scale_old = jnp.exp(m - new_m)
-        l = l * scale_old + p.sum(axis=-1, keepdims=True)
-        acc = acc * scale_old + jax.lax.dot_general(
+        scale_old = jnp.exp(m_prev - new_m)
+        new_l = l_prev * scale_old + p.sum(axis=-1, keepdims=True)
+        acc_ref[...] = acc_ref[...] * scale_old + jax.lax.dot_general(
             p.astype(v_blk.dtype), v_blk,
             dimension_numbers=(((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
-        return acc, new_m, l
+        m_ref[...] = jnp.broadcast_to(new_m, m_ref.shape)
+        l_ref[...] = jnp.broadcast_to(new_l, l_ref.shape)
 
-    acc0 = jnp.zeros((bq, d), jnp.float32)
-    m0 = jnp.full((bq, 1), NEG_INF, jnp.float32)
-    l0 = jnp.zeros((bq, 1), jnp.float32)
-    if causal:
-        # blocks strictly above the diagonal contribute nothing: iterate
-        # only up to (and including) the q block's diagonal band
-        n_iter = jnp.minimum(((iq + 1) * bq + block_k - 1) // block_k, n_k)
-    else:
-        n_iter = n_k
-    acc, m, l = jax.lax.fori_loop(0, n_iter, body, (acc0, m0, l0))
-    out_ref[0] = (acc / jnp.maximum(l, 1e-30)).astype(out_ref.dtype)
+    @pl.when(jk == n_k - 1)
+    def _flush():
+        l = l_ref[...][:, :1]
+        out_ref[0] = (acc_ref[...] / jnp.maximum(l, 1e-30)
+                      ).astype(out_ref.dtype)
 
 
 def _fa_forward(q, k, v, pad_mask, causal: bool, block_q: int, block_k: int,
@@ -98,6 +118,7 @@ def _fa_forward(q, k, v, pad_mask, causal: bool, block_q: int, block_k: int,
     bk = min(block_k, T)
     if T % bq or T % bk:
         raise ValueError(f"T={T} must divide by blocks ({bq}, {bk})")
+    n_k = T // bk
 
     # [B, T, H, D] -> [B*H, T, D]
     def to_bh(x):
@@ -107,24 +128,28 @@ def _fa_forward(q, k, v, pad_mask, causal: bool, block_q: int, block_k: int,
     # dims equal to the array dims (TPU tiling requirement for B > 1)
     mask = jnp.broadcast_to(pad_mask.astype(jnp.float32), (B, T))[:, None, :]
 
-    grid = (B * H, T // bq)
+    grid = (B * H, T // bq, n_k)
     out = pl.pallas_call(
-        functools.partial(_fa_kernel, block_k=bk, causal=causal,
-                          scale=scale),
+        functools.partial(_fa_kernel, causal=causal, scale=scale, n_k=n_k),
         grid=grid,
         in_specs=[
-            pl.BlockSpec((1, 1, T), lambda bh, iq: (bh // H, 0, 0),
+            pl.BlockSpec((1, 1, bk), lambda bh, iq, jk: (bh // H, 0, jk),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, bq, D), lambda bh, iq: (bh, iq, 0),
+            pl.BlockSpec((1, bq, D), lambda bh, iq, jk: (bh, iq, 0),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, T, D), lambda bh, iq: (bh, 0, 0),
+            pl.BlockSpec((1, bk, D), lambda bh, iq, jk: (bh, jk, 0),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, T, D), lambda bh, iq: (bh, 0, 0),
+            pl.BlockSpec((1, bk, D), lambda bh, iq, jk: (bh, jk, 0),
                          memory_space=pltpu.VMEM),
         ],
-        out_specs=pl.BlockSpec((1, bq, D), lambda bh, iq: (bh, iq, 0),
+        out_specs=pl.BlockSpec((1, bq, D), lambda bh, iq, jk: (bh, iq, 0),
                                memory_space=pltpu.VMEM),
         out_shape=jax.ShapeDtypeStruct((B * H, T, D), q.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((bq, D), jnp.float32),
+            pltpu.VMEM((bq, _LANES), jnp.float32),
+            pltpu.VMEM((bq, _LANES), jnp.float32),
+        ],
         interpret=interpret,
     )(mask, to_bh(q), to_bh(k), to_bh(v))
     return out.reshape(B, H, T, D).transpose(0, 2, 1, 3)

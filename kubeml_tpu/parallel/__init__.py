@@ -1,4 +1,9 @@
 from kubeml_tpu.parallel.mesh import make_mesh, data_axis_size
 from kubeml_tpu.parallel.kavg import KAvgEngine, RoundStats
+from kubeml_tpu.parallel.pp import (pipeline_apply, sequential_apply,
+                                    stack_stage_params)
+from kubeml_tpu.parallel.ep import init_moe_params, moe_apply
 
-__all__ = ["make_mesh", "data_axis_size", "KAvgEngine", "RoundStats"]
+__all__ = ["make_mesh", "data_axis_size", "KAvgEngine", "RoundStats",
+           "pipeline_apply", "sequential_apply", "stack_stage_params",
+           "init_moe_params", "moe_apply"]

@@ -1,0 +1,132 @@
+"""Expert parallelism — GShard-style mixture-of-experts over the mesh
+`expert` axis.
+
+Net-new relative to the reference (SURVEY.md §2a: "Absent: ... expert
+parallelism"). TPU-first design: routing is expressed as dense one-hot
+einsum dispatch/combine (the GShard/Mesh-TensorFlow formulation) rather
+than gather/scatter — static shapes, MXU-friendly, and the expert-major
+intermediates are annotated with `with_sharding_constraint` over the
+`expert` axis so XLA's SPMD partitioner inserts the all-to-alls on ICI.
+No manual collective code is needed; the same program runs on one chip
+(expert axis size 1) or a full slice.
+
+Capacity semantics: each expert processes at most C = ceil(T/E *
+capacity_factor) tokens per call; overflow tokens are dropped from that
+expert (their combine weight is zero, so they pass through the residual
+path in `MoEBlock`-style use). Auxiliary load-balancing loss follows
+Shazeer et al.: E * sum_e(fraction_routed_e * mean_prob_e).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from kubeml_tpu.parallel.mesh import EXPERT_AXIS
+
+PyTree = Any
+
+
+def init_moe_params(rng: jax.Array, d_model: int, d_ff: int,
+                    n_experts: int) -> Dict[str, jax.Array]:
+    """Router + stacked expert-FFN parameters.
+
+    Leaves carry the expert dim leading so `EP_RULES`-style placement (or
+    the constraints inside `moe_apply`) shard them over the expert axis.
+    """
+    kr, ki, ko = jax.random.split(rng, 3)
+    scale_in = 1.0 / jnp.sqrt(d_model)
+    scale_out = 1.0 / jnp.sqrt(d_ff)
+    return {
+        "router": jax.random.normal(kr, (d_model, n_experts)) * scale_in,
+        "wi": jax.random.normal(ki, (n_experts, d_model, d_ff)) * scale_in,
+        "bi": jnp.zeros((n_experts, d_ff)),
+        "wo": jax.random.normal(ko, (n_experts, d_ff, d_model)) * scale_out,
+        "bo": jnp.zeros((n_experts, d_model)),
+    }
+
+
+def make_dispatch(logits: jax.Array, capacity: int, k: int = 2
+                  ) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Top-k routing with per-expert capacity.
+
+    logits: [T, E]. Returns (dispatch [T, E, C] 0/1, combine [T, E, C]
+    float, aux_loss scalar). A token contributes to at most k experts;
+    within an expert, slots fill in token order (GShard's cumsum position
+    assignment) and overflow is dropped.
+    """
+    t, e = logits.shape
+    k = min(k, e)
+    probs = jax.nn.softmax(logits, axis=-1)
+
+    dispatch = jnp.zeros((t, e, capacity), logits.dtype)
+    combine = jnp.zeros((t, e, capacity), logits.dtype)
+    masked = probs
+    # Slot tokens expert-by-expert for each of the k choices. Loop bound k
+    # is a static Python int — unrolled at trace time, XLA-friendly.
+    fill = jnp.zeros((e,), jnp.int32)  # slots already used per expert
+    for _ in range(k):
+        choice = jnp.argmax(masked, axis=-1)                      # [T]
+        onehot = jax.nn.one_hot(choice, e, dtype=logits.dtype)    # [T, E]
+        pos = (jnp.cumsum(onehot, axis=0) - 1) * onehot           # [T, E]
+        pos = pos + fill[None, :] * onehot
+        keep = onehot * (pos < capacity)
+        slot = jax.nn.one_hot(pos.astype(jnp.int32), capacity,
+                              dtype=logits.dtype)                 # [T, E, C]
+        d = keep[..., None] * slot
+        dispatch = dispatch + d
+        combine = combine + d * probs[..., None]
+        fill = fill + keep.sum(axis=0).astype(jnp.int32)
+        masked = masked * (1.0 - onehot)  # next choice excludes this expert
+
+    # Load-balance auxiliary loss over the FIRST choice distribution.
+    first = jax.nn.one_hot(jnp.argmax(probs, axis=-1), e,
+                           dtype=logits.dtype)
+    aux = e * jnp.sum(first.mean(axis=0) * probs.mean(axis=0))
+    return dispatch, combine, aux
+
+
+def moe_apply(params: Dict[str, jax.Array], x: jax.Array,
+              mesh: Optional[Mesh] = None, *, k: int = 2,
+              capacity_factor: float = 1.25
+              ) -> Tuple[jax.Array, jax.Array]:
+    """Apply the expert layer to tokens x [T, d_model].
+
+    Returns (y [T, d_model], aux_loss). With a mesh, expert-major
+    intermediates are constrained to the `expert` axis so the SPMD
+    partitioner materializes dispatch/return as all-to-alls.
+    """
+    t = x.shape[0]
+    e = params["router"].shape[1]
+    capacity = max(1, math.ceil((t / e) * capacity_factor))
+
+    def on_expert_axis(arr):
+        if mesh is None or mesh.shape[EXPERT_AXIS] == 1:
+            return arr
+        return jax.lax.with_sharding_constraint(
+            arr, NamedSharding(mesh, P(EXPERT_AXIS)))
+
+    logits = x @ params["router"]
+    dispatch, combine, aux = make_dispatch(logits, capacity, k)
+
+    expert_in = on_expert_axis(jnp.einsum("tec,td->ecd", dispatch, x))
+    h = jax.nn.gelu(
+        jnp.einsum("ecd,edf->ecf", expert_in, params["wi"])
+        + params["bi"][:, None, :])
+    # Empty slots get the bias too, but combine is zero there — harmless.
+    out = on_expert_axis(
+        jnp.einsum("ecf,efd->ecd", h, params["wo"])
+        + params["bo"][:, None, :])
+    y = jnp.einsum("tec,ecd->td", combine, out)
+    return y, aux
+
+
+# Placement rules for `tp.shard_variables`-style use: expert-stacked
+# leaves shard their leading dim over the expert axis.
+EP_RULES = [
+    (r".*/(wi|wo|bi|bo)$", P(EXPERT_AXIS)),
+]

@@ -3,8 +3,9 @@
 The reference's "cluster" is a fleet of Fission function pods coordinated
 over HTTP (SURVEY.md §2b). Here the cluster is a `jax.sharding.Mesh`:
 the `data` axis carries the data-parallel lanes that replace function
-replicas, and an optional `model` axis carries tensor/sequence parallelism
-(net-new relative to the reference, which has none — SURVEY.md §2a).
+replicas; `model`/`seq`/`stage`/`expert` axes carry tensor, sequence,
+pipeline, and expert parallelism (all net-new relative to the reference,
+which has none — SURVEY.md §2a).
 
 Collectives ride ICI within a slice; multi-slice meshes extend over DCN via
 jax.distributed (same code path — the mesh abstracts the transport).
@@ -21,34 +22,42 @@ from jax.sharding import Mesh
 DATA_AXIS = "data"
 MODEL_AXIS = "model"
 SEQ_AXIS = "seq"
+STAGE_AXIS = "stage"
+EXPERT_AXIS = "expert"
 
 
 def make_mesh(n_data: Optional[int] = None, n_model: int = 1,
-              n_seq: int = 1, devices: Optional[Sequence] = None) -> Mesh:
-    """Create a (data, model, seq) mesh.
+              n_seq: int = 1, n_stage: int = 1, n_expert: int = 1,
+              devices: Optional[Sequence] = None) -> Mesh:
+    """Create a (data, model, seq, stage, expert) mesh.
 
-    n_data defaults to `len(devices) // (n_model * n_seq)`. The model and
-    seq axes are always present (size 1 when unused) so the same
-    PartitionSpecs work for pure-DP, DP x TP, and DP x SP programs without
-    recompiling call sites. Axis order puts `data` outermost: on real
-    slices, adjacent devices (fast ICI hops) land on the model/seq axes,
-    which carry the latency-sensitive TP/ring collectives.
+    n_data defaults to `len(devices) // (n_model * n_seq * n_stage *
+    n_expert)`. All five axes are always present (size 1 when unused) so
+    the same PartitionSpecs work for pure-DP, DP x TP, DP x SP, PP, and
+    EP programs without recompiling call sites. Axis order puts `data`
+    outermost: on real slices, adjacent devices (fast ICI hops) land on
+    the inner axes, which carry the latency-sensitive TP/ring/pipeline/
+    all-to-all collectives.
     """
     if devices is None:
         devices = jax.devices()
     devices = list(devices)
-    inner = n_model * n_seq
+    inner = n_model * n_seq * n_stage * n_expert
     if n_data is None:
         if len(devices) % inner:
             raise ValueError(
-                f"{len(devices)} devices not divisible by {n_model}x{n_seq}")
+                f"{len(devices)} devices not divisible by "
+                f"{n_model}x{n_seq}x{n_stage}x{n_expert}")
         n_data = len(devices) // inner
     need = n_data * inner
     if need > len(devices):
-        raise ValueError(f"mesh {n_data}x{n_model}x{n_seq} needs {need} "
-                         f"devices, have {len(devices)}")
-    arr = np.array(devices[:need]).reshape(n_data, n_model, n_seq)
-    return Mesh(arr, (DATA_AXIS, MODEL_AXIS, SEQ_AXIS))
+        raise ValueError(
+            f"mesh {n_data}x{n_model}x{n_seq}x{n_stage}x{n_expert} needs "
+            f"{need} devices, have {len(devices)}")
+    arr = np.array(devices[:need]).reshape(
+        n_data, n_model, n_seq, n_stage, n_expert)
+    return Mesh(arr, (DATA_AXIS, MODEL_AXIS, SEQ_AXIS, STAGE_AXIS,
+                      EXPERT_AXIS))
 
 
 def data_axis_size(mesh: Mesh) -> int:

@@ -1,0 +1,120 @@
+"""Pipeline parallelism — GPipe-style microbatch pipelining over the mesh
+`stage` axis.
+
+Net-new relative to the reference (SURVEY.md §2a: "Absent: ... pipeline
+parallelism"). TPU-first design: instead of per-stage processes passing
+activations over a network (the GPU-framework pattern), ONE SPMD program
+runs on all stages under `shard_map`. Stage parameters are stacked on a
+leading [P] dim and sharded over the `stage` axis; at every clock tick
+each stage applies its block to its current activation and `ppermute`s
+the result one hop along the ICI ring to its successor. M microbatches
+drain in M + P - 1 ticks — the (P-1)-tick fill/drain bubble is the
+standard GPipe cost, amortized by choosing M >> P.
+
+The whole pipeline is differentiable end-to-end: `ppermute` and `scan`
+have transposes, so `jax.grad` through `pipeline_apply` yields correct
+stage-parameter gradients, with the reverse activation transfers riding
+the same ICI ring in the opposite direction.
+
+Restriction (by construction of the SPMD formulation): every stage maps
+activations of one fixed shape to the same shape. Embed/head layers that
+change shape run outside the pipelined trunk (see `models/`).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Sequence
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from kubeml_tpu.parallel.mesh import STAGE_AXIS
+
+PyTree = Any
+# stage_fn(stage_params, activation [B, ...]) -> activation [B, ...]
+StageFn = Callable[[PyTree, jax.Array], jax.Array]
+
+
+def stack_stage_params(params_list: Sequence[PyTree]) -> PyTree:
+    """Stack per-stage param pytrees on a new leading [P] dim.
+
+    The stacked tree is what `pipeline_apply` shards over the stage axis.
+    All stages must share one tree structure and leaf shapes (uniform
+    blocks — the transformer/MLP-trunk case).
+    """
+    return jax.tree_util.tree_map(
+        lambda *xs: jnp.stack(xs, axis=0), *params_list)
+
+
+def pipeline_apply(stage_fn: StageFn, stage_params: PyTree, x: jax.Array,
+                   mesh: Mesh) -> jax.Array:
+    """Run x through P pipeline stages with microbatch pipelining.
+
+    stage_params: pytree with leading dim [P] on every leaf (see
+        `stack_stage_params`), laid out over the mesh `stage` axis.
+    x: [M, B, ...] — M microbatches. More microbatches = smaller bubble
+        fraction (bubble = (P-1)/(M+P-1) of ticks).
+    Returns [M, B, ...] outputs, replicated over the stage axis.
+    """
+    n_stage = mesh.shape[STAGE_AXIS]
+    for leaf in jax.tree_util.tree_leaves(stage_params):
+        if leaf.shape[0] != n_stage:
+            raise ValueError(
+                f"stage_params stack {leaf.shape[0]} stages but the mesh "
+                f"stage axis is {n_stage}; they must match")
+
+    def lane(params, xs):
+        # params leaves arrive sliced to [1, ...] for this stage.
+        params = jax.tree_util.tree_map(lambda p: p[0], params)
+        sid = lax.axis_index(STAGE_AXIS)
+        m = xs.shape[0]
+        perm = [(i, (i + 1) % n_stage) for i in range(n_stage)]
+
+        def tick(act, t):
+            # Stage 0 injects microbatch t (clipped during drain ticks —
+            # those outputs never reach the collected window); others
+            # consume the activation ppermuted in on the previous tick.
+            inp = jnp.where(sid == 0,
+                            lax.dynamic_index_in_dim(
+                                xs, jnp.clip(t, 0, m - 1), keepdims=False),
+                            act)
+            out = stage_fn(params, inp)
+            nxt = lax.ppermute(out, STAGE_AXIS, perm)
+            return nxt, out
+
+        _, outs = lax.scan(tick, jnp.zeros_like(xs[0]),
+                           jnp.arange(m + n_stage - 1))
+        # Microbatch j finishes on the last stage at tick j + P - 1.
+        ys = outs[n_stage - 1:]
+        # Zero everywhere but the last stage, then psum-broadcast so the
+        # result is replicated (out_spec P() below).
+        ys = jnp.where(sid == n_stage - 1, ys, jnp.zeros_like(ys))
+        return lax.psum(ys, STAGE_AXIS)
+
+    sharded = jax.shard_map(
+        lane, mesh=mesh,
+        in_specs=(P(STAGE_AXIS), P()),
+        out_specs=P(),
+        check_vma=False)
+    return sharded(stage_params, x)
+
+
+def sequential_apply(stage_fn: StageFn, stage_params: PyTree,
+                     x: jax.Array) -> jax.Array:
+    """Reference semantics: the same chain with no pipelining.
+
+    stage_params leaves [P, ...]; x [M, B, ...]. Used by tests and as the
+    single-device fallback when the mesh has no stage axis.
+    """
+    n_stage = jax.tree_util.tree_leaves(stage_params)[0].shape[0]
+
+    def one(mb):
+        act = mb
+        for s in range(n_stage):
+            p = jax.tree_util.tree_map(lambda q: q[s], stage_params)
+            act = stage_fn(p, act)
+        return act
+
+    return jax.vmap(one)(x)
