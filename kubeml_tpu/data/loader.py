@@ -19,10 +19,13 @@ offer opt-in per-epoch doc shuffling.
 from __future__ import annotations
 
 import dataclasses
+import queue
+import threading
 from typing import Dict, Iterator, Optional, Tuple
 
 import numpy as np
 
+from kubeml_tpu import native
 from kubeml_tpu.api.errors import DataError
 from kubeml_tpu.data.registry import DatasetHandle
 from kubeml_tpu.data.sharding import EpochPlan, RoundPlan, plan_epoch
@@ -94,16 +97,57 @@ def _fill_chunk(xs: np.ndarray, ys: np.ndarray, steps: int, batch: int
             mask.reshape(steps, batch))
 
 
+def prefetch_rounds(rounds: Iterator[RoundBatch], depth: int = 2
+                    ) -> Iterator[RoundBatch]:
+    """Assemble upcoming rounds in a background thread.
+
+    The native assembler runs under ctypes (GIL released), so round r+1's
+    host-side gather overlaps the device's compute of round r — the
+    TPU-host equivalent of the reference functions' concurrent Mongo
+    prefetch while training (dataset.py:150-165). `depth` bounds host
+    memory at depth extra round tensors.
+    """
+    q: "queue.Queue" = queue.Queue(maxsize=depth)
+    done = object()
+
+    def feeder():
+        try:
+            for rb in rounds:
+                q.put(rb)
+            q.put(done)
+        except BaseException as e:  # surfaced in the consumer thread
+            q.put(e)
+
+    threading.Thread(target=feeder, daemon=True).start()
+    while True:
+        item = q.get()
+        if item is done:
+            return
+        if isinstance(item, BaseException):
+            raise item
+        yield item
+
+
 class RoundLoader:
     """Materializes train/eval round tensors for one job."""
 
     def __init__(self, handle: DatasetHandle, dataset: KubeDataset,
-                 n_lanes: int, seed: int = 0, shuffle: bool = False):
+                 n_lanes: int, seed: int = 0, shuffle: bool = False,
+                 use_native: bool = True):
         self.handle = handle
         self.dataset = dataset
         self.n_lanes = n_lanes
         self.shuffle = shuffle
         self._root_rng = np.random.SeedSequence(seed)
+        # The C++ assembler implements exactly the identity-transform,
+        # unshuffled layout; user transform hooks or doc permutation fall
+        # back to the numpy path (same outputs, tested equal).
+        self._native_train = (
+            use_native and native.available() and not shuffle
+            and type(dataset).transform_train is KubeDataset.transform_train)
+        self._native_eval = (
+            use_native and native.available()
+            and type(dataset).transform_test is KubeDataset.transform_test)
 
     # ------------------------------------------------------------- training
 
@@ -137,6 +181,12 @@ class RoundLoader:
             np.random.SeedSequence([self._root_rng.entropy, epoch, 7]))
 
         for rp in plan.rounds:
+            if self._native_train and perm is None:
+                rngs = key_rng.integers(0, 2**32, size=(W, S, 2),
+                                        dtype=np.uint32)
+                yield self._native_round(rp, W, S, B, x_mm, y_mm, rngs,
+                                         len(plan.rounds))
+                continue
             xs_all, ys_all = [], []
             sample_mask = np.zeros((W, S, B), dtype=np.float32)
             step_mask = np.zeros((W, S), dtype=np.float32)
@@ -166,6 +216,24 @@ class RoundLoader:
                 sample_mask=sample_mask, step_mask=step_mask,
                 worker_mask=worker_mask, rngs=rngs,
                 round_index=rp.index, num_rounds=len(plan.rounds))
+
+    def _native_round(self, rp: RoundPlan, W, S, B, x_mm, y_mm, rngs,
+                      num_rounds) -> RoundBatch:
+        """C++ fast path: one multithreaded gather+cycle-pad per round."""
+        ss = self.handle.subset_size
+        act = [c for c in rp.chunks if c.active]
+        n = len(x_mm)
+        x, y, sample_mask, step_mask, worker_mask = native.assemble_round(
+            x_mm, y_mm,
+            np.array([c.worker for c in act]),
+            np.array([c.doc_start * ss for c in act]),
+            np.array([min(c.doc_end * ss, n) for c in act]),
+            np.array([c.num_steps for c in act]),
+            W, S, B)
+        return RoundBatch(batch={"x": x, "y": y}, sample_mask=sample_mask,
+                          step_mask=step_mask, worker_mask=worker_mask,
+                          rngs=rngs, round_index=rp.index,
+                          num_rounds=num_rounds)
 
     def _chunk_samples(self, x_mm, y_mm, doc_start, doc_end, perm):
         ss = self.handle.subset_size
@@ -199,6 +267,18 @@ class RoundLoader:
         S = plan.rounds[0].max_steps
         B = batch_size
         x_mm, y_mm = self.handle.test_arrays()
+        if self._native_eval:
+            ss = self.handle.subset_size
+            act = [c for c in plan.rounds[0].chunks if c.active]
+            n = len(x_mm)
+            x, y, sample_mask, _, _ = native.assemble_round(
+                x_mm, y_mm,
+                np.array([c.worker for c in act]),
+                np.array([c.doc_start * ss for c in act]),
+                np.array([min(c.doc_end * ss, n) for c in act]),
+                np.array([c.num_steps for c in act]),
+                W, S, B)
+            return ({"x": x, "y": y}, sample_mask)
         xs_all, ys_all = [], []
         sample_mask = np.zeros((W, S, B), dtype=np.float32)
         for c in plan.rounds[0].chunks:

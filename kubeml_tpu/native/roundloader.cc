@@ -1,0 +1,112 @@
+// Native host-side round assembler — the hot data-plane loop.
+//
+// The reference's native layer is Go: its TrainJob assembles/merges model
+// and minibatch traffic in compiled code (ml/pkg/model/model.go,
+// ml/pkg/train/job.go). On a TPU host the equivalent hot loop is round
+// assembly: gathering each worker's doc-range samples out of the mmapped
+// dataset arrays and cycle-padding them into the dense [W, S, B, ...]
+// round tensor the jitted program consumes. That is pure memory movement
+// — this library does it with wide memcpy runs fanned out over a thread
+// pool, called from Python via ctypes (which releases the GIL, so the
+// assembly of round r+1 overlaps the device's compute of round r).
+//
+// Layout contract (must match kubeml_tpu/data/loader.py):
+//   x_out/y_out: [W, S, B, ...] C-contiguous, pre-zeroed by the caller.
+//   A chunk for worker w with `steps` steps owns the contiguous prefix
+//   of worker w's [S*B] sample slots; samples are the chunk's range
+//   [lo, hi) cycled to fill steps*B slots; sample_mask marks the first
+//   (hi-lo) slots, step_mask the first `steps` steps.
+
+#include <atomic>
+#include <cstdint>
+#include <cstring>
+#include <thread>
+#include <vector>
+
+namespace {
+
+struct Chunk {
+  int64_t worker;
+  int64_t lo;     // first sample index
+  int64_t hi;     // one past last sample index
+  int64_t steps;  // real local steps for this worker
+};
+
+// Fill `need` sample slots at dst by cycling the n samples at src.
+void cycle_copy(uint8_t* dst, const uint8_t* src, int64_t n, int64_t need,
+                int64_t item) {
+  if (n <= 0) return;
+  int64_t done = 0;
+  while (done < need) {
+    int64_t run = n < (need - done) ? n : (need - done);
+    std::memcpy(dst + done * item, src, static_cast<size_t>(run * item));
+    done += run;
+  }
+}
+
+void assemble_one(const Chunk& c, const uint8_t* x_src, const uint8_t* y_src,
+                  int64_t x_item, int64_t y_item, int64_t S, int64_t B,
+                  uint8_t* x_out, uint8_t* y_out, float* sample_mask,
+                  float* step_mask, float* worker_mask) {
+  const int64_t n = c.hi - c.lo;
+  const int64_t need = c.steps * B;
+  uint8_t* xw = x_out + c.worker * S * B * x_item;
+  uint8_t* yw = y_out + c.worker * S * B * y_item;
+  cycle_copy(xw, x_src + c.lo * x_item, n, need, x_item);
+  cycle_copy(yw, y_src + c.lo * y_item, n, need, y_item);
+
+  float* sm = sample_mask + c.worker * S * B;
+  const int64_t real = n < need ? n : need;
+  for (int64_t i = 0; i < real; ++i) sm[i] = 1.0f;
+  float* stm = step_mask + c.worker * S;
+  for (int64_t s = 0; s < c.steps; ++s) stm[s] = 1.0f;
+  worker_mask[c.worker] = 1.0f;
+}
+
+}  // namespace
+
+extern "C" {
+
+int64_t kml_native_abi_version() { return 1; }
+
+// Assemble one sync round. All chunks must target distinct workers (the
+// epoch plan guarantees one chunk per worker per round), so threads never
+// write the same bytes. Buffers are caller-allocated and pre-zeroed.
+void kml_assemble_round(const uint8_t* x_src, const uint8_t* y_src,
+                        int64_t x_item, int64_t y_item,
+                        const int64_t* chunk_worker, const int64_t* chunk_lo,
+                        const int64_t* chunk_hi, const int64_t* chunk_steps,
+                        int64_t n_chunks, int64_t S, int64_t B,
+                        uint8_t* x_out, uint8_t* y_out, float* sample_mask,
+                        float* step_mask, float* worker_mask,
+                        int64_t n_threads) {
+  std::vector<Chunk> chunks(static_cast<size_t>(n_chunks));
+  for (int64_t i = 0; i < n_chunks; ++i) {
+    chunks[static_cast<size_t>(i)] = {chunk_worker[i], chunk_lo[i],
+                                      chunk_hi[i], chunk_steps[i]};
+  }
+  if (n_threads <= 1 || n_chunks <= 1) {
+    for (const Chunk& c : chunks) {
+      assemble_one(c, x_src, y_src, x_item, y_item, S, B, x_out, y_out,
+                   sample_mask, step_mask, worker_mask);
+    }
+    return;
+  }
+  std::atomic<int64_t> next(0);
+  auto worker = [&]() {
+    for (;;) {
+      const int64_t i = next.fetch_add(1);
+      if (i >= n_chunks) return;
+      assemble_one(chunks[static_cast<size_t>(i)], x_src, y_src, x_item,
+                   y_item, S, B, x_out, y_out, sample_mask, step_mask,
+                   worker_mask);
+    }
+  };
+  const int64_t nt = n_threads < n_chunks ? n_threads : n_chunks;
+  std::vector<std::thread> pool;
+  pool.reserve(static_cast<size_t>(nt));
+  for (int64_t t = 0; t < nt; ++t) pool.emplace_back(worker);
+  for (std::thread& th : pool) th.join();
+}
+
+}  // extern "C"

@@ -39,7 +39,7 @@ import numpy as np
 from kubeml_tpu.api.errors import KubeMLException, MergeError
 from kubeml_tpu.api.types import (History, JobHistory, MetricUpdate,
                                   TrainTask)
-from kubeml_tpu.data.loader import RoundLoader
+from kubeml_tpu.data.loader import RoundLoader, prefetch_rounds
 from kubeml_tpu.data.registry import DatasetRegistry
 from kubeml_tpu.models.base import KubeDataset, KubeModel
 from kubeml_tpu.parallel.kavg import KAvgEngine
@@ -245,7 +245,7 @@ class TrainJob:
                                  self.req.batch_size)
         loss_sums = np.zeros(0)
         step_counts = np.zeros(0)
-        for rb in self._loader.epoch_rounds(plan, epoch):
+        for rb in prefetch_rounds(self._loader.epoch_rounds(plan, epoch)):
             self.variables, stats = self._engine.train_round(
                 self.variables, rb.batch, rb.sample_mask, rb.step_mask,
                 rb.worker_mask, rb.rngs, lr=self.req.lr, epoch=epoch)
