@@ -1,0 +1,165 @@
+"""Experiment driver — grid sweeps over train submissions.
+
+Parity with the reference harness (ml/experiments/common/experiment.py:
+122-181): expand a parameter grid into TrainRequests, submit each through
+the client SDK, poll until the task leaves the task list, pull the
+persisted History, and derive the paper metrics — time-per-epoch,
+max accuracy, and time-to-accuracy (TTA) — from the per-epoch arrays.
+Results accumulate as plain dict rows; `to_frame` gives a pandas
+DataFrame when pandas is present.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import itertools
+import json
+import os
+import time
+from typing import Dict, Iterable, List, Optional
+
+from kubeml_tpu.api.types import History, TrainOptions, TrainRequest
+from kubeml_tpu.control.client import KubemlClient
+
+
+def expand_grid(grid: Dict[str, Iterable]) -> List[Dict]:
+    """Cartesian product of a parameter grid, reference-style
+    (ml/experiments/common/utils.py:12-28 defines grids as dicts of
+    lists)."""
+    keys = list(grid)
+    return [dict(zip(keys, vals))
+            for vals in itertools.product(*(grid[k] for k in keys))]
+
+
+def time_to_accuracy(history: History, goal_pct: float) -> Optional[float]:
+    """Seconds of training until validation accuracy first reaches
+    goal_pct, per the reference's TTA methodology (figures tta99/tta70;
+    goal-accuracy stop `ml/pkg/train/job.go:354-359`). None if never
+    reached."""
+    elapsed = 0.0
+    accs = history.data.accuracy
+    durs = history.data.epoch_duration
+    for i, dur in enumerate(durs):
+        elapsed += dur
+        if i < len(accs) and accs[i] >= goal_pct:
+            return elapsed
+    return None
+
+
+@dataclasses.dataclass
+class ExperimentResult:
+    job_id: str
+    config: Dict
+    history: History
+    wall_time: float
+
+    def row(self, tta_goals: Iterable[float] = ()) -> Dict:
+        h = self.history.data
+        row = dict(self.config)
+        row.update({
+            "job_id": self.job_id,
+            "wall_time_s": round(self.wall_time, 3),
+            "epochs_run": len(h.train_loss),
+            "train_time_s": round(sum(h.epoch_duration), 3),
+            "mean_epoch_s": (round(sum(h.epoch_duration)
+                                   / max(len(h.epoch_duration), 1), 3)),
+            "final_train_loss": h.train_loss[-1] if h.train_loss else None,
+            "final_accuracy": h.accuracy[-1] if h.accuracy else None,
+            "max_accuracy": max(h.accuracy) if h.accuracy else None,
+            "final_parallelism": (h.parallelism[-1]
+                                  if h.parallelism else None),
+        })
+        for goal in tta_goals:
+            row[f"tta{goal:g}_s"] = time_to_accuracy(self.history, goal)
+        return row
+
+
+class KubemlExperiment:
+    """Submit TrainRequests and collect results through the public API."""
+
+    def __init__(self, client: Optional[KubemlClient] = None,
+                 poll_interval: float = 0.5, timeout: float = 3600.0):
+        self.client = client or KubemlClient()
+        self.poll_interval = poll_interval
+        self.timeout = timeout
+        self.results: List[ExperimentResult] = []
+
+    def make_request(self, function: str, dataset: str, epochs: int,
+                     batch: int, lr: float, parallelism: int, k: int,
+                     static: bool = True, validate_every: int = 1,
+                     goal_accuracy: float = 100.0) -> TrainRequest:
+        return TrainRequest(
+            model_type=function, function_name=function, dataset=dataset,
+            epochs=epochs, batch_size=batch, lr=lr,
+            options=TrainOptions(default_parallelism=parallelism,
+                                 static_parallelism=static,
+                                 validate_every=validate_every, k=k,
+                                 goal_accuracy=goal_accuracy))
+
+    def run(self, req: TrainRequest, config: Optional[Dict] = None
+            ) -> ExperimentResult:
+        """Submit one request and block until its history is persisted."""
+        v1 = self.client.v1()
+        t0 = time.time()
+        job_id = v1.networks().train(req)
+        deadline = t0 + self.timeout
+        history = None
+        while time.time() < deadline:
+            running = {t.job_id for t in v1.tasks().list()}
+            if job_id not in running:
+                try:
+                    history = v1.histories().get(job_id)
+                    break
+                except Exception:
+                    pass  # finish raced ahead of the history write
+            time.sleep(self.poll_interval)
+        if history is None:
+            raise TimeoutError(f"job {job_id} did not finish in "
+                               f"{self.timeout}s")
+        result = ExperimentResult(job_id=job_id,
+                                  config=config or self._req_config(req),
+                                  history=history,
+                                  wall_time=time.time() - t0)
+        self.results.append(result)
+        return result
+
+    def run_grid(self, function: str, dataset: str, grid: Dict[str, Iterable],
+                 epochs: int, lr: float, on_result=None
+                 ) -> List[ExperimentResult]:
+        """Run the full cartesian grid; grid keys: batch, k, parallelism."""
+        out = []
+        for cfg in expand_grid(grid):
+            req = self.make_request(
+                function=function, dataset=dataset, epochs=epochs,
+                batch=cfg["batch"], lr=lr, parallelism=cfg["parallelism"],
+                k=cfg["k"])
+            full_cfg = {"function": function, "dataset": dataset,
+                        "epochs": epochs, "lr": lr, **cfg}
+            res = self.run(req, config=full_cfg)
+            out.append(res)
+            if on_result:
+                on_result(res)
+        return out
+
+    @staticmethod
+    def _req_config(req: TrainRequest) -> Dict:
+        return {"function": req.function_name or req.model_type,
+                "dataset": req.dataset, "epochs": req.epochs,
+                "lr": req.lr, "batch": req.batch_size,
+                "k": req.options.k,
+                "parallelism": req.options.default_parallelism}
+
+    # ------------------------------------------------------------- reporting
+
+    def rows(self, tta_goals: Iterable[float] = ()) -> List[Dict]:
+        return [r.row(tta_goals) for r in self.results]
+
+    def to_frame(self, tta_goals: Iterable[float] = ()):
+        import pandas as pd
+        return pd.DataFrame(self.rows(tta_goals))
+
+    def save_jsonl(self, path: str, tta_goals: Iterable[float] = ()) -> None:
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        with open(path, "w") as f:
+            for row in self.rows(tta_goals):
+                f.write(json.dumps(row) + "\n")

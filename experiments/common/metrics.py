@@ -1,0 +1,73 @@
+"""System-metric sampling during experiment runs.
+
+Parity with the reference's out-of-band collector
+(ml/experiments/common/metrics.py:95-160), which samples psutil/GPUtil
+every 2 seconds through a side Flask API. Here the sampler is in-process
+(the experiments and training share the TPU host), records CPU, memory,
+and this process's RSS, and snapshots results to JSON. GPU sampling is
+intentionally absent — accelerator-side behavior is captured by the
+per-epoch duration/parallelism arrays in the job History instead.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from typing import Dict, List, Optional
+
+try:
+    import psutil
+except ImportError:  # environment without psutil: sampler becomes a no-op
+    psutil = None
+
+
+class SystemMetricsSampler:
+    """Background sampler; start()/stop() around an experiment run."""
+
+    def __init__(self, interval: float = 2.0):
+        self.interval = interval
+        self.samples: List[Dict] = []
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._proc = psutil.Process() if psutil else None
+
+    def _sample(self) -> Dict:
+        return {
+            "ts": time.time(),
+            "cpu_pct": psutil.cpu_percent(interval=None),
+            "mem_pct": psutil.virtual_memory().percent,
+            "proc_rss_mb": self._proc.memory_info().rss / 2**20,
+        }
+
+    def _loop(self):
+        psutil.cpu_percent(interval=None)  # prime the counter
+        while not self._stop.wait(self.interval):
+            self.samples.append(self._sample())
+
+    def start(self) -> "SystemMetricsSampler":
+        if psutil is None:
+            return self
+        self._stop.clear()
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> List[Dict]:
+        if self._thread:
+            self._stop.set()
+            self._thread.join(timeout=self.interval + 1)
+            self._thread = None
+        return self.samples
+
+    def save(self, path: str) -> None:
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        with open(path, "w") as f:
+            json.dump(self.samples, f)
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop()

@@ -1,0 +1,30 @@
+"""Published sweep grids — the benchmark protocol of the reference.
+
+These reproduce the parameter grids of ml/experiments/common/utils.py:
+12-28 and ml/experiments/train.py:14-61 verbatim, so results are
+comparable sweep-for-sweep with the reference figures (BASELINE.md).
+"""
+
+# LeNet/MNIST: batch x K x parallelism, lr 0.01, 30 epochs, static
+# (ml/experiments/common/utils.py:12-16, train.py:14-38)
+LENET_GRID = {
+    "batch": [128, 64, 32, 16],
+    "k": [-1, 32, 16, 8],
+    "parallelism": [1, 2, 4, 8],
+}
+LENET_EPOCHS = 30
+LENET_LR = 0.01
+LENET_TTA_GOAL = 99.0  # TTA-99 figure (figures/paper/lenet/tta99.pdf)
+
+# ResNet/CIFAR-10: active grid of utils.py:18-28 (batch sweep, K=-1, p=8),
+# lr 0.1, 30 epochs (train.py:41-61). The reference uses ResNet-34; our
+# flagship config is ResNet-18 per BASELINE.json's north star, and the
+# same grid runs for either depth.
+RESNET_GRID = {
+    "batch": [256, 128, 64, 32],
+    "k": [-1],
+    "parallelism": [8],
+}
+RESNET_EPOCHS = 30
+RESNET_LR = 0.1
+RESNET_TTA_GOAL = 70.0  # TTA-70 figure (figures/paper/resnet34/tta70.pdf)

@@ -1,0 +1,133 @@
+"""Sweep driver — runs the published benchmark grids.
+
+Equivalent of ml/experiments/train.py: picks a grid (lenet | resnet),
+expands it, submits every config through the client SDK, and writes one
+JSONL row per run with epoch timings, accuracies, and TTA.
+
+Usage:
+    # against a running control plane
+    python -m experiments.train --grid lenet --controller http://host:port
+
+    # self-contained on this host (boots the control plane in-process)
+    python -m experiments.train --grid lenet --local --limit 4 \
+        --epochs 2 --out results/lenet.jsonl
+
+Datasets must already be registered (kubeml dataset create ...); --local
+accepts --synthetic to register a small synthetic stand-in so the full
+path runs anywhere.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+from experiments.common import utils as grids
+from experiments.common.experiment import KubemlExperiment, expand_grid
+from experiments.common.metrics import SystemMetricsSampler
+
+GRIDS = {
+    "lenet": dict(grid=grids.LENET_GRID, epochs=grids.LENET_EPOCHS,
+                  lr=grids.LENET_LR, tta=grids.LENET_TTA_GOAL,
+                  function="lenet", dataset="mnist"),
+    "resnet": dict(grid=grids.RESNET_GRID, epochs=grids.RESNET_EPOCHS,
+                   lr=grids.RESNET_LR, tta=grids.RESNET_TTA_GOAL,
+                   function="resnet18", dataset="cifar10"),
+}
+
+
+# input sample shapes for the sweep functions (dataset stand-ins)
+_SHAPES = {"lenet": (28, 28, 1), "resnet18": (32, 32, 3),
+           "resnet34": (32, 32, 3), "resnet50": (32, 32, 3),
+           "vgg11": (32, 32, 3), "mlp": (8,)}
+
+
+def _register_synthetic(client, name: str, function: str) -> None:
+    import tempfile
+
+    import numpy as np
+
+    shape = _SHAPES[function]
+    rng = np.random.RandomState(0)
+    with tempfile.TemporaryDirectory() as d:
+        paths = {}
+        for split, n in (("train", 512), ("test", 128)):
+            x = rng.rand(n, *shape).astype(np.float32)
+            y = rng.randint(0, 10, n).astype(np.int64)
+            np.save(f"{d}/x_{split}.npy", x)
+            np.save(f"{d}/y_{split}.npy", y)
+            paths[split] = (f"{d}/x_{split}.npy", f"{d}/y_{split}.npy")
+        client.v1().datasets().create(
+            name, paths["train"][0], paths["train"][1],
+            paths["test"][0], paths["test"][1])
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--grid", choices=sorted(GRIDS), required=True)
+    ap.add_argument("--controller", default=None,
+                    help="controller URL; omit with --local")
+    ap.add_argument("--local", action="store_true",
+                    help="boot the control plane in-process")
+    ap.add_argument("--synthetic", action="store_true",
+                    help="register a synthetic dataset if missing")
+    ap.add_argument("--epochs", type=int, default=None)
+    ap.add_argument("--limit", type=int, default=None,
+                    help="run only the first N grid configs")
+    ap.add_argument("--out", default=None, help="results JSONL path")
+    ap.add_argument("--metrics-out", default=None,
+                    help="system-metrics JSON path")
+    args = ap.parse_args(argv)
+
+    spec = GRIDS[args.grid]
+    dep = None
+    if args.local:
+        from kubeml_tpu.control.deployment import start_deployment
+        dep = start_deployment()
+        controller = dep.controller_url
+    else:
+        controller = args.controller
+
+    from kubeml_tpu.control.client import KubemlClient
+    client = KubemlClient(controller)
+    exp = KubemlExperiment(client)
+
+    try:
+        names = [d.name for d in client.v1().datasets().list()]
+        if spec["dataset"] not in names:
+            if not args.synthetic:
+                print(f"dataset {spec['dataset']} not registered "
+                      f"(use kubeml dataset create, or --synthetic)",
+                      file=sys.stderr)
+                return 1
+            _register_synthetic(client, spec["dataset"], spec["function"])
+
+        configs = expand_grid(spec["grid"])
+        if args.limit:
+            configs = configs[: args.limit]
+        epochs = args.epochs or spec["epochs"]
+        sampler = SystemMetricsSampler().start()
+        for i, cfg in enumerate(configs):
+            req = exp.make_request(
+                function=spec["function"], dataset=spec["dataset"],
+                epochs=epochs, batch=cfg["batch"], lr=spec["lr"],
+                parallelism=cfg["parallelism"], k=cfg["k"])
+            res = exp.run(req, config={"function": spec["function"],
+                                       "dataset": spec["dataset"],
+                                       "epochs": epochs, "lr": spec["lr"],
+                                       **cfg})
+            row = res.row([spec["tta"]])
+            print(f"[{i + 1}/{len(configs)}] {row}")
+        sampler.stop()
+        if args.out:
+            exp.save_jsonl(args.out, [spec["tta"]])
+        if args.metrics_out:
+            sampler.save(args.metrics_out)
+        return 0
+    finally:
+        if dep is not None:
+            dep.stop()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,88 @@
+"""Experiment harness tests: grid expansion, TTA math, live sweep."""
+
+import sys
+import os
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from experiments.common.experiment import (KubemlExperiment, expand_grid,
+                                           time_to_accuracy)
+from experiments.common.metrics import SystemMetricsSampler
+from kubeml_tpu.api.types import (History, JobHistory, TrainOptions,
+                                  TrainRequest)
+
+
+def _hist(accs, durs):
+    return History(
+        id="x",
+        task=TrainRequest(model_type="m", batch_size=1, epochs=len(accs),
+                          dataset="d", lr=0.1, options=TrainOptions()),
+        data=JobHistory(accuracy=list(accs), epoch_duration=list(durs),
+                        train_loss=[0.0] * len(accs),
+                        validation_loss=[0.0] * len(accs),
+                        parallelism=[1] * len(accs)))
+
+
+def test_expand_grid_cartesian():
+    grid = {"batch": [1, 2], "k": [-1], "parallelism": [4, 8]}
+    cfgs = expand_grid(grid)
+    assert len(cfgs) == 4
+    assert {"batch": 2, "k": -1, "parallelism": 8} in cfgs
+
+
+def test_time_to_accuracy():
+    h = _hist([50.0, 80.0, 95.0], [10.0, 10.0, 10.0])
+    assert time_to_accuracy(h, 70.0) == 20.0
+    assert time_to_accuracy(h, 95.0) == 30.0
+    assert time_to_accuracy(h, 99.0) is None
+
+
+def test_metrics_sampler_collects():
+    s = SystemMetricsSampler(interval=0.05)
+    with s:
+        import time
+        time.sleep(0.3)
+    assert len(s.samples) >= 2
+    assert {"ts", "cpu_pct", "mem_pct", "proc_rss_mb"} <= set(s.samples[0])
+
+
+@pytest.fixture()
+def live(tmp_path, tmp_home, mesh8, monkeypatch):
+    from kubeml_tpu.control.client import KubemlClient
+    from kubeml_tpu.control.deployment import start_deployment
+    dep = start_deployment(mesh=mesh8)
+    rng = np.random.RandomState(0)
+    y = rng.randint(0, 3, 600).astype(np.int32)
+    x = rng.randn(600, 8).astype(np.float32) * 1.5
+    x[np.arange(600), y * 2] += 3.0
+    paths = {}
+    for name, arr in (("xtr", x), ("ytr", y), ("xte", x[:100]),
+                      ("yte", y[:100])):
+        p = tmp_path / f"{name}.npy"
+        np.save(p, arr)
+        paths[name] = str(p)
+    client = KubemlClient(dep.controller_url)
+    client.v1().datasets().create("blobs", paths["xtr"], paths["ytr"],
+                                  paths["xte"], paths["yte"])
+    yield client
+    dep.stop()
+
+
+def test_grid_sweep_live(live):
+    exp = KubemlExperiment(live, timeout=300)
+    results = exp.run_grid("mlp", "blobs",
+                           {"batch": [32], "k": [2], "parallelism": [2, 4]},
+                           epochs=2, lr=0.1)
+    assert len(results) == 2
+    rows = exp.rows([50.0])
+    for row in rows:
+        assert row["epochs_run"] == 2
+        assert row["train_time_s"] > 0
+        assert row["max_accuracy"] is not None
+    # the blob task is separable: a 50%-accuracy TTA should be hit
+    assert any(r["tta50_s"] is not None for r in rows)
+    df = exp.to_frame([50.0])
+    assert {"batch", "parallelism", "tta50_s"} <= set(df.columns)
