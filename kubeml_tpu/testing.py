@@ -1,0 +1,26 @@
+"""Test/dry-run utilities.
+
+The container's sitecustomize may eagerly initialize a 1-chip accelerator
+backend at interpreter start, which makes env vars like
+``--xla_force_host_platform_device_count`` too late. The supported path to
+a multi-device virtual mesh without hardware is to clear the initialized
+backends and retarget JAX at N CPU devices — shared here so the test
+conftest and the driver dry-run entry use one copy of the (unstable
+extension API) recipe.
+"""
+
+from __future__ import annotations
+
+
+def ensure_virtual_cpu_devices(n: int) -> None:
+    """Make `jax.devices()` return at least n CPU devices (idempotent)."""
+    import jax
+
+    if len(jax.devices()) >= n and jax.devices()[0].platform == "cpu":
+        return
+    import jax.extend.backend
+    jax.extend.backend.clear_backends()
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_num_cpu_devices", n)
+    assert len(jax.devices()) >= n, \
+        f"failed to create {n} virtual CPU devices"

@@ -13,14 +13,9 @@ import sys
 # repo root on sys.path so `import kubeml_tpu` works without installation
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-import jax  # noqa: E402
-import jax.extend.backend  # noqa: E402
+from kubeml_tpu.testing import ensure_virtual_cpu_devices  # noqa: E402
 
-if len(jax.devices()) != 8 or jax.devices()[0].platform != "cpu":
-    jax.extend.backend.clear_backends()
-    jax.config.update("jax_platforms", "cpu")
-    jax.config.update("jax_num_cpu_devices", 8)
-    assert len(jax.devices()) == 8, "failed to create 8 virtual CPU devices"
+ensure_virtual_cpu_devices(8)
 
 import pytest  # noqa: E402
 
