@@ -21,7 +21,7 @@ GPU_BASELINE_SAMPLES_PER_SEC = 2000.0
 BATCH = 256        # per-step batch per worker
 STEPS_PER_ROUND = 8   # K local steps per sync round
 WARMUP_ROUNDS = 2
-TIMED_ROUNDS = 5
+TIMED_ROUNDS = 10
 
 
 def main():
@@ -56,14 +56,24 @@ def main():
         return engine.train_round(variables, batch, rngs=rngs, lr=0.1,
                                   epoch=epoch, **masks)
 
+    # Synchronize via device->host readbacks, not block_until_ready:
+    # tunneled backends can report ready before execution completes, which
+    # would inflate the number. Reading both the last round's loss and an
+    # element derived from the returned (averaged) variables waits for the
+    # full dependency chain including the final merge psum.
+    def sync(variables, stats):
+        _ = stats.loss_sum
+        leaf = jax.tree_util.tree_leaves(variables)[0]
+        _ = np.asarray(leaf.ravel()[:1])
+
     for i in range(WARMUP_ROUNDS):
-        variables, _ = round_(variables, i)
-    jax.block_until_ready(variables)
+        variables, stats = round_(variables, i)
+    sync(variables, stats)
 
     t0 = time.perf_counter()
     for i in range(TIMED_ROUNDS):
-        variables, _ = round_(variables, i)
-    jax.block_until_ready(variables)
+        variables, stats = round_(variables, i)
+    sync(variables, stats)
     elapsed = time.perf_counter() - t0
 
     samples = TIMED_ROUNDS * W * S * B

@@ -35,7 +35,6 @@ averaged model). N < W is expressed through the worker mask.
 
 from __future__ import annotations
 
-import dataclasses
 from functools import partial
 from typing import Any, Callable, Dict, Optional, Tuple
 
@@ -59,14 +58,36 @@ MetricsFn = Callable[[PyTree, PyTree], Dict[str, jax.Array]]
 TxFactory = Callable[[jax.Array, jax.Array], optax.GradientTransformation]
 
 
-@dataclasses.dataclass
 class RoundStats:
-    """Host-side view of one sync round's outcome."""
+    """Host-side view of one sync round's outcome.
 
-    loss_sum: np.ndarray      # [W] masked sum of per-step mean losses
-    step_count: np.ndarray    # [W] real local steps taken
-    sample_count: np.ndarray  # [W] real samples consumed
-    contributors: float       # number of workers merged
+    `loss_sum` materializes LAZILY: reading it blocks on the round and
+    costs a device->host readback (tens of ms on tunneled backends), so
+    dispatch loops should accumulate `loss_sum_device` on device and read
+    back once per epoch. `step_count`, `sample_count`, and `contributors`
+    are host-derived from the masks (free — the merge's contributor count
+    is exactly `worker_mask.sum()`).
+    """
+
+    def __init__(self, loss_sum_device: jax.Array, step_count: np.ndarray,
+                 sample_count: np.ndarray, contributors: float):
+        self.loss_sum_device = loss_sum_device    # [W] device array
+        self.step_count = step_count              # [W] real local steps
+        self.sample_count = sample_count          # [W] real samples
+        self.contributors = contributors          # workers merged
+        self._loss_sum: Optional[np.ndarray] = None
+
+    @property
+    def loss_sum(self) -> np.ndarray:
+        """[W] masked sum of per-step mean losses (synchronizing)."""
+        if self._loss_sum is None:
+            self._loss_sum = np.asarray(self.loss_sum_device)
+        return self._loss_sum
+
+    def __repr__(self):
+        return (f"RoundStats(steps={self.step_count.sum():.0f}, "
+                f"samples={self.sample_count.sum():.0f}, "
+                f"contributors={self.contributors:.0f})")
 
 
 def _select_tree(mask: jax.Array, new: PyTree, old: PyTree) -> PyTree:
@@ -168,13 +189,13 @@ class KAvgEngine:
             avg = jax.tree_util.tree_map(
                 lambda c, ref: (lax.psum(c, DATA_AXIS) / count).astype(ref.dtype),
                 contrib, variables)
-            return avg, jnp.stack(loss_sums), raw_count
+            return avg, jnp.stack(loss_sums)
 
         sharded = jax.shard_map(
             lane_fn, mesh=mesh,
             in_specs=(P(), P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS),
                       P(DATA_AXIS), P(DATA_AXIS), P(), P()),
-            out_specs=(P(), P(DATA_AXIS), P()),
+            out_specs=(P(), P(DATA_AXIS)),
             check_vma=False)
         donate = (0,) if self.donate else ()
         return jax.jit(sharded, donate_argnums=donate)
@@ -201,7 +222,7 @@ class KAvgEngine:
 
         # shard_map slices dim 0 contiguously: lane d owns virtual workers
         # [d*W/D, (d+1)*W/D) — matching the reference's contiguous doc shards.
-        avg, loss_sums, count = self._train_cache[key](
+        avg, loss_sums = self._train_cache[key](
             variables, batch,
             jnp.asarray(sample_mask, jnp.float32),
             jnp.asarray(step_mask, jnp.float32),
@@ -209,10 +230,10 @@ class KAvgEngine:
             jnp.asarray(rngs, jnp.uint32),
             jnp.float32(lr), jnp.int32(epoch))
         stats = RoundStats(
-            loss_sum=np.asarray(loss_sums),
+            loss_sum_device=loss_sums,
             step_count=np.asarray(step_mask).sum(axis=1),
             sample_count=np.asarray(sample_mask).sum(axis=(1, 2)),
-            contributors=float(count),
+            contributors=float(np.asarray(worker_mask).sum()),
         )
         return avg, stats
 

@@ -243,21 +243,28 @@ class TrainJob:
     def _train_epoch(self, parallelism: int, epoch: int) -> float:
         plan = self._loader.plan(parallelism, self.req.options.k,
                                  self.req.batch_size)
-        loss_sums = np.zeros(0)
+        # Loss is accumulated ON DEVICE and read back once per epoch: a
+        # per-round readback would serialize dispatch and costs tens of ms
+        # on tunneled backends (see RoundStats). The zero-contributor check
+        # uses the host-side worker mask, which fully determines the device
+        # contributor count.
+        dev_loss = None
         step_counts = np.zeros(0)
         for rb in prefetch_rounds(self._loader.epoch_rounds(plan, epoch)):
-            self.variables, stats = self._engine.train_round(
-                self.variables, rb.batch, rb.sample_mask, rb.step_mask,
-                rb.worker_mask, rb.rngs, lr=self.req.lr, epoch=epoch)
-            if stats.contributors < 1 or rb.worker_mask.sum() < 1:
+            if rb.worker_mask.sum() < 1:
                 # all workers lost: abort like job.go:188-193
                 raise MergeError(
                     f"round {rb.round_index}: no workers contributed")
-            if loss_sums.size == 0:
-                loss_sums = np.zeros(len(stats.loss_sum))
-                step_counts = np.zeros(len(stats.loss_sum))
-            loss_sums += stats.loss_sum
+            self.variables, stats = self._engine.train_round(
+                self.variables, rb.batch, rb.sample_mask, rb.step_mask,
+                rb.worker_mask, rb.rngs, lr=self.req.lr, epoch=epoch)
+            if step_counts.size == 0:
+                step_counts = np.zeros(len(stats.step_count))
             step_counts += stats.step_count
+            dev_loss = stats.loss_sum_device if dev_loss is None \
+                else dev_loss + stats.loss_sum_device
+        loss_sums = np.asarray(dev_loss) if dev_loss is not None \
+            else np.zeros(0)
         # per-worker epoch loss, then unweighted mean over workers that ran
         # (reference aggregation ml/pkg/train/util.go:82-98)
         ran = step_counts > 0
