@@ -208,19 +208,49 @@ def cmd_logs(args):
 # --------------------------------------------------------------------- serve
 
 def cmd_serve(args):
-    from kubeml_tpu.control.deployment import start_deployment
+    """Role mux, parity with the reference's single binary whose role is
+    chosen by flag (ml/cmd/ml/main.go:60-156): --role all boots the whole
+    control plane in one process; a single role binds only that service
+    and reaches its peers through the --*-url flags / KUBEML_*_URL env."""
+    from kubeml_tpu.api import const
     from kubeml_tpu.parallel.mesh import make_mesh
     mesh = make_mesh(n_data=args.mesh_data) if args.mesh_data else None
-    dep = start_deployment(mesh=mesh, use_default_ports=not args.free_ports)
-    print(f"controller: {dep.controller.url}")
-    print(f"scheduler:  {dep.scheduler.url}")
-    print(f"ps:         {dep.ps.url}  (metrics at {dep.ps.url}/metrics)")
-    print(f"storage:    {dep.storage.url}")
+
+    if args.role == "all":
+        from kubeml_tpu.control.deployment import start_deployment
+        svc = start_deployment(mesh=mesh,
+                               use_default_ports=not args.free_ports)
+        if args.standalone_jobs:
+            svc.ps.standalone_jobs = True
+        print(f"controller: {svc.controller.url}")
+        print(f"scheduler:  {svc.scheduler.url}")
+        print(f"ps:         {svc.ps.url}  (metrics at {svc.ps.url}/metrics)")
+        print(f"storage:    {svc.storage.url}")
+    elif args.role == "controller":
+        from kubeml_tpu.control.controller import Controller
+        svc = Controller(scheduler_url=args.scheduler_url,
+                         ps_url=args.ps_url, storage_url=args.storage_url,
+                         port=args.port or const.CONTROLLER_PORT)
+    elif args.role == "scheduler":
+        from kubeml_tpu.control.scheduler import Scheduler
+        svc = Scheduler(ps_url=args.ps_url,
+                        port=args.port or const.SCHEDULER_PORT)
+    elif args.role == "ps":
+        from kubeml_tpu.control.ps import ParameterServer
+        svc = ParameterServer(mesh=mesh, port=args.port or const.PS_PORT,
+                              scheduler_url=args.scheduler_url,
+                              standalone_jobs=args.standalone_jobs or None)
+    else:  # storage
+        from kubeml_tpu.control.storage import StorageService
+        svc = StorageService(port=args.port or const.STORAGE_PORT)
+    if args.role != "all":
+        svc.start()
+        print(f"{args.role}: {svc.url}")
     try:
         while True:
             time.sleep(3600)
     except KeyboardInterrupt:
-        dep.stop()
+        svc.stop()
 
 
 # ---------------------------------------------------------------------- main
@@ -302,6 +332,23 @@ def build_parser() -> argparse.ArgumentParser:
     s.add_argument("--mesh-data", type=int, default=0,
                    help="data-axis size (default: all devices)")
     s.add_argument("--free-ports", action="store_true")
+    s.add_argument("--role", default="all",
+                   choices=["all", "controller", "scheduler", "ps",
+                            "storage"],
+                   help="run one role (reference main.go:60-156); the "
+                        "job role runs via python -m "
+                        "kubeml_tpu.train.jobserver")
+    s.add_argument("--port", type=int, default=0,
+                   help="port for a single role (default: the role's "
+                        "standard port)")
+    s.add_argument("--scheduler-url", default=os.environ.get(
+        "KUBEML_SCHEDULER_URL"))
+    s.add_argument("--ps-url", default=os.environ.get("KUBEML_PS_URL"))
+    s.add_argument("--storage-url", default=os.environ.get(
+        "KUBEML_STORAGE_URL"))
+    s.add_argument("--standalone-jobs", action="store_true",
+                   help="run each job as its own process "
+                        "(STANDALONE_JOBS=true equivalent)")
     s.set_defaults(fn=cmd_serve)
     return p
 

@@ -18,16 +18,31 @@ REST surface (ml/pkg/ps/api.go:335-345):
                              which only works while the job's tensors exist;
                              checkpoints fix that, SURVEY.md §3.3)
 
-Jobs run as threads in this process — the reference's "threaded mode"
-(STANDALONE_JOBS=false, ml/pkg/ps/api.go:211-217). The pod-per-job mode
-maps to process-per-job on a TPU host and can be layered on later; the mesh
-is shared either way since all chips belong to this host's slice.
+Job execution has the reference's two modes (STANDALONE_JOBS env,
+ml/cmd/ml/main.go:115-133):
+
+  - threaded (default): the job runs as a thread of this process, sharing
+    the device mesh — the natural mode on a TPU host, where one process
+    owns the chips (reference threaded mode, ml/pkg/ps/api.go:211-217);
+  - standalone (STANDALONE_JOBS=true): one child PROCESS per job running
+    `python -m kubeml_tpu.train.jobserver`, spoken to over the same
+    per-job REST surface as the reference's job pod (creation + readiness
+    wait + retried /start mirror ml/pkg/ps/job_pod.go:18-62 and
+    ml/pkg/ps/api.go:192-207). Use when jobs should be isolated (CPU
+    hosts, or TPU hosts where each job is pinned to a distinct device
+    subset via JAX visible-devices env vars).
 """
 
 from __future__ import annotations
 
 import logging
+import os
+import shutil
+import subprocess
+import sys
+import tempfile
 import threading
+import time
 from typing import Dict, Optional
 
 import numpy as np
@@ -49,23 +64,74 @@ logger = logging.getLogger("kubeml_tpu.ps")
 
 
 class _JobRecord:
-    def __init__(self, task: TrainTask, job: TrainJob,
-                 thread: threading.Thread):
+    """A running job: either a thread of this process (job + thread set)
+    or a standalone child process (proc + url set)."""
+
+    def __init__(self, task: TrainTask, job: Optional[TrainJob] = None,
+                 thread: Optional[threading.Thread] = None,
+                 proc: Optional[subprocess.Popen] = None,
+                 url: Optional[str] = None):
         self.task = task
         self.job = job
         self.thread = thread
+        self.proc = proc
+        self.url = url
         self.next_parallelism: Optional[int] = None
         self.update_event = threading.Event()
+
+    def push_update(self, parallelism: int):
+        if self.proc is not None and self.url is None:
+            raise KubeMLException(
+                f"job {self.task.job_id} still starting", 503)
+        if self.url is not None:
+            http_json("POST", f"{self.url}/update",
+                      {"parallelism": parallelism})
+        else:
+            self.next_parallelism = parallelism
+            self.update_event.set()
+
+    def request_stop(self):
+        if self.url is not None:
+            http_json("DELETE", f"{self.url}/stop")
+        elif self.job is not None:
+            self.job.stop()
+        else:
+            raise KubeMLException(
+                f"job {self.task.job_id} still starting", 503)
+
+    def join(self, timeout: Optional[float]) -> bool:
+        """True when the job is no longer running."""
+        if self.proc is not None:
+            try:
+                self.proc.wait(timeout)
+            except subprocess.TimeoutExpired:
+                return False
+            return True
+        self.thread.join(timeout)
+        return not self.thread.is_alive()
 
 
 class ParameterServer(JsonService):
     name = "ps"
 
     def __init__(self, mesh=None, port: int = 0,
-                 scheduler_url: Optional[str] = None):
+                 scheduler_url: Optional[str] = None,
+                 standalone_jobs: Optional[bool] = None,
+                 job_env: Optional[Dict[str, str]] = None):
         super().__init__(port=port)
-        self.mesh = mesh if mesh is not None else make_mesh()
+        # Lazy mesh: in standalone mode the PARENT must not initialize the
+        # accelerator backend (on TPU, libtpu is single-process-exclusive —
+        # the chips belong to the job processes). The mesh is only built
+        # when a threaded job actually needs it.
+        self._mesh = mesh
         self.scheduler_url = scheduler_url
+        if standalone_jobs is None:  # reference env toggle, main.go:115-133
+            standalone_jobs = os.environ.get(
+                "STANDALONE_JOBS", "").lower() in ("1", "true", "yes")
+        self.standalone_jobs = standalone_jobs
+        # extra env for standalone job processes (e.g. per-job TPU
+        # visible-devices pinning)
+        self.job_env = job_env or {}
         self.jobs: Dict[str, _JobRecord] = {}
         self._jobs_lock = threading.RLock()
         self.metrics = MetricsRegistry()
@@ -82,6 +148,12 @@ class ParameterServer(JsonService):
         self.route("GET", "/metrics", self._h_prom)
         self.route("POST", "/infer", self._h_infer)
 
+    @property
+    def mesh(self):
+        if self._mesh is None:
+            self._mesh = make_mesh()
+        return self._mesh
+
     # ------------------------------------------------------------- handlers
 
     def _h_start(self, req: Request):
@@ -95,8 +167,7 @@ class ParameterServer(JsonService):
             rec = self.jobs.get(job_id)
         if rec is None:
             raise JobNotFoundError(job_id)
-        rec.next_parallelism = int(req.body["parallelism"])
-        rec.update_event.set()
+        rec.push_update(int(req.body["parallelism"]))
         return {"ok": True}
 
     def _h_metrics(self, req: Request):
@@ -114,7 +185,7 @@ class ParameterServer(JsonService):
             rec = self.jobs.get(job_id)
         if rec is None:
             raise JobNotFoundError(job_id)
-        rec.job.stop()
+        rec.request_stop()
         rec.task.state = "stopping"
         return {"ok": True}
 
@@ -140,8 +211,12 @@ class ParameterServer(JsonService):
     # ------------------------------------------------------------- job mgmt
 
     def start_task(self, task: TrainTask) -> None:
-        """Instantiate model/dataset from the function registry and launch
-        the job thread (ps/api.go:139-222 without the pod machinery)."""
+        """Launch the job: as a child process in standalone mode
+        (ps/api.go:139-222, pod -> process) or as a thread otherwise
+        (ps/api.go:211-217)."""
+        if self.standalone_jobs:
+            self._start_standalone(task)
+            return
         fn_name = task.parameters.function_name or task.parameters.model_type
         model_cls, dataset_cls = self.fn_registry.resolve(fn_name)
         model = model_cls()
@@ -174,6 +249,103 @@ class ParameterServer(JsonService):
             job.train()
         except Exception:
             logger.exception("job %s thread failed", job.task.job_id)
+
+    # ------------------------------------------------------- standalone mode
+
+    def _start_standalone(self, task: TrainTask) -> None:
+        """Spawn the per-job server process and hand it the task — the
+        reference's pod creation + readiness wait + retried StartTask
+        (ps/job_pod.go:18-62, ps/api.go:192-207), process-shaped.
+
+        The job id is reserved in the index BEFORE spawning, so duplicate
+        submissions are rejected up front and an immediately-failing child
+        whose /finish races this method still finds its record. The parent
+        deliberately makes no JAX calls here: on TPU the chips belong to
+        the job processes (each can be pinned to a device subset via
+        JAX/TPU visible-devices env vars passed through `job_env`)."""
+        rec = _JobRecord(task)
+        with self._jobs_lock:
+            if task.job_id in self.jobs:
+                raise InvalidArgsError(f"job {task.job_id} already exists")
+            self.jobs[task.job_id] = rec
+        self.metrics.running_total.inc("train")
+        task.state = "starting"
+
+        tmp_dir = tempfile.mkdtemp(prefix=f"kubeml-job-{task.job_id}-")
+        port_file = os.path.join(tmp_dir, "port")
+        cmd = [sys.executable, "-m", "kubeml_tpu.train.jobserver",
+               "--job-id", task.job_id, "--ps-url", self.url,
+               "--port-file", port_file]
+        if self._mesh is not None:
+            # explicit mesh: size hint + (tests) mirror a virtual-CPU view
+            from kubeml_tpu.parallel.mesh import data_axis_size
+            cmd += ["--mesh-data", str(data_axis_size(self._mesh))]
+            devs = self._mesh.devices.ravel()
+            if devs[0].platform == "cpu":
+                cmd += ["--virtual-cpu-devices", str(len(devs))]
+        if self.scheduler_url:
+            cmd += ["--scheduler-url", self.scheduler_url]
+        env = dict(os.environ)
+        env.update(self.job_env)
+        repo_root = os.path.dirname(os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__))))
+        env["PYTHONPATH"] = repo_root + os.pathsep + env.get("PYTHONPATH", "")
+        try:
+            rec.proc = subprocess.Popen(cmd, env=env)
+            rec.url = self._wait_job_ready(rec.proc, port_file)
+            # retried start push, parity ps/api.go:192-207 (10x backoff)
+            delay = 0.1
+            for attempt in range(10):
+                try:
+                    http_json("POST", f"{rec.url}/start", task.to_dict())
+                    break
+                except KubeMLException:
+                    if attempt == 9:
+                        raise
+                    time.sleep(delay)
+                    delay = min(delay * 2, 5.0)
+        except Exception:
+            with self._jobs_lock:
+                popped = self.jobs.pop(task.job_id, None)
+            if popped is not None:  # not already finished via /finish
+                self.metrics.running_total.inc("train", -1.0)
+            if rec.proc is not None:
+                rec.proc.terminate()
+            raise
+        finally:
+            shutil.rmtree(tmp_dir, ignore_errors=True)
+        task.state = "running"
+
+    def _wait_job_ready(self, proc: subprocess.Popen, port_file: str,
+                        timeout: float = 120.0) -> str:
+        """Poll for the child's bound port, then its /health — the
+        reference's waitForPodRunning loop (job_pod.go:18-62; longer
+        timeout here because the child pays JAX import + backend init)."""
+        deadline = time.monotonic() + timeout
+        while not os.path.exists(port_file):
+            if proc.poll() is not None:
+                raise KubeMLException(
+                    f"job process exited with {proc.returncode} "
+                    "before binding", 500)
+            if time.monotonic() > deadline:
+                proc.terminate()
+                raise KubeMLException("job process start timed out", 500)
+            time.sleep(0.1)
+        with open(port_file) as f:
+            url = f"http://127.0.0.1:{int(f.read())}"
+        while True:
+            try:
+                http_json("GET", f"{url}/health")
+                return url
+            except KubeMLException:
+                if proc.poll() is not None:
+                    raise KubeMLException(
+                        f"job process exited with {proc.returncode} "
+                        "before becoming healthy", 500)
+                if time.monotonic() > deadline:
+                    proc.terminate()
+                    raise
+                time.sleep(0.2)
 
     def _request_parallelism(self, task: TrainTask) -> Optional[int]:
         """Between-epoch parallelism negotiation (job.go:196-215)."""
@@ -211,6 +383,11 @@ class ParameterServer(JsonService):
             rec = self.jobs.pop(job_id, None)
         if rec is None:
             return
+        if rec.proc is not None:
+            # the job process exits after its finish notification; reap it
+            # off-thread so this handler (called BY that process) returns
+            threading.Thread(target=self._reap, args=(rec.proc,),
+                             name=f"reap-{job_id}", daemon=True).start()
         self.metrics.clear_job(job_id)
         self.metrics.running_total.inc("train", -1.0)
         if error:
@@ -222,12 +399,19 @@ class ParameterServer(JsonService):
                 logger.warning("could not notify scheduler finish: %s",
                                e.message)
 
+    def _reap(self, proc: subprocess.Popen):
+        try:
+            proc.wait(30.0)
+        except subprocess.TimeoutExpired:
+            logger.warning("job process %d did not exit; killing", proc.pid)
+            proc.kill()
+            proc.wait()
+
     def wait_for_job(self, job_id: str, timeout: Optional[float] = None
                      ) -> bool:
-        """Test/experiment helper: join a job thread."""
+        """Test/experiment helper: join a job thread/process."""
         with self._jobs_lock:
             rec = self.jobs.get(job_id)
         if rec is None:
             return True
-        rec.thread.join(timeout)
-        return not rec.thread.is_alive()
+        return rec.join(timeout)

@@ -1,0 +1,207 @@
+"""Standalone per-job server — the reference's job pod, as a process.
+
+Parity with the reference's pod-per-job deployment: the PS creates one
+pod per training job running `/kubeml --jobPort 9090 --jobId <id>`
+(ml/pkg/ps/job_pod.go:140-217) whose TrainJob exposes a per-job REST API
+(ml/pkg/train/api.go:141-149). Here the job is a child PROCESS on the TPU
+host with the same surface:
+
+    POST   /start     receive the TrainTask, begin training
+    POST   /update    next-epoch parallelism push {"parallelism": N}
+    DELETE /stop      graceful stop at the next epoch boundary
+    GET    /health    readiness probe (built into JsonService)
+
+(The reference's POST /next/{funcId} merge barrier has no equivalent:
+the N serverless functions collapsed into the compiled K-avg round, so
+there is no per-function HTTP rendezvous — SURVEY.md §2b.)
+
+Control-plane callbacks run over HTTP, exactly like the reference job
+pod: metric pushes to the PS (`POST {ps}/metrics/{jobId}`,
+ml/pkg/train/util.go:19-50), re-parallelization requests to the scheduler
+(`POST {scheduler}/job` then block for the PS-relayed `/update`,
+ml/pkg/train/job.go:196-215), and the finish notification
+(`POST {ps}/finish/{jobId}`, ml/pkg/ps/client/client.go:142-160).
+
+Run directly (the reference's `--jobPort --jobId` role of the single
+binary, ml/cmd/ml/main.go:60-156):
+
+    python -m kubeml_tpu.train.jobserver --job-id abc123 \
+        --ps-url http://host:port --scheduler-url http://host:port \
+        [--port 9090] [--port-file /path] [--mesh-data N] \
+        [--virtual-cpu-devices N]
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import os
+import threading
+from typing import Optional
+
+from kubeml_tpu.api.errors import InvalidArgsError, KubeMLException
+from kubeml_tpu.api.types import MetricUpdate, TrainTask
+from kubeml_tpu.control.httpd import JsonService, Request, http_json
+
+logger = logging.getLogger("kubeml_tpu.jobserver")
+
+
+class JobServer(JsonService):
+    name = "job"
+
+    def __init__(self, job_id: str, ps_url: Optional[str] = None,
+                 scheduler_url: Optional[str] = None, port: int = 0,
+                 mesh=None):
+        super().__init__(port=port)
+        self.job_id = job_id
+        self.ps_url = ps_url
+        self.scheduler_url = scheduler_url
+        self.mesh = mesh
+        self.finished = threading.Event()  # set after the job ends
+        self.exit_error: Optional[str] = None
+        self._job = None
+        self._job_thread: Optional[threading.Thread] = None
+        self._next_parallelism: Optional[int] = None
+        self._update_event = threading.Event()
+
+        self.route("POST", "/start", self._h_start)
+        self.route("POST", "/update", self._h_update)
+        self.route("DELETE", "/stop", self._h_stop)
+
+    # ------------------------------------------------------------- handlers
+
+    def _h_start(self, req: Request):
+        if self._job is not None:
+            raise InvalidArgsError(f"job {self.job_id} already started")
+        task = TrainTask.from_dict(req.body)
+        if task.job_id != self.job_id:
+            raise InvalidArgsError(
+                f"task {task.job_id} sent to job server {self.job_id}")
+        self._launch(task)
+        return {"job_id": self.job_id}
+
+    def _h_update(self, req: Request):
+        self._next_parallelism = int(req.body["parallelism"])
+        self._update_event.set()
+        return {"ok": True}
+
+    def _h_stop(self, req: Request):
+        if self._job is None:
+            raise InvalidArgsError("job not started")
+        self._job.stop()
+        return {"ok": True}
+
+    # ------------------------------------------------------------ lifecycle
+
+    def _launch(self, task: TrainTask):
+        from kubeml_tpu.api.const import kubeml_home
+        from kubeml_tpu.data.registry import DatasetRegistry
+        from kubeml_tpu.models.base import KubeDataset
+        from kubeml_tpu.parallel.mesh import make_mesh
+        from kubeml_tpu.train.functionlib import FunctionRegistry
+        from kubeml_tpu.train.history import HistoryStore
+        from kubeml_tpu.train.job import JobCallbacks, TrainJob
+
+        fn_name = task.parameters.function_name or task.parameters.model_type
+        model_cls, dataset_cls = FunctionRegistry().resolve(fn_name)
+        model = model_cls()
+        dataset = (dataset_cls(task.parameters.dataset) if dataset_cls
+                   else KubeDataset(task.parameters.dataset))
+        self._job = TrainJob(
+            task, model, dataset,
+            self.mesh if self.mesh is not None else make_mesh(),
+            registry=DatasetRegistry(),
+            history_store=HistoryStore(),
+            callbacks=JobCallbacks(
+                request_parallelism=self._request_parallelism,
+                publish_metrics=self._publish_metrics,
+                on_finish=self._on_finish),
+            log_file=os.path.join(kubeml_home(), "logs",
+                                  f"{task.job_id}.log"))
+        self._job_thread = threading.Thread(
+            target=self._run, name=f"job-{self.job_id}", daemon=True)
+        self._job_thread.start()
+
+    def _run(self):
+        try:
+            self._job.train()
+        except Exception:
+            logger.exception("job %s failed", self.job_id)
+            self.finished.set()  # train() reports on_finish itself; backstop
+
+    # ------------------------------------------------------------ callbacks
+
+    def _request_parallelism(self, task: TrainTask) -> Optional[int]:
+        """job.go:196-215 over HTTP: ask the scheduler, then block for the
+        PS-relayed POST /update."""
+        if self.scheduler_url is None:
+            return None
+        self._update_event.clear()
+        try:
+            http_json("POST", f"{self.scheduler_url}/job", task.to_dict())
+        except KubeMLException as e:
+            logger.warning("scheduler unreachable: %s", e.message)
+            return None
+        if not self._update_event.wait(timeout=60.0):
+            logger.warning("no parallelism update within 60s")
+            return None
+        self._update_event.clear()
+        return self._next_parallelism
+
+    def _publish_metrics(self, m: MetricUpdate):
+        if self.ps_url is None:
+            return
+        try:
+            http_json("POST", f"{self.ps_url}/metrics/{self.job_id}",
+                      m.to_dict())
+        except KubeMLException as e:
+            logger.warning("metric push failed: %s", e.message)
+
+    def _on_finish(self, job_id: str, error: Optional[str]):
+        self.exit_error = error
+        if self.ps_url is not None:
+            try:
+                http_json("POST", f"{self.ps_url}/finish/{job_id}",
+                          {"error": error})
+            except KubeMLException as e:
+                logger.warning("finish notification failed: %s", e.message)
+        self.finished.set()
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(prog="kubeml-job")
+    p.add_argument("--job-id", required=True)
+    p.add_argument("--ps-url", default=None)
+    p.add_argument("--scheduler-url", default=None)
+    p.add_argument("--port", type=int, default=0)
+    p.add_argument("--port-file", default=None,
+                   help="write the bound port here (parent discovery)")
+    p.add_argument("--mesh-data", type=int, default=0,
+                   help="data-axis size (default: all devices)")
+    p.add_argument("--virtual-cpu-devices", type=int, default=0,
+                   help="retarget JAX at N virtual CPU devices (tests)")
+    args = p.parse_args(argv)
+
+    logging.basicConfig(level=logging.INFO)
+    if args.virtual_cpu_devices:
+        from kubeml_tpu.testing import ensure_virtual_cpu_devices
+        ensure_virtual_cpu_devices(args.virtual_cpu_devices)
+
+    from kubeml_tpu.parallel.mesh import make_mesh
+    mesh = make_mesh(n_data=args.mesh_data or None)
+    server = JobServer(args.job_id, ps_url=args.ps_url,
+                       scheduler_url=args.scheduler_url, port=args.port,
+                       mesh=mesh)
+    port = server.start()
+    if args.port_file:
+        tmp = args.port_file + ".tmp"
+        with open(tmp, "w") as f:
+            f.write(str(port))
+        os.replace(tmp, args.port_file)  # atomic: parent never reads partial
+    logger.info("job server %s on port %d", args.job_id, port)
+    server.finished.wait()
+    server.stop()
+
+
+if __name__ == "__main__":
+    main()

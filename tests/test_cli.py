@@ -98,3 +98,15 @@ def test_cli_validation_errors(stack, capsys):
                 "-b", "4096", "--lr", "0.1")
     err = capsys.readouterr().err
     assert "batch" in err
+
+
+def test_serve_role_flags_parse():
+    from kubeml_tpu.cli.main import build_parser
+    p = build_parser()
+    args = p.parse_args(["serve", "--role", "ps", "--port", "9999",
+                         "--scheduler-url", "http://h:1",
+                         "--standalone-jobs"])
+    assert args.role == "ps" and args.port == 9999
+    assert args.scheduler_url == "http://h:1" and args.standalone_jobs
+    args = p.parse_args(["serve"])
+    assert args.role == "all" and not args.standalone_jobs

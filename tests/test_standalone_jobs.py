@@ -1,0 +1,101 @@
+"""Standalone (process-per-job) mode: PS spawns a jobserver child process
+and speaks the reference's per-job REST surface to it.
+
+Mirrors the reference's STANDALONE_JOBS=true pod-per-job deployment
+(ml/pkg/ps/job_pod.go + ml/pkg/train/api.go:141-149): job in its own
+process, /start pushed with retries after readiness, scheduler updates
+relayed through PS POST /update/{jobId} -> job POST /update, metric and
+finish notifications flowing back over HTTP.
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+from kubeml_tpu.api.errors import KubeMLException
+from kubeml_tpu.api.types import TrainOptions, TrainRequest
+from kubeml_tpu.control.client import KubemlClient
+from kubeml_tpu.control.deployment import start_deployment
+
+from tests.test_control_plane import wait_history, write_blob_files
+
+
+@pytest.fixture()
+def standalone_stack(tmp_path, tmp_home, mesh8, monkeypatch):
+    monkeypatch.setenv("STANDALONE_JOBS", "true")
+    dep = start_deployment(mesh=mesh8)
+    assert dep.ps.standalone_jobs
+    client = KubemlClient(dep.controller_url)
+    yield dep, client, tmp_path
+    dep.stop()
+
+
+def test_standalone_train_updates_and_infer(standalone_stack):
+    dep, client, tmp_path = standalone_stack
+    paths = write_blob_files(tmp_path)
+    client.v1().datasets().create(
+        "blobs", paths["xtr"], paths["ytr"], paths["xte"], paths["yte"])
+
+    # dynamic parallelism: exercises the full relay chain
+    # child -> scheduler /job -> PS /update/{jobId} -> child /update
+    req = TrainRequest(model_type="mlp", batch_size=32, epochs=3,
+                       dataset="blobs", lr=0.1,
+                       options=TrainOptions(default_parallelism=2, k=2))
+    job_id = client.v1().networks().train(req)
+
+    # the job must be running as a child process, not a thread (records
+    # are reserved before the spawn, so wait for the url to be set)
+    deadline = time.time() + 180
+    rec = None
+    while time.time() < deadline:
+        with dep.ps._jobs_lock:
+            rec = dep.ps.jobs.get(job_id)
+        if rec is not None and rec.url is not None:
+            break
+        time.sleep(0.2)
+    assert rec is not None, "job record never appeared"
+    assert rec.proc is not None and rec.url is not None
+    assert rec.thread is None and rec.job is None
+
+    history = wait_history(client, job_id, timeout=240)
+    assert len(history.data.train_loss) == 3
+    # throughput policy always scales up on the second decision
+    assert history.data.parallelism[0] == 2
+    assert history.data.parallelism[1] >= 2
+
+    # child process reaped after finish; metrics series cleared
+    assert dep.ps.wait_for_job(job_id, timeout=30)
+    assert f'jobid="{job_id}"' not in dep.ps.metrics.exposition()
+
+    # inference from the checkpoint written by the CHILD process
+    x = np.load(paths["xte"])[:5]
+    preds = client.v1().networks().infer(job_id, x.tolist())
+    assert len(preds) == 5
+
+
+def test_standalone_stop(standalone_stack):
+    dep, client, tmp_path = standalone_stack
+    paths = write_blob_files(tmp_path)
+    client.v1().datasets().create(
+        "blobs", paths["xtr"], paths["ytr"], paths["xte"], paths["yte"])
+
+    req = TrainRequest(model_type="mlp", batch_size=16, epochs=500,
+                       dataset="blobs", lr=0.05,
+                       options=TrainOptions(default_parallelism=2,
+                                            static_parallelism=True, k=1))
+    job_id = client.v1().networks().train(req)
+
+    # wait until it is actually training, then stop through the controller
+    deadline = time.time() + 180
+    while time.time() < deadline:
+        tasks = client.v1().tasks().list()
+        if any(t.job_id == job_id and t.state == "running" for t in tasks):
+            break
+        time.sleep(0.3)
+    client.v1().tasks().stop(job_id)
+
+    assert dep.ps.wait_for_job(job_id, timeout=240), "job did not stop"
+    # a stopped job still records its partial history (job.go:250-260)
+    history = wait_history(client, job_id, timeout=60)
+    assert len(history.data.train_loss) < 500
