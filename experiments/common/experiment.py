@@ -124,17 +124,20 @@ class KubemlExperiment:
         return result
 
     def run_grid(self, function: str, dataset: str, grid: Dict[str, Iterable],
-                 epochs: int, lr: float, on_result=None
-                 ) -> List[ExperimentResult]:
-        """Run the full cartesian grid; grid keys: batch, k, parallelism."""
+                 epochs: int, lr: float, static: bool = True,
+                 on_result=None) -> List[ExperimentResult]:
+        """Run the full cartesian grid; grid keys: batch, k, parallelism.
+        static=False benchmarks the scheduler's dynamic-parallelism
+        autoscale (BASELINE config 3)."""
         out = []
         for cfg in expand_grid(grid):
             req = self.make_request(
                 function=function, dataset=dataset, epochs=epochs,
                 batch=cfg["batch"], lr=lr, parallelism=cfg["parallelism"],
-                k=cfg["k"])
+                k=cfg["k"], static=static)
             full_cfg = {"function": function, "dataset": dataset,
-                        "epochs": epochs, "lr": lr, **cfg}
+                        "epochs": epochs, "lr": lr, "static": static,
+                        **cfg}
             res = self.run(req, config=full_cfg)
             out.append(res)
             if on_result:

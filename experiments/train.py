@@ -33,13 +33,32 @@ GRIDS = {
     "resnet": dict(grid=grids.RESNET_GRID, epochs=grids.RESNET_EPOCHS,
                    lr=grids.RESNET_LR, tta=grids.RESNET_TTA_GOAL,
                    function="resnet18", dataset="cifar10"),
+    # BASELINE.json configs 3-5
+    "resnet50": dict(grid=grids.RESNET50_GRID, epochs=grids.RESNET50_EPOCHS,
+                     lr=grids.RESNET50_LR, tta=grids.RESNET50_TTA_GOAL,
+                     function="resnet50", dataset="imagenette",
+                     static=False),  # dynamic-parallelism autoscale
+    "lstm": dict(grid=grids.LSTM_GRID, epochs=grids.LSTM_EPOCHS,
+                 lr=grids.LSTM_LR, tta=grids.LSTM_TTA_GOAL,
+                 function="lstm", dataset="agnews"),
+    "bert": dict(grid=grids.BERT_GRID, epochs=grids.BERT_EPOCHS,
+                 lr=grids.BERT_LR, tta=grids.BERT_TTA_GOAL,
+                 function="bert-tiny", dataset="sst2"),
 }
 
 
-# input sample shapes for the sweep functions (dataset stand-ins)
-_SHAPES = {"lenet": (28, 28, 1), "resnet18": (32, 32, 3),
-           "resnet34": (32, 32, 3), "resnet50": (32, 32, 3),
-           "vgg11": (32, 32, 3), "mlp": (8,)}
+# synthetic stand-in spec per sweep function: image functions get float
+# images, text functions get padded int token sequences
+_SYNTH = {
+    "lenet": dict(shape=(28, 28, 1), classes=10),
+    "resnet18": dict(shape=(32, 32, 3), classes=10),
+    "resnet34": dict(shape=(32, 32, 3), classes=10),
+    "resnet50": dict(shape=(64, 64, 3), classes=10),
+    "vgg11": dict(shape=(32, 32, 3), classes=10),
+    "mlp": dict(shape=(8,), classes=3),
+    "lstm": dict(seq_len=64, vocab=32000, classes=4),
+    "bert-tiny": dict(seq_len=64, vocab=30522, classes=2),
+}
 
 
 def _register_synthetic(client, name: str, function: str) -> None:
@@ -47,13 +66,19 @@ def _register_synthetic(client, name: str, function: str) -> None:
 
     import numpy as np
 
-    shape = _SHAPES[function]
+    spec = _SYNTH[function]
     rng = np.random.RandomState(0)
     with tempfile.TemporaryDirectory() as d:
         paths = {}
         for split, n in (("train", 512), ("test", 128)):
-            x = rng.rand(n, *shape).astype(np.float32)
-            y = rng.randint(0, 10, n).astype(np.int64)
+            if "seq_len" in spec:  # text: ragged token ids, pad id 0
+                T = spec["seq_len"]
+                x = rng.randint(1, spec["vocab"], (n, T)).astype(np.int32)
+                lengths = rng.randint(T // 4, T + 1, n)
+                x[np.arange(T)[None, :] >= lengths[:, None]] = 0
+            else:
+                x = rng.rand(n, *spec["shape"]).astype(np.float32)
+            y = rng.randint(0, spec["classes"], n).astype(np.int64)
             np.save(f"{d}/x_{split}.npy", x)
             np.save(f"{d}/y_{split}.npy", y)
             paths[split] = (f"{d}/x_{split}.npy", f"{d}/y_{split}.npy")
@@ -111,7 +136,8 @@ def main(argv=None) -> int:
             req = exp.make_request(
                 function=spec["function"], dataset=spec["dataset"],
                 epochs=epochs, batch=cfg["batch"], lr=spec["lr"],
-                parallelism=cfg["parallelism"], k=cfg["k"])
+                parallelism=cfg["parallelism"], k=cfg["k"],
+                static=spec.get("static", True))
             res = exp.run(req, config={"function": spec["function"],
                                        "dataset": spec["dataset"],
                                        "epochs": epochs, "lr": spec["lr"],

@@ -1,5 +1,6 @@
 """Experiment harness tests: grid expansion, TTA math, live sweep."""
 
+import json
 import sys
 import os
 
@@ -86,3 +87,23 @@ def test_grid_sweep_live(live):
     assert any(r["tta50_s"] is not None for r in rows)
     df = exp.to_frame([50.0])
     assert {"batch", "parallelism", "tta50_s"} <= set(df.columns)
+
+
+@pytest.mark.parametrize("grid", ["lstm", "bert"])
+def test_baseline_text_grids_run(grid, tmp_home, tmp_path):
+    """BASELINE.json configs 4-5 run end-to-end on synthetic stand-ins."""
+    from experiments.train import main as sweep_main
+    out = tmp_path / f"{grid}.jsonl"
+    rc = sweep_main(["--grid", grid, "--local", "--synthetic",
+                     "--limit", "1", "--epochs", "1",
+                     "--out", str(out)])
+    assert rc == 0
+    rows = [json.loads(l) for l in out.read_text().splitlines()]
+    assert len(rows) == 1 and rows[0]["epochs_run"] == 1
+
+
+def test_resnet50_grid_is_autoscale():
+    """BASELINE.json config 3 uses dynamic parallelism (autoscale)."""
+    from experiments.train import GRIDS
+    assert GRIDS["resnet50"]["static"] is False
+    assert GRIDS["resnet50"]["function"] == "resnet50"
