@@ -141,6 +141,7 @@ def main(argv=None) -> int:
             res = exp.run(req, config={"function": spec["function"],
                                        "dataset": spec["dataset"],
                                        "epochs": epochs, "lr": spec["lr"],
+                                       "static": spec.get("static", True),
                                        **cfg})
             row = res.row([spec["tta"]])
             print(f"[{i + 1}/{len(configs)}] {row}")

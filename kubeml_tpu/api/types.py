@@ -26,6 +26,9 @@ class TrainOptions:
     validate_every: int = 1
     k: int = 1                     # K-step local SGD period; -1 => once per epoch
     goal_accuracy: float = 100.0   # early-stop accuracy target (percent)
+    # net-new vs the reference (which has no checkpointing, SURVEY.md §5):
+    # also checkpoint every N epochs (0 = final checkpoint only)
+    checkpoint_every: int = 0
 
     def to_dict(self) -> dict:
         return {
@@ -34,6 +37,7 @@ class TrainOptions:
             "validate_every": self.validate_every,
             "K": self.k,
             "goal_accuracy": self.goal_accuracy,
+            "checkpoint_every": self.checkpoint_every,
         }
 
     @classmethod
@@ -44,6 +48,7 @@ class TrainOptions:
             validate_every=d.get("validate_every", 1),
             k=d.get("K", d.get("k", 1)),
             goal_accuracy=d.get("goal_accuracy", 100.0),
+            checkpoint_every=d.get("checkpoint_every", 0),
         )
 
 
@@ -58,6 +63,9 @@ class TrainRequest:
     lr: float
     function_name: str = ""
     options: TrainOptions = field(default_factory=TrainOptions)
+    # warm-start from another job's checkpoint (net-new: the reference
+    # deletes weights at job end and has no resume path, SURVEY.md §5)
+    resume_from: str = ""
 
     def to_dict(self) -> dict:
         return {
@@ -68,6 +76,7 @@ class TrainRequest:
             "lr": self.lr,
             "function_name": self.function_name or self.model_type,
             "options": self.options.to_dict(),
+            "resume_from": self.resume_from,
         }
 
     @classmethod
@@ -80,6 +89,7 @@ class TrainRequest:
             lr=float(d["lr"]),
             function_name=d.get("function_name", ""),
             options=TrainOptions.from_dict(d.get("options", {})),
+            resume_from=d.get("resume_from", ""),
         )
 
 

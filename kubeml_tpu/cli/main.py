@@ -3,6 +3,7 @@
 Parity with ml/pkg/kubeml-cli/ (cmd/root.go:8-12 + cmd/*.go):
     kubeml train -f FN -d DS -e N -b N --lr F [--validate-every N]
                  [-p N] [--static] [-K N] [--sparse-avg] [--goal-accuracy F]
+                 [--resume-from JOBID] [--checkpoint-every N]
     kubeml infer -n JOBID --datafile FILE
     kubeml dataset create|delete|list
     kubeml fn create|delete|list
@@ -62,11 +63,13 @@ def cmd_train(args):
     req = TrainRequest(
         model_type=args.function, batch_size=args.batch, epochs=args.epochs,
         dataset=args.dataset, lr=args.lr, function_name=args.function,
+        resume_from=args.resume_from,
         options=TrainOptions(
             default_parallelism=args.parallelism,
             static_parallelism=args.static,
             validate_every=args.validate_every, k=k,
-            goal_accuracy=args.goal_accuracy))
+            goal_accuracy=args.goal_accuracy,
+            checkpoint_every=args.checkpoint_every))
     job_id = client.v1().networks().train(req)
     print(job_id)
 
@@ -275,6 +278,10 @@ def build_parser() -> argparse.ArgumentParser:
     t.add_argument("--sparse-avg", action="store_true",
                    help="average once per epoch (K=-1)")
     t.add_argument("--goal-accuracy", type=float, default=100.0)
+    t.add_argument("--resume-from", default="", metavar="JOBID",
+                   help="warm-start from another job's checkpoint")
+    t.add_argument("--checkpoint-every", type=int, default=0, metavar="N",
+                   help="also checkpoint every N epochs (0 = final only)")
     t.set_defaults(fn=cmd_train)
 
     i = sub.add_parser("infer", help="run inference on a trained model")

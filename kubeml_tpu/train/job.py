@@ -73,7 +73,8 @@ class TrainJob:
                  history_store: Optional[HistoryStore] = None,
                  callbacks: Optional[JobCallbacks] = None,
                  seed: int = 0, checkpoint: bool = True,
-                 log_file: Optional[str] = None):
+                 log_file: Optional[str] = None,
+                 round_hook: Optional[Callable] = None):
         self.task = task
         self.log_file = log_file
         self._file_logger = None
@@ -87,6 +88,11 @@ class TrainJob:
         self.callbacks = callbacks or JobCallbacks()
         self.seed = seed
         self.checkpoint = checkpoint
+        # round_hook(RoundBatch) -> RoundBatch: fault injection / chaos
+        # testing (utils/chaos.py) — the reference has no such tooling
+        # (SURVEY.md §5), its failure tolerance was only exercised by
+        # real pod deaths
+        self.round_hook = round_hook
         self.stop_event = threading.Event()
         self.history = JobHistory()
         self.exit_err: Optional[str] = None
@@ -145,6 +151,7 @@ class TrainJob:
             epochs = self.req.epochs
             opts = self.req.options
 
+            last_ckpt_epoch = -1
             for epoch in range(epochs):
                 t0 = time.time()
                 used_parallelism = parallelism
@@ -180,6 +187,12 @@ class TrainJob:
                             train_loss, val_loss, accuracy, used_parallelism,
                             elapsed)
 
+                if self.checkpoint and opts.checkpoint_every > 0 and \
+                        (epoch + 1) % opts.checkpoint_every == 0:
+                    save_checkpoint(job_id, self.variables,
+                                    self._manifest(epoch=epoch + 1))
+                    last_ckpt_epoch = epoch + 1
+
                 if self.stop_event.is_set():
                     self._log("job %s stopped by request", job_id)
                     break
@@ -199,12 +212,12 @@ class TrainJob:
                     self.history.validation_loss[-1] = val_loss
                     self.history.accuracy[-1] = accuracy
 
-            if self.checkpoint:
-                save_checkpoint(job_id, self.variables, {
-                    "model": self.req.model_type,
-                    "function": self.req.function_name or self.req.model_type,
-                    "dataset": self.req.dataset,
-                })
+            # final checkpoint, unless the last periodic save already
+            # captured exactly this state (weights don't change after the
+            # last trained epoch)
+            if self.checkpoint and \
+                    last_ckpt_epoch != len(self.history.train_loss):
+                save_checkpoint(job_id, self.variables, self._manifest())
             record = History(id=job_id, task=self.req, data=self.history)
             if self.history_store is not None:
                 self.history_store.save(record)
@@ -222,6 +235,16 @@ class TrainJob:
 
     # ------------------------------------------------------------ internals
 
+    def _manifest(self, epoch: Optional[int] = None) -> dict:
+        m = {
+            "model": self.req.model_type,
+            "function": self.req.function_name or self.req.model_type,
+            "dataset": self.req.dataset,
+        }
+        if epoch is not None:
+            m["epoch"] = epoch
+        return m
+
     def _init_model(self):
         handle = self.registry.get(self.req.dataset)
         self._handle = handle
@@ -231,6 +254,21 @@ class TrainJob:
         self._engine = KAvgEngine(self.mesh, self.model.loss,
                                   self.model.metrics,
                                   self.model.configure_optimizers)
+        restored = None
+        if self.req.resume_from:
+            # warm-start from another job's checkpoint (net-new vs the
+            # reference, which deletes weights at job end — SURVEY.md §5).
+            # Validated BEFORE model init so a mismatched function fails
+            # with a clear error, not a shape explosion inside init.
+            from kubeml_tpu.train.checkpoint import load_checkpoint
+            restored, manifest = load_checkpoint(self.req.resume_from)
+            ckpt_fn = manifest.get("function") or manifest.get("model")
+            this_fn = self.req.function_name or self.req.model_type
+            if ckpt_fn != this_fn:
+                raise KubeMLException(
+                    f"checkpoint {self.req.resume_from} holds function "
+                    f"{ckpt_fn!r}, not {this_fn!r}", 400)
+
         # init from one real batch, like the reference's init function
         # (network.py:174-189 runs user init then saves the state dict)
         x, y = handle.doc_range("train", 0, 1)
@@ -239,6 +277,16 @@ class TrainJob:
             np.asarray(y[: self.req.batch_size]))
         self.variables = self.model.init_variables(
             jax.random.PRNGKey(self.seed), sample)
+        if restored is not None:
+            fresh, loaded = (jax.tree_util.tree_leaves(self.variables),
+                             jax.tree_util.tree_leaves(restored))
+            if [l.shape for l in fresh] != [l.shape for l in loaded]:
+                raise KubeMLException(
+                    f"checkpoint {self.req.resume_from} is shaped for a "
+                    "different model configuration", 400)
+            self.variables = restored
+            self._log("job %s warm-started from checkpoint %s",
+                      self.task.job_id, self.req.resume_from)
 
     def _train_epoch(self, parallelism: int, epoch: int) -> float:
         plan = self._loader.plan(parallelism, self.req.options.k,
@@ -251,6 +299,8 @@ class TrainJob:
         dev_loss = None
         step_counts = np.zeros(0)
         for rb in prefetch_rounds(self._loader.epoch_rounds(plan, epoch)):
+            if self.round_hook is not None:
+                rb = self.round_hook(rb)
             if rb.worker_mask.sum() < 1:
                 # all workers lost: abort like job.go:188-193
                 raise MergeError(
@@ -260,7 +310,10 @@ class TrainJob:
                 rb.worker_mask, rb.rngs, lr=self.req.lr, epoch=epoch)
             if step_counts.size == 0:
                 step_counts = np.zeros(len(stats.step_count))
-            step_counts += stats.step_count
+            # count only merged workers' steps: a masked-out worker (lost
+            # function) contributes neither loss nor steps, matching the
+            # reference's average-over-responders (util.go:82-98)
+            step_counts += stats.step_count * rb.worker_mask
             dev_loss = stats.loss_sum_device if dev_loss is None \
                 else dev_loss + stats.loss_sum_device
         loss_sums = np.asarray(dev_loss) if dev_loss is not None \

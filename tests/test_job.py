@@ -134,3 +134,88 @@ def test_failure_reports_exit_err(setup):
         job.train()
     assert finished and finished[0][1] is not None
     assert task.state == "failed"
+
+
+def test_checkpoint_every_and_warm_start(setup, monkeypatch):
+    reg, store, model, mesh = setup
+    # epoch-cadence checkpointing: every epoch must produce a checkpoint
+    # save in addition to the final one
+    import kubeml_tpu.train.job as job_mod
+    saved = []
+    real_save = job_mod.save_checkpoint
+    monkeypatch.setattr(
+        job_mod, "save_checkpoint",
+        lambda jid, v, m: saved.append(m) or real_save(jid, v, m))
+    task = make_task(job_id="ckptjob1", epochs=2)
+    task.parameters.options.checkpoint_every = 1
+    TrainJob(task, model, ToyDataset(), mesh, registry=reg,
+             history_store=store).train()
+    # the final save is elided: the epoch-2 periodic checkpoint already
+    # captured the end state
+    assert [m.get("epoch") for m in saved] == [1, 2]
+    variables, manifest = load_checkpoint("ckptjob1")
+    assert manifest["function"] == "mlp"
+
+    # warm start: the resumed job's first-epoch loss must be ~ the donor's
+    # last loss, well below a cold start's first-epoch loss
+    cold = TrainJob(make_task(job_id="coldjob1", epochs=1),
+                    get_builtin("mlp")(hidden=16, num_classes=4),
+                    ToyDataset(), mesh, registry=reg, history_store=store)
+    cold_rec = cold.train()
+
+    warm_task = make_task(job_id="warmjob1", epochs=1)
+    warm_task.parameters.resume_from = "ckptjob1"
+    warm = TrainJob(warm_task,
+                    get_builtin("mlp")(hidden=16, num_classes=4),
+                    ToyDataset(), mesh, registry=reg, history_store=store)
+    warm_rec = warm.train()
+    assert warm_rec.data.train_loss[0] < cold_rec.data.train_loss[0]
+
+
+def test_warm_start_function_mismatch_rejected(setup):
+    reg, store, model, mesh = setup
+    donor = TrainJob(make_task(job_id="donor1", epochs=1), model,
+                     ToyDataset(), mesh, registry=reg, history_store=store)
+    donor.train()
+
+    task = make_task(job_id="mismatch1", epochs=1)
+    task.parameters.model_type = "lenet"
+    task.parameters.resume_from = "donor1"
+    bad = TrainJob(task, get_builtin("lenet")(), ToyDataset(), mesh,
+                   registry=reg, history_store=store)
+    with pytest.raises(Exception, match="holds function"):
+        bad.train()
+
+
+def test_straggler_tolerance_under_fault_injection(setup):
+    """Random worker loss every round: the job must finish, learn, and
+    average only over survivors (reference semantics util.go:144-166)."""
+    from kubeml_tpu.utils.chaos import WorkerLossInjector
+
+    reg, store, model, mesh = setup
+    chaos = WorkerLossInjector(p=0.4, seed=7)
+    job = TrainJob(make_task(job_id="chaosjob1", epochs=3, parallelism=4),
+                   model, ToyDataset(), mesh, registry=reg,
+                   history_store=store, round_hook=chaos)
+    record = job.train()
+    assert chaos.degraded_rounds > 0 and chaos.workers_lost > 0
+    assert len(record.data.train_loss) == 3
+    assert record.data.train_loss[-1] < record.data.train_loss[0]
+    assert np.isfinite(record.data.train_loss).all()
+    assert record.data.accuracy[-1] > 50.0
+
+
+def test_all_workers_lost_aborts(setup):
+    """Zero survivors in a round is the job-abort path (job.go:188-193)."""
+    reg, store, model, mesh = setup
+
+    def kill_all(rb):
+        import dataclasses as dc
+        return dc.replace(rb, worker_mask=np.zeros_like(rb.worker_mask))
+
+    job = TrainJob(make_task(job_id="deadjob1", epochs=2), model,
+                   ToyDataset(), mesh, registry=reg, history_store=store,
+                   round_hook=kill_all)
+    with pytest.raises(Exception, match="no workers contributed"):
+        job.train()
+    assert job.exit_err is not None
