@@ -17,9 +17,13 @@ TPU-first:
 
 from __future__ import annotations
 
+from typing import Optional
+
 import flax.linen as nn
+import jax
 import jax.numpy as jnp
 import optax
+from jax import lax
 
 from kubeml_tpu.models import register_model
 from kubeml_tpu.models.base import ClassifierModel
@@ -34,9 +38,13 @@ class EncoderBlock(nn.Module):
     ffn: int
     dropout: float
     dtype: jnp.dtype
+    # set to the mesh seq-axis name for sequence parallelism: the block
+    # then runs inside shard_map with [B, T_local, ...] activations and
+    # attention becomes the ppermute ring (parallel/ring_attention.py)
+    seq_axis: Optional[str] = None
 
     @nn.compact
-    def __call__(self, h, pad_mask, train: bool):
+    def __call__(self, h, pad_mask, train: bool, pos=None):
         head_dim = self.hidden // self.heads
         x = nn.LayerNorm(dtype=jnp.float32)(h)
         q = nn.DenseGeneral((self.heads, head_dim), dtype=self.dtype,
@@ -45,8 +53,16 @@ class EncoderBlock(nn.Module):
                             name="k")(x)
         v = nn.DenseGeneral((self.heads, head_dim), dtype=self.dtype,
                             name="v")(x)
-        # auto-dispatch: pallas flash kernel on TPU, jnp reference on CPU
-        attn = masked_attention(q, k, v, pad_mask)
+        if self.seq_axis is not None:
+            # long-context path: KV blocks rotate around the seq ring;
+            # full attention over the GLOBAL sequence, O(T_local^2) HBM
+            from kubeml_tpu.parallel.ring_attention import ring_attention
+            attn = ring_attention(q, k, v, q_pos=pos, kv_pos=pos,
+                                  kv_mask=pad_mask, causal=False,
+                                  axis_name=self.seq_axis)
+        else:
+            # auto-dispatch: pallas flash kernel on TPU, jnp ref on CPU
+            attn = masked_attention(q, k, v, pad_mask)
         attn = nn.DenseGeneral(self.hidden, axis=(-2, -1), dtype=self.dtype,
                                name="out")(attn)
         attn = nn.Dropout(self.dropout, deterministic=not train)(attn)
@@ -69,29 +85,48 @@ class BertModule(nn.Module):
     num_classes: int = 2
     dropout: float = 0.1
     dtype: jnp.dtype = jnp.bfloat16
+    seq_axis: Optional[str] = None  # sequence-parallel mode (see below)
 
     @nn.compact
     def __call__(self, x, train: bool = False):
-        # x: int32 token ids [B, T], T <= max_len, pad id 0
+        # x: int32 token ids [B, T], T <= max_len, pad id 0.
+        # With seq_axis set, this runs inside shard_map: x is the LOCAL
+        # [B, T/n] sequence block, positions are offset by the shard
+        # index, attention rides the ppermute ring, and the mean-pool
+        # reduces over the seq axis — so the module computes exactly the
+        # global-sequence forward while no chip ever holds the full T.
         B, T = x.shape
-        if T > self.max_len:  # static shape: trace-time guard, not lax.cond
+        n_shards = 1 if self.seq_axis is None else lax.axis_size(self.seq_axis)
+        if T * n_shards > self.max_len:  # static trace-time guard
             raise ValueError(
-                f"sequence length {T} exceeds max_len {self.max_len}")
+                f"sequence length {T * n_shards} exceeds max_len "
+                f"{self.max_len}")
         pad_mask = (x != PAD_ID).astype(jnp.float32)
+        if self.seq_axis is None:
+            pos_ids = jnp.arange(T)
+        else:
+            pos_ids = lax.axis_index(self.seq_axis) * T + jnp.arange(T)
         h = nn.Embed(self.vocab_size, self.hidden, dtype=self.dtype,
                      name="tok_embed")(x)
         pos = nn.Embed(self.max_len, self.hidden, dtype=self.dtype,
-                       name="pos_embed")(jnp.arange(T)[None, :])
+                       name="pos_embed")(pos_ids[None, :])
         h = h + pos
         h = nn.Dropout(self.dropout, deterministic=not train)(h)
         for i in range(self.layers):
             h = EncoderBlock(self.hidden, self.heads, self.ffn, self.dropout,
-                             self.dtype, name=f"layer_{i}")(h, pad_mask,
-                                                            train)
+                             self.dtype, seq_axis=self.seq_axis,
+                             name=f"layer_{i}")(h, pad_mask, train,
+                                                pos=pos_ids)
         h = nn.LayerNorm(dtype=jnp.float32)(h)
-        # masked mean-pool (robust without a trained [CLS])
-        pooled = (h * pad_mask[..., None]).sum(axis=1) / \
-            jnp.maximum(pad_mask.sum(axis=1), 1.0)[..., None]
+        # masked mean-pool (robust without a trained [CLS]); in
+        # seq-parallel mode the pool is a psum over the seq ring, after
+        # which the logits are replicated across shards
+        num = (h * pad_mask[..., None]).sum(axis=1)
+        den = pad_mask.sum(axis=1)
+        if self.seq_axis is not None:
+            num = lax.psum(num, self.seq_axis)
+            den = lax.psum(den, self.seq_axis)
+        pooled = num / jnp.maximum(den, 1.0)[..., None]
         out = nn.Dense(self.num_classes, dtype=self.dtype,
                        name="classifier")(pooled.astype(self.dtype))
         return out.astype(jnp.float32)
@@ -107,3 +142,37 @@ class BertTiny(ClassifierModel):
 
     def configure_optimizers(self, lr, epoch):
         return optax.adamw(lr, weight_decay=0.01)
+
+    def forward_seq_parallel(self, variables, x, mesh):
+        """Long-context forward over the mesh `seq` axis.
+
+        x: [B, T] with T divisible by the seq-axis size; the same
+        variables as the dense module (shapes are identical, only the
+        execution is sharded). Returns [B, num_classes] logits equal to
+        the dense forward — no chip ever materializes the full sequence
+        or an O(T^2) score tensor.
+        """
+        from jax.sharding import PartitionSpec as P
+
+        from kubeml_tpu.parallel.mesh import SEQ_AXIS
+
+        n_seq = mesh.shape[SEQ_AXIS]
+        if x.shape[1] % n_seq:
+            raise ValueError(
+                f"sequence length {x.shape[1]} not divisible by the "
+                f"seq-axis size {n_seq}")
+        key = (mesh, x.shape[1] // n_seq)
+        if not hasattr(self, "_sp_cache"):
+            self._sp_cache = {}
+        if key not in self._sp_cache:
+            # clone copies every dense-module field, overriding only the
+            # execution mode — dense/seq-parallel parity by construction
+            sp_module = self.module.clone(seq_axis=SEQ_AXIS)
+
+            def fwd(variables, x_local):
+                return sp_module.apply(variables, x_local, train=False)
+
+            self._sp_cache[key] = jax.jit(jax.shard_map(
+                fwd, mesh=mesh, in_specs=(P(), P(None, SEQ_AXIS)),
+                out_specs=P(), check_vma=False))
+        return self._sp_cache[key](variables, x)
