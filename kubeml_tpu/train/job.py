@@ -47,6 +47,7 @@ from kubeml_tpu.parallel.mesh import data_axis_size
 from kubeml_tpu.train.checkpoint import save_checkpoint
 from kubeml_tpu.train.history import HistoryStore
 from kubeml_tpu.utils.env import limit_parallelism
+from kubeml_tpu.utils.trace import Tracer
 
 logger = logging.getLogger("kubeml_tpu.train")
 
@@ -93,6 +94,7 @@ class TrainJob:
         # (SURVEY.md §5), its failure tolerance was only exercised by
         # real pod deaths
         self.round_hook = round_hook
+        self.tracer = Tracer()  # host-phase spans, summarized per epoch
         self.stop_event = threading.Event()
         self.history = JobHistory()
         self.exit_err: Optional[str] = None
@@ -183,9 +185,10 @@ class TrainJob:
                     accuracy=accuracy, train_loss=train_loss,
                     parallelism=used_parallelism, epoch_duration=elapsed))
                 self._log("job %s epoch %d/%d loss=%.4f val=%.4f acc=%.2f "
-                            "N=%d %.2fs", job_id, epoch + 1, epochs,
+                            "N=%d %.2fs [%s]", job_id, epoch + 1, epochs,
                             train_loss, val_loss, accuracy, used_parallelism,
-                            elapsed)
+                            elapsed, self.tracer.format_summary())
+                self.tracer.reset()
 
                 if self.checkpoint and opts.checkpoint_every > 0 and \
                         (epoch + 1) % opts.checkpoint_every == 0:
@@ -298,16 +301,22 @@ class TrainJob:
         # contributor count.
         dev_loss = None
         step_counts = np.zeros(0)
-        for rb in prefetch_rounds(self._loader.epoch_rounds(plan, epoch)):
+        rounds = iter(prefetch_rounds(self._loader.epoch_rounds(plan, epoch)))
+        while True:
+            with self.tracer.span("data_wait"):
+                rb = next(rounds, None)
+            if rb is None:
+                break
             if self.round_hook is not None:
                 rb = self.round_hook(rb)
             if rb.worker_mask.sum() < 1:
                 # all workers lost: abort like job.go:188-193
                 raise MergeError(
                     f"round {rb.round_index}: no workers contributed")
-            self.variables, stats = self._engine.train_round(
-                self.variables, rb.batch, rb.sample_mask, rb.step_mask,
-                rb.worker_mask, rb.rngs, lr=self.req.lr, epoch=epoch)
+            with self.tracer.span("dispatch"):
+                self.variables, stats = self._engine.train_round(
+                    self.variables, rb.batch, rb.sample_mask, rb.step_mask,
+                    rb.worker_mask, rb.rngs, lr=self.req.lr, epoch=epoch)
             if step_counts.size == 0:
                 step_counts = np.zeros(len(stats.step_count))
             # count only merged workers' steps: a masked-out worker (lost
@@ -316,8 +325,9 @@ class TrainJob:
             step_counts += stats.step_count * rb.worker_mask
             dev_loss = stats.loss_sum_device if dev_loss is None \
                 else dev_loss + stats.loss_sum_device
-        loss_sums = np.asarray(dev_loss) if dev_loss is not None \
-            else np.zeros(0)
+        with self.tracer.span("device_drain"):
+            loss_sums = np.asarray(dev_loss) if dev_loss is not None \
+                else np.zeros(0)
         # per-worker epoch loss, then unweighted mean over workers that ran
         # (reference aggregation ml/pkg/train/util.go:82-98)
         ran = step_counts > 0

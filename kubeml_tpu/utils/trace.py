@@ -1,0 +1,89 @@
+"""Lightweight span tracing + optional XLA profiler capture.
+
+The reference has no tracing subsystem — only ad-hoc zap timings around
+the merge and epoch loops (ml/pkg/train/job.go:307,397,412) and an
+out-of-band psutil sampler in the experiment harness (SURVEY.md §5).
+Here tracing is structural:
+
+  - `Tracer.span(name)` wraps any host-side phase; per-epoch summaries
+    (count / total / mean) go to the job log, so `kubeml logs --id`
+    shows where wall-clock went (data wait vs device dispatch vs
+    readback) without external tooling;
+  - `xla_profile(dir)` captures a real XLA profiler trace (viewable in
+    TensorBoard / Perfetto) around any block, for kernel-level work.
+
+Host-side spans are the right default on TPU: the device timeline
+belongs to XLA's profiler, while the host loop — input assembly, round
+dispatch, blocking readbacks — is exactly what the job controls and what
+usually stalls a TPU step pipeline.
+"""
+
+from __future__ import annotations
+
+import collections
+import contextlib
+import time
+from typing import Dict, List, Tuple
+
+
+class Tracer:
+    """Accumulates named spans; cheap enough to stay on in production."""
+
+    def __init__(self):
+        self._spans: Dict[str, List[float]] = collections.defaultdict(list)
+
+    @contextlib.contextmanager
+    def span(self, name: str):
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            self._spans[name].append(time.perf_counter() - t0)
+
+    def add(self, name: str, seconds: float):
+        self._spans[name].append(seconds)
+
+    def summary(self) -> Dict[str, Dict[str, float]]:
+        return {
+            name: {
+                "count": len(xs),
+                "total_s": round(sum(xs), 4),
+                "mean_s": round(sum(xs) / len(xs), 6),
+            }
+            for name, xs in self._spans.items()
+        }
+
+    def format_summary(self) -> str:
+        parts = []
+        for name, s in sorted(self.summary().items()):
+            parts.append(f"{name}={s['total_s']:.3f}s/{s['count']}")
+        return " ".join(parts)
+
+    def reset(self) -> Dict[str, Dict[str, float]]:
+        out = self.summary()
+        self._spans.clear()
+        return out
+
+
+@contextlib.contextmanager
+def xla_profile(log_dir: str):
+    """Capture an XLA profiler trace into log_dir (TensorBoard-viewable).
+
+    Degrades to a no-op (with a logged warning, never silently) when the
+    backend lacks profiler support or the trace cannot start."""
+    import logging
+
+    import jax
+
+    try:
+        jax.profiler.start_trace(log_dir)
+        started = True
+    except Exception as e:  # backend without profiler support / bad dir
+        logging.getLogger("kubeml_tpu.trace").warning(
+            "xla_profile: could not start trace in %s: %s", log_dir, e)
+        started = False
+    try:
+        yield
+    finally:
+        if started:
+            jax.profiler.stop_trace()

@@ -1,0 +1,67 @@
+"""Tracing subsystem: span accounting + per-epoch summaries in job logs."""
+
+import re
+
+from kubeml_tpu.utils.trace import Tracer, xla_profile
+
+
+def test_tracer_spans_and_summary():
+    tr = Tracer()
+    with tr.span("a"):
+        pass
+    with tr.span("a"):
+        pass
+    tr.add("b", 0.5)
+    s = tr.summary()
+    assert s["a"]["count"] == 2
+    assert s["b"]["total_s"] == 0.5
+    txt = tr.format_summary()
+    assert "a=" in txt and "b=0.500s/1" in txt
+    assert tr.reset()["a"]["count"] == 2
+    assert tr.summary() == {}
+
+
+def test_xla_profile_noop_safe(tmp_path):
+    # must not raise even if the backend lacks profiler support
+    with xla_profile(str(tmp_path / "prof")):
+        import jax.numpy as jnp
+        jnp.ones(4).sum()
+
+
+def test_xla_profile_fallback_on_start_failure(tmp_path, monkeypatch,
+                                               caplog):
+    # start_trace failure: warn, run the block, and never call stop_trace
+    import jax
+
+    def boom(*a, **k):
+        raise RuntimeError("no profiler here")
+
+    stopped = []
+    monkeypatch.setattr(jax.profiler, "start_trace", boom)
+    monkeypatch.setattr(jax.profiler, "stop_trace",
+                        lambda: stopped.append(True))
+    ran = []
+    with caplog.at_level("WARNING", logger="kubeml_tpu.trace"):
+        with xla_profile(str(tmp_path / "prof")):
+            ran.append(True)
+    assert ran and not stopped
+    assert "could not start trace" in caplog.text
+
+
+def test_job_logs_trace_summary(tmp_path, tmp_home, mesh8):
+    from tests.test_job import ToyDataset, make_blobs, make_task
+    from kubeml_tpu.data.registry import DatasetRegistry
+    from kubeml_tpu.models import get_builtin
+    from kubeml_tpu.train.job import TrainJob
+
+    reg = DatasetRegistry()
+    make_blobs(reg)
+    log = tmp_path / "job.log"
+    job = TrainJob(make_task(job_id="tracejob1", epochs=2),
+                   get_builtin("mlp")(hidden=16, num_classes=4),
+                   ToyDataset(), mesh8, registry=reg, log_file=str(log))
+    job.train()
+    text = log.read_text()
+    # every epoch line carries the phase breakdown
+    assert len(re.findall(r"\[data_wait=\S+ device_drain=\S+ dispatch=\S+\]",
+                          text)) == 2
