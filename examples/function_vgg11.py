@@ -1,0 +1,46 @@
+"""A KubeML function to train VGG-11 on CIFAR-100.
+
+Equivalent of the reference example ml/experiments/kubeml/
+function_vgg11.py (used in its max-accuracy/TTA app experiments).
+
+    kubeml fn create -n vgg11-example --code examples/function_vgg11.py
+    kubeml train -f vgg11-example -d cifar100 -e 30 -b 128 --lr 0.05 -p 8
+"""
+
+import numpy as np
+import optax
+
+from kubeml_tpu import KubeDataset
+from kubeml_tpu.models.base import ClassifierModel
+from kubeml_tpu.models.vgg import VGGModule
+
+CIFAR_MEAN = np.array([0.5071, 0.4866, 0.4409], np.float32)
+CIFAR_STD = np.array([0.2673, 0.2564, 0.2762], np.float32)
+
+
+class KubeVGG11(ClassifierModel):
+    name = "vgg11-example"
+    num_classes = 100
+
+    def build(self):
+        return VGGModule(num_classes=self.num_classes)
+
+    def configure_optimizers(self, lr, epoch):
+        return optax.chain(optax.add_decayed_weights(5e-4),
+                           optax.sgd(lr, momentum=0.9))
+
+
+class Cifar100Dataset(KubeDataset):
+    dataset = "cifar100"
+
+    def _normalize(self, data):
+        x = data.astype(np.float32)
+        if x.max() > 1.5:
+            x = x / 255.0
+        return (x - CIFAR_MEAN) / CIFAR_STD
+
+    def transform_train(self, data, labels):
+        return {"x": self._normalize(data), "y": labels.astype(np.int32)}
+
+    def transform_test(self, data, labels):
+        return {"x": self._normalize(data), "y": labels.astype(np.int32)}

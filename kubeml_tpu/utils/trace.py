@@ -86,4 +86,8 @@ def xla_profile(log_dir: str):
         yield
     finally:
         if started:
-            jax.profiler.stop_trace()
+            try:
+                jax.profiler.stop_trace()
+            except Exception as e:  # export failure must not kill the run
+                logging.getLogger("kubeml_tpu.trace").warning(
+                    "xla_profile: could not stop/export trace: %s", e)

@@ -1,0 +1,99 @@
+"""The examples/ function files: registry resolution + an end-to-end
+train of the LeNet example through the control plane."""
+
+import os
+import time
+
+import numpy as np
+import pytest
+
+from kubeml_tpu.train.functionlib import FunctionRegistry
+
+EXAMPLES = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "examples")
+
+
+@pytest.mark.parametrize("fname,fn_name", [
+    ("function_lenet.py", "lenet-example"),
+    ("function_resnet34.py", "resnet34-example"),
+    ("function_vgg11.py", "vgg11-example"),
+])
+def test_example_resolves(tmp_home, fname, fn_name):
+    reg = FunctionRegistry()
+    reg.create(fn_name, os.path.join(EXAMPLES, fname))
+    model_cls, dataset_cls = reg.resolve(fn_name)
+    model = model_cls()
+    assert model.num_classes >= 10
+    assert dataset_cls is not None
+    ds = dataset_cls()
+    out = ds.transform_train(np.random.rand(4, 32, 32, 3).astype(np.float32)
+                             if "lenet" not in fname else
+                             np.random.rand(4, 28, 28).astype(np.float32),
+                             np.zeros(4, np.int64))
+    assert set(out) == {"x", "y"} and out["x"].dtype == np.float32
+
+
+def test_lenet_example_trains_end_to_end(tmp_home, tmp_path, mesh8):
+    from kubeml_tpu.api.types import TrainOptions, TrainRequest
+    from kubeml_tpu.control.client import KubemlClient
+    from kubeml_tpu.control.deployment import start_deployment
+
+    dep = start_deployment(mesh=mesh8)
+    try:
+        client = KubemlClient(dep.controller_url)
+        rng = np.random.RandomState(0)
+        # raw 0..255 uint8 uploads, like a real MNIST ingest
+        paths = {}
+        for split, n in (("train", 256), ("test", 64)):
+            x = rng.randint(0, 256, (n, 28, 28)).astype(np.uint8)
+            y = rng.randint(0, 10, n).astype(np.int64)
+            np.save(tmp_path / f"x_{split}.npy", x)
+            np.save(tmp_path / f"y_{split}.npy", y)
+            paths[split] = (str(tmp_path / f"x_{split}.npy"),
+                            str(tmp_path / f"y_{split}.npy"))
+        client.v1().datasets().create("mnist", paths["train"][0],
+                                      paths["train"][1], paths["test"][0],
+                                      paths["test"][1])
+        client.v1().functions().create(
+            "lenet-example", os.path.join(EXAMPLES, "function_lenet.py"))
+        req = TrainRequest(model_type="lenet-example", batch_size=32,
+                           epochs=1, dataset="mnist", lr=0.05,
+                           function_name="lenet-example",
+                           options=TrainOptions(default_parallelism=2,
+                                                static_parallelism=True,
+                                                k=2))
+        job_id = client.v1().networks().train(req)
+        from tests.test_control_plane import wait_history
+        history = wait_history(client, job_id, timeout=240)
+        assert len(history.data.train_loss) == 1
+        assert np.isfinite(history.data.train_loss).all()
+    finally:
+        dep.stop()
+
+
+def test_two_jobs_run_concurrently(tmp_home, tmp_path, mesh8):
+    """The reference runs jobs concurrently (one pod each); the threaded
+    PS must handle overlapping jobs on one mesh."""
+    from tests.test_control_plane import wait_history, write_blob_files
+    from kubeml_tpu.api.types import TrainOptions, TrainRequest
+    from kubeml_tpu.control.client import KubemlClient
+    from kubeml_tpu.control.deployment import start_deployment
+
+    dep = start_deployment(mesh=mesh8)
+    try:
+        client = KubemlClient(dep.controller_url)
+        paths = write_blob_files(tmp_path)
+        client.v1().datasets().create("blobs", paths["xtr"], paths["ytr"],
+                                      paths["xte"], paths["yte"])
+        req = TrainRequest(model_type="mlp", batch_size=32, epochs=2,
+                           dataset="blobs", lr=0.1,
+                           options=TrainOptions(default_parallelism=2,
+                                                static_parallelism=True,
+                                                k=2))
+        ids = [client.v1().networks().train(req) for _ in range(2)]
+        assert len(set(ids)) == 2
+        for jid in ids:
+            history = wait_history(client, jid, timeout=240)
+            assert len(history.data.train_loss) == 2
+    finally:
+        dep.stop()
