@@ -18,8 +18,7 @@ import jax.numpy as jnp
 import numpy as np
 import optax
 
-from kubeml_tpu import KubeDataset
-from kubeml_tpu.models.base import ClassifierModel
+from kubeml_tpu import ClassifierModel, KubeDataset
 
 # MNIST channel statistics (the reference normalizes identically through
 # torchvision.transforms.Normalize)

@@ -15,8 +15,7 @@ import jax.numpy as jnp
 import numpy as np
 import optax
 
-from kubeml_tpu import KubeDataset
-from kubeml_tpu.models.base import ClassifierModel
+from kubeml_tpu import ClassifierModel, KubeDataset
 from kubeml_tpu.models.resnet import BasicBlock, ResNetModule
 
 CIFAR_MEAN = np.array([0.4914, 0.4822, 0.4465], np.float32)

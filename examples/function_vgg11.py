@@ -10,8 +10,7 @@ function_vgg11.py (used in its max-accuracy/TTA app experiments).
 import numpy as np
 import optax
 
-from kubeml_tpu import KubeDataset
-from kubeml_tpu.models.base import ClassifierModel
+from kubeml_tpu import ClassifierModel, KubeDataset
 from kubeml_tpu.models.vgg import VGGModule
 
 CIFAR_MEAN = np.array([0.5071, 0.4866, 0.4409], np.float32)

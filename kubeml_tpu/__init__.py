@@ -13,7 +13,8 @@ Public API mirrors the reference's `python/kubeml` pip package
 """
 
 from kubeml_tpu.version import __version__
-from kubeml_tpu.models.base import KubeModel, KubeDataset
+from kubeml_tpu.models.base import KubeModel, KubeDataset, ClassifierModel
+from kubeml_tpu.api.types import TrainOptions, TrainRequest
 from kubeml_tpu.api.errors import (
     KubeMLException,
     MergeError,
@@ -28,6 +29,9 @@ __all__ = [
     "__version__",
     "KubeModel",
     "KubeDataset",
+    "ClassifierModel",
+    "TrainOptions",
+    "TrainRequest",
     "KubeMLException",
     "MergeError",
     "DataError",

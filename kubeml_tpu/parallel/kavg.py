@@ -191,11 +191,17 @@ class KAvgEngine:
                 contrib, variables)
             return avg, jnp.stack(loss_sums)
 
+        # Only the data axis is manual (the masked-psum merge); all inner
+        # axes (model/seq/stage/expert) stay AUTO, so variables sharded
+        # over them — e.g. Megatron TP rules via parallel.tp — train
+        # as-is: GSPMD inserts the model-axis collectives inside each DP
+        # lane while the weight average still psums over `data` only.
         sharded = jax.shard_map(
             lane_fn, mesh=mesh,
             in_specs=(P(), P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS),
                       P(DATA_AXIS), P(DATA_AXIS), P(), P()),
             out_specs=(P(), P(DATA_AXIS)),
+            axis_names={DATA_AXIS},
             check_vma=False)
         donate = (0,) if self.donate else ()
         return jax.jit(sharded, donate_argnums=donate)
@@ -267,6 +273,7 @@ class KAvgEngine:
             lane_fn, mesh=mesh,
             in_specs=(P(), P(DATA_AXIS), P(DATA_AXIS)),
             out_specs=(P(), P()),
+            axis_names={DATA_AXIS},
             check_vma=False)
         return jax.jit(sharded)
 

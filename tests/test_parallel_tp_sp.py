@@ -143,3 +143,55 @@ def test_tp_fallback_replicates_indivisible():
     out = shard_variables(tree, mesh, BERT_TP_RULES)
     spec = out["layer_0"]["q"]["kernel"].sharding.spec
     assert spec == jax.sharding.PartitionSpec()
+
+
+def test_kavg_trains_tp_sharded_variables():
+    """DP x TP training: the K-avg round on a 4x2 mesh with Megatron-
+    sharded BERT variables must produce the same averaged weights as the
+    fully-replicated run on a pure-DP mesh (same worker count, same
+    data) — GSPMD handles the model axis inside each DP lane while the
+    merge psums over `data` only."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from kubeml_tpu.models import get_builtin
+    from kubeml_tpu.parallel.kavg import KAvgEngine
+    from kubeml_tpu.parallel.mesh import make_mesh
+    from kubeml_tpu.parallel.tp import BERT_TP_RULES, shard_variables
+
+    model = get_builtin("bert-tiny")()
+    rng = np.random.RandomState(0)
+    W, S, B, T = 4, 2, 4, 16
+    x = rng.randint(1, 1000, size=(W, S, B, T)).astype(np.int32)
+    y = rng.randint(0, 2, size=(W, S, B)).astype(np.int32)
+    batch = {"x": jnp.asarray(x), "y": jnp.asarray(y)}
+    masks = dict(sample_mask=np.ones((W, S, B), np.float32),
+                 step_mask=np.ones((W, S), np.float32),
+                 worker_mask=np.ones(W, np.float32))
+    rngs = rng.randint(0, 2**31, size=(W, S, 2)).astype(np.uint32)
+    variables = model.init_variables(jax.random.PRNGKey(0),
+                                     {"x": jnp.asarray(x[0, 0])})
+
+    def run(mesh, variables):
+        # plain SGD: adamw's g/(sqrt(v)+eps) amplifies bf16 layout noise
+        # on near-zero grads, which would make exact comparison
+        # ill-conditioned without changing what this test proves
+        import optax
+        eng = KAvgEngine(mesh, model.loss, model.metrics,
+                         lambda lr, epoch: optax.sgd(lr), donate=False)
+        out, stats = eng.train_round(variables, batch, rngs=rngs,
+                                     lr=1e-2, epoch=0, **masks)
+        assert stats.contributors == W
+        return out
+
+    ref = run(make_mesh(n_data=4), variables)
+
+    mesh_tp = make_mesh(n_data=4, n_model=2)
+    sharded = shard_variables(variables, mesh_tp, BERT_TP_RULES)
+    out_tp = run(mesh_tp, sharded)
+
+    for pr, pt in zip(jax.tree_util.tree_leaves(ref),
+                      jax.tree_util.tree_leaves(out_tp)):
+        np.testing.assert_allclose(np.asarray(pr), np.asarray(pt),
+                                   rtol=2e-2, atol=2e-3)
