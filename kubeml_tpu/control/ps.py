@@ -134,6 +134,9 @@ class ParameterServer(JsonService):
         self.job_env = job_env or {}
         self.jobs: Dict[str, _JobRecord] = {}
         self._jobs_lock = threading.RLock()
+        import collections
+        self._infer_cache = collections.OrderedDict()
+        self._infer_cache_lock = threading.Lock()
         self.metrics = MetricsRegistry()
         self.fn_registry = FunctionRegistry()
         self.ds_registry = DatasetRegistry()
@@ -201,12 +204,38 @@ class ParameterServer(JsonService):
         model_id = req.body.get("model_id")
         if not model_id:
             raise InvalidArgsError("model_id required")
+        model, variables = self._load_for_infer(model_id)
+        preds = model.infer(variables, np.asarray(req.body.get("data")))
+        return {"predictions": np.asarray(preds).tolist()}
+
+    def _load_for_infer(self, model_id: str):
+        """Checkpoint load with a small LRU keyed on the manifest's
+        saved_at stamp (checkpoint.checkpoint_saved_at — immune to
+        filesystem mtime granularity), so repeated inference against one
+        model doesn't re-read the weights from disk per request (the
+        reference reads live RedisAI tensors — scheduler/api.go:140)."""
+        from kubeml_tpu.train.checkpoint import checkpoint_saved_at
+        saved_at = checkpoint_saved_at(model_id)
+        if saved_at is not None:  # unreadable manifests never hit the cache
+            with self._infer_cache_lock:
+                hit = self._infer_cache.get(model_id)
+                if hit is not None and hit[0] == saved_at:
+                    self._infer_cache.move_to_end(model_id)
+                    return hit[1], hit[2]
         variables, manifest = load_checkpoint(model_id)
         model_cls, _ = self.fn_registry.resolve(
             manifest.get("function") or manifest.get("model"))
         model = model_cls()
-        preds = model.infer(variables, np.asarray(req.body.get("data")))
-        return {"predictions": np.asarray(preds).tolist()}
+        # key on the LOADED manifest's stamp so the (stamp, weights) pair
+        # is consistent even if a save raced the probe above
+        key = manifest.get("saved_at")
+        if key is not None:
+            with self._infer_cache_lock:
+                self._infer_cache[model_id] = (key, model, variables)
+                self._infer_cache.move_to_end(model_id)
+                while len(self._infer_cache) > 4:
+                    self._infer_cache.popitem(last=False)
+        return model, variables
 
     # ------------------------------------------------------------- job mgmt
 

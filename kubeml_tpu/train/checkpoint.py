@@ -91,6 +91,21 @@ def load_checkpoint(job_id: str, root: Optional[str] = None
     return variables, manifest
 
 
+def checkpoint_saved_at(job_id: str, root: Optional[str] = None
+                        ) -> Optional[float]:
+    """The manifest's saved_at stamp, or None when absent/unreadable.
+
+    The cheap freshness probe for caches: save_checkpoint writes a
+    monotonically newer time.time() into every manifest, so comparing
+    saved_at is immune to filesystem mtime granularity."""
+    d = os.path.join(root or _models_root(), job_id)
+    try:
+        with open(os.path.join(d, "manifest.json")) as f:
+            return json.load(f).get("saved_at")
+    except (OSError, ValueError):
+        return None
+
+
 def delete_checkpoint(job_id: str, root: Optional[str] = None) -> None:
     root = root or _models_root()
     d = os.path.join(root, job_id)

@@ -157,3 +157,38 @@ def test_error_envelope_on_bad_requests(stack):
     with pytest.raises(KubeMLException) as ei:
         client.v1().datasets().delete("nonexist1")
     assert ei.value.status_code == 404
+
+
+def test_infer_cache_invalidates_on_new_checkpoint(stack):
+    """Repeated inference hits the PS cache; a re-written checkpoint
+    (same job id) invalidates it."""
+    dep, client, tmp_path = stack
+    paths = write_blob_files(tmp_path)
+    client.v1().datasets().create(
+        "blobs", paths["xtr"], paths["ytr"], paths["xte"], paths["yte"])
+    req = TrainRequest(model_type="mlp", batch_size=32, epochs=1,
+                       dataset="blobs", lr=0.1,
+                       options=TrainOptions(default_parallelism=2,
+                                            static_parallelism=True, k=2))
+    job_id = client.v1().networks().train(req)
+    wait_history(client, job_id)
+
+    x = np.load(paths["xte"])[:4].tolist()
+    p1 = client.v1().networks().infer(job_id, x)
+    assert job_id in dep.ps._infer_cache
+    p2 = client.v1().networks().infer(job_id, x)
+    assert p1 == p2
+
+    # overwrite the checkpoint with different weights -> cache must miss
+    # and the NEW weights must be served
+    from kubeml_tpu.train.checkpoint import (checkpoint_saved_at,
+                                             load_checkpoint,
+                                             save_checkpoint)
+    import jax
+    variables, manifest = load_checkpoint(job_id)
+    zeroed = jax.tree_util.tree_map(lambda a: np.asarray(a) * 0.0, variables)
+    save_checkpoint(job_id, zeroed, manifest)
+    p3 = client.v1().networks().infer(job_id, x)
+    # all-zero weights predict class 0 everywhere — different model served
+    assert p3 == [0] * len(x)
+    assert dep.ps._infer_cache[job_id][0] == checkpoint_saved_at(job_id)
