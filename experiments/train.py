@@ -61,24 +61,34 @@ _SYNTH = {
 }
 
 
+def make_synthetic_split(function: str, n: int, rng) -> tuple:
+    """One (x, y) synthetic split for a sweep function — shared by the
+    distributed driver and the single-node baseline arm so both train on
+    identically-distributed data (text: ragged token ids, pad id 0)."""
+    import numpy as np
+
+    spec = _SYNTH[function]
+    if "seq_len" in spec:
+        T = spec["seq_len"]
+        x = rng.randint(1, spec["vocab"], (n, T)).astype(np.int32)
+        lengths = rng.randint(T // 4, T + 1, n)
+        x[np.arange(T)[None, :] >= lengths[:, None]] = 0
+    else:
+        x = rng.rand(n, *spec["shape"]).astype(np.float32)
+    y = rng.randint(0, spec["classes"], n).astype(np.int64)
+    return x, y
+
+
 def _register_synthetic(client, name: str, function: str) -> None:
     import tempfile
 
     import numpy as np
 
-    spec = _SYNTH[function]
     rng = np.random.RandomState(0)
     with tempfile.TemporaryDirectory() as d:
         paths = {}
         for split, n in (("train", 512), ("test", 128)):
-            if "seq_len" in spec:  # text: ragged token ids, pad id 0
-                T = spec["seq_len"]
-                x = rng.randint(1, spec["vocab"], (n, T)).astype(np.int32)
-                lengths = rng.randint(T // 4, T + 1, n)
-                x[np.arange(T)[None, :] >= lengths[:, None]] = 0
-            else:
-                x = rng.rand(n, *spec["shape"]).astype(np.float32)
-            y = rng.randint(0, spec["classes"], n).astype(np.int64)
+            x, y = make_synthetic_split(function, n, rng)
             np.save(f"{d}/x_{split}.npy", x)
             np.save(f"{d}/y_{split}.npy", y)
             paths[split] = (f"{d}/x_{split}.npy", f"{d}/y_{split}.npy")

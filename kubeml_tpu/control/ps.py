@@ -35,6 +35,7 @@ ml/cmd/ml/main.go:115-133):
 
 from __future__ import annotations
 
+import collections
 import logging
 import os
 import shutil
@@ -55,7 +56,8 @@ from kubeml_tpu.data.registry import DatasetRegistry
 from kubeml_tpu.metrics.prom import MetricsRegistry
 from kubeml_tpu.models.base import KubeDataset
 from kubeml_tpu.parallel.mesh import make_mesh
-from kubeml_tpu.train.checkpoint import load_checkpoint
+from kubeml_tpu.train.checkpoint import (checkpoint_saved_at,
+                                         load_checkpoint)
 from kubeml_tpu.train.functionlib import FunctionRegistry
 from kubeml_tpu.train.history import HistoryStore
 from kubeml_tpu.train.job import JobCallbacks, TrainJob
@@ -134,8 +136,8 @@ class ParameterServer(JsonService):
         self.job_env = job_env or {}
         self.jobs: Dict[str, _JobRecord] = {}
         self._jobs_lock = threading.RLock()
-        import collections
-        self._infer_cache = collections.OrderedDict()
+        self._infer_cache: "collections.OrderedDict" = \
+            collections.OrderedDict()
         self._infer_cache_lock = threading.Lock()
         self.metrics = MetricsRegistry()
         self.fn_registry = FunctionRegistry()
@@ -204,8 +206,11 @@ class ParameterServer(JsonService):
         model_id = req.body.get("model_id")
         if not model_id:
             raise InvalidArgsError("model_id required")
+        data = req.body.get("data")
+        if data is None:
+            raise InvalidArgsError("data required")
         model, variables = self._load_for_infer(model_id)
-        preds = model.infer(variables, np.asarray(req.body.get("data")))
+        preds = model.infer(variables, np.asarray(data))
         return {"predictions": np.asarray(preds).tolist()}
 
     def _load_for_infer(self, model_id: str):
@@ -214,7 +219,6 @@ class ParameterServer(JsonService):
         filesystem mtime granularity), so repeated inference against one
         model doesn't re-read the weights from disk per request (the
         reference reads live RedisAI tensors — scheduler/api.go:140)."""
-        from kubeml_tpu.train.checkpoint import checkpoint_saved_at
         saved_at = checkpoint_saved_at(model_id)
         if saved_at is not None:  # unreadable manifests never hit the cache
             with self._infer_cache_lock:

@@ -107,3 +107,17 @@ def test_resnet50_grid_is_autoscale():
     from experiments.train import GRIDS
     assert GRIDS["resnet50"]["static"] is False
     assert GRIDS["resnet50"]["function"] == "resnet50"
+
+
+def test_single_node_baseline_arm(tmp_path):
+    """The reference's TF/Keras comparison arm, as a plain JAX loop."""
+    from experiments.baseline_train import main as baseline_main
+    out = tmp_path / "baseline.jsonl"
+    rc = baseline_main(["--function", "mlp", "--epochs", "2",
+                        "--batch", "32", "--lr", "0.1",
+                        "--samples", "256", "--out", str(out)])
+    assert rc == 0
+    rows = [json.loads(l) for l in out.read_text().splitlines()]
+    assert len(rows) == 2
+    assert rows[0]["arm"] == "single-node-baseline"
+    assert rows[1]["train_loss"] <= rows[0]["train_loss"] * 1.2
