@@ -34,7 +34,12 @@ from kubeml_tpu.models.base import KubeDataset
 
 @dataclasses.dataclass
 class RoundBatch:
-    """Everything KAvgEngine.train_round needs for one sync round."""
+    """Everything KAvgEngine.train_round needs for one sync round.
+
+    `batch` leaves start as host numpy but may be jax device arrays once
+    a prefetch transform has staged them (TrainJob._stage_batch) — hooks
+    that mutate round contents should touch only the mask fields, which
+    always stay host-side numpy."""
 
     batch: Dict[str, np.ndarray]   # leaves [W, S, B, ...]
     sample_mask: np.ndarray        # [W, S, B]
@@ -97,8 +102,8 @@ def _fill_chunk(xs: np.ndarray, ys: np.ndarray, steps: int, batch: int
             mask.reshape(steps, batch))
 
 
-def prefetch_rounds(rounds: Iterator[RoundBatch], depth: int = 2
-                    ) -> Iterator[RoundBatch]:
+def prefetch_rounds(rounds: Iterator[RoundBatch], depth: int = 2,
+                    transform=None) -> Iterator[RoundBatch]:
     """Assemble upcoming rounds in a background thread.
 
     The native assembler runs under ctypes (GIL released), so round r+1's
@@ -106,26 +111,56 @@ def prefetch_rounds(rounds: Iterator[RoundBatch], depth: int = 2
     TPU-host equivalent of the reference functions' concurrent Mongo
     prefetch while training (dataset.py:150-165). `depth` bounds host
     memory at depth extra round tensors.
+
+    `transform(rb) -> rb` runs in the feeder thread too; the job uses it
+    to device_put the batch with its mesh sharding, so the host->device
+    transfer of round r+1 also overlaps round r's compute. With a
+    device-staging transform, up to depth+2 rounds are device-resident at
+    once (queued + consumer-held + feeder-in-flight) — callers staging to
+    device should pass depth=1.
+
+    If the consumer abandons the iterator (error mid-epoch, early stop),
+    the feeder is told to quit and the queue is drained, so staged
+    rounds don't stay pinned for the life of the process.
     """
     q: "queue.Queue" = queue.Queue(maxsize=depth)
     done = object()
+    abandoned = threading.Event()
+
+    def put(item) -> bool:
+        while not abandoned.is_set():
+            try:
+                q.put(item, timeout=0.1)
+                return True
+            except queue.Full:
+                continue
+        return False
 
     def feeder():
         try:
             for rb in rounds:
-                q.put(rb)
-            q.put(done)
+                if not put(rb if transform is None else transform(rb)):
+                    return
+            put(done)
         except BaseException as e:  # surfaced in the consumer thread
-            q.put(e)
+            put(e)
 
     threading.Thread(target=feeder, daemon=True).start()
-    while True:
-        item = q.get()
-        if item is done:
-            return
-        if isinstance(item, BaseException):
-            raise item
-        yield item
+    try:
+        while True:
+            item = q.get()
+            if item is done:
+                return
+            if isinstance(item, BaseException):
+                raise item
+            yield item
+    finally:
+        abandoned.set()
+        while True:  # release any staged rounds still queued
+            try:
+                q.get_nowait()
+            except queue.Empty:
+                break
 
 
 class RoundLoader:

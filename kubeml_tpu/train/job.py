@@ -257,6 +257,10 @@ class TrainJob:
         self._engine = KAvgEngine(self.mesh, self.model.loss,
                                   self.model.metrics,
                                   self.model.configure_optimizers)
+        from jax.sharding import NamedSharding, PartitionSpec
+        from kubeml_tpu.parallel.mesh import DATA_AXIS
+        self._batch_sharding = NamedSharding(self.mesh,
+                                             PartitionSpec(DATA_AXIS))
         restored = None
         if self.req.resume_from:
             # warm-start from another job's checkpoint (net-new vs the
@@ -291,6 +295,17 @@ class TrainJob:
             self._log("job %s warm-started from checkpoint %s",
                       self.task.job_id, self.req.resume_from)
 
+    def _stage_batch(self, rb):
+        """Runs in the prefetch thread: push the (large) batch leaves to
+        device with the mesh's data-axis sharding, overlapping round
+        r+1's host->device transfer with round r's compute. Masks/rngs
+        stay host-side numpy — they are tiny, the job's abort check and
+        RoundStats read them without a device readback, and round hooks
+        may mutate them (device-resident batch leaves are immutable)."""
+        batch = jax.tree_util.tree_map(
+            lambda a: jax.device_put(a, self._batch_sharding), rb.batch)
+        return dataclasses.replace(rb, batch=batch)
+
     def _train_epoch(self, parallelism: int, epoch: int) -> float:
         plan = self._loader.plan(parallelism, self.req.options.k,
                                  self.req.batch_size)
@@ -301,7 +316,11 @@ class TrainJob:
         # contributor count.
         dev_loss = None
         step_counts = np.zeros(0)
-        rounds = iter(prefetch_rounds(self._loader.epoch_rounds(plan, epoch)))
+        # depth=1: the staging transform makes queued rounds
+        # device-resident, so keep at most ~3 rounds of HBM in flight
+        rounds = iter(prefetch_rounds(self._loader.epoch_rounds(plan, epoch),
+                                      depth=1,
+                                      transform=self._stage_batch))
         while True:
             with self.tracer.span("data_wait"):
                 rb = next(rounds, None)
