@@ -1,0 +1,95 @@
+"""Split-role deployment: each control-plane role in its own OS process
+(`kubeml serve --role ...`), wired together by URLs — the reference's
+one-binary-per-role Kubernetes layout (ml/cmd/ml/main.go:60-156), on
+plain processes."""
+
+import json
+import os
+import subprocess
+import sys
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from kubeml_tpu.utils.env import find_free_port
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _wait_health(url, proc, timeout=90):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if proc.poll() is not None:
+            raise AssertionError(
+                f"role process died with {proc.returncode}")
+        try:
+            urllib.request.urlopen(url + "/health", timeout=2)
+            return
+        except (urllib.error.URLError, OSError):
+            time.sleep(0.3)
+    raise AssertionError(f"{url} never became healthy")
+
+
+def test_split_role_processes_train(tmp_home, tmp_path):
+    env = dict(os.environ)
+    env.update({
+        "KUBEML_TPU_HOME": os.environ["KUBEML_TPU_HOME"],
+        "PYTHONPATH": REPO + os.pathsep + env.get("PYTHONPATH", ""),
+        # force the virtual CPU backend in the children (the PS trains)
+        "PALLAS_AXON_POOL_IPS": "",
+        "JAX_PLATFORMS": "cpu",
+        "JAX_NUM_CPU_DEVICES": "8",
+    })
+    ports = {r: find_free_port() for r in
+             ("storage", "ps", "scheduler", "controller")}
+    urls = {r: f"http://127.0.0.1:{p}" for r, p in ports.items()}
+
+    def serve(role, *extra):
+        return subprocess.Popen(
+            [sys.executable, "-m", "kubeml_tpu.cli.main", "serve",
+             "--role", role, "--port", str(ports[role]), *extra],
+            env=env, cwd=REPO)
+
+    roles = ("storage", "ps", "scheduler", "controller")
+    procs = [serve("storage"),
+             serve("ps", "--scheduler-url", urls["scheduler"]),
+             serve("scheduler", "--ps-url", urls["ps"]),
+             serve("controller", "--scheduler-url", urls["scheduler"],
+                   "--ps-url", urls["ps"],
+                   "--storage-url", urls["storage"])]
+    try:
+        for r, p in zip(roles, procs):
+            _wait_health(urls[r], p)
+
+        from kubeml_tpu.api.types import TrainOptions, TrainRequest
+        from kubeml_tpu.control.client import KubemlClient
+        from tests.test_control_plane import wait_history, write_blob_files
+
+        client = KubemlClient(urls["controller"])
+        paths = write_blob_files(tmp_path)
+        client.v1().datasets().create("blobs", paths["xtr"], paths["ytr"],
+                                      paths["xte"], paths["yte"])
+        req = TrainRequest(model_type="mlp", batch_size=32, epochs=2,
+                           dataset="blobs", lr=0.1,
+                           options=TrainOptions(default_parallelism=2,
+                                                static_parallelism=True,
+                                                k=2))
+        job_id = client.v1().networks().train(req)
+        history = wait_history(client, job_id, timeout=240)
+        assert len(history.data.train_loss) == 2
+
+        # inference against the PS process's checkpoint, via the controller
+        x = np.load(paths["xte"])[:3]
+        preds = client.v1().networks().infer(job_id, x.tolist())
+        assert len(preds) == 3
+    finally:
+        for p in procs:
+            p.terminate()
+        for p in procs:
+            try:
+                p.wait(10)
+            except subprocess.TimeoutExpired:
+                p.kill()
