@@ -41,7 +41,10 @@ class EncoderBlock(nn.Module):
     # set to the mesh seq-axis name for sequence parallelism: the block
     # then runs inside shard_map with [B, T_local, ...] activations and
     # attention becomes the ppermute ring (parallel/ring_attention.py)
+    # or, with seq_impl="ulysses", the all-to-all head-sharded scheme
+    # (parallel/ulysses.py — needs heads % seq-axis == 0)
     seq_axis: Optional[str] = None
+    seq_impl: str = "ring"
 
     @nn.compact
     def __call__(self, h, pad_mask, train: bool, pos=None):
@@ -53,8 +56,15 @@ class EncoderBlock(nn.Module):
                             name="k")(x)
         v = nn.DenseGeneral((self.heads, head_dim), dtype=self.dtype,
                             name="v")(x)
-        if self.seq_axis is not None:
-            # long-context path: KV blocks rotate around the seq ring;
+        if self.seq_axis is not None and self.seq_impl == "ulysses":
+            # long-context path B: two all-to-alls re-shard seq->heads,
+            # stock full attention per head group (flash-eligible)
+            from kubeml_tpu.parallel.ulysses import ulysses_attention
+            attn = ulysses_attention(q, k, v, kv_mask=pad_mask,
+                                     causal=False,
+                                     axis_name=self.seq_axis)
+        elif self.seq_axis is not None:
+            # long-context path A: KV blocks rotate around the seq ring;
             # full attention over the GLOBAL sequence, O(T_local^2) HBM
             from kubeml_tpu.parallel.ring_attention import ring_attention
             attn = ring_attention(q, k, v, q_pos=pos, kv_pos=pos,
@@ -86,6 +96,7 @@ class BertModule(nn.Module):
     dropout: float = 0.1
     dtype: jnp.dtype = jnp.bfloat16
     seq_axis: Optional[str] = None  # sequence-parallel mode (see below)
+    seq_impl: str = "ring"          # 'ring' | 'ulysses'
 
     @nn.compact
     def __call__(self, x, train: bool = False):
@@ -115,6 +126,7 @@ class BertModule(nn.Module):
         for i in range(self.layers):
             h = EncoderBlock(self.hidden, self.heads, self.ffn, self.dropout,
                              self.dtype, seq_axis=self.seq_axis,
+                             seq_impl=self.seq_impl,
                              name=f"layer_{i}")(h, pad_mask, train,
                                                 pos=pos_ids)
         h = nn.LayerNorm(dtype=jnp.float32)(h)
@@ -143,7 +155,7 @@ class BertTiny(ClassifierModel):
     def configure_optimizers(self, lr, epoch):
         return optax.adamw(lr, weight_decay=0.01)
 
-    def forward_seq_parallel(self, variables, x, mesh):
+    def forward_seq_parallel(self, variables, x, mesh, impl="ring"):
         """Long-context forward over the mesh `seq` axis.
 
         x: [B, T] with T divisible by the seq-axis size; the same
@@ -151,23 +163,29 @@ class BertTiny(ClassifierModel):
         execution is sharded). Returns [B, num_classes] logits equal to
         the dense forward — no chip ever materializes the full sequence
         or an O(T^2) score tensor.
+
+        impl: 'ring' (ppermute KV rotation) or 'ulysses' (all-to-all
+        head sharding; needs heads % seq-axis == 0).
         """
         from jax.sharding import PartitionSpec as P
 
         from kubeml_tpu.parallel.mesh import SEQ_AXIS
 
+        if impl not in ("ring", "ulysses"):
+            raise ValueError(f"unknown seq-parallel impl {impl!r}; "
+                             f"expected 'ring' or 'ulysses'")
         n_seq = mesh.shape[SEQ_AXIS]
         if x.shape[1] % n_seq:
             raise ValueError(
                 f"sequence length {x.shape[1]} not divisible by the "
                 f"seq-axis size {n_seq}")
-        key = (mesh, x.shape[1] // n_seq)
+        key = (mesh, x.shape[1] // n_seq, impl)
         if not hasattr(self, "_sp_cache"):
             self._sp_cache = {}
         if key not in self._sp_cache:
             # clone copies every dense-module field, overriding only the
             # execution mode — dense/seq-parallel parity by construction
-            sp_module = self.module.clone(seq_axis=SEQ_AXIS)
+            sp_module = self.module.clone(seq_axis=SEQ_AXIS, seq_impl=impl)
 
             def fwd(variables, x_local):
                 return sp_module.apply(variables, x_local, train=False)
