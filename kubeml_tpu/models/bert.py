@@ -56,6 +56,9 @@ class EncoderBlock(nn.Module):
                             name="k")(x)
         v = nn.DenseGeneral((self.heads, head_dim), dtype=self.dtype,
                             name="v")(x)
+        if self.seq_impl not in ("ring", "ulysses"):  # static field
+            raise ValueError(f"unknown seq_impl {self.seq_impl!r}; "
+                             f"expected 'ring' or 'ulysses'")
         if self.seq_axis is not None and self.seq_impl == "ulysses":
             # long-context path B: two all-to-alls re-shard seq->heads,
             # stock full attention per head group (flash-eligible)

@@ -5,8 +5,9 @@ from kubeml_tpu.parallel.pp import (pipeline_apply, sequential_apply,
 from kubeml_tpu.parallel.ep import init_moe_params, moe_apply
 from kubeml_tpu.parallel.distributed import (initialize, is_coordinator,
                                              make_multislice_mesh)
+from kubeml_tpu.parallel.syncdp import SyncDPEngine
 
 __all__ = ["make_mesh", "data_axis_size", "KAvgEngine", "RoundStats",
            "pipeline_apply", "sequential_apply", "stack_stage_params",
            "init_moe_params", "moe_apply", "initialize", "is_coordinator",
-           "make_multislice_mesh"]
+           "make_multislice_mesh", "SyncDPEngine"]

@@ -96,6 +96,23 @@ def _select_tree(mask: jax.Array, new: PyTree, old: PyTree) -> PyTree:
         lambda n, o: jnp.where(mask.astype(jnp.bool_), n, o), new, old)
 
 
+def masked_scalar_loss(loss_fn: LossFn, model_state: PyTree, batch: PyTree,
+                       rng: jax.Array, smask: jax.Array):
+    """params -> (masked-mean loss, new model state) — THE per-step loss
+    definition shared by every training engine (K-avg and sync-DP), so
+    the masked-mean semantics (padded examples excluded, zero-sample
+    guard) cannot silently diverge between them."""
+
+    def scalar(params):
+        per_ex, new_state = loss_fn(
+            {"params": params, **model_state}, batch,
+            jax.random.wrap_key_data(rng), smask)
+        denom = jnp.maximum(smask.sum(), 1.0)
+        return (per_ex * smask).sum() / denom, new_state
+
+    return scalar
+
+
 class KAvgEngine:
     """Builds and caches the jitted sync-round and eval-round programs.
 
@@ -140,16 +157,9 @@ class KAvgEngine:
             def step(carry, xs):
                 params, model_state, opt_state = carry
                 batch, smask, stmask, rng = xs
-
-                def scalar_loss(p):
-                    per_ex, new_state = loss_fn(
-                        {"params": p, **model_state}, batch,
-                        jax.random.wrap_key_data(rng), smask)
-                    denom = jnp.maximum(smask.sum(), 1.0)
-                    return (per_ex * smask).sum() / denom, new_state
-
                 (loss, new_state), grads = jax.value_and_grad(
-                    scalar_loss, has_aux=True)(params)
+                    masked_scalar_loss(loss_fn, model_state, batch, rng,
+                                       smask), has_aux=True)(params)
                 updates, new_opt = tx.update(grads, opt_state, params)
                 new_params = optax.apply_updates(params, updates)
                 params = _select_tree(stmask, new_params, params)

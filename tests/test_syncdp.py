@@ -1,0 +1,167 @@
+"""SyncDPEngine: per-step gradient averaging + ZeRO-1 state sharding.
+
+Runs on the 8-virtual-CPU-device mesh (conftest).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from kubeml_tpu.models import get_builtin
+from kubeml_tpu.parallel.mesh import DATA_AXIS
+from kubeml_tpu.parallel.syncdp import SyncDPEngine
+
+S, B = 4, 32  # steps per dispatch, global batch
+
+
+def _problem(seed=0, n_features=16, ncls=4):
+    model = get_builtin("mlp")(hidden=32, num_classes=ncls)
+    rng = np.random.RandomState(seed)
+    centers = rng.randn(ncls, n_features) * 3
+    y = rng.randint(0, ncls, size=(S * 6, B)).astype(np.int32)
+    x = (centers[y] + rng.randn(*y.shape, n_features)).astype(np.float32)
+    variables = model.init_variables(jax.random.PRNGKey(0),
+                                     {"x": jnp.asarray(x[0])})
+    return model, x, y, variables
+
+
+def _single_stream(model, variables, x, y, rngs, tx, steps):
+    """Reference: plain sequential training on the full global batch."""
+    params = variables["params"]
+    opt_state = tx.init(params)
+
+    @jax.jit
+    def step(params, opt_state, mb, rng):
+        def scalar_loss(p):
+            per_ex, _ = model.loss({"params": p}, mb,
+                                   jax.random.wrap_key_data(rng),
+                                   jnp.ones(mb["y"].shape[0]))
+            return per_ex.mean()
+
+        grads = jax.grad(scalar_loss)(params)
+        updates, opt_state2 = tx.update(grads, opt_state, params)
+        return optax.apply_updates(params, updates), opt_state2
+
+    for i in range(steps):
+        params, opt_state = step(
+            params, opt_state,
+            {"x": jnp.asarray(x[i]), "y": jnp.asarray(y[i])},
+            jnp.asarray(rngs[i]))
+    return params
+
+
+@pytest.mark.parametrize("zero1", [True, False])
+def test_syncdp_matches_single_stream(mesh8, zero1):
+    """Sharded-batch + (optionally) sharded-opt-state training equals the
+    same adam steps run sequentially on one stream — GSPMD's inserted
+    collectives change nothing numerically (f32 model)."""
+    model, x, y, variables = _problem()
+    rngs = np.random.RandomState(1).randint(
+        0, 2**31, size=(S * 2, 2)).astype(np.uint32)
+
+    tx = optax.adam(1e-2)
+    ref_params = _single_stream(model, variables, x, y, rngs, tx, S * 2)
+
+    eng = SyncDPEngine(mesh8, model.loss, lambda lr, epoch: optax.adam(1e-2),
+                       zero1=zero1, donate=False)
+    state = eng.init_state(variables)
+    for r in range(2):
+        sl = slice(r * S, (r + 1) * S)
+        state, losses = eng.train_steps(
+            state, {"x": jnp.asarray(x[sl]), "y": jnp.asarray(y[sl])},
+            np.ones((S, B), np.float32), rngs[sl], lr=0.0, epoch=0)
+        assert losses.shape == (S,)
+    for pr, pe in zip(jax.tree_util.tree_leaves(ref_params),
+                      jax.tree_util.tree_leaves(state["params"])):
+        np.testing.assert_allclose(np.asarray(pe), np.asarray(pr),
+                                   rtol=1e-4, atol=1e-5)
+
+
+def test_zero1_opt_state_is_sharded(mesh8):
+    """Adam's m/v for divisible-dim-0 leaves land sharded over `data`
+    (each device stores 1/8), and zero1=False keeps them replicated."""
+    model, x, y, variables = _problem()
+
+    for zero1, want in ((True, P(DATA_AXIS)), (False, P())):
+        eng = SyncDPEngine(mesh8, model.loss,
+                           lambda lr, epoch: optax.adam(1e-2),
+                           zero1=zero1, donate=False)
+        state = eng.init_state(variables)
+        mu = state["opt_state"][0].mu  # adam first moment, mirrors params
+        # find a leaf with divisible dim 0 (16 or 32 features, lanes=8)
+        leaves = [l for l in jax.tree_util.tree_leaves(mu)
+                  if l.ndim >= 1 and l.shape[0] % 8 == 0]
+        assert leaves, "test problem must have a divisible leaf"
+        assert all(l.sharding.spec == want for l in leaves), zero1
+        if zero1:
+            shard = leaves[0].addressable_shards[0].data
+            assert shard.shape[0] == leaves[0].shape[0] // 8
+
+        # the layout must survive a training dispatch (the scan carry)
+        state, _ = eng.train_steps(
+            state, {"x": jnp.asarray(x[:S]), "y": jnp.asarray(y[:S])},
+            np.ones((S, B), np.float32),
+            np.zeros((S, 2), np.uint32), lr=0.0, epoch=0)
+        mu2 = state["opt_state"][0].mu
+        leaves2 = [l for l in jax.tree_util.tree_leaves(mu2)
+                   if l.ndim >= 1 and l.shape[0] % 8 == 0]
+        assert all(l.sharding.spec == want for l in leaves2), zero1
+
+
+def test_syncdp_padded_samples_do_not_contribute(mesh8):
+    """A zero sample_mask entry must leave the update identical to the
+    batch without that example (masked-mean grads)."""
+    model, x, y, variables = _problem(seed=2)
+    eng = SyncDPEngine(mesh8, model.loss, lambda lr, epoch: optax.sgd(0.1),
+                       zero1=False, donate=False)
+    rngs = np.zeros((1, 2), np.uint32)
+
+    # batch A: B real examples; batch B: same but last 8 are garbage + masked
+    xa, ya = x[:1], y[:1]
+    xb = xa.copy()
+    xb[0, B - 8:] = 1e3  # poison the padded slots
+    mask = np.ones((1, B), np.float32)
+    mask[0, B - 8:] = 0.0
+
+    sa = eng.init_state(variables)
+    sa, _ = eng.train_steps(sa, {"x": jnp.asarray(xa[:, :B - 8]),
+                                 "y": jnp.asarray(ya[:, :B - 8])},
+                            np.ones((1, B - 8), np.float32), rngs,
+                            lr=0.0, epoch=0)
+    sb = eng.init_state(variables)
+    sb, _ = eng.train_steps(sb, {"x": jnp.asarray(xb), "y": jnp.asarray(ya)},
+                            mask, rngs, lr=0.0, epoch=0)
+    for pa, pb in zip(jax.tree_util.tree_leaves(sa["params"]),
+                      jax.tree_util.tree_leaves(sb["params"])):
+        np.testing.assert_allclose(np.asarray(pb), np.asarray(pa),
+                                   rtol=1e-5, atol=1e-6)
+
+
+def test_syncdp_converges_and_state_persists(mesh8):
+    """Loss falls across dispatches WITHOUT optimizer reset — the defining
+    difference from the K-avg engine (which re-inits opt state per round;
+    adam's momentum here must carry across train_steps calls)."""
+    model, x, y, variables = _problem(seed=3)
+    eng = SyncDPEngine(mesh8, model.loss,
+                       lambda lr, epoch: optax.adam(1e-2), donate=False)
+    state = eng.init_state(variables)
+    rng = np.random.RandomState(0)
+    first = last = None
+    for r in range(6):
+        sl = slice(r * S, (r + 1) * S)
+        state, losses = eng.train_steps(
+            state, {"x": jnp.asarray(x[sl]), "y": jnp.asarray(y[sl])},
+            np.ones((S, B), np.float32),
+            rng.randint(0, 2**31, size=(S, 2)).astype(np.uint32),
+            lr=0.0, epoch=0)
+        mean = float(np.asarray(losses).mean())
+        first = mean if first is None else first
+        last = mean
+    assert last < first * 0.5, (first, last)
+    # adam's step count advanced across all dispatches (no reset)
+    counts = [l for l in jax.tree_util.tree_leaves(state["opt_state"])
+              if getattr(l, "ndim", None) == 0]
+    assert any(int(c) == 6 * S for c in counts), counts
