@@ -122,16 +122,35 @@ class KAvgEngine:
     """
 
     def __init__(self, mesh: Mesh, loss_fn: LossFn, metrics_fn: MetricsFn,
-                 tx_factory: TxFactory, donate: bool = True):
+                 tx_factory: TxFactory, donate: bool = True,
+                 merge_dtype: Any = None):
         """donate=True donates the input variables buffer to each
         train_round (frees a full model copy of HBM) — the caller must then
         always continue from the *returned* variables, never reuse the
-        argument. Pass donate=False for interactive/experimental use."""
+        argument. Pass donate=False for interactive/experimental use.
+
+        merge_dtype compresses the merge collective: the summed weight
+        contributions are cast to this dtype (e.g. jnp.bfloat16) before
+        the cross-lane psum, halving the all-reduce bytes on ICI — and,
+        on multislice meshes, on the much slower DCN phase. None (default)
+        keeps the reduction in float32. This is the TPU-native analog of
+        the gradient-compression family the reference lacks entirely
+        (SURVEY.md §2a "Absent: ... gradient compression"): lossy
+        compression applied exactly at the communication boundary, with
+        local math still in f32."""
         self.mesh = mesh
         self.loss_fn = loss_fn
         self.metrics_fn = metrics_fn
         self.tx_factory = tx_factory
         self.donate = donate
+        self.merge_dtype = merge_dtype
+        if merge_dtype is not None:
+            inner = mesh.size // mesh.shape[DATA_AXIS]
+            if inner != 1:
+                raise ValueError(
+                    "merge_dtype compression requires a pure-DP mesh "
+                    f"(inner axes size 1, got {inner}); use the f32 merge "
+                    "when composing with tensor/seq/pipeline sharding")
         self.n_lanes = mesh.shape[DATA_AXIS]
         self._train_cache: Dict[Any, Callable] = {}
         self._eval_cache: Dict[Any, Callable] = {}
@@ -196,9 +215,24 @@ class KAvgEngine:
 
             raw_count = lax.psum(worker_mask.sum(), DATA_AXIS)
             count = jnp.maximum(raw_count, 1.0)  # guard 0-contributor divide
-            avg = jax.tree_util.tree_map(
-                lambda c, ref: (lax.psum(c, DATA_AXIS) / count).astype(ref.dtype),
-                contrib, variables)
+            merge_dtype = self.merge_dtype
+
+            def merge_leaf(c, ref):
+                # integer leaves (BatchNorm counters) stay uncompressed:
+                # bf16's 8-bit mantissa would drift a counter > 256 even
+                # when every worker agrees, breaking the exact average-
+                # and-truncate contract above
+                if (merge_dtype is not None
+                        and jnp.issubdtype(ref.dtype, jnp.floating)):
+                    # compress at the communication boundary only: local
+                    # accumulation stays f32, the wire carries merge_dtype
+                    # (float compression is scale-invariant, so the raw
+                    # contribution sum loses no more than ~2^-8 relative)
+                    s = lax.psum(c.astype(merge_dtype), DATA_AXIS)
+                    return (s.astype(jnp.float32) / count).astype(ref.dtype)
+                return (lax.psum(c, DATA_AXIS) / count).astype(ref.dtype)
+
+            avg = jax.tree_util.tree_map(merge_leaf, contrib, variables)
             return avg, jnp.stack(loss_sums)
 
         # Only the data axis is manual (the masked-psum merge); all inner
@@ -206,13 +240,19 @@ class KAvgEngine:
         # over them — e.g. Megatron TP rules via parallel.tp — train
         # as-is: GSPMD inserts the model-axis collectives inside each DP
         # lane while the weight average still psums over `data` only.
+        # Exception: with merge_dtype the shard_map goes FULL manual —
+        # the SPMD partitioner miscompiles a sub-f32 all-reduce on
+        # partially-manual meshes ("invalid binary instruction opcode
+        # copy") — which is why compression requires a pure-DP mesh.
+        shmap_kwargs: Dict[str, Any] = dict(axis_names={DATA_AXIS})
+        if self.merge_dtype is not None:  # pure-DP checked in __init__
+            shmap_kwargs = {}
         sharded = jax.shard_map(
             lane_fn, mesh=mesh,
             in_specs=(P(), P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS),
                       P(DATA_AXIS), P(DATA_AXIS), P(), P()),
             out_specs=(P(), P(DATA_AXIS)),
-            axis_names={DATA_AXIS},
-            check_vma=False)
+            check_vma=False, **shmap_kwargs)
         donate = (0,) if self.donate else ()
         return jax.jit(sharded, donate_argnums=donate)
 

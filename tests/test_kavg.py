@@ -100,6 +100,48 @@ class TestTrainRound:
                                    rtol=1e-5)
         assert stats.contributors == 6
 
+    def test_compressed_merge_close_to_f32(self, mesh8, rng):
+        """merge_dtype=bf16 halves the all-reduce bytes; the result must
+        stay within bf16 relative error of the f32 merge, including with
+        masked (straggler) workers."""
+        W, S, B, lr = 8, 3, 4, 0.05
+        xs, ys = make_round_data(rng, W, S, B)
+        w0 = rng.randn(D).astype(np.float32)
+        worker_mask = np.array([1, 1, 0, 1, 1, 1, 0, 1], dtype=float)
+        kw = dict(sample_mask=np.ones((W, S, B)), step_mask=np.ones((W, S)),
+                  worker_mask=worker_mask,
+                  rngs=np.zeros((W, S, 2), np.uint32), lr=lr, epoch=0)
+        batch = {"x": jnp.asarray(xs), "y": jnp.asarray(ys)}
+        variables = {"params": {"w": jnp.asarray(w0)}}
+
+        ref_eng = KAvgEngine(mesh8, linear_loss, linear_metrics, sgd_factory,
+                             donate=False)
+        ref, _ = ref_eng.train_round(variables, batch, **kw)
+        eng = KAvgEngine(mesh8, linear_loss, linear_metrics, sgd_factory,
+                         donate=False, merge_dtype=jnp.bfloat16)
+        out, stats = eng.train_round(variables, batch, **kw)
+        assert stats.contributors == 6
+        a, b = np.asarray(out["params"]["w"]), np.asarray(ref["params"]["w"])
+        np.testing.assert_allclose(a, b, rtol=2e-2, atol=2e-2)
+        assert not np.allclose(a, b, rtol=1e-6, atol=0)  # really compressed
+
+        # the compressed engine still trains: a few rounds reduce loss
+        var, first = variables, None
+        for r in range(5):
+            var, st = eng.train_round(var, batch, **kw)
+            loss = float(st.loss_sum.sum())
+            first = loss if first is None else first
+        assert loss < first
+
+    def test_compressed_merge_rejects_inner_axes(self, mesh4x2, rng):
+        """Compression is pure-DP only (full-manual shard_map); a DP x TP
+        mesh must fail loudly instead of miscompiling."""
+        W, S, B = 4, 2, 4
+        xs, ys = make_round_data(rng, W, S, B)
+        with pytest.raises(ValueError, match="pure-DP"):
+            KAvgEngine(mesh4x2, linear_loss, linear_metrics, sgd_factory,
+                       donate=False, merge_dtype=jnp.bfloat16)
+
     def test_step_mask_freezes_padded_steps(self, mesh8, rng):
         """Ragged chunks: a masked step must leave weights untouched."""
         W, S, B, lr = 8, 3, 4, 0.05
@@ -166,17 +208,22 @@ class TestTrainRound:
             per_ex, _ = linear_loss(variables, batch, rng_, sm)
             return per_ex, {"state": {"count": variables["state"]["count"] + 1}}
 
-        engine = KAvgEngine(mesh8, loss_with_counter, linear_metrics,
-                            sgd_factory)
-        variables = {"params": {"w": jnp.zeros(D, jnp.float32)},
-                     "state": {"count": jnp.asarray(7, jnp.int32)}}
-        avg, _ = engine.train_round(
-            variables, {"x": jnp.asarray(xs), "y": jnp.asarray(ys)},
-            sample_mask=np.ones((W, S, B)), step_mask=np.ones((W, S)),
-            worker_mask=np.ones(W), rngs=np.zeros((W, S, 2), np.uint32),
-            lr=0.0, epoch=0)
-        assert avg["state"]["count"].dtype == jnp.int32
-        assert int(avg["state"]["count"]) == 8
+        # int leaves must stay EXACT in both merge modes — bf16 compression
+        # skips non-float leaves (a 7-bit mantissa would drift counters)
+        for merge_dtype, start, want in ((None, 7, 8), (jnp.bfloat16, 7, 8),
+                                         (jnp.bfloat16, 1336, 1337)):
+            engine = KAvgEngine(mesh8, loss_with_counter, linear_metrics,
+                                sgd_factory, donate=False,
+                                merge_dtype=merge_dtype)
+            variables = {"params": {"w": jnp.zeros(D, jnp.float32)},
+                         "state": {"count": jnp.asarray(start, jnp.int32)}}
+            avg, _ = engine.train_round(
+                variables, {"x": jnp.asarray(xs), "y": jnp.asarray(ys)},
+                sample_mask=np.ones((W, S, B)), step_mask=np.ones((W, S)),
+                worker_mask=np.ones(W), rngs=np.zeros((W, S, 2), np.uint32),
+                lr=0.0, epoch=0)
+            assert avg["state"]["count"].dtype == jnp.int32
+            assert int(avg["state"]["count"]) == want, merge_dtype
 
 
 class TestEvalRound:
