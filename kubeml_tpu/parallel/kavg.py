@@ -145,6 +145,10 @@ class KAvgEngine:
         self.donate = donate
         self.merge_dtype = merge_dtype
         if merge_dtype is not None:
+            if not jnp.issubdtype(jnp.dtype(merge_dtype), jnp.floating):
+                raise ValueError(
+                    f"merge_dtype must be a floating dtype, got "
+                    f"{jnp.dtype(merge_dtype)}")
             inner = mesh.size // mesh.shape[DATA_AXIS]
             if inner != 1:
                 raise ValueError(
@@ -225,9 +229,11 @@ class KAvgEngine:
                 if (merge_dtype is not None
                         and jnp.issubdtype(ref.dtype, jnp.floating)):
                     # compress at the communication boundary only: local
-                    # accumulation stays f32, the wire carries merge_dtype
-                    # (float compression is scale-invariant, so the raw
-                    # contribution sum loses no more than ~2^-8 relative)
+                    # accumulation stays f32, the wire carries merge_dtype.
+                    # Error: ~2^-8 relative per cast PLUS the psum chain
+                    # accumulating in bf16, so worst case grows with the
+                    # lane count (~D*2^-8) — acceptable for weight
+                    # averaging, not for exact counters (skipped above)
                     s = lax.psum(c.astype(merge_dtype), DATA_AXIS)
                     return (s.astype(jnp.float32) / count).astype(ref.dtype)
                 return (lax.psum(c, DATA_AXIS) / count).astype(ref.dtype)

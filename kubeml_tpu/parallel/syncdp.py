@@ -24,6 +24,12 @@ collectives:
     replicated params. A `with_sharding_constraint` inside the scan body
     pins the layout so it persists across steps instead of decaying to
     whatever the partitioner prefers;
+  - FSDP (ZeRO-3): `fsdp=True` extends the same layout rule to the
+    PARAMETERS — each chip stores 1/D of the model; GSPMD all-gathers a
+    layer's weights at its use site in forward/backward and
+    reduce-scatters the grads back to the shards. Zero model code
+    changes: FSDP here is literally a different `PartitionSpec` on the
+    same program;
   - S steps run as one `lax.scan` under a single jit — one dispatch per
     round, same async-dispatch discipline as the K-avg engine.
 
@@ -60,18 +66,25 @@ class SyncDPEngine:
     """
 
     def __init__(self, mesh: Mesh, loss_fn: Callable, tx_factory: Callable,
-                 zero1: bool = True, donate: bool = True):
+                 zero1: bool = True, fsdp: bool = False,
+                 donate: bool = True):
         """zero1=True shards optimizer state over the data axis (ZeRO-1);
+        fsdp=True additionally shards the PARAMETERS over the data axis
+        (ZeRO-3 / FSDP: each chip stores 1/D of the model and GSPMD
+        all-gathers each layer at use, reduce-scattering the grads), for
+        models too large to replicate per chip. fsdp implies zero1.
         donate=True donates the carried state to each train_steps call —
         thread the returned state, never reuse the argument."""
         self.mesh = mesh
         self.loss_fn = loss_fn
         self.tx_factory = tx_factory
-        self.zero1 = zero1
+        self.zero1 = zero1 or fsdp
+        self.fsdp = fsdp
         self.donate = donate
         self.n_lanes = mesh.shape[DATA_AXIS]
         self._cache: Dict[Any, Callable] = {}
         self._opt_specs: Optional[PyTree] = None
+        self._param_specs: Optional[PyTree] = None
 
     # ----------------------------------------------------------------- state
 
@@ -86,11 +99,18 @@ class SyncDPEngine:
 
     def init_state(self, variables: PyTree, lr: float = 0.0,
                    epoch: int = 0) -> PyTree:
-        """Build {params, model_state, opt_state} with opt_state laid out
-        per the ZeRO rule. lr/epoch only parameterize schedules whose state
-        shape depends on them (none of the stock optax ones do)."""
+        """Build {params, model_state, opt_state} with opt_state (and,
+        with fsdp, params) laid out per the ZeRO rule. lr/epoch only
+        parameterize schedules whose state shape depends on them (none of
+        the stock optax ones do)."""
         tx = self.tx_factory(jnp.float32(lr), jnp.int32(epoch))
         params = variables["params"]
+        self._param_specs = jax.tree_util.tree_map(
+            self._opt_spec_for if self.fsdp else (lambda _: P()), params)
+        params = jax.tree_util.tree_map(
+            lambda x, spec: jax.device_put(x, NamedSharding(self.mesh,
+                                                            spec)),
+            params, self._param_specs)
         opt_state = jax.eval_shape(tx.init, params)
         self._opt_specs = jax.tree_util.tree_map(self._opt_spec_for,
                                                  opt_state)
@@ -110,7 +130,7 @@ class SyncDPEngine:
 
     # ----------------------------------------------------------------- train
 
-    def _build(self, opt_specs):
+    def _build(self, opt_specs, param_specs):
         mesh = self.mesh
         loss_fn = self.loss_fn
         tx_factory = self.tx_factory
@@ -126,11 +146,15 @@ class SyncDPEngine:
                                        smask), has_aux=True)(params)
                 updates, new_opt = tx.update(grads, opt_state, params)
                 new_params = optax.apply_updates(params, updates)
-                # pin the ZeRO layout so it survives the scan carry
+                # pin the ZeRO/FSDP layouts so they survive the scan carry
                 new_opt = jax.tree_util.tree_map(
                     lambda x, spec: lax.with_sharding_constraint(
                         x, NamedSharding(mesh, spec)),
                     new_opt, opt_specs)
+                new_params = jax.tree_util.tree_map(
+                    lambda x, spec: lax.with_sharding_constraint(
+                        x, NamedSharding(mesh, spec)),
+                    new_params, param_specs)
                 return (new_params, new_state, new_opt), loss
 
             (params, model_state, opt_state), losses = lax.scan(
@@ -166,8 +190,8 @@ class SyncDPEngine:
                 batch)
             state_sh = {
                 "params": jax.tree_util.tree_map(
-                    lambda _: NamedSharding(self.mesh, P()),
-                    state["params"]),
+                    lambda spec: NamedSharding(self.mesh, spec),
+                    self._param_specs),
                 "model_state": jax.tree_util.tree_map(
                     lambda _: NamedSharding(self.mesh, P()),
                     state["model_state"]),
@@ -178,7 +202,7 @@ class SyncDPEngine:
             rep = NamedSharding(self.mesh, P())
             mask_sh = NamedSharding(self.mesh, P(None, DATA_AXIS))
             self._cache[key] = jax.jit(
-                self._build(self._opt_specs),
+                self._build(self._opt_specs, self._param_specs),
                 in_shardings=(state_sh, batch_sh, mask_sh, rep, rep, rep),
                 # pin outputs to the input layout: without this GSPMD may
                 # return params/opt leaves in whatever sharding propagation

@@ -135,12 +135,14 @@ class TestTrainRound:
 
     def test_compressed_merge_rejects_inner_axes(self, mesh4x2, rng):
         """Compression is pure-DP only (full-manual shard_map); a DP x TP
-        mesh must fail loudly instead of miscompiling."""
-        W, S, B = 4, 2, 4
-        xs, ys = make_round_data(rng, W, S, B)
+        mesh must fail loudly instead of miscompiling, as must a
+        non-float wire dtype."""
         with pytest.raises(ValueError, match="pure-DP"):
             KAvgEngine(mesh4x2, linear_loss, linear_metrics, sgd_factory,
                        donate=False, merge_dtype=jnp.bfloat16)
+        with pytest.raises(ValueError, match="floating"):
+            KAvgEngine(mesh4x2, linear_loss, linear_metrics, sgd_factory,
+                       donate=False, merge_dtype=jnp.int16)
 
     def test_step_mask_freezes_padded_steps(self, mesh8, rng):
         """Ragged chunks: a masked step must leave weights untouched."""
