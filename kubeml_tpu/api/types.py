@@ -29,6 +29,10 @@ class TrainOptions:
     # net-new vs the reference (which has no checkpointing, SURVEY.md §5):
     # also checkpoint every N epochs (0 = final checkpoint only)
     checkpoint_every: int = 0
+    # net-new: training engine — 'kavg' is the reference's K-step local
+    # SGD with weight averaging; 'syncdp' is per-step gradient averaging
+    # with persistent optimizer state (parallel/syncdp.py; K is ignored)
+    engine: str = "kavg"
 
     def to_dict(self) -> dict:
         return {
@@ -38,6 +42,7 @@ class TrainOptions:
             "K": self.k,
             "goal_accuracy": self.goal_accuracy,
             "checkpoint_every": self.checkpoint_every,
+            "engine": self.engine,
         }
 
     @classmethod
@@ -49,6 +54,7 @@ class TrainOptions:
             k=d.get("K", d.get("k", 1)),
             goal_accuracy=d.get("goal_accuracy", 100.0),
             checkpoint_every=d.get("checkpoint_every", 0),
+            engine=d.get("engine", "kavg"),
         )
 
 

@@ -69,7 +69,8 @@ def cmd_train(args):
             static_parallelism=args.static,
             validate_every=args.validate_every, k=k,
             goal_accuracy=args.goal_accuracy,
-            checkpoint_every=args.checkpoint_every))
+            checkpoint_every=args.checkpoint_every,
+            engine=args.engine))
     job_id = client.v1().networks().train(req)
     print(job_id)
 
@@ -282,6 +283,10 @@ def build_parser() -> argparse.ArgumentParser:
                    help="warm-start from another job's checkpoint")
     t.add_argument("--checkpoint-every", type=int, default=0, metavar="N",
                    help="also checkpoint every N epochs (0 = final only)")
+    t.add_argument("--engine", choices=("kavg", "syncdp"), default="kavg",
+                   help="kavg = K-step local SGD with weight averaging "
+                        "(reference semantics); syncdp = per-step gradient "
+                        "averaging with persistent optimizer state")
     t.set_defaults(fn=cmd_train)
 
     i = sub.add_parser("infer", help="run inference on a trained model")

@@ -52,7 +52,7 @@ import optax
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from kubeml_tpu.parallel.kavg import masked_scalar_loss
+from kubeml_tpu.parallel.kavg import _select_tree, masked_scalar_loss
 from kubeml_tpu.parallel.mesh import DATA_AXIS
 
 PyTree = Any
@@ -146,6 +146,12 @@ class SyncDPEngine:
                                        smask), has_aux=True)(params)
                 updates, new_opt = tx.update(grads, opt_state, params)
                 new_params = optax.apply_updates(params, updates)
+                # an all-masked step (ragged epoch tail) must be a true
+                # no-op: zero grads alone would still move adam's momentum
+                stmask = (smask.sum() > 0).astype(jnp.float32)
+                new_params = _select_tree(stmask, new_params, params)
+                new_state = _select_tree(stmask, new_state, model_state)
+                new_opt = _select_tree(stmask, new_opt, opt_state)
                 # pin the ZeRO/FSDP layouts so they survive the scan carry
                 new_opt = jax.tree_util.tree_map(
                     lambda x, spec: lax.with_sharding_constraint(

@@ -254,13 +254,28 @@ class TrainJob:
         self._loader = RoundLoader(handle, self.dataset,
                                    n_lanes=data_axis_size(self.mesh),
                                    seed=self.seed)
+        engine_kind = self.req.options.engine
+        if engine_kind not in ("kavg", "syncdp"):
+            raise KubeMLException(
+                f"unknown training engine {engine_kind!r}; "
+                f"expected 'kavg' or 'syncdp'", 400)
+        # the K-avg engine always exists: it runs kavg training AND the
+        # eval rounds for both engines (weighted-metrics fan-out)
         self._engine = KAvgEngine(self.mesh, self.model.loss,
                                   self.model.metrics,
                                   self.model.configure_optimizers)
+        self._sync_engine = None
+        self._sync_state = None
+        if engine_kind == "syncdp":
+            from kubeml_tpu.parallel.syncdp import SyncDPEngine
+            self._sync_engine = SyncDPEngine(
+                self.mesh, self.model.loss, self.model.configure_optimizers)
         from jax.sharding import NamedSharding, PartitionSpec
         from kubeml_tpu.parallel.mesh import DATA_AXIS
         self._batch_sharding = NamedSharding(self.mesh,
                                              PartitionSpec(DATA_AXIS))
+        self._sync_batch_sharding = NamedSharding(
+            self.mesh, PartitionSpec(None, DATA_AXIS))
         restored = None
         if self.req.resume_from:
             # warm-start from another job's checkpoint (net-new vs the
@@ -306,7 +321,49 @@ class TrainJob:
             lambda a: jax.device_put(a, self._batch_sharding), rb.batch)
         return dataclasses.replace(rb, batch=batch)
 
+    @staticmethod
+    def _to_global(a):
+        """THE [W, S, B, ...] -> [S, W*B, ...] reflow (step s = every
+        worker's step-s samples side by side). One definition for batch
+        leaves AND masks — they must interleave identically or samples
+        silently misalign with their mask entries."""
+        a = np.asarray(a)
+        W, S, B = a.shape[:3]
+        return np.ascontiguousarray(np.moveaxis(a, 0, 1)).reshape(
+            (S, W * B) + a.shape[3:])
+
+    def _stage_batch_sync(self, rb):
+        """syncdp staging: reflow the round into per-step global batches
+        on the host, then stage batch-sharded over the data axis. Same
+        prefetch-thread overlap as _stage_batch; masks stay host-side so
+        round hooks (fault injection) can still mutate worker_mask
+        before dispatch."""
+        batch = jax.tree_util.tree_map(
+            lambda a: jax.device_put(self._to_global(a),
+                                     self._sync_batch_sharding), rb.batch)
+        return dataclasses.replace(rb, batch=batch)
+
+    def _epoch_round_iter(self, plan, epoch, transform):
+        """Shared round-iteration scaffold for both engines: prefetch
+        with device staging, apply the fault-injection hook, abort on
+        zero contributors (job.go:188-193)."""
+        rounds = iter(prefetch_rounds(self._loader.epoch_rounds(plan, epoch),
+                                      depth=1, transform=transform))
+        while True:
+            with self.tracer.span("data_wait"):
+                rb = next(rounds, None)
+            if rb is None:
+                return
+            if self.round_hook is not None:
+                rb = self.round_hook(rb)
+            if rb.worker_mask.sum() < 1:
+                raise MergeError(
+                    f"round {rb.round_index}: no workers contributed")
+            yield rb
+
     def _train_epoch(self, parallelism: int, epoch: int) -> float:
+        if self._sync_engine is not None:
+            return self._train_epoch_syncdp(parallelism, epoch)
         plan = self._loader.plan(parallelism, self.req.options.k,
                                  self.req.batch_size)
         # Loss is accumulated ON DEVICE and read back once per epoch: a
@@ -318,20 +375,7 @@ class TrainJob:
         step_counts = np.zeros(0)
         # depth=1: the staging transform makes queued rounds
         # device-resident, so keep at most ~3 rounds of HBM in flight
-        rounds = iter(prefetch_rounds(self._loader.epoch_rounds(plan, epoch),
-                                      depth=1,
-                                      transform=self._stage_batch))
-        while True:
-            with self.tracer.span("data_wait"):
-                rb = next(rounds, None)
-            if rb is None:
-                break
-            if self.round_hook is not None:
-                rb = self.round_hook(rb)
-            if rb.worker_mask.sum() < 1:
-                # all workers lost: abort like job.go:188-193
-                raise MergeError(
-                    f"round {rb.round_index}: no workers contributed")
+        for rb in self._epoch_round_iter(plan, epoch, self._stage_batch):
             with self.tracer.span("dispatch"):
                 self.variables, stats = self._engine.train_round(
                     self.variables, rb.batch, rb.sample_mask, rb.step_mask,
@@ -354,6 +398,45 @@ class TrainJob:
             raise MergeError("epoch produced no training steps")
         per_worker = loss_sums[ran] / step_counts[ran]
         return float(per_worker.mean())
+
+    def _train_epoch_syncdp(self, parallelism: int, epoch: int) -> float:
+        """Per-step gradient-averaging epoch (options.engine='syncdp').
+
+        Reuses the K-avg loader plan — N workers' contiguous shards —
+        but every step is one GLOBAL batch of all workers' step-s
+        samples, merged by GSPMD's gradient all-reduce instead of the
+        K-round weight average. Straggler parity is preserved: a
+        masked-out worker (lost function) contributes no samples, via
+        the worker mask folded into the per-sample mask."""
+        plan = self._loader.plan(parallelism, self.req.options.k,
+                                 self.req.batch_size)
+        dev_loss = None
+        real_steps = 0
+        for rb in self._epoch_round_iter(plan, epoch,
+                                         self._stage_batch_sync):
+            smask = (rb.sample_mask * rb.step_mask[:, :, None]
+                     * rb.worker_mask[:, None, None])
+            smask_global = self._to_global(smask)
+            if self._sync_state is None:
+                self._sync_state = self._sync_engine.init_state(
+                    self.variables)
+            with self.tracer.span("dispatch"):
+                self._sync_state, losses = self._sync_engine.train_steps(
+                    self._sync_state, rb.batch, smask_global,
+                    rb.rngs[0], lr=self.req.lr, epoch=epoch)
+            real_steps += int((smask_global.sum(axis=1) > 0).sum())
+            dev_loss = losses if dev_loss is None else dev_loss + losses
+        with self.tracer.span("device_drain"):
+            loss_sums = np.asarray(dev_loss) if dev_loss is not None \
+                else np.zeros(0)
+        # keep the variables view current for validate/checkpoint/infer
+        # (refreshed every epoch: the next dispatch donates this state)
+        self.variables = self._sync_engine.variables(self._sync_state)
+        if real_steps == 0:
+            raise MergeError("epoch produced no training steps")
+        # empty (all-masked) steps contributed 0 to the device sum, so
+        # dividing by the REAL step count gives the mean per-step loss
+        return float(loss_sums.sum()) / real_steps
 
     def _validate(self, parallelism: int):
         if self._handle.test_samples == 0:
