@@ -23,15 +23,19 @@ class HistoryStore:
     def __init__(self, path: Optional[str] = None):
         self.path = path or os.path.join(kubeml_home(), "history.db")
         os.makedirs(os.path.dirname(self.path), exist_ok=True)
-        with self._conn() as c:
-            c.execute("CREATE TABLE IF NOT EXISTS history ("
-                      "id TEXT PRIMARY KEY, task TEXT, data TEXT)")
+        with self._conn():
+            pass  # fail fast on an unwritable path
 
     @contextlib.contextmanager
     def _conn(self):
         conn = sqlite3.connect(self.path)
         try:
             with conn:  # transaction
+                # per-connection: sqlite silently recreates a db file that
+                # was deleted under a live service; ensure the schema on
+                # every open so such a store heals instead of erroring
+                conn.execute("CREATE TABLE IF NOT EXISTS history ("
+                             "id TEXT PRIMARY KEY, task TEXT, data TEXT)")
                 yield conn
         finally:
             conn.close()

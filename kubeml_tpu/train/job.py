@@ -34,6 +34,7 @@ import time
 from typing import Callable, Optional
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 
 from kubeml_tpu.api.errors import KubeMLException, MergeError
@@ -50,6 +51,11 @@ from kubeml_tpu.utils.env import limit_parallelism
 from kubeml_tpu.utils.trace import Tracer
 
 logger = logging.getLogger("kubeml_tpu.train")
+
+# Reduce a list of per-round device loss arrays in ONE dispatch: under
+# jit the list is a pytree of N leaves, so there is no per-element eager
+# expand_dims/concatenate dispatch (compiled once per round-count, cached).
+reduce_losses = jax.jit(lambda losses: jnp.stack(losses).sum(axis=0))
 
 
 @dataclasses.dataclass
@@ -366,12 +372,15 @@ class TrainJob:
             return self._train_epoch_syncdp(parallelism, epoch)
         plan = self._loader.plan(parallelism, self.req.options.k,
                                  self.req.batch_size)
-        # Loss is accumulated ON DEVICE and read back once per epoch: a
+        # Loss stays ON DEVICE and is read back once per epoch: a
         # per-round readback would serialize dispatch and costs tens of ms
-        # on tunneled backends (see RoundStats). The zero-contributor check
-        # uses the host-side worker mask, which fully determines the device
-        # contributor count.
-        dev_loss = None
+        # on tunneled backends (see RoundStats). Per-round arrays are
+        # collected and reduced in ONE stack+sum dispatch at epoch end —
+        # a per-round eager add would pay one host dispatch per round,
+        # which is noticeably slow during a backend's dispatch ramp.
+        # The zero-contributor check uses the host-side worker mask,
+        # which fully determines the device contributor count.
+        dev_losses = []
         step_counts = np.zeros(0)
         # depth=1: the staging transform makes queued rounds
         # device-resident, so keep at most ~3 rounds of HBM in flight
@@ -386,11 +395,10 @@ class TrainJob:
             # function) contributes neither loss nor steps, matching the
             # reference's average-over-responders (util.go:82-98)
             step_counts += stats.step_count * rb.worker_mask
-            dev_loss = stats.loss_sum_device if dev_loss is None \
-                else dev_loss + stats.loss_sum_device
+            dev_losses.append(stats.loss_sum_device)
         with self.tracer.span("device_drain"):
-            loss_sums = np.asarray(dev_loss) if dev_loss is not None \
-                else np.zeros(0)
+            loss_sums = np.asarray(reduce_losses(dev_losses)) \
+                if dev_losses else np.zeros(0)
         # per-worker epoch loss, then unweighted mean over workers that ran
         # (reference aggregation ml/pkg/train/util.go:82-98)
         ran = step_counts > 0
@@ -410,7 +418,7 @@ class TrainJob:
         the worker mask folded into the per-sample mask."""
         plan = self._loader.plan(parallelism, self.req.options.k,
                                  self.req.batch_size)
-        dev_loss = None
+        dev_losses = []
         real_steps = 0
         for rb in self._epoch_round_iter(plan, epoch,
                                          self._stage_batch_sync):
@@ -425,15 +433,15 @@ class TrainJob:
                     self._sync_state, rb.batch, smask_global,
                     rb.rngs[0], lr=self.req.lr, epoch=epoch)
             real_steps += int((smask_global.sum(axis=1) > 0).sum())
-            dev_loss = losses if dev_loss is None else dev_loss + losses
+            dev_losses.append(losses)
         with self.tracer.span("device_drain"):
-            loss_sums = np.asarray(dev_loss) if dev_loss is not None \
-                else np.zeros(0)
+            loss_sums = np.asarray(reduce_losses(dev_losses)) \
+                if dev_losses else np.zeros(0)
+        if real_steps == 0:  # zero-round epoch: _sync_state may still be None
+            raise MergeError("epoch produced no training steps")
         # keep the variables view current for validate/checkpoint/infer
         # (refreshed every epoch: the next dispatch donates this state)
         self.variables = self._sync_engine.variables(self._sync_state)
-        if real_steps == 0:
-            raise MergeError("epoch produced no training steps")
         # empty (all-masked) steps contributed 0 to the device sum, so
         # dividing by the REAL step count gives the mean per-step loss
         return float(loss_sums.sum()) / real_steps
