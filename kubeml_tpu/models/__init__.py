@@ -20,7 +20,7 @@ def register_model(name):
 
 def _load_zoo():
     import importlib
-    for mod in ("lenet", "resnet", "vgg", "lstm", "bert", "mlp"):
+    for mod in ("lenet", "resnet", "vgg", "lstm", "bert", "gpt", "mlp"):
         try:
             importlib.import_module(f"kubeml_tpu.models.{mod}")
         except ModuleNotFoundError:

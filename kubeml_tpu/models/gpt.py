@@ -1,0 +1,285 @@
+"""Decoder-only transformer (GPT-style) for causal language modeling.
+
+Net-new relative to the reference (SURVEY.md §2a lists transformer and
+long-context workloads as absent; its largest models are ResNet-34/VGG-11):
+this is the framework's generative/long-context flagship, built on the same
+attention primitive stack as BERT-tiny:
+
+  - causal attention goes through ops.masked_attention(causal=True) — bf16
+    QK^T/PV matmuls on the MXU, f32 softmax — which auto-dispatches to the
+    pallas flash kernel on TPU (KV-block streaming, no O(T^2) HBM);
+  - long-context execution: the SAME module runs under shard_map over the
+    mesh `seq` axis, with the causal KV ring (parallel/ring_attention.py)
+    or the ulysses all-to-all head-sharded scheme (parallel/ulysses.py)
+    swapped in at the attention call — no chip ever holds the full
+    sequence (forward_seq_parallel below);
+  - pre-LN blocks, GELU MLPs, learned positional embeddings, weight-tied
+    LM head (Embed.attend);
+  - LayerNorm params stay float32; all matmuls bfloat16.
+
+Training plugs into the standard engines through the KubeModel contract:
+`loss` returns one value per SEQUENCE (mean over its real next-token
+positions), so the K-avg weight averaging and the datapoint-weighted
+validation aggregation (ml/pkg/train/util.go:100-122) treat a sequence
+exactly like the reference treats one sample.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from jax import lax
+
+from kubeml_tpu.models import register_model
+from kubeml_tpu.models.base import KubeModel
+from kubeml_tpu.ops.attention import masked_attention
+
+PAD_ID = 0
+
+
+class DecoderBlock(nn.Module):
+    hidden: int
+    heads: int
+    ffn: int
+    dropout: float
+    dtype: jnp.dtype
+    # set to the mesh seq-axis name for sequence parallelism (see
+    # models/bert.py EncoderBlock — same contract, causal variant)
+    seq_axis: Optional[str] = None
+    seq_impl: str = "ring"
+
+    @nn.compact
+    def __call__(self, h, pad_mask, train: bool, pos=None):
+        head_dim = self.hidden // self.heads
+        x = nn.LayerNorm(dtype=jnp.float32)(h)
+        q = nn.DenseGeneral((self.heads, head_dim), dtype=self.dtype,
+                            name="q")(x)
+        k = nn.DenseGeneral((self.heads, head_dim), dtype=self.dtype,
+                            name="k")(x)
+        v = nn.DenseGeneral((self.heads, head_dim), dtype=self.dtype,
+                            name="v")(x)
+        if self.seq_impl not in ("ring", "ulysses"):  # static field
+            raise ValueError(f"unknown seq_impl {self.seq_impl!r}; "
+                             f"expected 'ring' or 'ulysses'")
+        if self.seq_axis is not None and self.seq_impl == "ulysses":
+            from kubeml_tpu.parallel.ulysses import ulysses_attention
+            attn = ulysses_attention(q, k, v, kv_mask=pad_mask,
+                                     causal=True, axis_name=self.seq_axis)
+        elif self.seq_axis is not None:
+            # causal KV ring: blocks rotate with their positions, the
+            # per-block bias keeps position ordering globally correct
+            from kubeml_tpu.parallel.ring_attention import ring_attention
+            attn = ring_attention(q, k, v, q_pos=pos, kv_pos=pos,
+                                  kv_mask=pad_mask, causal=True,
+                                  axis_name=self.seq_axis)
+        else:
+            attn = masked_attention(q, k, v, pad_mask, causal=True)
+        attn = nn.DenseGeneral(self.hidden, axis=(-2, -1), dtype=self.dtype,
+                               name="out")(attn)
+        attn = nn.Dropout(self.dropout, deterministic=not train)(attn)
+        h = h + attn
+        x = nn.LayerNorm(dtype=jnp.float32)(h)
+        x = nn.Dense(self.ffn, dtype=self.dtype)(x)
+        x = nn.gelu(x)
+        x = nn.Dense(self.hidden, dtype=self.dtype)(x)
+        x = nn.Dropout(self.dropout, deterministic=not train)(x)
+        return h + x
+
+
+class GPTModule(nn.Module):
+    vocab_size: int = 8192
+    max_len: int = 512
+    hidden: int = 256
+    layers: int = 4
+    heads: int = 4
+    ffn: int = 1024
+    dropout: float = 0.1
+    dtype: jnp.dtype = jnp.bfloat16
+    seq_axis: Optional[str] = None  # sequence-parallel mode
+    seq_impl: str = "ring"          # 'ring' | 'ulysses'
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        # x: int32 token ids [B, T], pad id 0. With seq_axis set this runs
+        # inside shard_map on the LOCAL [B, T/n] block (positions offset by
+        # the shard index) and returns the LOCAL logits block — the causal
+        # ring/all-to-all reconstructs exactly the dense forward.
+        B, T = x.shape
+        n_shards = 1 if self.seq_axis is None else lax.axis_size(self.seq_axis)
+        if T * n_shards > self.max_len:  # static trace-time guard
+            raise ValueError(f"sequence length {T * n_shards} exceeds "
+                             f"max_len {self.max_len}")
+        pad_mask = (x != PAD_ID).astype(jnp.float32)
+        if self.seq_axis is None:
+            pos_ids = jnp.arange(T)
+        else:
+            pos_ids = lax.axis_index(self.seq_axis) * T + jnp.arange(T)
+        embed = nn.Embed(self.vocab_size, self.hidden, dtype=self.dtype,
+                         name="tok_embed")
+        h = embed(x)
+        pos = nn.Embed(self.max_len, self.hidden, dtype=self.dtype,
+                       name="pos_embed")(pos_ids[None, :])
+        h = h + pos
+        h = nn.Dropout(self.dropout, deterministic=not train)(h)
+        for i in range(self.layers):
+            h = DecoderBlock(self.hidden, self.heads, self.ffn, self.dropout,
+                             self.dtype, seq_axis=self.seq_axis,
+                             seq_impl=self.seq_impl,
+                             name=f"layer_{i}")(h, pad_mask, train,
+                                                pos=pos_ids)
+        h = nn.LayerNorm(dtype=jnp.float32)(h)
+        # weight-tied LM head: logits = h @ tok_embed^T
+        logits = embed.attend(h.astype(self.dtype))
+        return logits.astype(jnp.float32)
+
+
+def _shift_targets(x: jax.Array):
+    """(targets, token_mask) for next-token prediction on [B, T] ids.
+
+    Position t predicts x[:, t+1]; a position contributes iff both it and
+    its target are real (non-pad) tokens. The last position never has a
+    target inside the window.
+    """
+    targets = jnp.concatenate(
+        [x[:, 1:], jnp.full((x.shape[0], 1), PAD_ID, x.dtype)], axis=1)
+    mask = ((x != PAD_ID) & (targets != PAD_ID)).astype(jnp.float32)
+    return targets, mask
+
+
+@register_model("gpt-mini")
+class GPTMini(KubeModel):
+    """~6M-param decoder-only LM (4 layers x 256 hidden x 4 heads)."""
+
+    name = "gpt-mini"
+
+    def build(self):
+        return GPTModule()
+
+    def init_variables(self, rng, sample_batch):
+        return self.module.init(rng, sample_batch["x"], train=False)
+
+    def apply_train(self, variables, x, rng):
+        mutable = [k for k in variables if k != "params"]
+        if mutable:
+            logits, new_state = self.module.apply(
+                variables, x, train=True, mutable=mutable,
+                rngs={"dropout": rng})
+            return logits, dict(new_state)
+        logits = self.module.apply(variables, x, train=True,
+                                   rngs={"dropout": rng})
+        return logits, {}
+
+    def loss(self, variables, batch, rng, sample_mask):
+        """Per-sequence mean next-token cross-entropy, [B]."""
+        x = batch["x"]
+        logits, new_state = self.apply_train(variables, x, rng)
+        targets, tok_mask = _shift_targets(x)
+        per_tok = optax.softmax_cross_entropy_with_integer_labels(
+            logits, targets)
+        denom = jnp.maximum(tok_mask.sum(axis=1), 1.0)
+        return (per_tok * tok_mask).sum(axis=1) / denom, new_state
+
+    def metrics(self, variables, batch):
+        x = batch["x"]
+        logits = self.module.apply(variables, x, train=False)
+        targets, tok_mask = _shift_targets(x)
+        per_tok = optax.softmax_cross_entropy_with_integer_labels(
+            logits, targets)
+        denom = jnp.maximum(tok_mask.sum(axis=1), 1.0)
+        hit = (jnp.argmax(logits, axis=-1) == targets).astype(jnp.float32)
+        return {"loss": (per_tok * tok_mask).sum(axis=1) / denom,
+                "accuracy": (hit * tok_mask).sum(axis=1) / denom}
+
+    def configure_optimizers(self, lr, epoch):
+        return optax.adamw(lr, weight_decay=0.01)
+
+    # ------------------------------------------------------------ inference
+
+    def infer(self, variables, data: np.ndarray,
+              max_new_tokens: int = 32) -> np.ndarray:
+        """Greedy continuation of prompt id rows [B, Tp] (0 = pad).
+
+        Each row's continuation starts after its last non-pad token;
+        generated tokens are never PAD_ID. One fixed-shape jitted forward
+        over the padded [B, max_len] window, re-dispatched per generated
+        token (same executable every step — no per-step recompiles). A KV
+        cache is unnecessary at this scale; the full forward is one
+        MXU-friendly batch.
+        """
+        prompts = np.asarray(data, np.int32)
+        B, Tp = prompts.shape
+        T = min(self.module.max_len, Tp + max_new_tokens)
+        if not hasattr(self, "_gen_step"):
+            module = self.module
+
+            @jax.jit
+            def gen_step(variables, window, lengths):
+                logits = module.apply(variables, window, train=False)
+                # logits at each row's last real position predict the next
+                nxt = jnp.take_along_axis(
+                    logits, (lengths - 1)[:, None, None], axis=1)[:, 0]
+                # generation never emits the pad token — it would truncate
+                # the row (everything after a 0 reads as padding)
+                nxt = nxt.at[:, PAD_ID].set(-jnp.inf)
+                return jnp.argmax(nxt, axis=-1).astype(jnp.int32)
+
+            self._gen_step = gen_step
+        window = np.zeros((B, T), np.int32)
+        window[:, :Tp] = prompts[:, :T]
+        # a row's prompt ends after its LAST non-pad token (interior 0s
+        # stay part of the prompt, never overwritten); all-pad rows have
+        # length 0 and produce unconditioned continuations from position 0
+        real = window != PAD_ID
+        lengths = np.where(real.any(axis=1),
+                           T - np.argmax(real[:, ::-1], axis=1), 0)
+        variables = jax.device_put(variables)  # once, not per token
+        for _ in range(T - Tp):
+            nxt = np.asarray(self._gen_step(
+                variables, jnp.asarray(window),
+                jnp.asarray(np.maximum(lengths, 1))))
+            grow = lengths < T
+            window[np.arange(B), np.minimum(lengths, T - 1)] = np.where(
+                grow, nxt, window[np.arange(B), np.minimum(lengths, T - 1)])
+            lengths = np.minimum(lengths + grow, T)
+        return window
+
+    # ----------------------------------------------------- sequence parallel
+
+    def forward_seq_parallel(self, variables, x, mesh, impl="ring"):
+        """Long-context causal forward over the mesh `seq` axis.
+
+        x: [B, T] with T divisible by the seq-axis size. Returns the full
+        [B, T, vocab] logits, numerically equal to the dense forward,
+        while each chip only ever holds a [B, T/n] sequence block (and the
+        flash/ring paths never materialize O(T^2) scores).
+        """
+        from jax.sharding import PartitionSpec as P
+
+        from kubeml_tpu.parallel.mesh import SEQ_AXIS
+
+        if impl not in ("ring", "ulysses"):
+            raise ValueError(f"unknown seq-parallel impl {impl!r}; "
+                             f"expected 'ring' or 'ulysses'")
+        n_seq = mesh.shape[SEQ_AXIS]
+        if x.shape[1] % n_seq:
+            raise ValueError(f"sequence length {x.shape[1]} not divisible "
+                             f"by the seq-axis size {n_seq}")
+        key = (mesh, x.shape[1] // n_seq, impl)
+        if not hasattr(self, "_sp_cache"):
+            self._sp_cache = {}
+        if key not in self._sp_cache:
+            sp_module = self.module.clone(seq_axis=SEQ_AXIS, seq_impl=impl)
+
+            def fwd(variables, x_local):
+                return sp_module.apply(variables, x_local, train=False)
+
+            # logits come back seq-sharded: out spec reassembles [B, T, V]
+            self._sp_cache[key] = jax.jit(jax.shard_map(
+                fwd, mesh=mesh, in_specs=(P(), P(None, SEQ_AXIS)),
+                out_specs=P(None, SEQ_AXIS), check_vma=False))
+        return self._sp_cache[key](variables, x)

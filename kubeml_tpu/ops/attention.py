@@ -61,6 +61,21 @@ def composed_bias(pad_mask: jax.Array, causal: bool, T: int) -> jax.Array:
     return bias
 
 
+def _flash_safe_context() -> bool:
+    """Whether a pallas (Mosaic) kernel may be emitted here.
+
+    The SPMD partitioner refuses to auto-partition Mosaic custom calls:
+    under a mesh context with any Auto (GSPMD-managed) axis — e.g. the
+    inner axes of a partially-manual shard_map, even when they have size
+    1 — lowering raises "Mosaic kernels cannot be automatically
+    partitioned". Safe contexts are fully-manual shard_map bodies and
+    plain jit with no surrounding mesh.
+    """
+    from jax.sharding import AxisType, get_abstract_mesh
+    am = get_abstract_mesh()
+    return am.empty or all(t == AxisType.Manual for t in am.axis_types)
+
+
 def masked_attention(q: jax.Array, k: jax.Array, v: jax.Array,
                      pad_mask: jax.Array, causal: bool = False,
                      impl: str = "auto") -> jax.Array:
@@ -75,7 +90,8 @@ def masked_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     if impl == "auto":
         on_tpu = jax.default_backend() == "tpu"
         tiles = T % 128 == 0 or (T <= 128 and T % 8 == 0)
-        impl = "flash" if on_tpu and tiles else "reference"
+        impl = "flash" if on_tpu and tiles and _flash_safe_context() \
+            else "reference"
     if impl == "flash":
         from kubeml_tpu.ops.pallas.flash_attention import flash_attention
         return flash_attention(q, k, v, pad_mask, causal)

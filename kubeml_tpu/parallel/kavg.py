@@ -159,6 +159,30 @@ class KAvgEngine:
         self._train_cache: Dict[Any, Callable] = {}
         self._eval_cache: Dict[Any, Callable] = {}
 
+    def _shmap_manual_kwargs(self) -> Dict[str, Any]:
+        """shard_map manual-axes kwargs shared by the train and eval
+        builders (they must partition identically).
+
+        Default: only the data axis is manual (the masked-psum merge);
+        all inner axes (model/seq/stage/expert) stay AUTO, so variables
+        sharded over them — e.g. Megatron TP rules via parallel.tp —
+        train as-is: GSPMD inserts the model-axis collectives inside
+        each DP lane while the weight average still psums over `data`
+        only. Exceptions that go FULL manual ({}):
+          - merge_dtype: the SPMD partitioner miscompiles a sub-f32
+            all-reduce on partially-manual meshes ("invalid binary
+            instruction opcode copy") — why compression requires a
+            pure-DP mesh;
+          - pure-DP meshes (all inner axes size 1): leaving size-1
+            axes Auto blocks pallas kernels inside the round ("Mosaic
+            kernels cannot be automatically partitioned"), which would
+            silently cost transformer models their flash attention.
+        """
+        if (self.merge_dtype is not None      # pure-DP checked in __init__
+                or self.mesh.size == self.mesh.shape[DATA_AXIS]):
+            return {}
+        return dict(axis_names={DATA_AXIS})
+
     # ---------------------------------------------------------------- train
 
     def _build_train_round(self, w_per_lane: int):
@@ -241,24 +265,12 @@ class KAvgEngine:
             avg = jax.tree_util.tree_map(merge_leaf, contrib, variables)
             return avg, jnp.stack(loss_sums)
 
-        # Only the data axis is manual (the masked-psum merge); all inner
-        # axes (model/seq/stage/expert) stay AUTO, so variables sharded
-        # over them — e.g. Megatron TP rules via parallel.tp — train
-        # as-is: GSPMD inserts the model-axis collectives inside each DP
-        # lane while the weight average still psums over `data` only.
-        # Exception: with merge_dtype the shard_map goes FULL manual —
-        # the SPMD partitioner miscompiles a sub-f32 all-reduce on
-        # partially-manual meshes ("invalid binary instruction opcode
-        # copy") — which is why compression requires a pure-DP mesh.
-        shmap_kwargs: Dict[str, Any] = dict(axis_names={DATA_AXIS})
-        if self.merge_dtype is not None:  # pure-DP checked in __init__
-            shmap_kwargs = {}
         sharded = jax.shard_map(
             lane_fn, mesh=mesh,
             in_specs=(P(), P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS),
                       P(DATA_AXIS), P(DATA_AXIS), P(), P()),
             out_specs=(P(), P(DATA_AXIS)),
-            check_vma=False, **shmap_kwargs)
+            check_vma=False, **self._shmap_manual_kwargs())
         donate = (0,) if self.donate else ()
         return jax.jit(sharded, donate_argnums=donate)
 
@@ -329,8 +341,7 @@ class KAvgEngine:
             lane_fn, mesh=mesh,
             in_specs=(P(), P(DATA_AXIS), P(DATA_AXIS)),
             out_specs=(P(), P()),
-            axis_names={DATA_AXIS},
-            check_vma=False)
+            check_vma=False, **self._shmap_manual_kwargs())
         return jax.jit(sharded)
 
     def eval_round(self, variables: PyTree, batch: PyTree,
