@@ -18,10 +18,12 @@ from __future__ import annotations
 import json
 import os
 import shutil
+import threading
 import time
 from typing import Any, Dict, Optional, Tuple
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 
 from kubeml_tpu.api.const import kubeml_home
@@ -89,6 +91,97 @@ def load_checkpoint(job_id: str, root: Optional[str] = None
     with np.load(os.path.join(d, "weights.npz")) as z:
         variables = _unflatten({k: z[k] for k in z.files})
     return variables, manifest
+
+
+class AsyncCheckpointer:
+    """Background checkpoint writer — training never blocks on a save.
+
+    `save()` snapshots the variables ON DEVICE (`jnp.copy` per leaf — a
+    fast HBM copy, so the snapshot survives the engines' buffer donation
+    of the live variables on the next round) and returns immediately; a
+    single daemon worker performs the expensive part (full-model
+    device→host readback, hundreds of ms on tunneled backends, plus the
+    atomic directory publish) off the training thread. Pending saves are
+    latest-wins per job id: if epochs outpace the writer, intermediate
+    snapshots are dropped and the newest wins — each published checkpoint
+    is always a complete, consistent epoch state.
+
+    `wait()` fully drains the queue and any in-flight write, then raises
+    the first error whose job never got a LATER successful save (a newer
+    durable checkpoint supersedes an earlier transient failure) — call it
+    before declaring a job finished. `close()` drains, stops the worker
+    thread, and releases everything; the owning job must call it so a
+    long-lived server does not accumulate idle writer threads, and so no
+    background write is mid-publish at process exit.
+    """
+
+    def __init__(self, root: Optional[str] = None):
+        self.root = root
+        self._cond = threading.Condition()
+        self._pending: Dict[str, Tuple[PyTree, dict]] = {}
+        self._in_flight_job: Optional[str] = None
+        self._errors: Dict[str, BaseException] = {}
+        self._thread: Optional[threading.Thread] = None
+        self._closed = False
+
+    def save(self, job_id: str, variables: PyTree, manifest: dict) -> None:
+        snap = jax.tree_util.tree_map(jnp.copy, variables)
+        with self._cond:
+            if self._closed:
+                raise RuntimeError("AsyncCheckpointer is closed")
+            self._pending[job_id] = (snap, manifest)
+            if self._thread is None:
+                self._thread = threading.Thread(
+                    target=self._run, name="kubeml-ckpt", daemon=True)
+                self._thread.start()
+            self._cond.notify_all()
+
+    def wait(self) -> None:
+        with self._cond:
+            self._cond.wait_for(
+                lambda: not self._pending and self._in_flight_job is None)
+            if self._errors:
+                err = next(iter(self._errors.values()))
+                self._errors.clear()
+                raise err
+
+    def close(self) -> None:
+        """Drain outstanding writes and stop the worker. Idempotent.
+        Errors are swallowed here — call wait() first when they must
+        surface."""
+        with self._cond:
+            self._closed = True
+            self._cond.notify_all()
+        if self._thread is not None:
+            self._thread.join()
+            self._thread = None
+        with self._cond:
+            self._errors.clear()
+
+    def _run(self) -> None:
+        while True:
+            with self._cond:
+                self._cond.wait_for(
+                    lambda: bool(self._pending) or self._closed)
+                if not self._pending:  # closed and drained
+                    return
+                job_id, (snap, manifest) = next(iter(self._pending.items()))
+                del self._pending[job_id]
+                self._in_flight_job = job_id
+            try:
+                save_checkpoint(job_id, snap, manifest, root=self.root)
+                with self._cond:  # durable newer save supersedes old error
+                    self._errors.pop(job_id, None)
+            except BaseException as e:  # surfaced by wait()
+                with self._cond:
+                    self._errors.setdefault(job_id, e)
+            finally:
+                # drop the model-sized snapshot before idling: the loop
+                # frame must not retain a full device copy between saves
+                snap = manifest = None
+                with self._cond:
+                    self._in_flight_job = None
+                    self._cond.notify_all()
 
 
 def checkpoint_saved_at(job_id: str, root: Optional[str] = None

@@ -45,7 +45,7 @@ from kubeml_tpu.data.registry import DatasetRegistry
 from kubeml_tpu.models.base import KubeDataset, KubeModel
 from kubeml_tpu.parallel.kavg import KAvgEngine
 from kubeml_tpu.parallel.mesh import data_axis_size
-from kubeml_tpu.train.checkpoint import save_checkpoint
+from kubeml_tpu.train.checkpoint import AsyncCheckpointer, save_checkpoint
 from kubeml_tpu.train.history import HistoryStore
 from kubeml_tpu.utils.env import limit_parallelism
 from kubeml_tpu.utils.trace import Tracer
@@ -100,6 +100,7 @@ class TrainJob:
         # (SURVEY.md §5), its failure tolerance was only exercised by
         # real pod deaths
         self.round_hook = round_hook
+        self._checkpointer = AsyncCheckpointer()
         self.tracer = Tracer()  # host-phase spans, summarized per epoch
         self.stop_event = threading.Event()
         self.history = JobHistory()
@@ -198,8 +199,10 @@ class TrainJob:
 
                 if self.checkpoint and opts.checkpoint_every > 0 and \
                         (epoch + 1) % opts.checkpoint_every == 0:
-                    save_checkpoint(job_id, self.variables,
-                                    self._manifest(epoch=epoch + 1))
+                    # async: the device snapshot is immediate; the full
+                    # readback + write happens off the epoch loop
+                    self._checkpointer.save(job_id, self.variables,
+                                            self._manifest(epoch=epoch + 1))
                     last_ckpt_epoch = epoch + 1
 
                 if self.stop_event.is_set():
@@ -221,12 +224,16 @@ class TrainJob:
                     self.history.validation_loss[-1] = val_loss
                     self.history.accuracy[-1] = accuracy
 
-            # final checkpoint, unless the last periodic save already
-            # captured exactly this state (weights don't change after the
-            # last trained epoch)
-            if self.checkpoint and \
-                    last_ckpt_epoch != len(self.history.train_loss):
-                save_checkpoint(job_id, self.variables, self._manifest())
+            # drain periodic saves (surfacing any unsuperseded failure),
+            # THEN write the final checkpoint synchronously — after the
+            # drain so a stale periodic snapshot can't clobber it, and
+            # sync because there is nothing left to overlap with (and it
+            # avoids a transient extra model copy at peak memory). Elided
+            # when the last periodic save already captured this state.
+            if self.checkpoint:
+                self._checkpointer.wait()
+                if last_ckpt_epoch != len(self.history.train_loss):
+                    save_checkpoint(job_id, self.variables, self._manifest())
             record = History(id=job_id, task=self.req, data=self.history)
             if self.history_store is not None:
                 self.history_store.save(record)
@@ -240,6 +247,11 @@ class TrainJob:
             self.callbacks.on_finish(job_id, self.exit_err)
             raise
         finally:
+            # stop the checkpoint writer in every exit path: a failed
+            # job's in-flight background write finishes (no mid-publish
+            # kill at process exit) and a long-lived server doesn't
+            # accumulate idle writer threads
+            self._checkpointer.close()
             self._close_log_file()
 
     # ------------------------------------------------------------ internals

@@ -1,0 +1,135 @@
+"""AsyncCheckpointer: latest-wins, donation safety, drain, error surfacing."""
+
+import threading
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from kubeml_tpu.train.checkpoint import (AsyncCheckpointer, load_checkpoint,
+                                         save_checkpoint)
+
+
+def test_async_save_and_wait(tmp_path):
+    ck = AsyncCheckpointer(root=str(tmp_path))
+    v = {"params": {"w": jnp.arange(4.0)}}
+    ck.save("j1", v, {"model": "m"})
+    ck.wait()
+    loaded, manifest = load_checkpoint("j1", root=str(tmp_path))
+    np.testing.assert_array_equal(loaded["params"]["w"], np.arange(4.0))
+    assert manifest["model"] == "m"
+
+
+def test_async_snapshot_survives_donation(tmp_path):
+    """save() must snapshot before returning: deleting the source buffers
+    right after (what engine donation does on the next round) must not
+    corrupt the written checkpoint."""
+    # gate the worker so deletion definitely happens before the write
+    import kubeml_tpu.train.checkpoint as ckpt_mod
+    gate = threading.Event()
+    real = ckpt_mod.save_checkpoint
+
+    def gated(jid, variables, manifest, root=None):
+        gate.wait(5)
+        return real(jid, variables, manifest, root=root)
+
+    ckpt_mod.save_checkpoint = gated
+    try:
+        ck = AsyncCheckpointer(root=str(tmp_path))
+        w = jnp.arange(8.0)
+        ck.save("j2", {"params": {"w": w}}, {})
+        w.delete()  # simulate donation of the live buffer
+        gate.set()
+        ck.wait()
+    finally:
+        ckpt_mod.save_checkpoint = real
+    loaded, _ = load_checkpoint("j2", root=str(tmp_path))
+    np.testing.assert_array_equal(loaded["params"]["w"], np.arange(8.0))
+
+
+def test_async_latest_wins(tmp_path):
+    """Saves queued faster than the writer drains collapse to the newest."""
+    import kubeml_tpu.train.checkpoint as ckpt_mod
+    written = []
+    gate = threading.Event()
+    real = ckpt_mod.save_checkpoint
+
+    def slow(jid, variables, manifest, root=None):
+        gate.wait(5)
+        written.append(manifest.get("epoch"))
+        return real(jid, variables, manifest, root=root)
+
+    ckpt_mod.save_checkpoint = slow
+    try:
+        ck = AsyncCheckpointer(root=str(tmp_path))
+        for e in range(5):
+            ck.save("j3", {"params": {"w": jnp.full(2, float(e))}},
+                    {"epoch": e})
+        gate.set()
+        ck.wait()
+    finally:
+        ckpt_mod.save_checkpoint = real
+    # the first dequeued save may be any early epoch (races with the
+    # enqueue loop), but the LAST write is always the newest snapshot
+    assert written[-1] == 4
+    loaded, manifest = load_checkpoint("j3", root=str(tmp_path))
+    assert manifest["epoch"] == 4
+    np.testing.assert_array_equal(loaded["params"]["w"], np.full(2, 4.0))
+
+
+def test_async_error_superseded_by_later_success(tmp_path):
+    """A transient save failure must NOT fail the job when a newer save
+    for the same job published a durable checkpoint."""
+    import kubeml_tpu.train.checkpoint as ckpt_mod
+    real = ckpt_mod.save_checkpoint
+    calls = {"n": 0}
+
+    def flaky(jid, variables, manifest, root=None):
+        calls["n"] += 1
+        if calls["n"] == 1:
+            raise OSError("transient disk error")
+        return real(jid, variables, manifest, root=root)
+
+    ckpt_mod.save_checkpoint = flaky
+    try:
+        ck = AsyncCheckpointer(root=str(tmp_path))
+        ck.save("j6", {"params": {"w": jnp.zeros(2)}}, {"epoch": 1})
+        # ensure the failing write fully ran before the next save so it
+        # is not collapsed away by latest-wins
+        while calls["n"] < 1:
+            time.sleep(0.01)
+        ck.save("j6", {"params": {"w": jnp.ones(2)}}, {"epoch": 2})
+        ck.wait()  # must not raise: epoch-2 save succeeded
+        ck.close()
+    finally:
+        ckpt_mod.save_checkpoint = real
+    loaded, manifest = load_checkpoint("j6", root=str(tmp_path))
+    assert manifest["epoch"] == 2
+    np.testing.assert_array_equal(loaded["params"]["w"], np.ones(2))
+
+
+def test_async_close_stops_worker_and_rejects_saves(tmp_path):
+    ck = AsyncCheckpointer(root=str(tmp_path))
+    ck.save("j7", {"params": {"w": jnp.zeros(2)}}, {})
+    ck.close()
+    assert ck._thread is None  # worker joined
+    load_checkpoint("j7", root=str(tmp_path))  # drained before stopping
+    with pytest.raises(RuntimeError):
+        ck.save("j8", {"params": {"w": jnp.zeros(2)}}, {})
+    ck.close()  # idempotent
+
+
+def test_async_error_surfaces_on_wait(tmp_path):
+    target = tmp_path / "not-a-dir"
+    target.write_text("file blocks the models root")
+    ck = AsyncCheckpointer(root=str(target))
+    ck.save("j4", {"params": {"w": jnp.zeros(1)}}, {})
+    with pytest.raises(Exception):
+        ck.wait()
+    # the error is consumed; a subsequent good save works
+    ck.root = str(tmp_path)
+    ck.save("j5", {"params": {"w": jnp.zeros(1)}}, {})
+    ck.wait()
+    load_checkpoint("j5", root=str(tmp_path))
