@@ -55,51 +55,56 @@ def _pad_workers(n_workers: int, n_lanes: int) -> int:
     return ((n_workers + n_lanes - 1) // n_lanes) * n_lanes
 
 
-def _pad_steps(xs: np.ndarray, ys: np.ndarray, smask: np.ndarray, S: int
-               ) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
-    """Zero-pad [steps, B, ...] chunk tensors up to the round-wide S."""
+def _pad_steps(tb: Dict[str, np.ndarray], smask: np.ndarray, S: int
+               ) -> Tuple[Dict[str, np.ndarray], np.ndarray]:
+    """Zero-pad [steps, B, ...] chunk tensors up to the round-wide S.
+
+    Operates on the full transform dict: batches are whatever keys the
+    dataset's transform produced ({'x','y'} for classifiers, {'x'} for
+    language models, arbitrary user structures otherwise).
+    """
     steps, B = smask.shape
     if steps < S:
-        xs = np.concatenate(
-            [xs, np.zeros((S - steps,) + xs.shape[1:], xs.dtype)])
-        ys = np.concatenate(
-            [ys, np.zeros((S - steps,) + ys.shape[1:], ys.dtype)])
+        tb = {k: np.concatenate(
+            [v, np.zeros((S - steps,) + v.shape[1:], v.dtype)])
+            for k, v in tb.items()}
         smask = np.concatenate([smask, np.zeros((S - steps, B), np.float32)])
-    return xs, ys, smask
+    return tb, smask
 
 
-def _fill_missing_workers(xs_all, ys_all, W):
-    """Materialize zero tensors for inactive chunks + lane-padding workers."""
-    x_tmpl = next(x for x in xs_all if x is not None)
-    y_tmpl = next(y for y in ys_all if y is not None)
-    xs = [x if x is not None else np.zeros(x_tmpl.shape, x_tmpl.dtype)
-          for x in xs_all]
-    ys = [y if y is not None else np.zeros(y_tmpl.shape, y_tmpl.dtype)
-          for y in ys_all]
-    while len(xs) < W:
-        xs.append(np.zeros(x_tmpl.shape, x_tmpl.dtype))
-        ys.append(np.zeros(y_tmpl.shape, y_tmpl.dtype))
-    return np.stack(xs), np.stack(ys)
+def _fill_missing_workers(tbs, W) -> Dict[str, np.ndarray]:
+    """Materialize zero tensors for inactive chunks + lane-padding workers,
+    then stack each transform key to [W, S, B, ...]."""
+    tmpl = next(t for t in tbs if t is not None)
+    zeros = {k: np.zeros(v.shape, v.dtype) for k, v in tmpl.items()}
+    filled = [t if t is not None else zeros for t in tbs]
+    filled += [zeros] * (W - len(filled))
+    return {k: np.stack([t[k] for t in filled]) for k in tmpl}
 
 
-def _fill_chunk(xs: np.ndarray, ys: np.ndarray, steps: int, batch: int
-                ) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
-    """Cycle-pad a chunk's samples to [steps*batch] and reshape to
-    [steps, batch, ...]; returns (x, y, sample_mask)."""
-    n = len(xs)
+def _fill_chunk(tb: Dict[str, np.ndarray], steps: int, batch: int
+                ) -> Tuple[Dict[str, np.ndarray], np.ndarray]:
+    """Cycle-pad a chunk's samples to [steps*batch] and reshape each
+    transform key to [steps, batch, ...]; returns (batch dict, sample_mask)."""
+    if not tb:
+        raise DataError("dataset transform returned an empty batch dict")
+    n = len(next(iter(tb.values())))
+    if any(len(v) != n for v in tb.values()):
+        raise DataError(
+            f"transform produced unequal lengths: "
+            f"{ {k: len(v) for k, v in tb.items()} }")
     need = steps * batch
     mask = np.zeros(need, dtype=np.float32)
     mask[:n] = 1.0
-    if n == 0:
-        x_pad = np.zeros((need,) + xs.shape[1:], dtype=xs.dtype)
-        y_pad = np.zeros((need,) + ys.shape[1:], dtype=ys.dtype)
-    else:
-        reps = -(-need // n)  # ceil
-        x_pad = np.concatenate([xs] * reps)[:need]
-        y_pad = np.concatenate([ys] * reps)[:need]
-    return (x_pad.reshape((steps, batch) + xs.shape[1:]),
-            y_pad.reshape((steps, batch) + ys.shape[1:]),
-            mask.reshape(steps, batch))
+    out = {}
+    for k, v in tb.items():
+        if n == 0:
+            pad = np.zeros((need,) + v.shape[1:], dtype=v.dtype)
+        else:
+            reps = -(-need // n)  # ceil
+            pad = np.concatenate([v] * reps)[:need]
+        out[k] = pad.reshape((steps, batch) + v.shape[1:])
+    return out, mask.reshape(steps, batch)
 
 
 def prefetch_rounds(rounds: Iterator[RoundBatch], depth: int = 2,
@@ -222,7 +227,7 @@ class RoundLoader:
                 yield self._native_round(rp, W, S, B, x_mm, y_mm, rngs,
                                          len(plan.rounds))
                 continue
-            xs_all, ys_all = [], []
+            tbs = []
             sample_mask = np.zeros((W, S, B), dtype=np.float32)
             step_mask = np.zeros((W, S), dtype=np.float32)
             worker_mask = np.zeros(W, dtype=np.float32)
@@ -231,23 +236,19 @@ class RoundLoader:
                     data, labels = self._chunk_samples(x_mm, y_mm, c.doc_start,
                                                        c.doc_end, perm)
                     tb = self.dataset.transform_train(data, labels)
-                    xs, ys, smask = _fill_chunk(tb["x"], tb["y"],
-                                                c.num_steps, B)
-                    xs, ys, smask = _pad_steps(xs, ys, smask, S)
+                    tb, smask = _fill_chunk(tb, c.num_steps, B)
+                    tb, smask = _pad_steps(tb, smask, S)
                     sample_mask[c.worker] = smask
                     step_mask[c.worker, :c.num_steps] = 1.0
                     worker_mask[c.worker] = 1.0
-                    xs_all.append(xs)
-                    ys_all.append(ys)
+                    tbs.append(tb)
                 else:
-                    xs_all.append(None)
-                    ys_all.append(None)
+                    tbs.append(None)
 
-            x_stack, y_stack = _fill_missing_workers(xs_all, ys_all, W)
             rngs = key_rng.integers(0, 2**32, size=(W, S, 2),
                                     dtype=np.uint32)
             yield RoundBatch(
-                batch={"x": x_stack, "y": y_stack},
+                batch=_fill_missing_workers(tbs, W),
                 sample_mask=sample_mask, step_mask=step_mask,
                 worker_mask=worker_mask, rngs=rngs,
                 round_index=rp.index, num_rounds=len(plan.rounds))
@@ -314,7 +315,7 @@ class RoundLoader:
                 np.array([c.num_steps for c in act]),
                 W, S, B)
             return ({"x": x, "y": y}, sample_mask)
-        xs_all, ys_all = [], []
+        tbs = []
         sample_mask = np.zeros((W, S, B), dtype=np.float32)
         for c in plan.rounds[0].chunks:
             if c.active:
@@ -322,13 +323,10 @@ class RoundLoader:
                 hi = min(c.doc_end * self.handle.subset_size, len(x_mm))
                 tb = self.dataset.transform_test(np.asarray(x_mm[lo:hi]),
                                                  np.asarray(y_mm[lo:hi]))
-                xs, ys, smask = _fill_chunk(tb["x"], tb["y"], c.num_steps, B)
-                xs, ys, smask = _pad_steps(xs, ys, smask, S)
+                tb, smask = _fill_chunk(tb, c.num_steps, B)
+                tb, smask = _pad_steps(tb, smask, S)
                 sample_mask[c.worker] = smask
-                xs_all.append(xs)
-                ys_all.append(ys)
+                tbs.append(tb)
             else:
-                xs_all.append(None)
-                ys_all.append(None)
-        x_stack, y_stack = _fill_missing_workers(xs_all, ys_all, W)
-        return ({"x": x_stack, "y": y_stack}, sample_mask)
+                tbs.append(None)
+        return (_fill_missing_workers(tbs, W), sample_mask)

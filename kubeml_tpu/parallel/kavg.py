@@ -123,7 +123,7 @@ class KAvgEngine:
 
     def __init__(self, mesh: Mesh, loss_fn: LossFn, metrics_fn: MetricsFn,
                  tx_factory: TxFactory, donate: bool = True,
-                 merge_dtype: Any = None):
+                 merge_dtype: Any = None, unroll: int = 2):
         """donate=True donates the input variables buffer to each
         train_round (frees a full model copy of HBM) — the caller must then
         always continue from the *returned* variables, never reuse the
@@ -137,13 +137,19 @@ class KAvgEngine:
         the gradient-compression family the reference lacks entirely
         (SURVEY.md §2a "Absent: ... gradient compression"): lossy
         compression applied exactly at the communication boundary, with
-        local math still in f32."""
+        local math still in f32.
+
+        unroll: lax.scan unroll factor for the K local steps. 2 measures
+        a few percent faster than 1 on v5e (scheduling slack across step
+        boundaries) while keeping compile time bounded for large K;
+        diminishing returns beyond."""
         self.mesh = mesh
         self.loss_fn = loss_fn
         self.metrics_fn = metrics_fn
         self.tx_factory = tx_factory
         self.donate = donate
         self.merge_dtype = merge_dtype
+        self.unroll = max(1, int(unroll))
         if merge_dtype is not None:
             if not jnp.issubdtype(jnp.dtype(merge_dtype), jnp.floating):
                 raise ValueError(
@@ -217,7 +223,7 @@ class KAvgEngine:
             (params, model_state, _), losses = lax.scan(
                 step, (params, model_state, opt_state),
                 (chunk["batch"], chunk["sample_mask"], chunk["step_mask"],
-                 chunk["rngs"]))
+                 chunk["rngs"]), unroll=self.unroll)
             return {"params": params, **model_state}, losses.sum()
 
         def lane_fn(variables, batch, sample_mask, step_mask, worker_mask,

@@ -113,6 +113,10 @@ class AsyncCheckpointer:
     thread, and releases everything; the owning job must call it so a
     long-lived server does not accumulate idle writer threads, and so no
     background write is mid-publish at process exit.
+
+    Lifecycle: one checkpointer per TrainJob (wait()/close() clear ALL
+    latched errors, so sharing one instance across concurrent jobs would
+    let one job's wait() swallow another's failure).
     """
 
     def __init__(self, root: Optional[str] = None):
