@@ -52,9 +52,34 @@ class DecoderBlock(nn.Module):
     # models/bert.py EncoderBlock — same contract, causal variant)
     seq_axis: Optional[str] = None
     seq_impl: str = "ring"
+    # KV-cache length for incremental decoding (None = no cache path)
+    cache_len: Optional[int] = None
+
+    def _cached_attention(self, q, k, v, bias, offset):
+        """Incremental decode: append this call's K/V into the block's
+        cache at `offset` and attend over the whole cache.
+
+        The cache lives in the flax 'cache' collection ([B, cache_len,
+        H, D] per block, created on first decode apply); `bias` is the
+        module-level [B, 1, Tq, cache_len] causal+validity bias.
+        """
+        B, _, H, D = k.shape
+        k_cache = self.variable(
+            "cache", "cached_k",
+            lambda: jnp.zeros((B, self.cache_len, H, D), self.dtype))
+        v_cache = self.variable(
+            "cache", "cached_v",
+            lambda: jnp.zeros((B, self.cache_len, H, D), self.dtype))
+        k_cache.value = lax.dynamic_update_slice(
+            k_cache.value, k.astype(self.dtype), (0, offset, 0, 0))
+        v_cache.value = lax.dynamic_update_slice(
+            v_cache.value, v.astype(self.dtype), (0, offset, 0, 0))
+        from kubeml_tpu.ops.attention import multi_head_attention
+        return multi_head_attention(q, k_cache.value, v_cache.value, bias)
 
     @nn.compact
-    def __call__(self, h, pad_mask, train: bool, pos=None):
+    def __call__(self, h, pad_mask, train: bool, pos=None,
+                 decode_bias=None, decode_offset=None):
         head_dim = self.hidden // self.heads
         x = nn.LayerNorm(dtype=jnp.float32)(h)
         q = nn.DenseGeneral((self.heads, head_dim), dtype=self.dtype,
@@ -66,7 +91,10 @@ class DecoderBlock(nn.Module):
         if self.seq_impl not in ("ring", "ulysses"):  # static field
             raise ValueError(f"unknown seq_impl {self.seq_impl!r}; "
                              f"expected 'ring' or 'ulysses'")
-        if self.seq_axis is not None and self.seq_impl == "ulysses":
+        if decode_offset is not None:
+            attn = self._cached_attention(q, k, v, decode_bias,
+                                          decode_offset)
+        elif self.seq_axis is not None and self.seq_impl == "ulysses":
             from kubeml_tpu.parallel.ulysses import ulysses_attention
             attn = ulysses_attention(q, k, v, kv_mask=pad_mask,
                                      causal=True, axis_name=self.seq_axis)
@@ -104,18 +132,51 @@ class GPTModule(nn.Module):
     seq_impl: str = "ring"          # 'ring' | 'ulysses'
 
     @nn.compact
-    def __call__(self, x, train: bool = False):
+    def __call__(self, x, train: bool = False, decode: bool = False,
+                 cache_len: Optional[int] = None):
         # x: int32 token ids [B, T], pad id 0. With seq_axis set this runs
         # inside shard_map on the LOCAL [B, T/n] block (positions offset by
         # the shard index) and returns the LOCAL logits block — the causal
         # ring/all-to-all reconstructs exactly the dense forward.
+        #
+        # decode=True is the incremental KV-cache path (apply with
+        # mutable=['cache']): this call's tokens are appended at the
+        # cache's current index, attention runs against all cached
+        # positions, and positions/validity advance — O(cache_len) per
+        # step instead of a full re-forward. cache_len (static) sizes the
+        # cache on the first decode call.
         B, T = x.shape
         n_shards = 1 if self.seq_axis is None else lax.axis_size(self.seq_axis)
-        if T * n_shards > self.max_len:  # static trace-time guard
+        if (not decode) and T * n_shards > self.max_len:  # trace-time guard
             raise ValueError(f"sequence length {T * n_shards} exceeds "
                              f"max_len {self.max_len}")
         pad_mask = (x != PAD_ID).astype(jnp.float32)
-        if self.seq_axis is None:
+        decode_bias = offset = None
+        if decode:
+            if train or self.seq_axis is not None:
+                raise ValueError("decode mode is eval-only and dense-only")
+            if cache_len is None or cache_len > self.max_len:
+                raise ValueError(f"decode needs cache_len <= max_len "
+                                 f"{self.max_len}, got {cache_len}")
+            index = self.variable("cache", "index",
+                                  lambda: jnp.zeros((), jnp.int32))
+            valid = self.variable("cache", "valid",
+                                  lambda: jnp.zeros((B, cache_len),
+                                                    jnp.float32))
+            offset = index.value
+            valid.value = lax.dynamic_update_slice(
+                valid.value, pad_mask, (0, offset))
+            # kv position j is attendable by query t (window position
+            # offset+t) iff j holds a real token and j <= offset+t
+            q_pos = offset + jnp.arange(T)
+            kv_pos = jnp.arange(cache_len)
+            causal = (kv_pos[None, :] <= q_pos[:, None]).astype(jnp.float32)
+            keep = valid.value[:, None, None, :] * causal[None, None]
+            from kubeml_tpu.ops.attention import NEG_INF
+            decode_bias = (1.0 - keep) * NEG_INF
+            pos_ids = q_pos
+            index.value = offset + T
+        elif self.seq_axis is None:
             pos_ids = jnp.arange(T)
         else:
             pos_ids = lax.axis_index(self.seq_axis) * T + jnp.arange(T)
@@ -130,12 +191,27 @@ class GPTModule(nn.Module):
             h = DecoderBlock(self.hidden, self.heads, self.ffn, self.dropout,
                              self.dtype, seq_axis=self.seq_axis,
                              seq_impl=self.seq_impl,
+                             cache_len=cache_len,
                              name=f"layer_{i}")(h, pad_mask, train,
-                                                pos=pos_ids)
+                                                pos=pos_ids,
+                                                decode_bias=decode_bias,
+                                                decode_offset=offset)
         h = nn.LayerNorm(dtype=jnp.float32)(h)
         # weight-tied LM head: logits = h @ tok_embed^T
         logits = embed.attend(h.astype(self.dtype))
         return logits.astype(jnp.float32)
+
+
+def _prompt_lengths(window: np.ndarray) -> np.ndarray:
+    """Per-row count of prompt tokens: one past the LAST non-pad token
+    (interior pads count as prompt), 0 for an all-pad row — the shared
+    definition for both generation paths. Callers clamp to >= 1 when
+    indexing the conditioning logits (an all-pad row conditions on
+    position 0)."""
+    real = window != PAD_ID
+    Tp = window.shape[1]
+    return np.where(real.any(axis=1),
+                    Tp - np.argmax(real[:, ::-1], axis=1), 0)
 
 
 def _shift_targets(x: jax.Array):
@@ -231,12 +307,9 @@ class GPTMini(KubeModel):
             self._gen_step = gen_step
         window = np.zeros((B, T), np.int32)
         window[:, :Tp] = prompts[:, :T]
-        # a row's prompt ends after its LAST non-pad token (interior 0s
-        # stay part of the prompt, never overwritten); all-pad rows have
-        # length 0 and produce unconditioned continuations from position 0
-        real = window != PAD_ID
-        lengths = np.where(real.any(axis=1),
-                           T - np.argmax(real[:, ::-1], axis=1), 0)
+        # interior 0s stay part of the prompt (never overwritten);
+        # all-pad rows produce unconditioned continuations from position 0
+        lengths = _prompt_lengths(window)
         variables = jax.device_put(variables)  # once, not per token
         for _ in range(T - Tp):
             nxt = np.asarray(self._gen_step(
@@ -247,6 +320,80 @@ class GPTMini(KubeModel):
                 grow, nxt, window[np.arange(B), np.minimum(lengths, T - 1)])
             lengths = np.minimum(lengths + grow, T)
         return window
+
+    def generate(self, variables, prompts: np.ndarray,
+                 max_new_tokens: int = 32, temperature: float = 0.0,
+                 seed: int = 0) -> np.ndarray:
+        """KV-cache generation: prefill once, then ONE jitted
+        lax.scan of single-token decode steps — O(cache_len) work per
+        token instead of infer()'s full re-forward, and the whole
+        continuation is a single device program (no per-token host
+        round-trips).
+
+        Positions follow the training convention (pads hold positions):
+        the [B, Tp] window is the prompt — interior/trailing pads are
+        masked context — and the continuation occupies window positions
+        Tp, Tp+1, ... for every row. The first generated token conditions
+        on each row's LAST REAL token (matching infer()); for full-length
+        prompts greedy generate() equals infer() exactly.
+
+        temperature 0 = greedy; > 0 samples from softmax(logits/T).
+        Generated tokens are never PAD_ID.
+        """
+        module = self.module
+        prompts = np.asarray(prompts, np.int32)
+        B, Tp = prompts.shape
+        n_new = min(max_new_tokens, module.max_len - Tp)
+        if n_new <= 0:
+            return prompts
+        cache_len = Tp + n_new
+        key = (B, Tp, n_new, temperature != 0.0)
+        if not hasattr(self, "_decode_cache"):
+            self._decode_cache = {}
+        if key not in self._decode_cache:
+            sample = temperature != 0.0
+
+            @jax.jit
+            def run(params, prompts, lengths, temp, rng_key):
+                # ---- prefill: whole prompt in one pass, cache populated
+                logits, state = module.apply(
+                    {"params": params}, prompts, decode=True,
+                    cache_len=cache_len, mutable=["cache"])
+                cache = state["cache"]
+                first = jnp.take_along_axis(
+                    logits, (lengths - 1)[:, None, None], axis=1)[:, 0]
+
+                def pick(logits, k):
+                    logits = logits.at[:, PAD_ID].set(-jnp.inf)
+                    if sample:
+                        return jax.random.categorical(
+                            k, logits / temp).astype(jnp.int32)
+                    return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+
+                # n_new picks total: one from the prefill logits, then
+                # n_new - 1 single-token decode steps
+                keys = jax.random.split(rng_key, n_new)
+                tok = pick(first, keys[0])
+
+                def body(carry, k):
+                    tok, cache = carry
+                    logits, state = module.apply(
+                        {"params": params, "cache": cache}, tok[:, None],
+                        decode=True, cache_len=cache_len,
+                        mutable=["cache"])
+                    return (pick(logits[:, 0], k), state["cache"]), tok
+
+                (last, _), toks = lax.scan(body, (tok, cache), keys[1:])
+                return jnp.concatenate(
+                    [toks.T, last[:, None]], axis=1)  # [B, n_new]
+
+            self._decode_cache[key] = run
+        lengths = _prompt_lengths(prompts)
+        new = np.asarray(self._decode_cache[key](
+            jax.device_put(variables["params"]), jnp.asarray(prompts),
+            jnp.asarray(np.maximum(lengths, 1)), jnp.float32(temperature),
+            jax.random.PRNGKey(seed)))
+        return np.concatenate([prompts, new], axis=1)
 
     # ----------------------------------------------------- sequence parallel
 

@@ -161,3 +161,34 @@ def test_gpt_generate_interior_and_all_pad():
     np.testing.assert_array_equal(out[0, :5], prompts[0])  # incl. token 9
     assert (out[0, 5:] != 0).all()
     assert (out[1, :4] != 0).all()  # all-pad row filled from position 0
+
+
+def test_gpt_cached_generate_matches_infer():
+    """The KV-cache scan decode must produce exactly infer()'s greedy
+    continuation for full-length prompts (same conditioning, positions)."""
+    model = TinyGPT()
+    rng = np.random.RandomState(0)
+    prompts = rng.randint(1, VOCAB, size=(3, 8)).astype(np.int32)
+    variables = model.init_variables(jax.random.PRNGKey(0),
+                                     {"x": jnp.asarray(prompts)})
+    slow = model.infer(variables, prompts, max_new_tokens=6)
+    fast = model.generate(variables, prompts, max_new_tokens=6)
+    assert fast.shape == (3, 14)
+    np.testing.assert_array_equal(fast, slow)
+
+
+def test_gpt_cached_generate_sampling_and_clip():
+    model = TinyGPT()  # max_len 32
+    rng = np.random.RandomState(1)
+    prompts = rng.randint(1, VOCAB, size=(2, 30)).astype(np.int32)
+    variables = model.init_variables(jax.random.PRNGKey(0),
+                                     {"x": jnp.asarray(prompts)})
+    out = model.generate(variables, prompts, max_new_tokens=10,
+                         temperature=1.0, seed=7)
+    assert out.shape == (2, 32)  # clipped to max_len
+    assert (out[:, 30:] != 0).all()  # sampled tokens are never PAD
+    np.testing.assert_array_equal(out[:, :30], prompts)
+    # different seeds give different samples (overwhelmingly likely)
+    out2 = model.generate(variables, prompts, max_new_tokens=10,
+                          temperature=1.0, seed=8)
+    assert (out[:, 30:] != out2[:, 30:]).any()
