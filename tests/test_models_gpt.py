@@ -171,10 +171,15 @@ def test_gpt_cached_generate_matches_infer():
     prompts = rng.randint(1, VOCAB, size=(3, 8)).astype(np.int32)
     variables = model.init_variables(jax.random.PRNGKey(0),
                                      {"x": jnp.asarray(prompts)})
-    slow = model.infer(variables, prompts, max_new_tokens=6)
+    # compare against the re-forward path directly: infer() itself
+    # delegates full-length prompts to generate(), so going through it
+    # would be tautological
+    slow = model._infer_reforward(variables, prompts, max_new_tokens=6)
     fast = model.generate(variables, prompts, max_new_tokens=6)
     assert fast.shape == (3, 14)
     np.testing.assert_array_equal(fast, slow)
+    np.testing.assert_array_equal(model.infer(variables, prompts,
+                                              max_new_tokens=6), fast)
 
 
 def test_gpt_cached_generate_sampling_and_clip():
@@ -192,3 +197,18 @@ def test_gpt_cached_generate_sampling_and_clip():
     out2 = model.generate(variables, prompts, max_new_tokens=10,
                           temperature=1.0, seed=8)
     assert (out[:, 30:] != out2[:, 30:]).any()
+
+
+def test_gpt_infer_empty_prompt():
+    """Width-0 prompts produce an unconditioned continuation via the
+    re-forward path (generate() requires >= 1 column and says so)."""
+    import pytest
+    model = TinyGPT()
+    empty = np.zeros((2, 0), np.int32)
+    variables = model.init_variables(jax.random.PRNGKey(0),
+                                     {"x": jnp.ones((2, 4), jnp.int32)})
+    out = model.infer(variables, empty, max_new_tokens=4)
+    assert out.shape == (2, 4)
+    assert (out != 0).all()
+    with pytest.raises(ValueError):
+        model.generate(variables, empty, max_new_tokens=4)
