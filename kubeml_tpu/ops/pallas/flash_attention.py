@@ -32,8 +32,15 @@ from jax.experimental.pallas import tpu as pltpu
 from kubeml_tpu.ops.attention import (NEG_INF, composed_bias,
                                       multi_head_attention)
 
-DEFAULT_BLOCK_Q = 128
-DEFAULT_BLOCK_K = 128
+# Measured on v5e at T=16384 (B*H=8, D=64): 128x128 blocks run at ~4
+# effective TF/s, 512x512 ~10, 1024x1024 ~11.5 with a plateau beyond —
+# small blocks leave the MXU idle between grid steps. VMEM at 1024x1024
+# is ~12 MB, dominated by the [BQ, BK] f32 score and prob intermediates
+# (4 MB each) over acc/row-stats/double-buffered KV blocks — budget that
+# quadratic term first when scaling blocks further. _fa_forward shrinks
+# a block by halving until it divides T (floor 8).
+DEFAULT_BLOCK_Q = 1024
+DEFAULT_BLOCK_K = 1024
 
 
 # Lane width of the m/l scratch rows (TPU vector lane count).
@@ -74,13 +81,16 @@ def _fa_kernel(mask_ref, q_ref, k_ref, v_ref, out_ref, acc_ref, m_ref,
 
     @pl.when(run)
     def _compute():
-        q = q_ref[0].astype(jnp.float32) * scale           # [BQ, D]
+        # QK^T with native (bf16) inputs and f32 MXU accumulation — an
+        # f32 cast before the dot would force the much slower f32x f32
+        # matmul path; the scale applies to the f32 scores instead
+        q = q_ref[0]                                       # [BQ, D]
         k_blk = k_ref[0]
         v_blk = v_ref[0]
         s = jax.lax.dot_general(
-            q, k_blk.astype(jnp.float32),
+            q, k_blk,
             dimension_numbers=(((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32)            # [BQ, BK]
+            preferred_element_type=jnp.float32) * scale    # [BQ, BK]
         keep = mask_ref[0, 0]                              # [BK]
         s = s + (1.0 - keep.astype(jnp.float32))[None, :] * NEG_INF
         if causal:
@@ -114,10 +124,20 @@ def _fa_forward(q, k, v, pad_mask, causal: bool, block_q: int, block_k: int,
                 interpret: bool):
     B, T, H, D = q.shape
     scale = 1.0 / float(D) ** 0.5
-    bq = min(block_q, T)
-    bk = min(block_k, T)
-    if T % bq or T % bk:
-        raise ValueError(f"T={T} must divide by blocks ({bq}, {bk})")
+
+    def fit(block):
+        b = min(block, T)
+        while b > 1 and T % b:  # halve until the block divides T
+            b //= 2
+        if b < 8:  # sub-sublane blocks = degenerate kernel; fail fast
+            raise ValueError(
+                f"T={T} has no block-aligned tiling (needs a divisor that "
+                f"is a halving of {min(block, T)}, >= 8); pad T or use "
+                f"impl='reference'")
+        return b
+
+    bq = fit(block_q)
+    bk = fit(block_k)
     n_k = T // bk
 
     # [B, T, H, D] -> [B*H, T, D]
