@@ -14,9 +14,13 @@ plus an optional causal flag — composed inside the kernel as additive
 NEG_INF terms, so results match the jnp reference exactly (softmax over
 fully-masked rows degrades to uniform, never NaN).
 
-Backward: jax.custom_vjp with a rematerialized jnp backward (recompute
-attention from saved q/k/v — standard flash practice of trading FLOPs for
-memory; a dedicated pallas backward kernel is a later optimization).
+Backward: jax.custom_vjp with dedicated pallas kernels (standard flash
+split): the forward additionally emits the per-row softmax stats (max m
+and normalizer l, kept separate for NEG_INF-scale precision), and two
+blocked passes recompute probabilities p = exp(s - m)/l — one
+accumulating dk/dv with the Q loop innermost, one accumulating dq with
+the KV loop innermost — so the backward, like the forward, never holds
+an O(T^2) tensor in HBM.
 """
 
 from __future__ import annotations
@@ -29,8 +33,7 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-from kubeml_tpu.ops.attention import (NEG_INF, composed_bias,
-                                      multi_head_attention)
+from kubeml_tpu.ops.attention import NEG_INF
 
 # Measured on v5e at T=16384 (B*H=8, D=64): 128x128 blocks run at ~4
 # effective TF/s, 512x512 ~10, 1024x1024 ~11.5 with a plateau beyond —
@@ -47,8 +50,25 @@ DEFAULT_BLOCK_K = 1024
 _LANES = 128
 
 
-def _fa_kernel(mask_ref, q_ref, k_ref, v_ref, out_ref, acc_ref, m_ref,
-               l_ref, *, causal: bool, scale: float, n_k: int):
+def _block_scores(q, k, mask_ref, iq, jk, bq, bk, scale, causal):
+    """Recompute the masked [BQ, BK] f32 score block — THE shared score
+    definition for the forward and both backward kernels (bf16 inputs,
+    f32 MXU accumulation, scale + pad + causal applied to f32 scores)."""
+    s = jax.lax.dot_general(
+        q, k, dimension_numbers=(((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32) * scale
+    keep = mask_ref[0, 0]
+    s = s + (1.0 - keep.astype(jnp.float32))[None, :] * NEG_INF
+    if causal:
+        q_pos = jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0) + iq * bq
+        k_pos = jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1) + jk * bk
+        s = s + jnp.where(q_pos >= k_pos, 0.0, NEG_INF)
+    return s
+
+
+def _fa_kernel(mask_ref, q_ref, k_ref, v_ref, out_ref, m_out_ref, l_out_ref,
+               acc_ref, m_ref, l_ref, *, causal: bool, scale: float,
+               n_k: int):
     """One (Q block, KV block) grid point of the online softmax.
 
     The KV loop is the LAST grid dimension, which pallas iterates
@@ -81,24 +101,9 @@ def _fa_kernel(mask_ref, q_ref, k_ref, v_ref, out_ref, acc_ref, m_ref,
 
     @pl.when(run)
     def _compute():
-        # QK^T with native (bf16) inputs and f32 MXU accumulation — an
-        # f32 cast before the dot would force the much slower f32x f32
-        # matmul path; the scale applies to the f32 scores instead
-        q = q_ref[0]                                       # [BQ, D]
-        k_blk = k_ref[0]
         v_blk = v_ref[0]
-        s = jax.lax.dot_general(
-            q, k_blk,
-            dimension_numbers=(((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32) * scale    # [BQ, BK]
-        keep = mask_ref[0, 0]                              # [BK]
-        s = s + (1.0 - keep.astype(jnp.float32))[None, :] * NEG_INF
-        if causal:
-            q_pos = jax.lax.broadcasted_iota(
-                jnp.int32, (bq, bk), 0) + iq * bq
-            k_pos = jax.lax.broadcasted_iota(
-                jnp.int32, (bq, bk), 1) + jk * bk
-            s = s + jnp.where(q_pos >= k_pos, 0.0, NEG_INF)
+        s = _block_scores(q_ref[0], k_ref[0], mask_ref, iq, jk, bq, bk,
+                          scale, causal)                   # [BQ, BK]
         m_prev = m_ref[...][:, :1]                         # [BQ, 1]
         l_prev = l_ref[...][:, :1]
         m_blk = s.max(axis=-1, keepdims=True)
@@ -115,41 +120,55 @@ def _fa_kernel(mask_ref, q_ref, k_ref, v_ref, out_ref, acc_ref, m_ref,
 
     @pl.when(jk == n_k - 1)
     def _flush():
-        l = l_ref[...][:, :1]
-        out_ref[0] = (acc_ref[...] / jnp.maximum(l, 1e-30)
-                      ).astype(out_ref.dtype)
+        l = jnp.maximum(l_ref[...][:, :1], 1e-30)
+        out_ref[0] = (acc_ref[...] / l).astype(out_ref.dtype)
+        # Row stats saved for the backward's probability recomputation:
+        # p = exp(s - m) / l. Saved SEPARATELY, not as lse = m + log l:
+        # for fully-masked rows m is at NEG_INF scale (1e9), where f32
+        # spacing (~64) swallows log l entirely — exp(s - lse) would give
+        # p = 1 instead of the forward's uniform 1/l, inflating all-pad
+        # rows' gradients by the row length.
+        m_out_ref[0, 0] = m_ref[...][:, 0]
+        l_out_ref[0, 0] = l[:, 0]
+
+
+def _fit_block(block: int, T: int) -> int:
+    b = min(block, T)
+    while b > 1 and T % b:  # halve until the block divides T
+        b //= 2
+    if b < 8 or b % 8:  # sub-sublane / unaligned = degenerate kernel
+        raise ValueError(
+            f"T={T} has no block-aligned tiling (needs a divisor that "
+            f"is a halving of {min(block, T)}, >= 8 and 8-aligned); pad "
+            f"T or use impl='reference'")
+    return b
+
+
+def _to_bh(x, B, H, T, D):
+    """[B, T, H, D] -> [B*H, T, D] (the kernels' grid layout)."""
+    return x.transpose(0, 2, 1, 3).reshape(B * H, T, D)
+
+
+def _from_bh(x, B, H, T, D):
+    return x.reshape(B, H, T, D).transpose(0, 2, 1, 3)
 
 
 def _fa_forward(q, k, v, pad_mask, causal: bool, block_q: int, block_k: int,
                 interpret: bool):
     B, T, H, D = q.shape
     scale = 1.0 / float(D) ** 0.5
-
-    def fit(block):
-        b = min(block, T)
-        while b > 1 and T % b:  # halve until the block divides T
-            b //= 2
-        if b < 8:  # sub-sublane blocks = degenerate kernel; fail fast
-            raise ValueError(
-                f"T={T} has no block-aligned tiling (needs a divisor that "
-                f"is a halving of {min(block, T)}, >= 8); pad T or use "
-                f"impl='reference'")
-        return b
-
-    bq = fit(block_q)
-    bk = fit(block_k)
+    bq = _fit_block(block_q, T)
+    bk = _fit_block(block_k, T)
     n_k = T // bk
-
-    # [B, T, H, D] -> [B*H, T, D]
-    def to_bh(x):
-        return x.transpose(0, 2, 1, 3).reshape(B * H, T, D)
 
     # [B, 1, T]: the singleton middle dim keeps the VMEM block's last two
     # dims equal to the array dims (TPU tiling requirement for B > 1)
     mask = jnp.broadcast_to(pad_mask.astype(jnp.float32), (B, T))[:, None, :]
+    row_spec = pl.BlockSpec((1, 1, bq), lambda bh, iq, jk: (bh, 0, iq),
+                            memory_space=pltpu.VMEM)
 
     grid = (B * H, T // bq, n_k)
-    out = pl.pallas_call(
+    out, m_rows, l_rows = pl.pallas_call(
         functools.partial(_fa_kernel, causal=causal, scale=scale, n_k=n_k),
         grid=grid,
         in_specs=[
@@ -162,19 +181,205 @@ def _fa_forward(q, k, v, pad_mask, causal: bool, block_q: int, block_k: int,
             pl.BlockSpec((1, bk, D), lambda bh, iq, jk: (bh, jk, 0),
                          memory_space=pltpu.VMEM),
         ],
-        out_specs=pl.BlockSpec((1, bq, D), lambda bh, iq, jk: (bh, iq, 0),
-                               memory_space=pltpu.VMEM),
-        out_shape=jax.ShapeDtypeStruct((B * H, T, D), q.dtype),
+        out_specs=[
+            pl.BlockSpec((1, bq, D), lambda bh, iq, jk: (bh, iq, 0),
+                         memory_space=pltpu.VMEM),
+            row_spec,
+            row_spec,
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((B * H, T, D), q.dtype),
+            jax.ShapeDtypeStruct((B * H, 1, T), jnp.float32),
+            jax.ShapeDtypeStruct((B * H, 1, T), jnp.float32),
+        ],
         scratch_shapes=[
             pltpu.VMEM((bq, D), jnp.float32),
             pltpu.VMEM((bq, _LANES), jnp.float32),
             pltpu.VMEM((bq, _LANES), jnp.float32),
         ],
         interpret=interpret,
-    )(mask, to_bh(q), to_bh(k), to_bh(v))
-    return out.reshape(B, H, T, D).transpose(0, 2, 1, 3)
+    )(mask, _to_bh(q, B, H, T, D), _to_bh(k, B, H, T, D),
+      _to_bh(v, B, H, T, D))
+    return _from_bh(out, B, H, T, D), m_rows, l_rows
 
 
+
+
+def _fa_bwd_dkv_kernel(mask_ref, q_ref, g_ref, m_ref, l_ref, delta_ref,
+                       k_ref, v_ref, dk_ref, dv_ref, dk_acc, dv_acc, *,
+                       causal: bool, scale: float, n_q: int):
+    """dK/dV pass: one KV block owns the grid point; the Q loop is the
+    last (sequential) grid dimension, accumulating into VMEM scratch.
+
+    With p = exp(s - m) / l (the forward's normalized probabilities,
+    recomputed from the saved per-row max m and normalizer l):
+        dV = p^T dO
+        dS = p * (dO V^T - delta),  delta = rowsum(dO * O)
+        dK = dS^T Q * scale
+    """
+    jk = pl.program_id(1)
+    iq = pl.program_id(2)
+    bq = q_ref.shape[1]
+    bk = k_ref.shape[1]
+
+    @pl.when(iq == 0)
+    def _init():
+        dk_acc[...] = jnp.zeros_like(dk_acc)
+        dv_acc[...] = jnp.zeros_like(dv_acc)
+
+    # causal: this KV block can only receive gradient from Q blocks that
+    # reach at least its first column
+    run = ((iq + 1) * bq > jk * bk) if causal else (iq >= 0)
+
+    @pl.when(run)
+    def _compute():
+        q = q_ref[0]
+        g = g_ref[0]
+        s = _block_scores(q, k_ref[0], mask_ref, iq, jk, bq, bk, scale,
+                          causal)
+        p = (jnp.exp(s - m_ref[0, 0][:, None])
+             / l_ref[0, 0][:, None])                       # [BQ, BK]
+        dv_acc[...] = dv_acc[...] + jax.lax.dot_general(
+            p.astype(g.dtype), g,
+            dimension_numbers=(((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)            # [BK, D]
+        dp = jax.lax.dot_general(
+            g, v_ref[0], dimension_numbers=(((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)            # [BQ, BK]
+        ds = p * (dp - delta_ref[0, 0][:, None]) * scale
+        dk_acc[...] = dk_acc[...] + jax.lax.dot_general(
+            ds.astype(q.dtype), q,
+            dimension_numbers=(((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)            # [BK, D]
+
+    @pl.when(iq == n_q - 1)
+    def _flush():
+        dk_ref[0] = dk_acc[...].astype(dk_ref.dtype)
+        dv_ref[0] = dv_acc[...].astype(dv_ref.dtype)
+
+
+def _fa_bwd_dq_kernel(mask_ref, q_ref, g_ref, m_ref, l_ref, delta_ref,
+                      k_ref, v_ref, dq_ref, dq_acc, *, causal: bool,
+                      scale: float, n_k: int):
+    """dQ pass: one Q block per grid point, KV loop last (sequential):
+    dQ = (p * (dO V^T - delta)) K * scale, accumulated over KV blocks."""
+    iq = pl.program_id(1)
+    jk = pl.program_id(2)
+    bq = q_ref.shape[1]
+    bk = k_ref.shape[1]
+
+    @pl.when(jk == 0)
+    def _init():
+        dq_acc[...] = jnp.zeros_like(dq_acc)
+
+    run = (jk * bk < (iq + 1) * bq) if causal else (jk >= 0)
+
+    @pl.when(run)
+    def _compute():
+        q = q_ref[0]
+        g = g_ref[0]
+        k_blk = k_ref[0]
+        s = _block_scores(q, k_blk, mask_ref, iq, jk, bq, bk, scale,
+                          causal)
+        p = (jnp.exp(s - m_ref[0, 0][:, None])
+             / l_ref[0, 0][:, None])
+        dp = jax.lax.dot_general(
+            g, v_ref[0], dimension_numbers=(((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        ds = p * (dp - delta_ref[0, 0][:, None]) * scale
+        dq_acc[...] = dq_acc[...] + jax.lax.dot_general(
+            ds.astype(k_blk.dtype), k_blk,
+            dimension_numbers=(((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)            # [BQ, D]
+
+    @pl.when(jk == n_k - 1)
+    def _flush():
+        dq_ref[0] = dq_acc[...].astype(dq_ref.dtype)
+
+
+def _fa_backward(q, k, v, pad_mask, out, m_rows, l_rows, g, causal,
+                 block_q, block_k, interpret):
+    B, T, H, D = q.shape
+    scale = 1.0 / float(D) ** 0.5
+    bq = _fit_block(block_q, T)
+    bk = _fit_block(block_k, T)
+    n_q, n_k = T // bq, T // bk
+
+    qb, kb, vb, gb, ob = (_to_bh(x, B, H, T, D) for x in (q, k, v, g, out))
+    # delta = rowsum(dO * O) per row — cheap elementwise, fused by XLA
+    delta = (gb.astype(jnp.float32) * ob.astype(jnp.float32)
+             ).sum(-1)[:, None, :]                          # [BH, 1, T]
+    mask = jnp.broadcast_to(pad_mask.astype(jnp.float32), (B, T))[:, None, :]
+
+    mask_spec = pl.BlockSpec((1, 1, bk), lambda bh, a, b: (bh // H, 0, b),
+                             memory_space=pltpu.VMEM)
+    row_args = [qb, gb, m_rows, l_rows, delta]
+
+    dk, dv = pl.pallas_call(
+        functools.partial(_fa_bwd_dkv_kernel, causal=causal, scale=scale,
+                          n_q=n_q),
+        grid=(B * H, n_k, n_q),
+        in_specs=[
+            pl.BlockSpec((1, 1, bk), lambda bh, jk, iq: (bh // H, 0, jk),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, bq, D), lambda bh, jk, iq: (bh, iq, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, bq, D), lambda bh, jk, iq: (bh, iq, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1, bq), lambda bh, jk, iq: (bh, 0, iq),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1, bq), lambda bh, jk, iq: (bh, 0, iq),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1, bq), lambda bh, jk, iq: (bh, 0, iq),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, bk, D), lambda bh, jk, iq: (bh, jk, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, bk, D), lambda bh, jk, iq: (bh, jk, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, bk, D), lambda bh, jk, iq: (bh, jk, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, bk, D), lambda bh, jk, iq: (bh, jk, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_shape=[jax.ShapeDtypeStruct((B * H, T, D), k.dtype),
+                   jax.ShapeDtypeStruct((B * H, T, D), v.dtype)],
+        scratch_shapes=[pltpu.VMEM((bk, D), jnp.float32),
+                        pltpu.VMEM((bk, D), jnp.float32)],
+        interpret=interpret,
+    )(mask, *row_args, kb, vb)
+
+    dq = pl.pallas_call(
+        functools.partial(_fa_bwd_dq_kernel, causal=causal, scale=scale,
+                          n_k=n_k),
+        grid=(B * H, n_q, n_k),
+        in_specs=[
+            mask_spec,
+            pl.BlockSpec((1, bq, D), lambda bh, iq, jk: (bh, iq, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, bq, D), lambda bh, iq, jk: (bh, iq, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1, bq), lambda bh, iq, jk: (bh, 0, iq),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1, bq), lambda bh, iq, jk: (bh, 0, iq),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1, bq), lambda bh, iq, jk: (bh, 0, iq),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, bk, D), lambda bh, iq, jk: (bh, jk, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, bk, D), lambda bh, iq, jk: (bh, jk, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((1, bq, D), lambda bh, iq, jk: (bh, iq, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((B * H, T, D), q.dtype),
+        scratch_shapes=[pltpu.VMEM((bq, D), jnp.float32)],
+        interpret=interpret,
+    )(mask, *row_args, kb, vb)
+
+    return (_from_bh(dq, B, H, T, D), _from_bh(dk, B, H, T, D),
+            _from_bh(dv, B, H, T, D))
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7))
@@ -189,26 +394,21 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     bias]) to float32 accuracy. `interpret=True` runs the kernel in the
     pallas interpreter (CPU tests).
     """
-    return _fa_forward(q, k, v, pad_mask, causal, block_q, block_k,
-                       interpret)
+    out, _, _ = _fa_forward(q, k, v, pad_mask, causal, block_q, block_k,
+                            interpret)
+    return out
 
 
 def _fa_fwd(q, k, v, pad_mask, causal, block_q, block_k, interpret):
-    out = _fa_forward(q, k, v, pad_mask, causal, block_q, block_k,
-                      interpret)
-    return out, (q, k, v, pad_mask)
+    out, m_rows, l_rows = _fa_forward(q, k, v, pad_mask, causal, block_q,
+                                      block_k, interpret)
+    return out, (q, k, v, pad_mask, out, m_rows, l_rows)
 
 
 def _fa_bwd(causal, block_q, block_k, interpret, res, g):
-    q, k, v, pad_mask = res
-    T = q.shape[1]
-
-    def ref(q, k, v):
-        return multi_head_attention(
-            q, k, v, composed_bias(pad_mask, causal, T))
-
-    _, vjp = jax.vjp(ref, q, k, v)
-    dq, dk, dv = vjp(g)
+    q, k, v, pad_mask, out, m_rows, l_rows = res
+    dq, dk, dv = _fa_backward(q, k, v, pad_mask, out, m_rows, l_rows, g,
+                              causal, block_q, block_k, interpret)
     return dq, dk, dv, jnp.zeros_like(pad_mask)
 
 

@@ -74,3 +74,28 @@ def test_flash_bf16():
     np.testing.assert_allclose(
         np.asarray(out, np.float32), np.asarray(ref, np.float32),
         rtol=5e-2, atol=5e-2)
+
+
+def test_flash_grads_all_pad_row_match_reference():
+    """An all-pad row (uniform softmax in the forward) must produce the
+    reference's gradients, not length-inflated ones — guards the
+    separate-(m, l) stats in the backward (lse = m + log l loses log l
+    to f32 rounding at NEG_INF scale, giving p = 1 instead of 1/l)."""
+    rng = np.random.RandomState(3)
+    q, k, v = _qkv(rng)
+    pad = np.ones((B, T), np.float32)
+    pad[0, :] = 0.0  # row 0 of batch 0: fully masked
+    pad = jnp.asarray(pad)
+
+    def loss_flash(q, k, v):
+        return (flash_attention(q, k, v, pad, False, 32, 32, True) ** 2).sum()
+
+    def loss_ref(q, k, v):
+        return (multi_head_attention(
+            q, k, v, composed_bias(pad, False, T)) ** 2).sum()
+
+    g_f = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    g_r = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g_f, g_r):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-4, atol=2e-4)
