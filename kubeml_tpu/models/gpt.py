@@ -26,7 +26,7 @@ exactly like the reference treats one sample.
 
 from __future__ import annotations
 
-from typing import Optional
+from typing import Any, Optional
 
 import flax.linen as nn
 import jax
@@ -54,6 +54,11 @@ class DecoderBlock(nn.Module):
     seq_impl: str = "ring"
     # KV-cache length for incremental decoding (None = no cache path)
     cache_len: Optional[int] = None
+    # > 0: replace the dense FFN with a mixture-of-experts layer
+    n_experts: int = 0
+    moe_k: int = 2
+    capacity_factor: float = 1.25
+    ep_mesh: Any = None
 
     def _cached_attention(self, q, k, v, bias, offset):
         """Incremental decode: append this call's K/V into the block's
@@ -112,11 +117,57 @@ class DecoderBlock(nn.Module):
         attn = nn.Dropout(self.dropout, deterministic=not train)(attn)
         h = h + attn
         x = nn.LayerNorm(dtype=jnp.float32)(h)
-        x = nn.Dense(self.ffn, dtype=self.dtype)(x)
-        x = nn.gelu(x)
-        x = nn.Dense(self.hidden, dtype=self.dtype)(x)
+        if self.n_experts > 0:
+            x = MoEFFN(self.hidden, self.ffn, self.n_experts,
+                       k=self.moe_k, capacity_factor=self.capacity_factor,
+                       ep_mesh=self.ep_mesh, name="moe")(x, pad_mask)
+        else:
+            x = nn.Dense(self.ffn, dtype=self.dtype)(x)
+            x = nn.gelu(x)
+            x = nn.Dense(self.hidden, dtype=self.dtype)(x)
         x = nn.Dropout(self.dropout, deterministic=not train)(x)
         return h + x
+
+
+class MoEFFN(nn.Module):
+    """Mixture-of-experts FFN: flax parameter wrapper over the GShard
+    dispatch/combine formulation in parallel/ep.py (same math the EP
+    tests pin). The auxiliary load-balance loss is sown into the
+    'intermediates' collection; GPTMoEMini.loss collects it."""
+
+    d_model: int
+    d_ff: int
+    n_experts: int
+    k: int = 2
+    capacity_factor: float = 1.25
+    ep_mesh: Any = None  # jax Mesh: shard experts over its `expert` axis
+
+    @nn.compact
+    def __call__(self, h, pad_mask):
+        from kubeml_tpu.parallel.ep import moe_apply
+        d, f, e = self.d_model, self.d_ff, self.n_experts
+        scale_in = 1.0 / np.sqrt(d)
+        scale_out = 1.0 / np.sqrt(f)
+        params = {
+            "router": self.param(
+                "router", nn.initializers.normal(scale_in), (d, e)),
+            "wi": self.param(
+                "wi", nn.initializers.normal(scale_in), (e, d, f)),
+            "bi": self.param("bi", nn.initializers.zeros, (e, f)),
+            "wo": self.param(
+                "wo", nn.initializers.normal(scale_out), (e, f, d)),
+            "bo": self.param("bo", nn.initializers.zeros, (e, d)),
+        }
+        B, T, D = h.shape
+        # pad tokens are excluded from routing and capacity entirely —
+        # unlike the dense FFN (row-independent), an unmasked MoE would
+        # let padding displace real tokens from expert slots
+        y, aux = moe_apply(params, h.reshape(B * T, D),
+                           mesh=self.ep_mesh, k=self.k,
+                           capacity_factor=self.capacity_factor,
+                           token_mask=pad_mask.reshape(B * T))
+        self.sow("intermediates", "moe_aux", aux)
+        return y.reshape(B, T, D).astype(h.dtype)
 
 
 class GPTModule(nn.Module):
@@ -130,6 +181,10 @@ class GPTModule(nn.Module):
     dtype: jnp.dtype = jnp.bfloat16
     seq_axis: Optional[str] = None  # sequence-parallel mode
     seq_impl: str = "ring"          # 'ring' | 'ulysses'
+    n_experts: int = 0              # > 0: MoE FFN in every block
+    moe_k: int = 2
+    capacity_factor: float = 1.25
+    ep_mesh: Any = None             # mesh whose `expert` axis shards experts
 
     @nn.compact
     def __call__(self, x, train: bool = False, decode: bool = False,
@@ -192,6 +247,9 @@ class GPTModule(nn.Module):
                              self.dtype, seq_axis=self.seq_axis,
                              seq_impl=self.seq_impl,
                              cache_len=cache_len,
+                             n_experts=self.n_experts, moe_k=self.moe_k,
+                             capacity_factor=self.capacity_factor,
+                             ep_mesh=self.ep_mesh,
                              name=f"layer_{i}")(h, pad_mask, train,
                                                 pos=pos_ids,
                                                 decode_bias=decode_bias,
@@ -212,6 +270,16 @@ def _prompt_lengths(window: np.ndarray) -> np.ndarray:
     Tp = window.shape[1]
     return np.where(real.any(axis=1),
                     Tp - np.argmax(real[:, ::-1], axis=1), 0)
+
+
+def _lm_per_example(logits: jax.Array, x: jax.Array) -> jax.Array:
+    """Per-sequence mean next-token cross-entropy [B] — THE LM loss
+    definition shared by the dense and MoE model classes."""
+    targets, tok_mask = _shift_targets(x)
+    per_tok = optax.softmax_cross_entropy_with_integer_labels(
+        logits, targets)
+    denom = jnp.maximum(tok_mask.sum(axis=1), 1.0)
+    return (per_tok * tok_mask).sum(axis=1) / denom
 
 
 def _shift_targets(x: jax.Array):
@@ -239,8 +307,9 @@ class GPTMini(KubeModel):
     def init_variables(self, rng, sample_batch):
         return self.module.init(rng, sample_batch["x"], train=False)
 
-    def apply_train(self, variables, x, rng):
-        mutable = [k for k in variables if k != "params"]
+    def apply_train(self, variables, x, rng, extra_mutable=()):
+        mutable = [k for k in variables if k != "params"] \
+            + list(extra_mutable)
         if mutable:
             logits, new_state = self.module.apply(
                 variables, x, train=True, mutable=mutable,
@@ -254,11 +323,7 @@ class GPTMini(KubeModel):
         """Per-sequence mean next-token cross-entropy, [B]."""
         x = batch["x"]
         logits, new_state = self.apply_train(variables, x, rng)
-        targets, tok_mask = _shift_targets(x)
-        per_tok = optax.softmax_cross_entropy_with_integer_labels(
-            logits, targets)
-        denom = jnp.maximum(tok_mask.sum(axis=1), 1.0)
-        return (per_tok * tok_mask).sum(axis=1) / denom, new_state
+        return _lm_per_example(logits, x), new_state
 
     def metrics(self, variables, batch):
         x = batch["x"]
@@ -275,6 +340,7 @@ class GPTMini(KubeModel):
         return optax.adamw(lr, weight_decay=0.01)
 
     # ------------------------------------------------------------ inference
+
 
     def infer(self, variables, data: np.ndarray,
               max_new_tokens: int = 32) -> np.ndarray:
@@ -448,3 +514,46 @@ class GPTMini(KubeModel):
                 fwd, mesh=mesh, in_specs=(P(), P(None, SEQ_AXIS)),
                 out_specs=P(None, SEQ_AXIS), check_vma=False))
         return self._sp_cache[key](variables, x)
+
+
+@register_model("gpt-moe-mini")
+class GPTMoEMini(GPTMini):
+    """MoE variant of gpt-mini: 8 experts x 512-wide FFN, top-2 routing
+    (GShard dispatch/combine from parallel/ep.py), same attention stack.
+
+    Expert parallelism at model level: construct with
+    `GPTMoEMini(ep_mesh=mesh)` to shard the expert-stacked FFN weights
+    and the dispatch/combine intermediates over the mesh `expert` axis —
+    GSPMD then materializes the token all-to-alls on ICI (EP_RULES in
+    parallel/ep.py give the parameter placements).
+
+    The router's load-balance auxiliary loss (Shazeer et al.) is sown by
+    each block and added to every sequence's loss with weight
+    `aux_coef`, so the K-avg/syncdp engines and the reference's
+    loss-aggregation semantics need no special-casing.
+    """
+
+    name = "gpt-moe-mini"
+    aux_coef = 0.01
+
+    def __init__(self, ep_mesh=None):
+        self.ep_mesh = ep_mesh
+
+    def build(self):
+        return GPTModule(ffn=512, n_experts=8, ep_mesh=self.ep_mesh)
+
+    def loss(self, variables, batch, rng, sample_mask):
+        x = batch["x"]
+        logits, new_state = self.apply_train(
+            variables, x, rng, extra_mutable=("intermediates",))
+        sown = new_state.pop("intermediates", {})
+        aux = sum(jax.tree_util.tree_leaves(sown)) / max(
+            1, self.module.layers)
+        return _lm_per_example(logits, x) + self.aux_coef * aux, new_state
+
+    def forward_seq_parallel(self, variables, x, mesh, impl="ring"):
+        raise NotImplementedError(
+            "sequence-parallel MoE is not supported: per-shard routing "
+            "capacity and expert sharding constraints do not compose "
+            "with the seq-axis shard_map; use the dense gpt-mini for "
+            "seq-parallel forwards")

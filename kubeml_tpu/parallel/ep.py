@@ -50,7 +50,8 @@ def init_moe_params(rng: jax.Array, d_model: int, d_ff: int,
     }
 
 
-def make_dispatch(logits: jax.Array, capacity: int, k: int = 2
+def make_dispatch(logits: jax.Array, capacity: int, k: int = 2,
+                  token_mask: Optional[jax.Array] = None
                   ) -> Tuple[jax.Array, jax.Array, jax.Array]:
     """Top-k routing with per-expert capacity.
 
@@ -58,20 +59,28 @@ def make_dispatch(logits: jax.Array, capacity: int, k: int = 2
     float, aux_loss scalar). A token contributes to at most k experts;
     within an expert, slots fill in token order (GShard's cumsum position
     assignment) and overflow is dropped.
+
+    token_mask [T] (1 = real): masked tokens are excluded from routing
+    entirely — they claim no capacity slots (so padding can never
+    displace real tokens from an expert) and do not enter the
+    load-balance statistics.
     """
     t, e = logits.shape
     k = min(k, e)
     probs = jax.nn.softmax(logits, axis=-1)
+    valid = (jnp.ones((t,), logits.dtype) if token_mask is None
+             else token_mask.astype(logits.dtype))
 
     dispatch = jnp.zeros((t, e, capacity), logits.dtype)
     combine = jnp.zeros((t, e, capacity), logits.dtype)
-    masked = probs
+    masked = probs * valid[:, None]
     # Slot tokens expert-by-expert for each of the k choices. Loop bound k
     # is a static Python int — unrolled at trace time, XLA-friendly.
     fill = jnp.zeros((e,), jnp.int32)  # slots already used per expert
     for _ in range(k):
         choice = jnp.argmax(masked, axis=-1)                      # [T]
-        onehot = jax.nn.one_hot(choice, e, dtype=logits.dtype)    # [T, E]
+        onehot = jax.nn.one_hot(choice, e, dtype=logits.dtype) \
+            * valid[:, None]                                      # [T, E]
         pos = (jnp.cumsum(onehot, axis=0) - 1) * onehot           # [T, E]
         pos = pos + fill[None, :] * onehot
         keep = onehot * (pos < capacity)
@@ -83,22 +92,31 @@ def make_dispatch(logits: jax.Array, capacity: int, k: int = 2
         fill = fill + keep.sum(axis=0).astype(jnp.int32)
         masked = masked * (1.0 - onehot)  # next choice excludes this expert
 
-    # Load-balance auxiliary loss over the FIRST choice distribution.
+    # Load-balance auxiliary loss over the FIRST choice distribution,
+    # statistics taken over REAL tokens only.
+    n_valid = jnp.maximum(valid.sum(), 1.0)
     first = jax.nn.one_hot(jnp.argmax(probs, axis=-1), e,
-                           dtype=logits.dtype)
-    aux = e * jnp.sum(first.mean(axis=0) * probs.mean(axis=0))
+                           dtype=logits.dtype) * valid[:, None]
+    aux = e * jnp.sum((first.sum(axis=0) / n_valid)
+                      * ((probs * valid[:, None]).sum(axis=0) / n_valid))
     return dispatch, combine, aux
 
 
 def moe_apply(params: Dict[str, jax.Array], x: jax.Array,
               mesh: Optional[Mesh] = None, *, k: int = 2,
-              capacity_factor: float = 1.25
+              capacity_factor: float = 1.25,
+              token_mask: Optional[jax.Array] = None
               ) -> Tuple[jax.Array, jax.Array]:
     """Apply the expert layer to tokens x [T, d_model].
 
     Returns (y [T, d_model], aux_loss). With a mesh, expert-major
     intermediates are constrained to the `expert` axis so the SPMD
     partitioner materializes dispatch/return as all-to-alls.
+
+    Routing/softmax/aux statistics run in f32; the expert matmuls (the
+    dominant FLOPs) run in x.dtype — bf16 activations keep the MXU on
+    its fast path, with biases/params cast to match. token_mask [T]
+    excludes padding from routing and capacity (see make_dispatch).
     """
     t = x.shape[0]
     e = params["router"].shape[1]
@@ -110,18 +128,21 @@ def moe_apply(params: Dict[str, jax.Array], x: jax.Array,
         return jax.lax.with_sharding_constraint(
             arr, NamedSharding(mesh, P(EXPERT_AXIS)))
 
-    logits = x @ params["router"]
-    dispatch, combine, aux = make_dispatch(logits, capacity, k)
+    logits = x.astype(jnp.float32) @ params["router"].astype(jnp.float32)
+    dispatch, combine, aux = make_dispatch(logits, capacity, k,
+                                           token_mask=token_mask)
 
-    expert_in = on_expert_axis(jnp.einsum("tec,td->ecd", dispatch, x))
+    cdt = x.dtype
+    expert_in = on_expert_axis(
+        jnp.einsum("tec,td->ecd", dispatch.astype(cdt), x))
     h = jax.nn.gelu(
-        jnp.einsum("ecd,edf->ecf", expert_in, params["wi"])
-        + params["bi"][:, None, :])
+        jnp.einsum("ecd,edf->ecf", expert_in, params["wi"].astype(cdt))
+        + params["bi"].astype(cdt)[:, None, :])
     # Empty slots get the bias too, but combine is zero there — harmless.
     out = on_expert_axis(
-        jnp.einsum("ecf,efd->ecd", h, params["wo"])
-        + params["bo"][:, None, :])
-    y = jnp.einsum("tec,ecd->td", combine, out)
+        jnp.einsum("ecf,efd->ecd", h, params["wo"].astype(cdt))
+        + params["bo"].astype(cdt)[:, None, :])
+    y = jnp.einsum("tec,ecd->td", combine.astype(cdt), out)
     return y, aux
 
 
