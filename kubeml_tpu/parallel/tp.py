@@ -25,15 +25,17 @@ from kubeml_tpu.parallel.mesh import MODEL_AXIS
 PyTree = Any
 Rules = Sequence[Tuple[str, P]]
 
-# Megatron split for the BERT encoder (models/bert.py param tree):
+# Megatron split for the transformer blocks (models/bert.py EncoderBlock
+# AND models/gpt.py DecoderBlock — both use the q/k/v/out DenseGeneral +
+# Dense_0/Dense_1 FFN layout, so one rule table covers both):
 #   q/k/v DenseGeneral kernels [hidden, heads, head_dim] -> shard heads;
 #   attention out DenseGeneral  [heads, head_dim, hidden] -> shard heads
 #     (row-parallel: XLA inserts one psum after it);
 #   FFN Dense_0 [hidden, ffn] -> column split; Dense_1 [ffn, hidden] ->
 #     row split (again one psum);
 #   token/position embeddings -> vocab/hidden kept replicated (tiny at
-#     BERT scale; shard via an extra rule when they dominate).
-BERT_TP_RULES: List[Tuple[str, P]] = [
+#     this scale; shard via an extra rule when they dominate).
+TRANSFORMER_TP_RULES: List[Tuple[str, P]] = [
     (r".*/(q|k|v)/kernel$", P(None, MODEL_AXIS, None)),
     (r".*/(q|k|v)/bias$", P(MODEL_AXIS, None)),
     (r".*/out/kernel$", P(MODEL_AXIS, None, None)),
@@ -41,6 +43,8 @@ BERT_TP_RULES: List[Tuple[str, P]] = [
     (r".*/Dense_0/bias$", P(MODEL_AXIS)),
     (r".*/Dense_1/kernel$", P(MODEL_AXIS, None)),
 ]
+BERT_TP_RULES = TRANSFORMER_TP_RULES  # back-compat alias
+GPT_TP_RULES = TRANSFORMER_TP_RULES
 
 
 def spec_for(path: str, rules: Rules) -> P:
