@@ -479,6 +479,89 @@ class GPTMini(KubeModel):
             jax.random.PRNGKey(seed)))
         return np.concatenate([prompts, new], axis=1)
 
+    # ----------------------------------------------------- pipeline parallel
+
+    def forward_pipelined(self, variables, x, mesh, microbatches: int = 4):
+        """Causal forward with the decoder trunk pipelined over the mesh
+        `stage` axis (GPipe microbatching, parallel/pp.py).
+
+        The embedding and LM head run outside the pipelined trunk (they
+        change activation shape); the L decoder blocks split into
+        `stage`-axis groups of L/P consecutive layers, each stage
+        scanning its group. x: [B, T] full-length (pad-free) token rows
+        with B divisible by `microbatches`. Returns [B, T, vocab] logits
+        equal to the dense forward up to bf16 noise.
+        """
+        from kubeml_tpu.parallel.mesh import STAGE_AXIS
+        from kubeml_tpu.parallel.pp import (pipeline_apply,
+                                            stack_stage_params)
+
+        module = self.module
+        if module.n_experts:
+            raise NotImplementedError(
+                "pipelined MoE is not supported (expert capacity is "
+                "computed per microbatch)")
+        n_stage = mesh.shape[STAGE_AXIS]
+        L = module.layers
+        if L % n_stage:
+            raise ValueError(f"{L} layers do not split over a "
+                             f"{n_stage}-stage axis")
+        per = L // n_stage
+        x = jnp.asarray(x)
+        B, T = x.shape
+        M = microbatches
+        if B % M:
+            raise ValueError(f"batch {B} not divisible by {M} microbatches")
+        if T > module.max_len:  # same guard as the dense forward
+            raise ValueError(f"sequence length {T} exceeds max_len "
+                             f"{module.max_len}")
+        # this is an eager host API (like forward_seq_parallel): enforce
+        # the documented pad-free precondition rather than silently
+        # diverging from the dense forward
+        if bool((x == PAD_ID).any()):
+            raise ValueError("forward_pipelined requires pad-free rows "
+                             "(the pipelined trunk runs without a pad "
+                             "mask); use the dense forward for padded "
+                             "batches")
+
+        key = (mesh, M)
+        if not hasattr(self, "_pp_cache"):
+            self._pp_cache = {}
+        if key not in self._pp_cache:
+            block = DecoderBlock(module.hidden, module.heads, module.ffn,
+                                 0.0, module.dtype)
+
+            def stage_fn(p, act):
+                ones = jnp.ones(act.shape[:2], jnp.float32)
+
+                def body(a, pj):
+                    return block.apply({"params": pj}, a, ones, False), None
+
+                act, _ = lax.scan(body, act, p)
+                return act
+
+            def fwd(variables, x):
+                params = variables["params"]
+                B, T = x.shape
+                # [P, per, ...]: stage s scans layers [s*per, (s+1)*per)
+                stage_params = stack_stage_params([
+                    stack_stage_params(
+                        [params[f"layer_{s * per + j}"] for j in range(per)])
+                    for s in range(n_stage)])
+                emb = params["tok_embed"]["embedding"].astype(module.dtype)
+                h = emb[x] + params["pos_embed"]["embedding"][
+                    jnp.arange(T)].astype(module.dtype)[None]
+                h = h.reshape(M, B // M, T, module.hidden)
+                h = pipeline_apply(stage_fn, stage_params, h, mesh)
+                h = h.reshape(B, T, module.hidden)
+                ln = nn.LayerNorm(dtype=jnp.float32)
+                h = ln.apply({"params": params["LayerNorm_0"]}, h)
+                logits = h.astype(module.dtype) @ emb.T
+                return logits.astype(jnp.float32)
+
+            self._pp_cache[key] = jax.jit(fwd)
+        return self._pp_cache[key](variables, x)
+
     # ----------------------------------------------------- sequence parallel
 
     def forward_seq_parallel(self, variables, x, mesh, impl="ring"):
