@@ -210,7 +210,13 @@ class ParameterServer(JsonService):
         if data is None:
             raise InvalidArgsError("data required")
         model, variables = self._load_for_infer(model_id)
-        preds = model.infer(variables, np.asarray(data))
+        try:
+            preds = model.infer(variables, np.asarray(data))
+        except ValueError as e:
+            # model-library input rejections (e.g. prompt > max_len) are
+            # client errors, not server faults: translate to the 4xx
+            # envelope instead of the generic 500
+            raise InvalidArgsError(str(e))
         return {"predictions": np.asarray(preds).tolist()}
 
     def _load_for_infer(self, model_id: str):

@@ -355,6 +355,14 @@ class GPTMini(KubeModel):
         """
         prompts = np.asarray(data, np.int32)
         Tp = prompts.shape[1]
+        if Tp > self.module.max_len:
+            # same contract as the module forward: the serving path must
+            # not hand back a silently truncated prompt with zero
+            # generated tokens
+            raise ValueError(
+                f"prompt length {Tp} exceeds max_len {self.module.max_len};"
+                " window the prompt to its last max_len tokens before"
+                " calling infer()")
         # width-0 prompts go to the re-forward path, which pads the
         # window and produces the unconditioned continuation
         if 0 < Tp < self.module.max_len and \

@@ -42,6 +42,15 @@ from kubeml_tpu.ops.attention import NEG_INF
 # (4 MB each) over acc/row-stats/double-buffered KV blocks — budget that
 # quadratic term first when scaling blocks further. _fa_forward shrinks
 # a block by halving until it divides T (floor 8).
+#
+# The BACKWARD kernels hold more live [BQ, BK] f32 intermediates per
+# grid point (s, p, dp, ds) plus two [BK, D] f32 accumulators, so the
+# shared default was re-measured for the grad path on v5e: full
+# fwd+bwd at 1024x1024 compiles and runs at T=2048 (B*H=32) and
+# T=8192 (B*H=8), causal, at ~13 ms/iter and ~55 effective TF/s
+# respectively — Mosaic reuses the score-block buffers, keeping the
+# quadratic term within the ~16 MB/core budget. 512x512 is no faster,
+# so forward and backward share one default.
 DEFAULT_BLOCK_Q = 1024
 DEFAULT_BLOCK_K = 1024
 

@@ -16,6 +16,7 @@ restore needs no template pytree.
 from __future__ import annotations
 
 import json
+import logging
 import os
 import shutil
 import threading
@@ -28,6 +29,8 @@ import numpy as np
 
 from kubeml_tpu.api.const import kubeml_home
 from kubeml_tpu.api.errors import JobNotFoundError
+
+logger = logging.getLogger("kubeml_tpu.checkpoint")
 
 PyTree = Any
 
@@ -145,14 +148,23 @@ class AsyncCheckpointer:
             self._cond.wait_for(
                 lambda: not self._pending and self._in_flight_job is None)
             if self._errors:
-                err = next(iter(self._errors.values()))
+                job_id, err = next(iter(self._errors.items()))
+                for other_job, other in self._errors.items():
+                    if other_job != job_id:
+                        # aggregated into the log, not the raise: a second
+                        # job's failure must stay observable even though
+                        # only the first latched error propagates
+                        logger.error(
+                            "checkpoint save for job %s also failed: %s",
+                            other_job, other)
                 self._errors.clear()
                 raise err
 
     def close(self) -> None:
         """Drain outstanding writes and stop the worker. Idempotent.
-        Errors are swallowed here — call wait() first when they must
-        surface."""
+        Errors don't propagate from here — call wait() first when they
+        must — but any still-latched failure is logged so it is never
+        silently lost."""
         with self._cond:
             self._closed = True
             self._cond.notify_all()
@@ -160,6 +172,10 @@ class AsyncCheckpointer:
             self._thread.join()
             self._thread = None
         with self._cond:
+            for job_id, err in self._errors.items():
+                logger.error(
+                    "checkpoint save for job %s failed (discarded at "
+                    "close): %s", job_id, err)
             self._errors.clear()
 
     def _run(self) -> None:

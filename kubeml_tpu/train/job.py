@@ -224,15 +224,26 @@ class TrainJob:
                     self.history.validation_loss[-1] = val_loss
                     self.history.accuracy[-1] = accuracy
 
-            # drain periodic saves (surfacing any unsuperseded failure),
-            # THEN write the final checkpoint synchronously — after the
-            # drain so a stale periodic snapshot can't clobber it, and
-            # sync because there is nothing left to overlap with (and it
-            # avoids a transient extra model copy at peak memory). Elided
-            # when the last periodic save already captured this state.
+            # drain periodic saves, THEN write the final checkpoint
+            # synchronously — after the drain so a stale periodic
+            # snapshot can't clobber it, and sync because there is
+            # nothing left to overlap with (and it avoids a transient
+            # extra model copy at peak memory). A transient periodic-save
+            # failure must not abort the job before the final save gets
+            # its chance: the drained queue means a final save written
+            # now still wins, and it captures the same end state the
+            # failed periodic save would have — so the final save acts
+            # as the remediation, and only a double failure aborts.
             if self.checkpoint:
-                self._checkpointer.wait()
-                if last_ckpt_epoch != len(self.history.train_loss):
+                ckpt_err = None
+                try:
+                    self._checkpointer.wait()
+                except Exception as e:
+                    ckpt_err = e
+                    self._log("job %s periodic checkpoint failed (%s); "
+                              "attempting final save", job_id, e)
+                if ckpt_err is not None or \
+                        last_ckpt_epoch != len(self.history.train_loss):
                     save_checkpoint(job_id, self.variables, self._manifest())
             record = History(id=job_id, task=self.req, data=self.history)
             if self.history_store is not None:

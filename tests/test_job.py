@@ -179,6 +179,37 @@ def test_checkpoint_every_and_warm_start(setup, monkeypatch):
     assert warm_rec.data.train_loss[0] < cold_rec.data.train_loss[0]
 
 
+def test_final_save_survives_periodic_failure(setup, monkeypatch):
+    """A transient periodic-save failure with no later successful save
+    must not abort the job: the final synchronous save is the
+    remediation (ADVICE r1), and the published checkpoint holds the end
+    state."""
+    import kubeml_tpu.train.checkpoint as ckpt_mod
+    reg, store, model, mesh = setup
+    real_save = ckpt_mod.save_checkpoint
+    calls = {"n": 0}
+
+    def flaky(jid, v, m, root=None):
+        calls["n"] += 1
+        if m.get("epoch") is not None:  # every periodic save fails
+            raise OSError("disk full")
+        return real_save(jid, v, m, root=root)
+
+    monkeypatch.setattr(ckpt_mod, "save_checkpoint", flaky)
+    task = make_task(job_id="flakyckpt1", epochs=2)
+    task.parameters.options.checkpoint_every = 2  # only the LAST epoch,
+    # so no later periodic success supersedes the failure
+    record = TrainJob(task, model, ToyDataset(), mesh, registry=reg,
+                      history_store=store).train()
+    assert len(record.data.train_loss) == 2
+    variables, manifest = load_checkpoint("flakyckpt1")
+    assert manifest["model"] == "mlp"
+    assert manifest.get("epoch") is None  # the final (sync) save won
+    # the periodic attempt ran (and failed) through the async writer;
+    # the final save goes through job.py's direct import, unpatched
+    assert calls["n"] >= 1
+
+
 def test_warm_start_function_mismatch_rejected(setup):
     reg, store, model, mesh = setup
     donor = TrainJob(make_task(job_id="donor1", epochs=1), model,

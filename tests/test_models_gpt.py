@@ -214,6 +214,18 @@ def test_gpt_infer_empty_prompt():
         model.generate(variables, empty, max_new_tokens=4)
 
 
+def test_gpt_infer_rejects_overlong_prompt():
+    """Prompts longer than max_len must raise, not come back silently
+    truncated with zero generated tokens (serving-path data loss)."""
+    import pytest
+    model = TinyGPT()
+    variables = model.init_variables(jax.random.PRNGKey(0),
+                                     {"x": jnp.ones((2, 4), jnp.int32)})
+    overlong = np.ones((2, model.module.max_len + 1), np.int32)
+    with pytest.raises(ValueError, match="max_len"):
+        model.infer(variables, overlong, max_new_tokens=4)
+
+
 class TinyMoE(GPTMoEMini):
     def build(self):
         return GPTModule(vocab_size=VOCAB, max_len=32, hidden=32, layers=2,
