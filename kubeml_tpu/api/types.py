@@ -27,12 +27,21 @@ class TrainOptions:
     k: int = 1                     # K-step local SGD period; -1 => once per epoch
     goal_accuracy: float = 100.0   # early-stop accuracy target (percent)
     # net-new vs the reference (which has no checkpointing, SURVEY.md §5):
-    # also checkpoint every N epochs (0 = final checkpoint only)
+    # checkpoint cadence in epochs. N > 0 = every N epochs; 0 (default) =
+    # auto — snapshot whenever the job validates, so a running job is
+    # inferable mid-run by default (the reference serves inference on a
+    # live job's weights, scheduler/api.go:119-162 — our equivalent needs
+    # a checkpoint on disk); -1 = final checkpoint only
     checkpoint_every: int = 0
     # net-new: training engine — 'kavg' is the reference's K-step local
     # SGD with weight averaging; 'syncdp' is per-step gradient averaging
     # with persistent optimizer state (parallel/syncdp.py; K is ignored)
     engine: str = "kavg"
+    # net-new: reshuffle the epoch's document order each epoch. The
+    # reference never shuffles (network.py:283 constructs its DataLoader
+    # without shuffle), so False is parity; real-data convergence sweeps
+    # want True
+    shuffle: bool = False
 
     def to_dict(self) -> dict:
         return {
@@ -43,6 +52,7 @@ class TrainOptions:
             "goal_accuracy": self.goal_accuracy,
             "checkpoint_every": self.checkpoint_every,
             "engine": self.engine,
+            "shuffle": self.shuffle,
         }
 
     @classmethod
@@ -55,6 +65,7 @@ class TrainOptions:
             goal_accuracy=d.get("goal_accuracy", 100.0),
             checkpoint_every=d.get("checkpoint_every", 0),
             engine=d.get("engine", "kavg"),
+            shuffle=d.get("shuffle", False),
         )
 
 

@@ -70,7 +70,8 @@ def cmd_train(args):
             validate_every=args.validate_every, k=k,
             goal_accuracy=args.goal_accuracy,
             checkpoint_every=args.checkpoint_every,
-            engine=args.engine))
+            engine=args.engine,
+            shuffle=args.shuffle))
     job_id = client.v1().networks().train(req)
     print(job_id)
 
@@ -282,11 +283,17 @@ def build_parser() -> argparse.ArgumentParser:
     t.add_argument("--resume-from", default="", metavar="JOBID",
                    help="warm-start from another job's checkpoint")
     t.add_argument("--checkpoint-every", type=int, default=0, metavar="N",
-                   help="also checkpoint every N epochs (0 = final only)")
+                   help="checkpoint every N epochs (0 = auto: every "
+                        "validated epoch, so the job is inferable "
+                        "mid-run; -1 = final checkpoint only)")
     t.add_argument("--engine", choices=("kavg", "syncdp"), default="kavg",
                    help="kavg = K-step local SGD with weight averaging "
                         "(reference semantics); syncdp = per-step gradient "
                         "averaging with persistent optimizer state")
+    t.add_argument("--shuffle", action="store_true",
+                   help="reshuffle training docs each epoch (the "
+                        "reference never shuffles; recommended for "
+                        "real-data convergence)")
     t.set_defaults(fn=cmd_train)
 
     i = sub.add_parser("infer", help="run inference on a trained model")

@@ -54,7 +54,7 @@ from kubeml_tpu.api.types import MetricUpdate, TrainTask
 from kubeml_tpu.control.httpd import JsonService, Raw, Request, http_json
 from kubeml_tpu.data.registry import DatasetRegistry
 from kubeml_tpu.metrics.prom import MetricsRegistry
-from kubeml_tpu.models.base import KubeDataset
+from kubeml_tpu.models.base import InferenceInputError, KubeDataset
 from kubeml_tpu.parallel.mesh import make_mesh
 from kubeml_tpu.train.checkpoint import (checkpoint_saved_at,
                                          load_checkpoint)
@@ -212,11 +212,11 @@ class ParameterServer(JsonService):
         model, variables = self._load_for_infer(model_id)
         try:
             preds = model.infer(variables, np.asarray(data))
-        except ValueError as e:
+        except InferenceInputError as e:
             # model-library input rejections (e.g. prompt > max_len) are
             # client errors, not server faults: translate to the 4xx
             # envelope instead of the generic 500
-            raise InvalidArgsError(str(e))
+            raise InvalidArgsError(str(e)) from e
         return {"predictions": np.asarray(preds).tolist()}
 
     def _load_for_infer(self, model_id: str):

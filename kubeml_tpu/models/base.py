@@ -39,6 +39,13 @@ import optax
 PyTree = Any
 
 
+class InferenceInputError(ValueError):
+    """A model rejected the caller-supplied inference payload (bad shape,
+    overlong prompt, ...). Serving layers translate exactly this type to
+    the 4xx error envelope; any other exception from infer() stays a
+    server fault (5xx)."""
+
+
 class KubeModel(abc.ABC):
     """Base class a user model subclasses (or a built-in provides)."""
 

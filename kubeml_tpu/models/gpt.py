@@ -36,7 +36,7 @@ import optax
 from jax import lax
 
 from kubeml_tpu.models import register_model
-from kubeml_tpu.models.base import KubeModel
+from kubeml_tpu.models.base import InferenceInputError, KubeModel
 from kubeml_tpu.ops.attention import masked_attention
 
 PAD_ID = 0
@@ -359,7 +359,7 @@ class GPTMini(KubeModel):
             # same contract as the module forward: the serving path must
             # not hand back a silently truncated prompt with zero
             # generated tokens
-            raise ValueError(
+            raise InferenceInputError(
                 f"prompt length {Tp} exceeds max_len {self.module.max_len};"
                 " window the prompt to its last max_len tokens before"
                 " calling infer()")

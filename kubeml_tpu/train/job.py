@@ -197,8 +197,18 @@ class TrainJob:
                             elapsed, self.tracer.format_summary())
                 self.tracer.reset()
 
-                if self.checkpoint and opts.checkpoint_every > 0 and \
-                        (epoch + 1) % opts.checkpoint_every == 0:
+                # checkpoint cadence: explicit every-N, or (default
+                # auto) every validated epoch — so a running job is
+                # inferable mid-run, matching the reference's live-job
+                # inference (scheduler/api.go:119-162) without its
+                # weights-vanish-at-finish flaw
+                if opts.checkpoint_every > 0:
+                    want_ckpt = (epoch + 1) % opts.checkpoint_every == 0
+                elif opts.checkpoint_every == 0:
+                    want_ckpt = accuracy == accuracy  # a validation ran
+                else:
+                    want_ckpt = False  # -1: final checkpoint only
+                if self.checkpoint and want_ckpt:
                     # async: the device snapshot is immediate; the full
                     # readback + write happens off the epoch loop
                     self._checkpointer.save(job_id, self.variables,
@@ -282,7 +292,8 @@ class TrainJob:
         self._handle = handle
         self._loader = RoundLoader(handle, self.dataset,
                                    n_lanes=data_axis_size(self.mesh),
-                                   seed=self.seed)
+                                   seed=self.seed,
+                                   shuffle=self.req.options.shuffle)
         engine_kind = self.req.options.engine
         if engine_kind not in ("kavg", "syncdp"):
             raise KubeMLException(

@@ -145,6 +145,47 @@ def test_task_stop_via_controller(stack):
     assert len(history.data.train_loss) < 50
 
 
+def test_mid_job_inference(stack):
+    """The reference serves inference on a LIVE job's weights
+    (scheduler/api.go:119-162). Default checkpoint cadence (auto:
+    every validated epoch) makes /infer answer while the job is still
+    running — and again after it finishes."""
+    dep, client, tmp_path = stack
+    paths = write_blob_files(tmp_path, n_train=4000)
+    client.v1().datasets().create(
+        "blobs", paths["xtr"], paths["ytr"], paths["xte"], paths["yte"])
+    req = TrainRequest(model_type="mlp", batch_size=16, epochs=40,
+                       dataset="blobs", lr=0.01,
+                       options=TrainOptions(default_parallelism=2,
+                                            static_parallelism=True, k=1))
+    job_id = client.v1().networks().train(req)
+    x = np.load(paths["xte"])[:3].tolist()
+
+    mid_preds = None
+    deadline = time.time() + 180
+    while time.time() < deadline:
+        try:
+            preds = client.v1().networks().infer(job_id, x)
+        except KubeMLException:
+            preds = None  # first checkpoint not yet published
+        if preds is not None:
+            # sample running AFTER the successful infer: only then is
+            # "the job was still running when inference answered" true
+            if any(t.job_id == job_id
+                   for t in client.v1().tasks().list()):
+                mid_preds = preds
+            break
+        time.sleep(0.1)
+    if mid_preds is None:
+        pytest.skip("job finished before the first checkpoint could be "
+                    "probed mid-run on this machine")
+    client.v1().tasks().stop(job_id)
+    wait_history(client, job_id)
+    dep.ps.wait_for_job(job_id)
+    post = client.v1().networks().infer(job_id, x)  # post-run still works
+    assert len(post) == 3
+
+
 def test_error_envelope_on_bad_requests(stack):
     dep, client, tmp_path = stack
     # missing dataset -> scheduler accepts, job fails; infer on unknown model

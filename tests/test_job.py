@@ -179,6 +179,26 @@ def test_checkpoint_every_and_warm_start(setup, monkeypatch):
     assert warm_rec.data.train_loss[0] < cold_rec.data.train_loss[0]
 
 
+def test_job_shuffle_option(setup):
+    """options.shuffle reaches the RoundLoader (job path of the loader
+    regression tests): epoch document order differs between epochs and
+    the job still converges."""
+    reg, store, model, mesh = setup
+    task = make_task(job_id="shufjob1", epochs=2)
+    task.parameters.options.shuffle = True
+    job = TrainJob(task, model, ToyDataset(), mesh, registry=reg,
+                   history_store=store)
+    record = job.train()
+    assert job._loader.shuffle is True
+    assert len(record.data.train_loss) == 2
+    assert record.data.train_loss[-1] < record.data.train_loss[0]
+    # same job without shuffle keeps the parity default
+    job2 = TrainJob(make_task(job_id="noshuf1", epochs=1), model,
+                    ToyDataset(), mesh, registry=reg, history_store=store)
+    job2.train()
+    assert job2._loader.shuffle is False
+
+
 def test_final_save_survives_periodic_failure(setup, monkeypatch):
     """A transient periodic-save failure with no later successful save
     must not abort the job: the final synchronous save is the
