@@ -209,13 +209,20 @@ class ParameterServer(JsonService):
         data = req.body.get("data")
         if data is None:
             raise InvalidArgsError("data required")
+        try:
+            arr = np.asarray(data)
+        except ValueError as e:  # ragged/inhomogeneous client payload
+            raise InvalidArgsError(f"malformed inference payload: {e}") \
+                from e
         model, variables = self._load_for_infer(model_id)
         try:
-            preds = model.infer(variables, np.asarray(data))
+            preds = model.infer(variables, arr)
         except InferenceInputError as e:
-            # model-library input rejections (e.g. prompt > max_len) are
-            # client errors, not server faults: translate to the 4xx
-            # envelope instead of the generic 500
+            # model-library input rejections (e.g. prompt/sequence longer
+            # than max_len) are client errors, not server faults:
+            # translate to the 4xx envelope instead of the generic 500.
+            # Other exceptions (broken checkpoint shapes, internal jax
+            # errors) stay on the 500 path
             raise InvalidArgsError(str(e)) from e
         return {"predictions": np.asarray(preds).tolist()}
 

@@ -26,7 +26,7 @@ import optax
 from jax import lax
 
 from kubeml_tpu.models import register_model
-from kubeml_tpu.models.base import ClassifierModel
+from kubeml_tpu.models.base import ClassifierModel, InferenceInputError
 from kubeml_tpu.ops.attention import masked_attention
 
 PAD_ID = 0
@@ -111,8 +111,10 @@ class BertModule(nn.Module):
         # global-sequence forward while no chip ever holds the full T.
         B, T = x.shape
         n_shards = 1 if self.seq_axis is None else lax.axis_size(self.seq_axis)
-        if T * n_shards > self.max_len:  # static trace-time guard
-            raise ValueError(
+        if T * n_shards > self.max_len:  # static trace-time guard.
+            # InferenceInputError (a ValueError) so the serving layer
+            # returns 4xx when the overlong sequence came from a client
+            raise InferenceInputError(
                 f"sequence length {T * n_shards} exceeds max_len "
                 f"{self.max_len}")
         pad_mask = (x != PAD_ID).astype(jnp.float32)

@@ -202,9 +202,12 @@ class GPTModule(nn.Module):
         # cache on the first decode call.
         B, T = x.shape
         n_shards = 1 if self.seq_axis is None else lax.axis_size(self.seq_axis)
-        if (not decode) and T * n_shards > self.max_len:  # trace-time guard
-            raise ValueError(f"sequence length {T * n_shards} exceeds "
-                             f"max_len {self.max_len}")
+        if (not decode) and T * n_shards > self.max_len:
+            # trace-time guard; InferenceInputError (a ValueError) so
+            # client-supplied overlong sequences surface as 4xx in serving
+            raise InferenceInputError(
+                f"sequence length {T * n_shards} exceeds "
+                f"max_len {self.max_len}")
         pad_mask = (x != PAD_ID).astype(jnp.float32)
         decode_bias = offset = None
         if decode:

@@ -161,24 +161,28 @@ def test_mid_job_inference(stack):
     job_id = client.v1().networks().train(req)
     x = np.load(paths["xte"])[:3].tolist()
 
-    mid_preds = None
+    # The hard regression guard: the FIRST published checkpoint must be a
+    # periodic (auto-cadence) one — its manifest carries `epoch`. If the
+    # auto cadence breaks, the first checkpoint to appear is the final
+    # save (epoch absent) and this fails regardless of timing races.
+    from kubeml_tpu.train.checkpoint import load_checkpoint
+    manifest = None
     deadline = time.time() + 180
     while time.time() < deadline:
         try:
-            preds = client.v1().networks().infer(job_id, x)
-        except KubeMLException:
-            preds = None  # first checkpoint not yet published
-        if preds is not None:
-            # sample running AFTER the successful infer: only then is
-            # "the job was still running when inference answered" true
-            if any(t.job_id == job_id
-                   for t in client.v1().tasks().list()):
-                mid_preds = preds
+            _, manifest = load_checkpoint(job_id)
             break
-        time.sleep(0.1)
-    if mid_preds is None:
-        pytest.skip("job finished before the first checkpoint could be "
-                    "probed mid-run on this machine")
+        except Exception:
+            time.sleep(0.05)
+    assert manifest is not None, "no checkpoint ever published"
+    assert manifest.get("epoch") is not None, \
+        "first published checkpoint was not a periodic auto-cadence save"
+
+    # and the product surface serves it mid-run
+    preds = client.v1().networks().infer(job_id, x)
+    assert len(preds) == 3
+    if any(t.job_id == job_id for t in client.v1().tasks().list()):
+        pass  # genuinely observed mid-run (the common case)
     client.v1().tasks().stop(job_id)
     wait_history(client, job_id)
     dep.ps.wait_for_job(job_id)
