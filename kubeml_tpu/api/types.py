@@ -42,6 +42,15 @@ class TrainOptions:
     # without shuffle), so False is parity; real-data convergence sweeps
     # want True
     shuffle: bool = False
+    # net-new: inner mesh axes, per job (the reference's only axis is
+    # data parallelism, SURVEY.md §2a). n_model > 1 = Megatron tensor
+    # parallelism (model must publish tp_rules); n_seq > 1 = ring/ulysses
+    # sequence parallelism (model must support enable_seq_parallel). The
+    # job carves its mesh as data x model x seq from the deployment's
+    # devices; data-axis size = devices / (n_model * n_seq).
+    n_model: int = 1
+    n_seq: int = 1
+    seq_impl: str = "ring"         # 'ring' | 'ulysses'
 
     def to_dict(self) -> dict:
         return {
@@ -53,6 +62,9 @@ class TrainOptions:
             "checkpoint_every": self.checkpoint_every,
             "engine": self.engine,
             "shuffle": self.shuffle,
+            "n_model": self.n_model,
+            "n_seq": self.n_seq,
+            "seq_impl": self.seq_impl,
         }
 
     @classmethod
@@ -66,6 +78,9 @@ class TrainOptions:
             checkpoint_every=d.get("checkpoint_every", 0),
             engine=d.get("engine", "kavg"),
             shuffle=d.get("shuffle", False),
+            n_model=int(d.get("n_model", 1)),
+            n_seq=int(d.get("n_seq", 1)),
+            seq_impl=d.get("seq_impl", "ring"),
         )
 
 

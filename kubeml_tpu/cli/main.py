@@ -49,6 +49,11 @@ def cmd_train(args):
         _fail(f"batch size must be in (0, {MAX_BATCH_SIZE}]")
     if args.epochs <= 0:
         _fail("epochs must be positive")
+    if args.tensor_parallel < 1 or args.seq_parallel < 1:
+        _fail("--tensor-parallel/--seq-parallel must be >= 1")
+    if args.tensor_parallel > 1 and args.seq_parallel > 1:
+        _fail("tensor and sequence parallelism cannot be combined in "
+              "one job yet; pick one")
     k = -1 if args.sparse_avg else args.K
     client = _client(args)
     # pre-validation (cmd/train.go:89-148): dataset + function must exist
@@ -71,7 +76,10 @@ def cmd_train(args):
             goal_accuracy=args.goal_accuracy,
             checkpoint_every=args.checkpoint_every,
             engine=args.engine,
-            shuffle=args.shuffle))
+            shuffle=args.shuffle,
+            n_model=args.tensor_parallel,
+            n_seq=args.seq_parallel,
+            seq_impl=args.seq_impl))
     job_id = client.v1().networks().train(req)
     print(job_id)
 
@@ -218,7 +226,13 @@ def cmd_serve(args):
     control plane in one process; a single role binds only that service
     and reaches its peers through the --*-url flags / KUBEML_*_URL env."""
     from kubeml_tpu.api import const
+    from kubeml_tpu.parallel.distributed import initialize
     from kubeml_tpu.parallel.mesh import make_mesh
+    # multi-host: join (or bootstrap) the jax.distributed cluster BEFORE
+    # any other JAX call. No-args = auto-discover (TPU pod metadata /
+    # KUBEML_COORDINATOR_ADDRESS env from tools/launch_distributed.py);
+    # single-host runs no-op through it.
+    initialize(args.coordinator, args.num_processes, args.process_id)
     mesh = make_mesh(n_data=args.mesh_data) if args.mesh_data else None
 
     if args.role == "all":
@@ -294,6 +308,16 @@ def build_parser() -> argparse.ArgumentParser:
                    help="reshuffle training docs each epoch (the "
                         "reference never shuffles; recommended for "
                         "real-data convergence)")
+    t.add_argument("--tensor-parallel", type=int, default=1, metavar="M",
+                   help="Megatron tensor parallelism over the mesh "
+                        "model axis (function must publish tp_rules; "
+                        "transformer families do)")
+    t.add_argument("--seq-parallel", type=int, default=1, metavar="S",
+                   help="ring/ulysses sequence parallelism over the "
+                        "mesh seq axis (transformer families)")
+    t.add_argument("--seq-impl", choices=("ring", "ulysses"),
+                   default="ring",
+                   help="sequence-parallel attention implementation")
     t.set_defaults(fn=cmd_train)
 
     i = sub.add_parser("infer", help="run inference on a trained model")
@@ -348,6 +372,12 @@ def build_parser() -> argparse.ArgumentParser:
     lg.set_defaults(fn=cmd_logs)
 
     s = sub.add_parser("serve", help="start the control plane on this host")
+    s.add_argument("--coordinator", default=None, metavar="HOST:PORT",
+                   help="jax.distributed coordinator for multi-host "
+                        "bring-up (defaults to auto-discovery / "
+                        "KUBEML_COORDINATOR_ADDRESS)")
+    s.add_argument("--num-processes", type=int, default=None)
+    s.add_argument("--process-id", type=int, default=None)
     s.add_argument("--mesh-data", type=int, default=0,
                    help="data-axis size (default: all devices)")
     s.add_argument("--free-ports", action="store_true")

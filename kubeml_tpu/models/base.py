@@ -52,6 +52,36 @@ class KubeModel(abc.ABC):
     #: name under which the model registers (for CLI `fn`/train lookup)
     name: str = ""
 
+    #: tensor-parallel sharding rules (parallel.tp rule table). None =
+    #: the model does not support TP; a job requesting --tensor-parallel
+    #: on it is rejected at start. Transformer families set this to the
+    #: shared Megatron table.
+    tp_rules = None
+
+    #: sequence-parallel batch layout: {batch key: dim index within the
+    #: per-example shape carrying the sequence}, e.g. {"x": 0} for
+    #: [B, T] token ids. None = no sequence-parallel support.
+    seq_batch_dims = None
+
+    def enable_seq_parallel(self, impl: str = "ring") -> None:
+        """Switch the model's module into sequence-parallel execution
+        (called by the job when --seq-parallel > 1).
+
+        The default implementation serves every family that declares
+        seq_batch_dims and whose module takes seq_axis/seq_impl (the
+        transformer families); models without seq support inherit the
+        rejection, and special cases (e.g. MoE) override with a curated
+        message."""
+        if self.seq_batch_dims is None:
+            raise ValueError(
+                f"function {self.name or type(self).__name__!r} does not "
+                "support sequence parallelism")
+        if impl not in ("ring", "ulysses"):
+            raise ValueError(f"unknown seq-parallel impl {impl!r}; "
+                             "expected 'ring' or 'ulysses'")
+        from kubeml_tpu.parallel.mesh import SEQ_AXIS
+        self._module = self.module.clone(seq_axis=SEQ_AXIS, seq_impl=impl)
+
     @abc.abstractmethod
     def build(self):
         """Return the flax nn.Module."""
@@ -62,6 +92,17 @@ class KubeModel(abc.ABC):
             self._module = self.build()
         return self._module
 
+    @property
+    def init_module(self):
+        """The module used for variable init: the DENSE clone when the
+        model is in sequence-parallel mode — seq collectives only exist
+        inside shard_map, while init runs outside it (variable shapes
+        are identical either way)."""
+        m = self.module
+        if getattr(m, "seq_axis", None) is not None:
+            return m.clone(seq_axis=None)
+        return m
+
     # ------------------------------------------------------------- lifecycle
 
     def init_variables(self, rng: jax.Array, sample_batch: PyTree) -> PyTree:
@@ -69,7 +110,7 @@ class KubeModel(abc.ABC):
 
         Default assumes classification-style batches {'x': ..., 'y': ...}.
         """
-        return self.module.init(rng, sample_batch["x"], train=False)
+        return self.init_module.init(rng, sample_batch["x"], train=False)
 
     # ------------------------------------------------------------- training
 

@@ -27,6 +27,7 @@ from jax import lax
 
 from kubeml_tpu.models import register_model
 from kubeml_tpu.models.base import ClassifierModel, InferenceInputError
+from kubeml_tpu.parallel.tp import TRANSFORMER_TP_RULES
 from kubeml_tpu.ops.attention import masked_attention
 
 PAD_ID = 0
@@ -153,6 +154,15 @@ class BertModule(nn.Module):
 class BertTiny(ClassifierModel):
     name = "bert-tiny"
     num_classes = 2
+
+    # job-surface parallelism: Megatron TP over the encoder blocks, and
+    # ring/ulysses SP over the token dim of 'x' (the base
+    # enable_seq_parallel serves any model declaring seq_batch_dims).
+    # The classifier's per-example loss is already seq-invariant (the
+    # module psums its mean-pool over the ring), so the engine's
+    # seq-parallel round needs no loss changes for this family.
+    seq_batch_dims = {"x": 0}
+    tp_rules = TRANSFORMER_TP_RULES
 
     def build(self):
         return BertModule(num_classes=self.num_classes)

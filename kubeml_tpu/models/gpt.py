@@ -37,6 +37,7 @@ from jax import lax
 
 from kubeml_tpu.models import register_model
 from kubeml_tpu.models.base import InferenceInputError, KubeModel
+from kubeml_tpu.parallel.tp import TRANSFORMER_TP_RULES
 from kubeml_tpu.ops.attention import masked_attention
 
 PAD_ID = 0
@@ -298,6 +299,41 @@ def _shift_targets(x: jax.Array):
     return targets, mask
 
 
+def _shift_targets_sp(x_local: jax.Array, axis_name: str):
+    """Seq-parallel _shift_targets: each shard holds a [B, T/n] block.
+
+    The block's last position targets the NEXT shard's first token,
+    fetched with one ppermute around the ring (the cross-boundary
+    prediction a local shift would drop). The global last position (last
+    shard's last column) keeps dense semantics — the ring wraps shard
+    0's first token to it, so it is explicitly masked out.
+    """
+    n = lax.axis_size(axis_name)
+    idx = lax.axis_index(axis_name)
+    nxt_first = lax.ppermute(x_local[:, :1], axis_name,
+                             perm=[((s + 1) % n, s) for s in range(n)])
+    targets = jnp.concatenate([x_local[:, 1:], nxt_first], axis=1)
+    mask = ((x_local != PAD_ID) & (targets != PAD_ID)).astype(jnp.float32)
+    last_col = jnp.where(idx == n - 1, 0.0, 1.0)
+    mask = mask.at[:, -1].mul(last_col)
+    return targets, mask
+
+
+def _lm_per_example_sp(logits: jax.Array, x_local: jax.Array,
+                       axis_name: str) -> jax.Array:
+    """Seq-parallel _lm_per_example: the per-sequence mean reduces over
+    the WHOLE sequence via psums of the local token-loss sum and count,
+    so the result is seq-invariant (equal on every shard and equal to
+    the dense loss) — the invariance the engine's vma-checked round
+    requires."""
+    targets, tok_mask = _shift_targets_sp(x_local, axis_name)
+    per_tok = optax.softmax_cross_entropy_with_integer_labels(
+        logits, targets)
+    num = lax.psum((per_tok * tok_mask).sum(axis=1), axis_name)
+    den = lax.psum(tok_mask.sum(axis=1), axis_name)
+    return num / jnp.maximum(den, 1.0)
+
+
 @register_model("gpt-mini")
 class GPTMini(KubeModel):
     """~6M-param decoder-only LM (4 layers x 256 hidden x 4 heads)."""
@@ -308,7 +344,7 @@ class GPTMini(KubeModel):
         return GPTModule()
 
     def init_variables(self, rng, sample_batch):
-        return self.module.init(rng, sample_batch["x"], train=False)
+        return self.init_module.init(rng, sample_batch["x"], train=False)
 
     def apply_train(self, variables, x, rng, extra_mutable=()):
         mutable = [k for k in variables if k != "params"] \
@@ -323,21 +359,45 @@ class GPTMini(KubeModel):
         return logits, {}
 
     def loss(self, variables, batch, rng, sample_mask):
-        """Per-sequence mean next-token cross-entropy, [B]."""
+        """Per-sequence mean next-token cross-entropy, [B].
+
+        With the module in seq-parallel mode (inside the engine's
+        vma-checked round) x is the LOCAL [B, T/n] block and the loss
+        reduces over the ring — identical value on every shard, equal to
+        the dense loss."""
         x = batch["x"]
         logits, new_state = self.apply_train(variables, x, rng)
+        if self.module.seq_axis is not None:
+            return _lm_per_example_sp(logits, x, self.module.seq_axis), \
+                new_state
         return _lm_per_example(logits, x), new_state
 
     def metrics(self, variables, batch):
         x = batch["x"]
         logits = self.module.apply(variables, x, train=False)
-        targets, tok_mask = _shift_targets(x)
+        if self.module.seq_axis is not None:
+            axis = self.module.seq_axis
+            targets, tok_mask = _shift_targets_sp(x, axis)
+        else:
+            targets, tok_mask = _shift_targets(x)
         per_tok = optax.softmax_cross_entropy_with_integer_labels(
             logits, targets)
-        denom = jnp.maximum(tok_mask.sum(axis=1), 1.0)
         hit = (jnp.argmax(logits, axis=-1) == targets).astype(jnp.float32)
-        return {"loss": (per_tok * tok_mask).sum(axis=1) / denom,
-                "accuracy": (hit * tok_mask).sum(axis=1) / denom}
+        num_l, num_h = (per_tok * tok_mask).sum(axis=1), \
+            (hit * tok_mask).sum(axis=1)
+        den = tok_mask.sum(axis=1)
+        if self.module.seq_axis is not None:
+            num_l = lax.psum(num_l, axis)
+            num_h = lax.psum(num_h, axis)
+            den = lax.psum(den, axis)
+        denom = jnp.maximum(den, 1.0)
+        return {"loss": num_l / denom, "accuracy": num_h / denom}
+
+    # job-surface parallelism (same table/dims as the BERT family: the
+    # decoder blocks share the q/k/v/out + Dense_0/Dense_1 param layout;
+    # base enable_seq_parallel handles the module clone)
+    seq_batch_dims = {"x": 0}
+    tp_rules = TRANSFORMER_TP_RULES
 
     def configure_optimizers(self, lr, epoch):
         return optax.adamw(lr, weight_decay=0.01)
@@ -629,9 +689,21 @@ class GPTMoEMini(GPTMini):
 
     name = "gpt-moe-mini"
     aux_coef = 0.01
+    seq_batch_dims = None  # MoE routing is not seq-parallel (see below)
+    # job-level TP stays rejected too: the Megatron table would shard
+    # only the attention stack while the expert FFNs (the bulk of the
+    # params, under 'moe') stay replicated — use ep_mesh expert
+    # parallelism for this family instead
+    tp_rules = None
 
     def __init__(self, ep_mesh=None):
         self.ep_mesh = ep_mesh
+
+    def enable_seq_parallel(self, impl: str = "ring") -> None:
+        raise ValueError(
+            "gpt-moe-mini does not compose expert routing with the "
+            "seq-axis shard_map; use the dense gpt-mini for "
+            "sequence-parallel jobs")
 
     def build(self):
         return GPTModule(ffn=512, n_experts=8, ep_mesh=self.ep_mesh)

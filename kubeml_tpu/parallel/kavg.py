@@ -45,7 +45,7 @@ import optax
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from kubeml_tpu.parallel.mesh import DATA_AXIS
+from kubeml_tpu.parallel.mesh import DATA_AXIS, SEQ_AXIS
 
 PyTree = Any
 
@@ -90,6 +90,17 @@ class RoundStats:
                 f"contributors={self.contributors:.0f})")
 
 
+def seq_batch_spec(key: str, seq_dims: Optional[Dict[str, int]]) -> P:
+    """THE PartitionSpec for a [W, S, B, ...] round-batch leaf: sharded
+    over `data` on dim 0, and — for sequence-carrying keys — over `seq`
+    on per-example dim d (full dim 3+d). One definition shared by the
+    engine's shard_map in_specs and the job's staging shardings, so
+    staged batches can never silently reshard on round entry."""
+    if seq_dims and key in seq_dims:
+        return P(DATA_AXIS, *([None] * (2 + seq_dims[key])), SEQ_AXIS)
+    return P(DATA_AXIS)
+
+
 def _select_tree(mask: jax.Array, new: PyTree, old: PyTree) -> PyTree:
     """Elementwise tree select: mask==1 -> new, else old (masked step)."""
     return jax.tree_util.tree_map(
@@ -123,7 +134,8 @@ class KAvgEngine:
 
     def __init__(self, mesh: Mesh, loss_fn: LossFn, metrics_fn: MetricsFn,
                  tx_factory: TxFactory, donate: bool = True,
-                 merge_dtype: Any = None, unroll: int = 2):
+                 merge_dtype: Any = None, unroll: int = 2,
+                 batch_seq_dims: Optional[Dict[str, int]] = None):
         """donate=True donates the input variables buffer to each
         train_round (frees a full model copy of HBM) — the caller must then
         always continue from the *returned* variables, never reuse the
@@ -142,7 +154,21 @@ class KAvgEngine:
         unroll: lax.scan unroll factor for the K local steps. 2 measures
         a few percent faster than 1 on v5e (scheduling slack across step
         boundaries) while keeping compile time bounded for large K;
-        diminishing returns beyond."""
+        diminishing returns beyond.
+
+        batch_seq_dims: sequence-parallel TRAINING. Maps top-level batch
+        keys to the dim (within the per-example shape) that carries the
+        sequence, e.g. {"x": 0} for [B, T] token ids. When the mesh seq
+        axis is > 1 and this is set, those leaves are sharded over `seq`
+        and the round runs with BOTH data and seq manual, under
+        check_vma=True — vma tracking is what makes grads w.r.t. the
+        replicated params come out correct (the backward inserts the
+        seq-axis psums at the invariant->varying boundaries; with
+        check_vma=False those grads are silently wrong, measured up to
+        4x off on a 4-way seq mesh). The loss_fn must be seq-aware: its
+        per-example loss must be invariant over `seq` (models do this
+        with an internal psum — bert.py pools over the ring, gpt.py
+        reduces its token loss over the axis)."""
         self.mesh = mesh
         self.loss_fn = loss_fn
         self.metrics_fn = metrics_fn
@@ -162,6 +188,9 @@ class KAvgEngine:
                     f"(inner axes size 1, got {inner}); use the f32 merge "
                     "when composing with tensor/seq/pipeline sharding")
         self.n_lanes = mesh.shape[DATA_AXIS]
+        self.batch_seq_dims = dict(batch_seq_dims or {})
+        self._seq_train = (mesh.shape[SEQ_AXIS] > 1
+                           and bool(self.batch_seq_dims))
         self._train_cache: Dict[Any, Callable] = {}
         self._eval_cache: Dict[Any, Callable] = {}
 
@@ -187,14 +216,43 @@ class KAvgEngine:
         if (self.merge_dtype is not None      # pure-DP checked in __init__
                 or self.mesh.size == self.mesh.shape[DATA_AXIS]):
             return {}
+        if self._seq_train:
+            # seq-parallel training: ALL axes manual (leaving the unused
+            # axes Auto trips the same partial-manual partitioner bug as
+            # merge_dtype: "Invalid binary instruction opcode copy") and
+            # vma tracking ON — required for correct grads w.r.t. the
+            # replicated params (see __init__ docstring). Consequence:
+            # SP does not compose with GSPMD TP in one job (validated at
+            # the job layer).
+            return dict(check_vma=True)
         return dict(axis_names={DATA_AXIS})
+
+    def _shmap_kwargs(self) -> Dict[str, Any]:
+        """Full shard_map kwargs: manual axes + the vma flag (default
+        off — masked-psum merges and pallas calls predate vma tracking;
+        seq-parallel training overrides it on)."""
+        kw = dict(check_vma=False)
+        kw.update(self._shmap_manual_kwargs())
+        return kw
+
+    def _batch_in_specs(self, batch: PyTree):
+        """Per-leaf PartitionSpecs for a [W, S, B, ...] round batch:
+        everything shards over `data` on dim 0; sequence-carrying keys
+        additionally shard their sequence dim over `seq`."""
+        if not self._seq_train:
+            return P(DATA_AXIS)
+        if not isinstance(batch, dict):
+            raise ValueError("sequence-parallel training requires a dict "
+                             "batch (keys matched against batch_seq_dims)")
+        return {k: seq_batch_spec(k, self.batch_seq_dims) for k in batch}
 
     # ---------------------------------------------------------------- train
 
-    def _build_train_round(self, w_per_lane: int):
+    def _build_train_round(self, w_per_lane: int, batch_template=None):
         mesh = self.mesh
         loss_fn = self.loss_fn
         tx_factory = self.tx_factory
+        seq_train = self._seq_train
 
         def run_chunk(variables, chunk, lr, epoch):
             """K masked local steps for one virtual worker.
@@ -206,6 +264,15 @@ class KAvgEngine:
             params = variables["params"]
             model_state = {k: v for k, v in variables.items() if k != "params"}
             opt_state = tx.init(params)  # fresh optimizer per sync round
+            if seq_train:
+                # vma: the scan carry becomes data-varying after step 1
+                # (local steps genuinely diverge per lane), so the
+                # invariant round-start params must be pcast to varying
+                # for the carry types to match. Values stay seq-INVARIANT
+                # throughout — that is what vma's backward enforces.
+                params, model_state, opt_state = jax.tree_util.tree_map(
+                    lambda x: lax.pcast(x, DATA_AXIS, to="varying"),
+                    (params, model_state, opt_state))
 
             def step(carry, xs):
                 params, model_state, opt_state = carry
@@ -273,10 +340,11 @@ class KAvgEngine:
 
         sharded = jax.shard_map(
             lane_fn, mesh=mesh,
-            in_specs=(P(), P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS),
+            in_specs=(P(), self._batch_in_specs(batch_template),
+                      P(DATA_AXIS), P(DATA_AXIS),
                       P(DATA_AXIS), P(DATA_AXIS), P(), P()),
             out_specs=(P(), P(DATA_AXIS)),
-            check_vma=False, **self._shmap_manual_kwargs())
+            **self._shmap_kwargs())
         donate = (0,) if self.donate else ()
         return jax.jit(sharded, donate_argnums=donate)
 
@@ -298,7 +366,8 @@ class KAvgEngine:
         key = (w_per_lane, tuple(lead.shape[1:3]),
                jax.tree_util.tree_structure(batch))
         if key not in self._train_cache:
-            self._train_cache[key] = self._build_train_round(w_per_lane)
+            self._train_cache[key] = self._build_train_round(
+                w_per_lane, batch_template=batch)
 
         # shard_map slices dim 0 contiguously: lane d owns virtual workers
         # [d*W/D, (d+1)*W/D) — matching the reference's contiguous doc shards.
@@ -319,7 +388,8 @@ class KAvgEngine:
 
     # ----------------------------------------------------------------- eval
 
-    def _build_eval_round(self, w_per_lane: int, metric_names: Tuple[str, ...]):
+    def _build_eval_round(self, w_per_lane: int, metric_names: Tuple[str, ...],
+                          batch_template=None):
         mesh = self.mesh
         metrics_fn = self.metrics_fn
 
@@ -345,9 +415,10 @@ class KAvgEngine:
 
         sharded = jax.shard_map(
             lane_fn, mesh=mesh,
-            in_specs=(P(), P(DATA_AXIS), P(DATA_AXIS)),
+            in_specs=(P(), self._batch_in_specs(batch_template),
+                      P(DATA_AXIS)),
             out_specs=(P(), P()),
-            check_vma=False, **self._shmap_manual_kwargs())
+            **self._shmap_kwargs())
         return jax.jit(sharded)
 
     def eval_round(self, variables: PyTree, batch: PyTree,
@@ -364,10 +435,13 @@ class KAvgEngine:
             raise ValueError(f"W={W} not a multiple of lanes={self.n_lanes}")
         w_per_lane = W // self.n_lanes
         lead = jax.tree_util.tree_leaves(batch)[0]
-        key = (w_per_lane, tuple(lead.shape[1:3]), metric_names)
+        # tree structure is part of the key: the compiled program bakes
+        # in per-key in_specs from the batch template (same as train)
+        key = (w_per_lane, tuple(lead.shape[1:3]), metric_names,
+               jax.tree_util.tree_structure(batch))
         if key not in self._eval_cache:
             self._eval_cache[key] = self._build_eval_round(
-                w_per_lane, metric_names)
+                w_per_lane, metric_names, batch_template=batch)
         totals, n = self._eval_cache[key](
             variables, batch, jnp.asarray(sample_mask, jnp.float32))
         n = float(n)

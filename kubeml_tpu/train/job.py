@@ -290,20 +290,74 @@ class TrainJob:
     def _init_model(self):
         handle = self.registry.get(self.req.dataset)
         self._handle = handle
-        self._loader = RoundLoader(handle, self.dataset,
-                                   n_lanes=data_axis_size(self.mesh),
-                                   seed=self.seed,
-                                   shuffle=self.req.options.shuffle)
-        engine_kind = self.req.options.engine
+        opts = self.req.options
+        engine_kind = opts.engine
         if engine_kind not in ("kavg", "syncdp"):
             raise KubeMLException(
                 f"unknown training engine {engine_kind!r}; "
                 f"expected 'kavg' or 'syncdp'", 400)
+
+        # ---- inner mesh axes (job-level TP / SP; net-new vs reference)
+        n_model = max(1, int(opts.n_model))
+        n_seq = max(1, int(opts.n_seq))
+        self._tp_rules = None
+        if n_model > 1 or n_seq > 1:
+            if engine_kind != "kavg":
+                raise KubeMLException(
+                    "tensor/sequence parallelism requires the kavg "
+                    "engine", 400)
+            if n_model > 1 and n_seq > 1:
+                # the SP round runs fully manual (partial-manual meshes
+                # trip an XLA partitioner bug — parallel/kavg.py), which
+                # precludes GSPMD TP in the same program
+                raise KubeMLException(
+                    "tensor and sequence parallelism cannot be combined "
+                    "in one job yet; pick one", 400)
+            devices = list(self.mesh.devices.flatten())
+            inner = n_model * n_seq
+            if len(devices) % inner:
+                raise KubeMLException(
+                    f"{len(devices)} devices not divisible by the "
+                    f"requested model x seq factor {inner}", 400)
+            from kubeml_tpu.parallel.mesh import make_mesh
+            self.mesh = make_mesh(n_data=len(devices) // inner,
+                                  n_model=n_model, n_seq=n_seq,
+                                  devices=devices)
+            if n_model > 1:
+                self._tp_rules = self.model.tp_rules
+                if self._tp_rules is None:
+                    raise KubeMLException(
+                        f"function {self.req.model_type!r} does not "
+                        "publish tensor-parallel sharding rules", 400)
+            if n_seq > 1:
+                # the model's own enable_seq_parallel carries the best
+                # error message (the base rejects models without
+                # seq_batch_dims; MoE explains why routing can't ride
+                # the seq shard_map)
+                try:
+                    self.model.enable_seq_parallel(opts.seq_impl)
+                except ValueError as e:
+                    raise KubeMLException(str(e), 400)
+                if self.model.seq_batch_dims is None:
+                    raise KubeMLException(
+                        f"function {self.req.model_type!r} enabled "
+                        "sequence parallelism but declares no "
+                        "seq_batch_dims", 400)
+            self._log("job %s mesh: data=%d model=%d seq=%d",
+                      self.task.job_id, data_axis_size(self.mesh),
+                      n_model, n_seq)
+
+        self._loader = RoundLoader(handle, self.dataset,
+                                   n_lanes=data_axis_size(self.mesh),
+                                   seed=self.seed,
+                                   shuffle=opts.shuffle)
         # the K-avg engine always exists: it runs kavg training AND the
         # eval rounds for both engines (weighted-metrics fan-out)
-        self._engine = KAvgEngine(self.mesh, self.model.loss,
-                                  self.model.metrics,
-                                  self.model.configure_optimizers)
+        self._engine = KAvgEngine(
+            self.mesh, self.model.loss, self.model.metrics,
+            self.model.configure_optimizers,
+            batch_seq_dims=(self.model.seq_batch_dims
+                            if n_seq > 1 else None))
         self._sync_engine = None
         self._sync_state = None
         if engine_kind == "syncdp":
@@ -311,9 +365,18 @@ class TrainJob:
             self._sync_engine = SyncDPEngine(
                 self.mesh, self.model.loss, self.model.configure_optimizers)
         from jax.sharding import NamedSharding, PartitionSpec
+        from kubeml_tpu.parallel.kavg import seq_batch_spec
         from kubeml_tpu.parallel.mesh import DATA_AXIS
-        self._batch_sharding = NamedSharding(self.mesh,
-                                             PartitionSpec(DATA_AXIS))
+        if n_seq > 1:
+            # sequence-carrying batch keys stage sharded over (data, seq)
+            # with the engine's own spec definition, so the round's
+            # shard_map does no resharding
+            dims = self.model.seq_batch_dims
+            self._batch_sharding = lambda key: NamedSharding(
+                self.mesh, seq_batch_spec(key, dims))
+        else:
+            _s = NamedSharding(self.mesh, PartitionSpec(DATA_AXIS))
+            self._batch_sharding = lambda key: _s
         self._sync_batch_sharding = NamedSharding(
             self.mesh, PartitionSpec(None, DATA_AXIS))
         restored = None
@@ -337,6 +400,24 @@ class TrainJob:
         sample = self.dataset.transform_train(
             np.asarray(x[: self.req.batch_size]),
             np.asarray(y[: self.req.batch_size]))
+        if n_seq > 1:
+            # pre-flight BOTH splits: a test split of different width
+            # would otherwise fail mid-job inside validation's shard_map
+            # with an opaque divisibility error after training compute
+            # was already spent
+            probes = [("train", sample)]
+            if handle.test_samples > 0:
+                xt, yt = handle.doc_range("test", 0, 1)
+                probes.append(("test", self.dataset.transform_test(
+                    np.asarray(xt[:1]), np.asarray(yt[:1]))))
+            for split, probe in probes:
+                for k, d in self.model.seq_batch_dims.items():
+                    T = np.asarray(probe[k]).shape[1 + d]
+                    if T % n_seq:
+                        raise KubeMLException(
+                            f"{split}-split sequence length {T} of batch "
+                            f"key {k!r} is not divisible by "
+                            f"--seq-parallel {n_seq}", 400)
         self.variables = self.model.init_variables(
             jax.random.PRNGKey(self.seed), sample)
         if restored is not None:
@@ -349,6 +430,12 @@ class TrainJob:
             self.variables = restored
             self._log("job %s warm-started from checkpoint %s",
                       self.task.job_id, self.req.resume_from)
+        if self._tp_rules is not None:
+            # Megatron placement over the mesh model axis; GSPMD inserts
+            # the TP collectives inside each DP lane (parallel/tp.py)
+            from kubeml_tpu.parallel.tp import shard_variables
+            self.variables = shard_variables(self.variables, self.mesh,
+                                             self._tp_rules)
 
     def _stage_batch(self, rb):
         """Runs in the prefetch thread: push the (large) batch leaves to
@@ -357,8 +444,9 @@ class TrainJob:
         stay host-side numpy — they are tiny, the job's abort check and
         RoundStats read them without a device readback, and round hooks
         may mutate them (device-resident batch leaves are immutable)."""
-        batch = jax.tree_util.tree_map(
-            lambda a: jax.device_put(a, self._batch_sharding), rb.batch)
+        batch = {k: jax.tree_util.tree_map(
+            lambda a: jax.device_put(a, self._batch_sharding(k)), v)
+            for k, v in rb.batch.items()}
         return dataclasses.replace(rb, batch=batch)
 
     @staticmethod

@@ -64,6 +64,12 @@ SLOW_TESTS = {
     # parallelism equivalence / convergence
     "test_parallel_tp_sp.py::test_kavg_trains_tp_sharded_variables",
     "test_parallel_tp_sp.py::test_kavg_trains_tp_sharded_gpt",
+    "test_parallel_tp_sp.py::test_kavg_trains_seq_parallel_bert_ring",
+    "test_parallel_tp_sp.py::test_kavg_trains_seq_parallel_gpt_ring",
+    "test_parallel_tp_sp.py::test_kavg_trains_seq_parallel_gpt_ulysses",
+    "test_job.py::test_job_tensor_parallel_bert",
+    "test_job.py::test_job_seq_parallel_gpt",
+    "test_control_plane.py::test_tensor_parallel_job_through_controller",
     "test_parallel_tp_sp.py::test_ring_attention_grads_match",
     "test_parallel_tp_sp.py::test_ulysses_grads_match",
     "test_parallel_tp_sp.py::test_ring_attention_matches_full",
@@ -76,6 +82,12 @@ SLOW_TESTS = {
     "test_parallel_pp_ep.py::test_pipeline_training_converges",
     # distributed / deployment / control-plane long paths
     "test_distributed.py::test_kavg_round_over_multislice_mesh",
+    "test_distributed_multiprocess.py::"
+    "test_two_process_cluster_runs_kavg_round",
+    "test_distributed_multiprocess.py::"
+    "test_two_process_result_matches_single_process",
+    "test_distributed_multiprocess.py::"
+    "test_checkpoint_written_by_coordinator",
     "test_role_deployment.py::test_split_role_processes_train",
     "test_standalone_jobs.py::test_standalone_stop",
     "test_standalone_jobs.py::test_standalone_train_updates_and_infer",

