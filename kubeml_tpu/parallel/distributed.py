@@ -54,7 +54,8 @@ def _cluster_env_present() -> bool:
     model copies and report wrong results. Single-host values (e.g.
     TPU_WORKER_HOSTNAMES=localhost on a 1-host slice) don't count."""
     if os.environ.get("JAX_COORDINATOR_ADDRESS") \
-            or os.environ.get("MEGASCALE_COORDINATOR_ADDRESS"):
+            or os.environ.get("MEGASCALE_COORDINATOR_ADDRESS") \
+            or os.environ.get("KUBEML_COORDINATOR_ADDRESS"):
         return True
     hosts = os.environ.get("TPU_WORKER_HOSTNAMES", "")
     if len([h for h in hosts.split(",") if h.strip()]) > 1:
@@ -93,6 +94,14 @@ def initialize(coordinator_address: Optional[str] = None,
     is_init = getattr(jax.distributed, "is_initialized", None)
     if is_init is not None and is_init():
         return  # already part of a cluster
+    # env-driven bring-up (tools/launch_distributed.py and manual
+    # multi-host runs set these; explicit arguments win)
+    if coordinator_address is None:
+        coordinator_address = os.environ.get("KUBEML_COORDINATOR_ADDRESS")
+    if num_processes is None and os.environ.get("KUBEML_NUM_PROCESSES"):
+        num_processes = int(os.environ["KUBEML_NUM_PROCESSES"])
+    if process_id is None and os.environ.get("KUBEML_PROCESS_ID"):
+        process_id = int(os.environ["KUBEML_PROCESS_ID"])
     kwargs = {}
     if coordinator_address is not None:
         kwargs["coordinator_address"] = coordinator_address

@@ -184,8 +184,24 @@ def main(argv=None):
 
     logging.basicConfig(level=logging.INFO)
     if args.virtual_cpu_devices:
+        from kubeml_tpu.parallel.distributed import _cluster_env_present
+        if _cluster_env_present():
+            # the no-silent-degrade guarantee (parallel/distributed.py):
+            # a declared cluster must never fall back to N independent
+            # single-process trainings
+            raise RuntimeError(
+                "--virtual-cpu-devices is single-process by "
+                "construction but the environment declares a "
+                "jax.distributed cluster; unset the cluster variables "
+                "or drop the flag")
         from kubeml_tpu.testing import ensure_virtual_cpu_devices
         ensure_virtual_cpu_devices(args.virtual_cpu_devices)
+    else:
+        # multi-host job pods join the jax.distributed cluster before
+        # any JAX call (auto-discovery / KUBEML_* env; single-host
+        # no-ops)
+        from kubeml_tpu.parallel.distributed import initialize
+        initialize()
 
     from kubeml_tpu.parallel.mesh import make_mesh
     mesh = make_mesh(n_data=args.mesh_data or None)

@@ -1,0 +1,91 @@
+"""Worker program for the 2-process jax.distributed test.
+
+Launched (2 processes x 4 virtual CPU devices) by
+tools/launch_distributed.py, which provides the KUBEML_* cluster env.
+Each process: joins the cluster, builds the slice-major multislice mesh,
+runs ONE K-avg sync round whose merge psum crosses the process boundary,
+and participates in a cluster-wide checkpoint (coordinator writes, all
+load back). Saves its view of the averaged weights for the parent test
+to compare across processes and against a single-process reference.
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__)))))
+
+import jax  # noqa: E402
+
+from kubeml_tpu.parallel.distributed import (initialize,  # noqa: E402
+                                             is_coordinator,
+                                             make_multislice_mesh)
+
+# env-driven join (KUBEML_COORDINATOR_ADDRESS et al. from the launcher).
+# MUST precede any other JAX call.
+initialize()
+
+import numpy as np  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+
+from kubeml_tpu.models import get_builtin  # noqa: E402
+from kubeml_tpu.parallel.kavg import KAvgEngine  # noqa: E402
+from kubeml_tpu.train.checkpoint import (load_checkpoint,  # noqa: E402
+                                         save_checkpoint)
+
+
+def main(outdir: str) -> None:
+    nproc = int(os.environ["KUBEML_NUM_PROCESSES"])
+    assert jax.process_count() == nproc, jax.process_count()
+    per = int(os.environ["JAX_NUM_CPU_DEVICES"])
+    assert len(jax.local_devices()) == per
+    assert len(jax.devices()) == nproc * per
+
+    mesh = make_multislice_mesh()
+    model = get_builtin("mlp")(hidden=16, num_classes=4)
+    rng = np.random.RandomState(0)  # identical data on every process
+    W, S, B, D = 8, 2, 4, 8
+    x = rng.randn(W, S, B, D).astype(np.float32)
+    y = rng.randint(0, 4, size=(W, S, B)).astype(np.int32)
+    rngs = rng.randint(0, 2**31, size=(W, S, 2)).astype(np.uint32)
+    variables = model.init_variables(jax.random.PRNGKey(0),
+                                     {"x": jnp.asarray(x[0, 0])})
+    # host-side (uncommitted) values: every process passes the same full
+    # array and jit forms each global array from the local slices — no
+    # cross-host transfer
+    variables = jax.tree_util.tree_map(np.asarray, variables)
+
+    engine = KAvgEngine(mesh, model.loss, model.metrics,
+                        model.configure_optimizers, donate=False)
+    avg, stats = engine.train_round(
+        variables, {"x": x, "y": y},
+        sample_mask=np.ones((W, S, B), np.float32),
+        step_mask=np.ones((W, S), np.float32),
+        worker_mask=np.ones(W, np.float32),
+        rngs=rngs, lr=0.1, epoch=0)
+    assert stats.contributors == W
+    # the averaged model is replicated (out_specs P()) => every process
+    # can read its local copy
+    leaves = [np.asarray(l) for l in jax.tree_util.tree_leaves(avg)]
+
+    pid = jax.process_index()
+    np.savez(os.path.join(outdir, f"avg_p{pid}.npz"),
+             **{str(i): l for i, l in enumerate(leaves)})
+
+    # cluster-wide checkpoint: coordinator writes, everyone syncs + loads
+    from jax.experimental import multihost_utils
+    root = os.path.join(outdir, "models")
+    if is_coordinator():
+        save_checkpoint("distjob1", avg,
+                        {"model": "mlp", "function": "mlp",
+                         "dataset": "synth"}, root=root)
+    multihost_utils.sync_global_devices("kubeml_ckpt_done")
+    restored, manifest = load_checkpoint("distjob1", root=root)
+    assert manifest["model"] == "mlp"
+    for a, b in zip(leaves, [np.asarray(l) for l in
+                             jax.tree_util.tree_leaves(restored)]):
+        np.testing.assert_array_equal(a, b)
+    print(f"proc {pid} OK", flush=True)
+
+
+if __name__ == "__main__":
+    main(sys.argv[1])

@@ -1,0 +1,138 @@
+"""Multi-process distributed launcher.
+
+Spawns N OS processes of one command, each joined into a single
+`jax.distributed` cluster via the KUBEML_* environment contract that
+`kubeml_tpu.parallel.distributed.initialize()` (and therefore `kubeml
+serve` / the jobserver) reads at startup:
+
+    KUBEML_COORDINATOR_ADDRESS   host:port of process 0
+    KUBEML_NUM_PROCESSES         total process count
+    KUBEML_PROCESS_ID            this process's rank
+
+Two modes:
+
+  --emulate-cpu D     CPU emulation on ONE machine: each process gets D
+                      virtual CPU devices (JAX_PLATFORMS=cpu,
+                      JAX_NUM_CPU_DEVICES=D, sitecustomize TPU pickup
+                      disabled) — the supported way to exercise the
+                      multi-process code path without N TPU hosts. The
+                      2-process CI test drives exactly this mode.
+  (default)           one process per invocation of this tool per HOST
+                      (real multi-host): run the SAME command on every
+                      host with --process-id set per host; devices are
+                      the host's real chips. On Cloud TPU pod slices
+                      prefer no launcher at all — `initialize()`
+                      auto-discovers from the TPU metadata environment.
+
+Replaces the role the reference's in-process harness plays
+(/root/reference/ml/tests/integration.go:14-36): bring up a multi-process
+deployment without a real cluster.
+
+Examples:
+
+    # 2 processes x 4 virtual CPU devices running a worker script
+    python -m tools.launch_distributed --processes 2 --emulate-cpu 4 \
+        -- python my_worker.py
+
+    # real 2-host bring-up (run once per host)
+    python -m tools.launch_distributed --processes 2 --process-id 0 \
+        --coordinator host0:12355 -- python -m kubeml_tpu.cli.main serve
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import signal
+import socket
+import subprocess
+import sys
+import threading
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("", 0))
+        return s.getsockname()[1]
+
+
+def _stream(proc: subprocess.Popen, rank: int) -> None:
+    for line in proc.stdout:
+        sys.stdout.write(f"[p{rank}] {line.decode(errors='replace')}")
+        sys.stdout.flush()
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        prog="launch_distributed",
+        description="spawn a jax.distributed multi-process run")
+    p.add_argument("--processes", type=int, required=True, metavar="N")
+    p.add_argument("--coordinator", default=None, metavar="HOST:PORT",
+                   help="coordinator address (default: localhost + a "
+                        "free port — emulation mode only)")
+    p.add_argument("--process-id", type=int, default=None,
+                   help="rank of THIS host's process (real multi-host "
+                        "mode: spawn exactly one process)")
+    p.add_argument("--emulate-cpu", type=int, default=0, metavar="D",
+                   help="spawn ALL N processes locally, each with D "
+                        "virtual CPU devices")
+    p.add_argument("command", nargs=argparse.REMAINDER,
+                   help="command to run (prefix with --)")
+    args = p.parse_args(argv)
+
+    cmd = args.command
+    if cmd and cmd[0] == "--":
+        cmd = cmd[1:]
+    if not cmd:
+        p.error("no command given (append: -- python your_script.py ...)")
+
+    coordinator = args.coordinator
+    if coordinator is None:
+        if args.emulate_cpu <= 0:
+            p.error("--coordinator is required outside --emulate-cpu mode")
+        coordinator = f"localhost:{_free_port()}"
+
+    base_env = dict(os.environ,
+                    KUBEML_COORDINATOR_ADDRESS=coordinator,
+                    KUBEML_NUM_PROCESSES=str(args.processes))
+
+    if args.emulate_cpu > 0:
+        ranks = range(args.processes)
+        base_env.update(
+            # the sitecustomize eagerly grabs the TPU backend; an empty
+            # pool-IPs var disables it so the CPU retarget works
+            PALLAS_AXON_POOL_IPS="",
+            JAX_PLATFORMS="cpu",
+            JAX_NUM_CPU_DEVICES=str(args.emulate_cpu))
+    else:
+        if args.process_id is None:
+            p.error("--process-id is required in real multi-host mode")
+        ranks = [args.process_id]
+
+    procs = []
+    threads = []
+    for rank in ranks:
+        env = dict(base_env, KUBEML_PROCESS_ID=str(rank))
+        proc = subprocess.Popen(cmd, env=env, stdout=subprocess.PIPE,
+                                stderr=subprocess.STDOUT)
+        t = threading.Thread(target=_stream, args=(proc, rank), daemon=True)
+        t.start()
+        procs.append(proc)
+        threads.append(t)
+
+    rc = 0
+    try:
+        for proc in procs:
+            rc = proc.wait() or rc
+    except KeyboardInterrupt:
+        for proc in procs:
+            proc.send_signal(signal.SIGINT)
+        for proc in procs:
+            rc = proc.wait() or rc
+    for t in threads:
+        t.join(timeout=5)
+    return rc
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
