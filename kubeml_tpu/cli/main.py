@@ -235,12 +235,16 @@ def cmd_serve(args):
     initialize(args.coordinator, args.num_processes, args.process_id)
     mesh = make_mesh(n_data=args.mesh_data) if args.mesh_data else None
 
+    partitions = None
+    if args.job_partition:
+        from kubeml_tpu.utils.env import parse_env_spec
+        partitions = [parse_env_spec(spec) for spec in args.job_partition]
     if args.role == "all":
         from kubeml_tpu.control.deployment import start_deployment
         svc = start_deployment(mesh=mesh,
-                               use_default_ports=not args.free_ports)
-        if args.standalone_jobs:
-            svc.ps.standalone_jobs = True
+                               use_default_ports=not args.free_ports,
+                               standalone_jobs=args.standalone_jobs,
+                               job_partitions=partitions)
         print(f"controller: {svc.controller.url}")
         print(f"scheduler:  {svc.scheduler.url}")
         print(f"ps:         {svc.ps.url}  (metrics at {svc.ps.url}/metrics)")
@@ -258,7 +262,8 @@ def cmd_serve(args):
         from kubeml_tpu.control.ps import ParameterServer
         svc = ParameterServer(mesh=mesh, port=args.port or const.PS_PORT,
                               scheduler_url=args.scheduler_url,
-                              standalone_jobs=args.standalone_jobs or None)
+                              standalone_jobs=args.standalone_jobs or None,
+                              job_partitions=partitions)
     else:  # storage
         from kubeml_tpu.control.storage import StorageService
         svc = StorageService(port=args.port or const.STORAGE_PORT)
@@ -398,6 +403,15 @@ def build_parser() -> argparse.ArgumentParser:
     s.add_argument("--standalone-jobs", action="store_true",
                    help="run each job as its own process "
                         "(STANDALONE_JOBS=true equivalent)")
+    s.add_argument("--job-partition", action="append", metavar="K=V[;K=V]",
+                   help="device-partition env for ONE concurrent "
+                        "standalone job slot; repeat per slot (e.g. "
+                        "--job-partition TPU_VISIBLE_DEVICES=0,1 "
+                        "--job-partition TPU_VISIBLE_DEVICES=2,3; "
+                        "';' separates multiple K=V pairs so values may "
+                        "contain commas). A starting job leases a free "
+                        "slot until its process exits; while every slot "
+                        "is leased the scheduler requeues new tasks")
     s.set_defaults(fn=cmd_serve)
     return p
 

@@ -39,11 +39,15 @@ class Deployment:
 def start_deployment(mesh=None, controller_port: int = 0,
                      scheduler_port: int = 0, ps_port: int = 0,
                      storage_port: int = 0,
-                     use_default_ports: bool = False) -> Deployment:
+                     use_default_ports: bool = False,
+                     standalone_jobs: bool = False,
+                     job_partitions=None) -> Deployment:
     """Start storage, PS, scheduler, controller wired together.
 
     Port 0 picks a free port (tests); use_default_ports uses the configured
     service ports (const.py) for a long-running host deployment.
+    job_partitions: device-partition env dicts for concurrent standalone
+    jobs (ParameterServer docs).
     """
     if use_default_ports:
         controller_port = controller_port or const.CONTROLLER_PORT
@@ -54,7 +58,9 @@ def start_deployment(mesh=None, controller_port: int = 0,
     storage = StorageService(port=storage_port)
     storage.start()
 
-    ps = ParameterServer(mesh=mesh, port=ps_port)
+    ps = ParameterServer(mesh=mesh, port=ps_port,
+                         standalone_jobs=standalone_jobs or None,
+                         job_partitions=job_partitions)
     ps.start()
 
     scheduler = Scheduler(ps_url=ps.url, port=scheduler_port)

@@ -44,7 +44,7 @@ import sys
 import tempfile
 import threading
 import time
-from typing import Dict, Optional
+from typing import Dict, List, Optional
 
 import numpy as np
 
@@ -78,6 +78,7 @@ class _JobRecord:
         self.thread = thread
         self.proc = proc
         self.url = url
+        self.partition: Optional[int] = None  # device-partition slot
         self.next_parallelism: Optional[int] = None
         self.update_event = threading.Event()
 
@@ -119,7 +120,8 @@ class ParameterServer(JsonService):
     def __init__(self, mesh=None, port: int = 0,
                  scheduler_url: Optional[str] = None,
                  standalone_jobs: Optional[bool] = None,
-                 job_env: Optional[Dict[str, str]] = None):
+                 job_env: Optional[Dict[str, str]] = None,
+                 job_partitions: Optional[List[Dict[str, str]]] = None):
         super().__init__(port=port)
         # Lazy mesh: in standalone mode the PARENT must not initialize the
         # accelerator backend (on TPU, libtpu is single-process-exclusive —
@@ -134,6 +136,15 @@ class ParameterServer(JsonService):
         # extra env for standalone job processes (e.g. per-job TPU
         # visible-devices pinning)
         self.job_env = job_env or {}
+        # device-partition slots for CONCURRENT standalone jobs: each
+        # entry is an env dict pinning one job process to a device
+        # subset (e.g. {"TPU_VISIBLE_DEVICES": "0,1"}). A starting job
+        # leases the first free slot and holds it until its process
+        # exits; with every slot busy, /start answers 503 (the
+        # scheduler's queue keeps the task until capacity frees). None =
+        # no partitioning, jobs share whatever the env exposes.
+        self.job_partitions = job_partitions
+        self._busy_partitions: set = set()
         self.jobs: Dict[str, _JobRecord] = {}
         self._jobs_lock = threading.RLock()
         self._infer_cache: "collections.OrderedDict" = \
@@ -313,6 +324,15 @@ class ParameterServer(JsonService):
         with self._jobs_lock:
             if task.job_id in self.jobs:
                 raise InvalidArgsError(f"job {task.job_id} already exists")
+            if self.job_partitions is not None:
+                free = [i for i in range(len(self.job_partitions))
+                        if i not in self._busy_partitions]
+                if not free:
+                    raise KubeMLException(
+                        "all device partitions are leased to running "
+                        "jobs; retry when one finishes", 503)
+                rec.partition = free[0]
+                self._busy_partitions.add(free[0])
             self.jobs[task.job_id] = rec
         self.metrics.running_total.inc("train")
         task.state = "starting"
@@ -332,7 +352,20 @@ class ParameterServer(JsonService):
         if self.scheduler_url:
             cmd += ["--scheduler-url", self.scheduler_url]
         env = dict(os.environ)
+        # the job child must NOT inherit the parent's jax.distributed
+        # rank: on multi-host serve these vars hold the PARENT's
+        # coordinator/rank, and a child re-joining as that rank hangs
+        # the cluster. Multi-host job processes get their own topology
+        # via job_env/partition env when wanted.
+        for var in ("KUBEML_COORDINATOR_ADDRESS", "KUBEML_NUM_PROCESSES",
+                    "KUBEML_PROCESS_ID"):
+            env.pop(var, None)
         env.update(self.job_env)
+        if rec.partition is not None:
+            env.update(self.job_partitions[rec.partition])
+            logger.info("job %s leased device partition %d (%s)",
+                        task.job_id, rec.partition,
+                        self.job_partitions[rec.partition])
         repo_root = os.path.dirname(os.path.dirname(
             os.path.dirname(os.path.abspath(__file__))))
         env["PYTHONPATH"] = repo_root + os.pathsep + env.get("PYTHONPATH", "")
@@ -357,10 +390,34 @@ class ParameterServer(JsonService):
                 self.metrics.running_total.inc("train", -1.0)
             if rec.proc is not None:
                 rec.proc.terminate()
+                threading.Thread(target=self._reap, args=(rec,),
+                                 name=f"reap-{task.job_id}",
+                                 daemon=True).start()
+            else:
+                self._release_partition(rec)
             raise
         finally:
             shutil.rmtree(tmp_dir, ignore_errors=True)
         task.state = "running"
+        # watchdog: a child that dies WITHOUT posting /finish (OOM-kill,
+        # segfault) must not pin its record — or its device partition —
+        # forever. proc.wait() here races the normal finish path safely:
+        # _finish pops the record exactly once, so whichever side loses
+        # the pop becomes a no-op.
+        threading.Thread(target=self._watch_standalone,
+                         args=(task.job_id, rec),
+                         name=f"watch-{task.job_id}", daemon=True).start()
+
+    def _watch_standalone(self, job_id: str, rec: _JobRecord):
+        rec.proc.wait()
+        with self._jobs_lock:
+            still_registered = self.jobs.get(job_id) is rec
+        if still_registered:
+            logger.warning("job %s process exited without finishing "
+                           "(rc=%s)", job_id, rec.proc.returncode)
+            self._finish(job_id,
+                         error=f"job process exited unexpectedly "
+                               f"(rc={rec.proc.returncode})")
 
     def _wait_job_ready(self, proc: subprocess.Popen, port_file: str,
                         timeout: float = 120.0) -> str:
@@ -432,8 +489,10 @@ class ParameterServer(JsonService):
         if rec.proc is not None:
             # the job process exits after its finish notification; reap it
             # off-thread so this handler (called BY that process) returns
-            threading.Thread(target=self._reap, args=(rec.proc,),
+            threading.Thread(target=self._reap, args=(rec,),
                              name=f"reap-{job_id}", daemon=True).start()
+        else:
+            self._release_partition(rec)
         self.metrics.clear_job(job_id)
         self.metrics.running_total.inc("train", -1.0)
         if error:
@@ -445,13 +504,25 @@ class ParameterServer(JsonService):
                 logger.warning("could not notify scheduler finish: %s",
                                e.message)
 
-    def _reap(self, proc: subprocess.Popen):
+    def _reap(self, rec: _JobRecord):
+        proc = rec.proc
         try:
             proc.wait(30.0)
         except subprocess.TimeoutExpired:
             logger.warning("job process %d did not exit; killing", proc.pid)
             proc.kill()
             proc.wait()
+        finally:
+            # the device partition frees only once the process is GONE —
+            # on TPU the chips stay held until exit
+            self._release_partition(rec)
+
+    def _release_partition(self, rec: _JobRecord):
+        if rec.partition is None:
+            return
+        with self._jobs_lock:
+            self._busy_partitions.discard(rec.partition)
+        rec.partition = None
 
     def wait_for_job(self, job_id: str, timeout: Optional[float] = None
                      ) -> bool:

@@ -124,6 +124,22 @@ class Scheduler(JsonService):
                 continue
             try:
                 self._schedule(task)
+            except KubeMLException as e:
+                if e.status_code == 503:
+                    # no capacity (e.g. every device partition leased):
+                    # the task goes BACK on the queue and retries once
+                    # capacity frees — dropping it would strand the
+                    # client's job id forever. The policy forgets the
+                    # task first: it never started, so the retry must
+                    # take the is_new /start path again, not /update
+                    logger.info("task %s deferred (%s); requeueing",
+                                task.job_id, e.message)
+                    self.policy.task_finished(task.job_id)
+                    self._stop.wait(0.5)  # don't hot-spin against the PS
+                    self.queue.push(task)
+                else:
+                    logger.exception("scheduling task %s failed",
+                                     task.job_id)
             except Exception:
                 logger.exception("scheduling task %s failed", task.job_id)
 

@@ -176,21 +176,25 @@ class KAvgEngine:
         self.donate = donate
         self.merge_dtype = merge_dtype
         self.unroll = max(1, int(unroll))
+        self.n_lanes = mesh.shape[DATA_AXIS]
+        self.batch_seq_dims = dict(batch_seq_dims or {})
+        self._seq_train = (mesh.shape[SEQ_AXIS] > 1
+                           and bool(self.batch_seq_dims))
+        # compressed merges on meshes with Auto inner axes must ride the
+        # ppermute ring: a sub-f32 lax.psum fatally miscompiles in the
+        # partially-manual partitioner (parallel/collectives.py)
+        self._compressed_ring = (merge_dtype is not None
+                                 and mesh.size != self.n_lanes)
         if merge_dtype is not None:
             if not jnp.issubdtype(jnp.dtype(merge_dtype), jnp.floating):
                 raise ValueError(
                     f"merge_dtype must be a floating dtype, got "
                     f"{jnp.dtype(merge_dtype)}")
-            inner = mesh.size // mesh.shape[DATA_AXIS]
-            if inner != 1:
+            if self._seq_train:
                 raise ValueError(
-                    "merge_dtype compression requires a pure-DP mesh "
-                    f"(inner axes size 1, got {inner}); use the f32 merge "
-                    "when composing with tensor/seq/pipeline sharding")
-        self.n_lanes = mesh.shape[DATA_AXIS]
-        self.batch_seq_dims = dict(batch_seq_dims or {})
-        self._seq_train = (mesh.shape[SEQ_AXIS] > 1
-                           and bool(self.batch_seq_dims))
+                    "merge_dtype compression does not compose with "
+                    "sequence-parallel training (the vma-checked round) "
+                    "yet; use the f32 merge")
         self._train_cache: Dict[Any, Callable] = {}
         self._eval_cache: Dict[Any, Callable] = {}
 
@@ -203,18 +207,16 @@ class KAvgEngine:
         sharded over them — e.g. Megatron TP rules via parallel.tp —
         train as-is: GSPMD inserts the model-axis collectives inside
         each DP lane while the weight average still psums over `data`
-        only. Exceptions that go FULL manual ({}):
-          - merge_dtype: the SPMD partitioner miscompiles a sub-f32
-            all-reduce on partially-manual meshes ("invalid binary
-            instruction opcode copy") — why compression requires a
-            pure-DP mesh;
-          - pure-DP meshes (all inner axes size 1): leaving size-1
-            axes Auto blocks pallas kernels inside the round ("Mosaic
-            kernels cannot be automatically partitioned"), which would
-            silently cost transformer models their flash attention.
+        only. Pure-DP meshes (all inner axes size 1) go FULL manual
+        ({}): leaving size-1 axes Auto blocks pallas kernels inside the
+        round ("Mosaic kernels cannot be automatically partitioned"),
+        which would silently cost transformer models their flash
+        attention. Compressed merges pick their collective accordingly:
+        direct sub-f32 psum when full-manual, the ppermute ring when
+        inner axes stay Auto (a partially-manual sub-f32 psum fatally
+        miscompiles — parallel/collectives.py).
         """
-        if (self.merge_dtype is not None      # pure-DP checked in __init__
-                or self.mesh.size == self.mesh.shape[DATA_AXIS]):
+        if self.mesh.size == self.mesh.shape[DATA_AXIS]:
             return {}
         if self._seq_train:
             # seq-parallel training: ALL axes manual (leaving the unused
@@ -317,6 +319,7 @@ class KAvgEngine:
             raw_count = lax.psum(worker_mask.sum(), DATA_AXIS)
             count = jnp.maximum(raw_count, 1.0)  # guard 0-contributor divide
             merge_dtype = self.merge_dtype
+            use_ring = self._compressed_ring
 
             def merge_leaf(c, ref):
                 # integer leaves (BatchNorm counters) stay uncompressed:
@@ -327,12 +330,22 @@ class KAvgEngine:
                         and jnp.issubdtype(ref.dtype, jnp.floating)):
                     # compress at the communication boundary only: local
                     # accumulation stays f32, the wire carries merge_dtype.
-                    # Error: ~2^-8 relative per cast PLUS the psum chain
-                    # accumulating in bf16, so worst case grows with the
-                    # lane count (~D*2^-8) — acceptable for weight
-                    # averaging, not for exact counters (skipped above)
-                    s = lax.psum(c.astype(merge_dtype), DATA_AXIS)
-                    return (s.astype(jnp.float32) / count).astype(ref.dtype)
+                    # Error: ~2^-8 relative per cast PLUS the reduction
+                    # chain accumulating through bf16 hops, so worst case
+                    # grows with the lane count (~D*2^-8) — acceptable
+                    # for weight averaging, not for exact counters
+                    # (skipped above). Full-manual meshes use the direct
+                    # sub-f32 psum; Auto-inner meshes must take the
+                    # ppermute ring (collectives.py: the partial-manual
+                    # sub-f32 psum is a fatal partitioner miscompile).
+                    if use_ring:
+                        from kubeml_tpu.parallel.collectives import \
+                            ring_psum
+                        s = ring_psum(c, DATA_AXIS, merge_dtype)
+                    else:
+                        s = lax.psum(c.astype(merge_dtype), DATA_AXIS
+                                     ).astype(jnp.float32)
+                    return (s / count).astype(ref.dtype)
                 return (lax.psum(c, DATA_AXIS) / count).astype(ref.dtype)
 
             avg = jax.tree_util.tree_map(merge_leaf, contrib, variables)

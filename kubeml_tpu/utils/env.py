@@ -18,6 +18,22 @@ def limit_parallelism() -> bool:
     return os.environ.get("LIMIT_PARALLELISM", "").lower() in ("1", "true", "yes")
 
 
+def parse_env_spec(spec: str) -> dict:
+    """'K=V[;K2=V2]' -> env dict. ';' separates the pairs so VALUES may
+    contain commas — device lists like TPU_VISIBLE_DEVICES=0,1 are the
+    primary use (--job-partition)."""
+    out = {}
+    for pair in spec.split(";"):
+        pair = pair.strip()
+        if not pair:
+            continue
+        if "=" not in pair:
+            raise ValueError(f"bad env spec {pair!r}: expected KEY=VALUE")
+        k, v = pair.split("=", 1)
+        out[k.strip()] = v
+    return out
+
+
 def find_free_port() -> int:
     with socket.socket(socket.AF_INET, socket.SOCK_STREAM) as s:
         s.bind(("127.0.0.1", 0))

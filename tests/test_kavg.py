@@ -133,16 +133,46 @@ class TestTrainRound:
             first = loss if first is None else first
         assert loss < first
 
-    def test_compressed_merge_rejects_inner_axes(self, mesh4x2, rng):
-        """Compression is pure-DP only (full-manual shard_map); a DP x TP
-        mesh must fail loudly instead of miscompiling, as must a
-        non-float wire dtype."""
-        with pytest.raises(ValueError, match="pure-DP"):
-            KAvgEngine(mesh4x2, linear_loss, linear_metrics, sgd_factory,
-                       donate=False, merge_dtype=jnp.bfloat16)
+    def test_compressed_merge_on_mixed_mesh(self, mesh4x2, rng):
+        """Compression now composes with DP x TP meshes (the r1 box):
+        the merge rides the bf16 ppermute ring (collectives.py) because
+        a partially-manual sub-f32 psum fatally miscompiles. Result must
+        match the pure-f32 merge on the same mesh to bf16 tolerance."""
+        W, S, B, lr = 8, 3, 4, 0.05
+        xs, ys = make_round_data(rng, W, S, B)
+        w0 = rng.randn(D).astype(np.float32)
+        worker_mask = np.array([1, 1, 0, 1, 1, 1, 0, 1], dtype=float)
+        kw = dict(sample_mask=np.ones((W, S, B)),
+                  step_mask=np.ones((W, S)), worker_mask=worker_mask,
+                  rngs=np.zeros((W, S, 2), np.uint32), lr=lr, epoch=0)
+        batch = {"x": jnp.asarray(xs), "y": jnp.asarray(ys)}
+        variables = {"params": {"w": jnp.asarray(w0)}}
+
+        ref_eng = KAvgEngine(mesh4x2, linear_loss, linear_metrics,
+                             sgd_factory, donate=False)
+        ref, _ = ref_eng.train_round(variables, batch, **kw)
+        eng = KAvgEngine(mesh4x2, linear_loss, linear_metrics,
+                         sgd_factory, donate=False,
+                         merge_dtype=jnp.bfloat16)
+        assert eng._compressed_ring
+        out, stats = eng.train_round(variables, batch, **kw)
+        assert stats.contributors == 6
+        a = np.asarray(out["params"]["w"])
+        b = np.asarray(ref["params"]["w"])
+        np.testing.assert_allclose(a, b, rtol=2e-2, atol=2e-2)
+
+    def test_compressed_merge_rejects_bad_configs(self, mesh4x2, rng):
+        """Non-float wire dtypes fail loudly; so does composing the
+        compressed merge with seq-parallel training (vma round)."""
         with pytest.raises(ValueError, match="floating"):
             KAvgEngine(mesh4x2, linear_loss, linear_metrics, sgd_factory,
                        donate=False, merge_dtype=jnp.int16)
+        from kubeml_tpu.parallel.mesh import make_mesh
+        seq_mesh = make_mesh(n_data=2, n_seq=2)
+        with pytest.raises(ValueError, match="sequence-parallel"):
+            KAvgEngine(seq_mesh, linear_loss, linear_metrics, sgd_factory,
+                       donate=False, merge_dtype=jnp.bfloat16,
+                       batch_seq_dims={"x": 0})
 
     def test_step_mask_freezes_padded_steps(self, mesh8, rng):
         """Ragged chunks: a masked step must leave weights untouched."""
