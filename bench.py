@@ -23,22 +23,28 @@ of an element derived from the last returned (averaged) variables waits
 for the full dependency chain including the final merge psum.
 
 Baseline: the reference publishes no numeric table (BASELINE.md — results
-exist only as figures), so `vs_baseline` is computed against a documented
-nominal proxy for the reference's setup: KubeML-class eager PyTorch
-ResNet-18/CIFAR-10 on a single datacenter GPU ≈ 2000 samples/sec
-(BASELINE.md "Targets": beat KubeML-on-GPU epoch wall-clock).
+exist only as figures), and its GPU stack cannot run here, so
+`vs_baseline` is MEASURED live against the framework's single-node
+baseline arm (experiments/baseline_train.py semantics: the same model
+and data trained by a plain jitted one-step-per-dispatch loop with
+persistent optimizer state, no K-avg, no masks — the role the
+reference's TF/Keras comparison runs play, ml/experiments/tf_train.py).
+Both arms run in this process on the same chip with the same
+readback-synchronized timing, so the ratio isolates the engine design
+(K local steps per dispatch + on-device merge vs a dispatch per step).
+The retired 2000 samples/sec GPU proxy of round 1 survives only as
+docs/performance.md context.
 """
 
 import json
 import math
 import time
 
-GPU_BASELINE_SAMPLES_PER_SEC = 2000.0
-
 BATCH = 256           # per-step batch per worker
 STEPS_PER_ROUND = 8   # K local steps per sync round
 EPOCH_SAMPLES = 50_000  # CIFAR-10 train split
 TIMED_EPOCHS = 3
+BASELINE_TIMED_EPOCHS = 1  # the arm exists for the ratio, not the curve
 
 
 def main():
@@ -112,12 +118,74 @@ def main():
 
     samples = TIMED_EPOCHS * rounds_per_epoch * W * S * B
     per_chip = samples / elapsed / n_chips
+
+    baseline_per_chip = _measure_baseline_arm(model, x, y)
     print(json.dumps({
         "metric": "resnet18_cifar10_train_throughput",
         "value": round(per_chip, 1),
         "unit": "samples/sec/chip",
-        "vs_baseline": round(per_chip / GPU_BASELINE_SAMPLES_PER_SEC, 3),
+        "vs_baseline": round(per_chip / baseline_per_chip, 3),
     }))
+
+
+def _measure_baseline_arm(model, x, y) -> float:
+    """Single-node baseline arm, measured in-process: plain jitted
+    one-step-per-dispatch training (persistent optimizer state, no
+    K-avg/masks — experiments/baseline_train.py semantics) over the
+    same samples/epoch. Returns samples/sec on the baseline's OWN
+    device count (one — it runs on the default device), so the
+    vs_baseline ratio compares per-chip to per-chip and does not
+    credit the engine for mere chip count."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    W, S, B = x.shape[:3]
+    flat_x = jnp.asarray(x.reshape(W * S, B, *x.shape[3:]))
+    flat_y = jnp.asarray(y.reshape(W * S, B))
+    steps_per_epoch = max(1, math.ceil(
+        EPOCH_SAMPLES / (W * S * B))) * W * S
+    variables = model.init_variables(
+        jax.random.PRNGKey(1), {"x": flat_x[0]})
+    tx = model.configure_optimizers(jnp.float32(0.1), jnp.int32(0))
+    opt_state = tx.init(variables["params"])
+    ones = jnp.ones((B,), jnp.float32)
+    rng = np.random.RandomState(1)
+
+    @jax.jit
+    def step(variables, opt_state, xb, yb, key):
+        def scalar(params):
+            per_ex, new_state = model.loss(
+                {**variables, "params": params}, {"x": xb, "y": yb},
+                jax.random.wrap_key_data(key), ones)
+            return per_ex.mean(), new_state
+        (loss, new_state), grads = jax.value_and_grad(
+            scalar, has_aux=True)(variables["params"])
+        updates, opt_state = tx.update(grads, opt_state,
+                                       variables["params"])
+        params = optax.apply_updates(variables["params"], updates)
+        return {**new_state, "params": params}, opt_state, loss
+
+    def run_epoch(variables, opt_state):
+        losses = []
+        keys = rng.randint(0, 2**31, size=(steps_per_epoch, 2)
+                           ).astype(np.uint32)
+        for i in range(steps_per_epoch):
+            variables, opt_state, loss = step(
+                variables, opt_state, flat_x[i % (W * S)],
+                flat_y[i % (W * S)], jnp.asarray(keys[i]))
+            losses.append(loss)
+        # same per-epoch sync discipline as the engine arm
+        np.asarray(jnp.stack(losses).sum())
+        return variables, opt_state
+
+    variables, opt_state = run_epoch(variables, opt_state)  # warmup
+    t0 = time.perf_counter()
+    for _ in range(BASELINE_TIMED_EPOCHS):
+        variables, opt_state = run_epoch(variables, opt_state)
+    elapsed = time.perf_counter() - t0
+    return BASELINE_TIMED_EPOCHS * steps_per_epoch * B / elapsed
 
 
 if __name__ == "__main__":

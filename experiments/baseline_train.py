@@ -107,24 +107,39 @@ def main(argv=None) -> int:
     ap.add_argument("--lr", type=float, default=0.01)
     ap.add_argument("--samples", type=int, default=512,
                     help="synthetic train samples")
+    ap.add_argument("--digits", action="store_true",
+                    help="train on the REAL digits arm "
+                         "(experiments/data.py) instead of synthetic")
     ap.add_argument("--out", default=None)
     args = ap.parse_args(argv)
 
     from experiments.train import make_synthetic_split
 
     rng = np.random.RandomState(0)
-    x_train, y_train = make_synthetic_split(args.function, args.samples, rng)
-    x_test, y_test = make_synthetic_split(args.function,
-                                          max(args.samples // 4, 1), rng)
+    if args.digits:
+        from experiments.data import real_digits
+        x_train, y_train, x_test, y_test = real_digits()
+        dataset = "digits"
+    else:
+        x_train, y_train = make_synthetic_split(args.function,
+                                                args.samples, rng)
+        x_test, y_test = make_synthetic_split(args.function,
+                                              max(args.samples // 4, 1),
+                                              rng)
+        dataset = "synthetic"
 
     t0 = time.time()
     rows = train_baseline(args.function, x_train, y_train, x_test, y_test,
                           args.epochs, args.batch, args.lr)
     wall = time.time() - t0
+    epoch_samples = (len(x_train) // args.batch) * args.batch
+    mean_epoch_s = float(np.mean([r["epoch_s"] for r in rows]))
     summary = {"function": args.function, "arm": "single-node-baseline",
+               "dataset": dataset,
                "epochs": args.epochs, "batch": args.batch, "lr": args.lr,
                "wall_time_s": round(wall, 3),
-               "mean_epoch_s": round(np.mean([r["epoch_s"] for r in rows]), 4),
+               "mean_epoch_s": round(mean_epoch_s, 4),
+               "samples_per_sec": round(epoch_samples / mean_epoch_s, 1),
                "final_train_loss": rows[-1]["train_loss"],
                "max_accuracy": max(r["accuracy"] for r in rows)}
     print(json.dumps(summary))

@@ -87,14 +87,16 @@ class KubemlExperiment:
     def make_request(self, function: str, dataset: str, epochs: int,
                      batch: int, lr: float, parallelism: int, k: int,
                      static: bool = True, validate_every: int = 1,
-                     goal_accuracy: float = 100.0) -> TrainRequest:
+                     goal_accuracy: float = 100.0,
+                     shuffle: bool = False) -> TrainRequest:
         return TrainRequest(
             model_type=function, function_name=function, dataset=dataset,
             epochs=epochs, batch_size=batch, lr=lr,
             options=TrainOptions(default_parallelism=parallelism,
                                  static_parallelism=static,
                                  validate_every=validate_every, k=k,
-                                 goal_accuracy=goal_accuracy))
+                                 goal_accuracy=goal_accuracy,
+                                 shuffle=shuffle))
 
     def run(self, req: TrainRequest, config: Optional[Dict] = None
             ) -> ExperimentResult:

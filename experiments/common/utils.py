@@ -16,6 +16,25 @@ LENET_EPOCHS = 30
 LENET_LR = 0.01
 LENET_TTA_GOAL = 99.0  # TTA-99 figure (figures/paper/lenet/tta99.pdf)
 
+# LeNet on the REAL digits arm (experiments/data.py: the one genuine
+# image dataset available without egress). Same protocol shape as the
+# reference grid, sized to the 1,437-sample train split: full batch
+# sweep, sparse-vs-K=8 averaging, parallelism sweep; TTA goal 95 (the
+# 360-sample test split makes 99% a coin flip of 3-4 samples, so the
+# derived TTA target is 95% — max accuracy is still recorded per run).
+# lr 0.1, not MNIST's 0.01: at ~45 steps/epoch (vs MNIST's ~1900) the
+# protocol needs the larger step to converge inside the sweep budget —
+# measured 97.4% max accuracy in 10 epochs on the baseline arm vs 44%
+# at lr 0.01.
+LENET_DIGITS_GRID = {
+    "batch": [128, 64, 32, 16],
+    "k": [-1, 8],
+    "parallelism": [1, 4, 8],
+}
+LENET_DIGITS_EPOCHS = 15
+LENET_DIGITS_LR = 0.1
+LENET_DIGITS_TTA_GOAL = 95.0
+
 # ResNet/CIFAR-10: active grid of utils.py:18-28 (batch sweep, K=-1, p=8),
 # lr 0.1, 30 epochs (train.py:41-61). The reference uses ResNet-34; our
 # flagship config is ResNet-18 per BASELINE.json's north star, and the

@@ -30,6 +30,14 @@ GRIDS = {
     "lenet": dict(grid=grids.LENET_GRID, epochs=grids.LENET_EPOCHS,
                   lr=grids.LENET_LR, tta=grids.LENET_TTA_GOAL,
                   function="lenet", dataset="mnist"),
+    # the REAL-data arm (experiments/data.py): genuine handwritten
+    # digits, epoch shuffling on (the real-data sweeps want convergence)
+    "lenet-digits": dict(grid=grids.LENET_DIGITS_GRID,
+                         epochs=grids.LENET_DIGITS_EPOCHS,
+                         lr=grids.LENET_DIGITS_LR,
+                         tta=grids.LENET_DIGITS_TTA_GOAL,
+                         function="lenet", dataset="digits",
+                         shuffle=True, real="digits"),
     "resnet": dict(grid=grids.RESNET_GRID, epochs=grids.RESNET_EPOCHS,
                    lr=grids.RESNET_LR, tta=grids.RESNET_TTA_GOAL,
                    function="resnet18", dataset="cifar10"),
@@ -109,6 +117,8 @@ def main(argv=None) -> int:
     ap.add_argument("--epochs", type=int, default=None)
     ap.add_argument("--limit", type=int, default=None,
                     help="run only the first N grid configs")
+    ap.add_argument("--offset", type=int, default=0,
+                    help="skip the first N grid configs (chunked sweeps)")
     ap.add_argument("--out", default=None, help="results JSONL path")
     ap.add_argument("--metrics-out", default=None,
                     help="system-metrics JSON path")
@@ -130,14 +140,21 @@ def main(argv=None) -> int:
     try:
         names = [d.name for d in client.v1().datasets().list()]
         if spec["dataset"] not in names:
-            if not args.synthetic:
+            if spec.get("real") == "digits":
+                from experiments.data import real_digits, register_arrays
+                register_arrays(client, spec["dataset"], *real_digits())
+            elif not args.synthetic:
                 print(f"dataset {spec['dataset']} not registered "
                       f"(use kubeml dataset create, or --synthetic)",
                       file=sys.stderr)
                 return 1
-            _register_synthetic(client, spec["dataset"], spec["function"])
+            else:
+                _register_synthetic(client, spec["dataset"],
+                                    spec["function"])
 
         configs = expand_grid(spec["grid"])
+        if args.offset:
+            configs = configs[args.offset:]
         if args.limit:
             configs = configs[: args.limit]
         epochs = args.epochs or spec["epochs"]
@@ -147,11 +164,14 @@ def main(argv=None) -> int:
                 function=spec["function"], dataset=spec["dataset"],
                 epochs=epochs, batch=cfg["batch"], lr=spec["lr"],
                 parallelism=cfg["parallelism"], k=cfg["k"],
-                static=spec.get("static", True))
+                static=spec.get("static", True),
+                shuffle=spec.get("shuffle", False))
             res = exp.run(req, config={"function": spec["function"],
                                        "dataset": spec["dataset"],
                                        "epochs": epochs, "lr": spec["lr"],
                                        "static": spec.get("static", True),
+                                       "shuffle": spec.get("shuffle",
+                                                           False),
                                        **cfg})
             row = res.row([spec["tta"]])
             print(f"[{i + 1}/{len(configs)}] {row}")

@@ -99,3 +99,108 @@ def test_standalone_stop(standalone_stack):
     # a stopped job still records its partial history (job.go:250-260)
     history = wait_history(client, job_id, timeout=60)
     assert len(history.data.train_loss) < 500
+
+
+@pytest.fixture()
+def partitioned_stack(tmp_path, tmp_home, monkeypatch):
+    """Standalone PS with TWO device-partition slots, each exposing its
+    own 2-virtual-CPU-device view to the job process (the single-chip
+    stand-in for per-job TPU_VISIBLE_DEVICES pinning)."""
+    part = {"PALLAS_AXON_POOL_IPS": "", "JAX_PLATFORMS": "cpu",
+            "JAX_NUM_CPU_DEVICES": "2"}
+    dep = start_deployment(mesh=None, standalone_jobs=True,
+                           job_partitions=[dict(part), dict(part)])
+    client = KubemlClient(dep.controller_url)
+    yield dep, client, tmp_path
+    dep.stop()
+
+
+def test_dual_standalone_jobs_with_partitions(partitioned_stack):
+    """Two CONCURRENT standalone jobs, each leasing its own device
+    partition (distinct slots while running); a third submission while
+    both slots are leased is refused 503; slots free after the
+    processes exit and a new job starts (VERDICT r1 item 10)."""
+    from kubeml_tpu.api.types import TrainTask
+    from kubeml_tpu.control.httpd import http_json
+
+    dep, client, tmp_path = partitioned_stack
+    paths = write_blob_files(tmp_path, n_train=2000)
+    client.v1().datasets().create(
+        "blobs", paths["xtr"], paths["ytr"], paths["xte"], paths["yte"])
+
+    req = TrainRequest(model_type="mlp", batch_size=16, epochs=4,
+                       dataset="blobs", lr=0.05,
+                       options=TrainOptions(default_parallelism=2, k=1,
+                                            static_parallelism=True))
+    ids = [client.v1().networks().train(req) for _ in range(2)]
+
+    # both running as processes, each holding a DIFFERENT partition
+    deadline = time.time() + 240
+    held = {}
+    while time.time() < deadline and len(held) < 2:
+        with dep.ps._jobs_lock:
+            for jid in ids:
+                rec = dep.ps.jobs.get(jid)
+                if rec is not None and rec.partition is not None:
+                    held[jid] = rec.partition
+        time.sleep(0.1)
+    assert sorted(held.values()) == [0, 1], held
+
+    # a direct /start while both slots are leased: PS refuses 503
+    extra = TrainTask(job_id="overflow1", parameters=req, parallelism=2)
+    with pytest.raises(KubeMLException) as ei:
+        http_json("POST", dep.ps.url + "/start", extra.to_dict())
+    assert ei.value.status_code == 503
+
+    # ... while the PRODUCT path does not lose the job: the scheduler
+    # requeues on 503 and starts it once a slot frees
+    third = client.v1().networks().train(req)
+
+    for jid in ids:
+        h = wait_history(client, jid, timeout=300)
+        assert len(h.data.train_loss) == 4
+        assert h.data.train_loss[-1] < h.data.train_loss[0]
+    h = wait_history(client, third, timeout=300)
+    assert len(h.data.train_loss) == 4
+    for jid in ids + [third]:
+        dep.ps.wait_for_job(jid)
+
+    # every slot released once the processes are gone
+    deadline = time.time() + 60
+    while time.time() < deadline and dep.ps._busy_partitions:
+        time.sleep(0.1)
+    assert not dep.ps._busy_partitions
+
+
+def test_crashed_job_process_releases_partition(partitioned_stack):
+    """A child that dies WITHOUT posting /finish (OOM-kill, segfault)
+    must not pin its record or its device partition: the PS watchdog
+    reaps it and frees the slot."""
+    dep, client, tmp_path = partitioned_stack
+    paths = write_blob_files(tmp_path, n_train=4000)
+    client.v1().datasets().create(
+        "blobs", paths["xtr"], paths["ytr"], paths["xte"], paths["yte"])
+    req = TrainRequest(model_type="mlp", batch_size=16, epochs=50,
+                       dataset="blobs", lr=0.05,
+                       options=TrainOptions(default_parallelism=2, k=1,
+                                            static_parallelism=True))
+    job_id = client.v1().networks().train(req)
+    deadline = time.time() + 240
+    rec = None
+    while time.time() < deadline:
+        with dep.ps._jobs_lock:
+            rec = dep.ps.jobs.get(job_id)
+        if rec is not None and rec.url is not None:
+            break
+        time.sleep(0.1)
+    assert rec is not None and rec.partition is not None
+    rec.proc.kill()  # simulated OOM-kill
+    deadline = time.time() + 60
+    while time.time() < deadline:
+        with dep.ps._jobs_lock:
+            gone = job_id not in dep.ps.jobs
+        if gone and not dep.ps._busy_partitions:
+            break
+        time.sleep(0.1)
+    assert gone
+    assert not dep.ps._busy_partitions
