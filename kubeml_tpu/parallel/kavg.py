@@ -134,7 +134,7 @@ class KAvgEngine:
 
     def __init__(self, mesh: Mesh, loss_fn: LossFn, metrics_fn: MetricsFn,
                  tx_factory: TxFactory, donate: bool = True,
-                 merge_dtype: Any = None, unroll: int = 2,
+                 merge_dtype: Any = None, unroll: int = 8,
                  batch_seq_dims: Optional[Dict[str, int]] = None):
         """donate=True donates the input variables buffer to each
         train_round (frees a full model copy of HBM) — the caller must then
@@ -151,10 +151,12 @@ class KAvgEngine:
         compression applied exactly at the communication boundary, with
         local math still in f32.
 
-        unroll: lax.scan unroll factor for the K local steps. 2 measures
-        a few percent faster than 1 on v5e (scheduling slack across step
-        boundaries) while keeping compile time bounded for large K;
-        diminishing returns beyond.
+        unroll: CAP on the lax.scan unroll factor for the K local steps
+        (actual factor = min(unroll, K)). Fully unrolling the K=8
+        headline round measures ~4% faster than unroll=2 on v5e
+        (scheduling slack across step boundaries, no scan bookkeeping);
+        the cap bounds compile time for large-K (sparse-averaging)
+        rounds where S can reach the whole-shard step count.
 
         batch_seq_dims: sequence-parallel TRAINING. Maps top-level batch
         keys to the dim (within the per-example shape) that carries the
@@ -292,7 +294,8 @@ class KAvgEngine:
             (params, model_state, _), losses = lax.scan(
                 step, (params, model_state, opt_state),
                 (chunk["batch"], chunk["sample_mask"], chunk["step_mask"],
-                 chunk["rngs"]), unroll=self.unroll)
+                 chunk["rngs"]),
+                unroll=min(self.unroll, chunk["step_mask"].shape[0]))
             return {"params": params, **model_state}, losses.sum()
 
         def lane_fn(variables, batch, sample_mask, step_mask, worker_mask,

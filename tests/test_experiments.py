@@ -121,3 +121,31 @@ def test_single_node_baseline_arm(tmp_path):
     assert len(rows) == 2
     assert rows[0]["arm"] == "single-node-baseline"
     assert rows[1]["train_loss"] <= rows[0]["train_loss"] * 1.2
+
+
+def test_real_digits_arm():
+    """The real-data arm: genuine sklearn digits on the MNIST canvas,
+    stratified 80/20, values in [0,1] with the true pixels centered."""
+    from experiments.data import real_digits
+
+    xtr, ytr, xte, yte = real_digits()
+    assert xtr.shape[1:] == (28, 28, 1) and xte.shape[1:] == (28, 28, 1)
+    assert len(xtr) + len(xte) == 1797
+    assert abs(len(xte) / 1797 - 0.2) < 0.01
+    assert xtr.min() >= 0.0 and xtr.max() <= 1.0
+    # stratified: every class present in both splits
+    import numpy as np
+    assert set(np.unique(ytr)) == set(range(10)) == set(np.unique(yte))
+    # the 8x8 payload sits centered; the border is the zero canvas
+    assert np.abs(xtr[:, :9, :, 0]).sum() == 0.0
+    assert xtr[:, 10:18, 10:18, 0].sum() > 0
+
+
+def test_lenet_digits_grid_registered():
+    from experiments.common import utils as grids
+    from experiments.train import GRIDS
+
+    spec = GRIDS["lenet-digits"]
+    assert spec["dataset"] == "digits" and spec["shuffle"] is True
+    assert spec["grid"] is grids.LENET_DIGITS_GRID
+    assert spec["tta"] == 95.0

@@ -62,16 +62,19 @@ def main(argv=None) -> int:
         # small real-valued task so both jobs visibly learn
         rng = np.random.RandomState(0)
         tmp = tempfile.mkdtemp()
+
+        def split(n):
+            y = rng.randint(0, 3, n).astype(np.int32)
+            x = rng.randn(n, 8).astype(np.float32)
+            x[np.arange(n), y * 2] += 3.0
+            return x, y
+
         paths = []
-        for name, n in (("xtr", 2000), ("ytr", 2000), ("xte", 200),
-                        ("yte", 200)):
-            if name.startswith("x"):
-                y = rng.randint(0, 3, n)
-                x = rng.randn(n, 8).astype(np.float32)
-                x[np.arange(n), y * 2] += 3.0
-                arr, yarr = x, y.astype(np.int32)
+        for name, arr in zip(("xtr", "ytr", "xte", "yte"),
+                             [a for s in (split(2000), split(200))
+                              for a in s]):
             p = f"{tmp}/{name}.npy"
-            np.save(p, arr if name.startswith("x") else yarr)
+            np.save(p, arr)
             paths.append(p)
         client.v1().datasets().create("blobs", *paths)
 
@@ -83,8 +86,13 @@ def main(argv=None) -> int:
         ids = [client.v1().networks().train(req) for _ in range(2)]
         print(f"submitted jobs: {ids}")
 
+        from kubeml_tpu.api.errors import KubeMLException
+
+        deadline = time.time() + 300
         seen = {}
         while len(seen) < 2:
+            if time.time() > deadline:
+                raise TimeoutError("jobs never leased their partitions")
             with dep.ps._jobs_lock:
                 for jid in ids:
                     rec = dep.ps.jobs.get(jid)
@@ -97,10 +105,13 @@ def main(argv=None) -> int:
 
         for jid in ids:
             while True:
+                if time.time() > deadline:
+                    raise TimeoutError(f"no history for job {jid} (did "
+                                       "its process crash?)")
                 try:
                     h = client.v1().histories().get(jid)
                     break
-                except Exception:
+                except KubeMLException:
                     time.sleep(0.5)
             print(f"job {jid}: loss {h.data.train_loss[0]:.3f} -> "
                   f"{h.data.train_loss[-1]:.3f}, "
