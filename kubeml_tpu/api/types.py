@@ -51,6 +51,12 @@ class TrainOptions:
     n_model: int = 1
     n_seq: int = 1
     seq_impl: str = "ring"         # 'ring' | 'ulysses'
+    # net-new guard: cap on scheduler-driven parallelism growth. The
+    # reference's throughput policy only floor-clamps at 1
+    # (policy.go:75-90), so a long dynamic job monotonically accretes
+    # workers and re-lowers its round program at every change; 0 keeps
+    # that parity behavior, N > 0 stops growth at N
+    max_parallelism: int = 0
 
     def to_dict(self) -> dict:
         return {
@@ -65,6 +71,7 @@ class TrainOptions:
             "n_model": self.n_model,
             "n_seq": self.n_seq,
             "seq_impl": self.seq_impl,
+            "max_parallelism": self.max_parallelism,
         }
 
     @classmethod
@@ -81,6 +88,7 @@ class TrainOptions:
             n_model=int(d.get("n_model", 1)),
             n_seq=int(d.get("n_seq", 1)),
             seq_impl=d.get("seq_impl", "ring"),
+            max_parallelism=int(d.get("max_parallelism", 0)),
         )
 
 

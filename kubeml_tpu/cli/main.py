@@ -79,7 +79,8 @@ def cmd_train(args):
             shuffle=args.shuffle,
             n_model=args.tensor_parallel,
             n_seq=args.seq_parallel,
-            seq_impl=args.seq_impl))
+            seq_impl=args.seq_impl,
+            max_parallelism=args.max_parallelism))
     job_id = client.v1().networks().train(req)
     print(job_id)
 
@@ -323,6 +324,9 @@ def build_parser() -> argparse.ArgumentParser:
     t.add_argument("--seq-impl", choices=("ring", "ulysses"),
                    default="ring",
                    help="sequence-parallel attention implementation")
+    t.add_argument("--max-parallelism", type=int, default=0, metavar="N",
+                   help="cap scheduler-driven parallelism growth at N "
+                        "(0 = unbounded, reference parity)")
     t.set_defaults(fn=cmd_train)
 
     i = sub.add_parser("infer", help="run inference on a trained model")

@@ -176,6 +176,14 @@ class TrainJob:
                     new_p = self.callbacks.request_parallelism(self.task)
                     if new_p and not limit_parallelism():
                         parallelism = max(1, int(new_p))
+                        if opts.max_parallelism > 0:
+                            # growth cap (net-new guard): without it the
+                            # reference policy accretes workers without
+                            # bound and re-lowers the round program at
+                            # every change (policy.go:75-90 floor-clamps
+                            # at 1 only)
+                            parallelism = min(parallelism,
+                                              opts.max_parallelism)
 
                 val_loss, accuracy = float("nan"), float("nan")
                 if opts.validate_every > 0 and \

@@ -453,3 +453,17 @@ def test_job_parallelism_option_validation(setup):
                m=get_builtin("bert-tiny")(), match="divisible")
     # SP on a model with no seq support
     expect_400(lambda o: setattr(o, "n_seq", 2), match="sequence")
+
+
+def test_max_parallelism_caps_scheduler_growth(setup):
+    """options.max_parallelism stops the reference policy's unbounded
+    worker accretion (policy.go:75-90 floor-clamps at 1 only)."""
+    reg, store, model, mesh = setup
+    task = make_task(job_id="capjob1", epochs=4, static=False)
+    task.parameters.options.max_parallelism = 3
+
+    job = TrainJob(task, model, ToyDataset(), mesh, registry=reg,
+                   callbacks=JobCallbacks(
+                       request_parallelism=lambda t: t.parallelism + 1))
+    record = job.train()
+    assert record.data.parallelism == [2, 3, 3, 3]
