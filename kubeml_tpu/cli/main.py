@@ -51,6 +51,8 @@ def cmd_train(args):
         _fail("epochs must be positive")
     if args.tensor_parallel < 1 or args.seq_parallel < 1:
         _fail("--tensor-parallel/--seq-parallel must be >= 1")
+    if args.max_parallelism < 0:
+        _fail("--max-parallelism must be >= 0")
     if args.tensor_parallel > 1 and args.seq_parallel > 1:
         _fail("tensor and sequence parallelism cannot be combined in "
               "one job yet; pick one")

@@ -562,16 +562,26 @@ class GPTMini(KubeModel):
         scanning its group. x: [B, T] full-length (pad-free) token rows
         with B divisible by `microbatches`. Returns [B, T, vocab] logits
         equal to the dense forward up to bf16 noise.
+
+        MoE trunks pipeline too (round 2): experts are replicated per
+        stage (no ep_mesh), routing capacity is computed PER MICROBATCH
+        — the standard pipelined-MoE semantics, equal to the
+        per-microbatch sequential reference, NOT bit-equal to the
+        full-batch dense forward — and the per-block load-balance
+        losses accumulate across real ticks, so the call returns
+        (logits, aux) with aux normalized like the dense loss
+        (mean per layer per microbatch).
         """
         from kubeml_tpu.parallel.mesh import STAGE_AXIS
         from kubeml_tpu.parallel.pp import (pipeline_apply,
                                             stack_stage_params)
 
         module = self.module
-        if module.n_experts:
-            raise NotImplementedError(
-                "pipelined MoE is not supported (expert capacity is "
-                "computed per microbatch)")
+        if module.n_experts and module.ep_mesh is not None:
+            raise ValueError(
+                "pipelined MoE runs with replicated experts per stage; "
+                "construct the model without ep_mesh (expert-axis "
+                "sharding does not compose with the stage shard_map)")
         n_stage = mesh.shape[STAGE_AXIS]
         L = module.layers
         if L % n_stage:
@@ -595,21 +605,44 @@ class GPTMini(KubeModel):
                              "mask); use the dense forward for padded "
                              "batches")
 
+        moe = bool(module.n_experts)
         key = (mesh, M)
         if not hasattr(self, "_pp_cache"):
             self._pp_cache = {}
         if key not in self._pp_cache:
             block = DecoderBlock(module.hidden, module.heads, module.ffn,
-                                 0.0, module.dtype)
+                                 0.0, module.dtype,
+                                 n_experts=module.n_experts,
+                                 moe_k=module.moe_k,
+                                 capacity_factor=module.capacity_factor)
 
             def stage_fn(p, act):
                 ones = jnp.ones(act.shape[:2], jnp.float32)
+                if not moe:
+                    def body(a, pj):
+                        return block.apply({"params": pj}, a, ones,
+                                           False), None
 
-                def body(a, pj):
-                    return block.apply({"params": pj}, a, ones, False), None
+                    act, _ = lax.scan(body, act, p)
+                    return act
 
-                act, _ = lax.scan(body, act, p)
-                return act
+                # MoE: each block sows its load-balance aux; routing
+                # capacity is computed PER MICROBATCH (the pipelined
+                # semantics — documented in the docstring)
+                def body(carry, pj):
+                    a, aux = carry
+                    out, st = block.apply({"params": pj}, a, ones, False,
+                                          mutable=["intermediates"])
+                    # the MoE combine returns f32; the pipeline carries
+                    # activations in the module compute dtype
+                    out = out.astype(a.dtype)
+                    aux = aux + jnp.asarray(
+                        sum(jax.tree_util.tree_leaves(st)), jnp.float32)
+                    return (out, aux), None
+
+                (act, aux), _ = lax.scan(
+                    body, (act, jnp.float32(0.0)), p)
+                return act, aux
 
             def fwd(variables, x):
                 params = variables["params"]
@@ -623,12 +656,19 @@ class GPTMini(KubeModel):
                 h = emb[x] + params["pos_embed"]["embedding"][
                     jnp.arange(T)].astype(module.dtype)[None]
                 h = h.reshape(M, B // M, T, module.hidden)
-                h = pipeline_apply(stage_fn, stage_params, h, mesh)
+                out = pipeline_apply(stage_fn, stage_params, h, mesh,
+                                     has_aux=moe)
+                h, aux = out if moe else (out, None)
                 h = h.reshape(B, T, module.hidden)
                 ln = nn.LayerNorm(dtype=jnp.float32)
                 h = ln.apply({"params": params["LayerNorm_0"]}, h)
-                logits = h.astype(module.dtype) @ emb.T
-                return logits.astype(jnp.float32)
+                logits = (h.astype(module.dtype) @ emb.T).astype(
+                    jnp.float32)
+                if moe:
+                    # mean per layer per microbatch — the pipelined
+                    # analog of the dense loss's sum(sown)/layers
+                    return logits, aux / (module.layers * M)
+                return logits
 
             self._pp_cache[key] = jax.jit(fwd)
         return self._pp_cache[key](variables, x)

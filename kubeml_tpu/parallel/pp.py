@@ -19,6 +19,13 @@ the same ICI ring in the opposite direction.
 Restriction (by construction of the SPMD formulation): every stage maps
 activations of one fixed shape to the same shape. Embed/head layers that
 change shape run outside the pipelined trunk (see `models/`).
+
+Stages may also emit a scalar auxiliary output (`has_aux=True` —
+stage_fn returns `(activation, aux)`): aux values from REAL ticks are
+summed across microbatches and stages (fill/drain ticks, whose inputs
+are clipped garbage, are masked out). This is what lets MoE blocks ride
+the pipeline — their sown load-balance losses accumulate exactly as in
+the sequential reference.
 """
 
 from __future__ import annotations
@@ -34,6 +41,7 @@ from kubeml_tpu.parallel.mesh import STAGE_AXIS
 
 PyTree = Any
 # stage_fn(stage_params, activation [B, ...]) -> activation [B, ...]
+#   (or -> (activation, aux_scalar) with has_aux=True)
 StageFn = Callable[[PyTree, jax.Array], jax.Array]
 
 
@@ -49,14 +57,18 @@ def stack_stage_params(params_list: Sequence[PyTree]) -> PyTree:
 
 
 def pipeline_apply(stage_fn: StageFn, stage_params: PyTree, x: jax.Array,
-                   mesh: Mesh) -> jax.Array:
+                   mesh: Mesh, has_aux: bool = False):
     """Run x through P pipeline stages with microbatch pipelining.
 
     stage_params: pytree with leading dim [P] on every leaf (see
         `stack_stage_params`), laid out over the mesh `stage` axis.
     x: [M, B, ...] — M microbatches. More microbatches = smaller bubble
         fraction (bubble = (P-1)/(M+P-1) of ticks).
-    Returns [M, B, ...] outputs, replicated over the stage axis.
+    has_aux: stage_fn returns (activation, aux_scalar); the call then
+        returns (outputs, aux_sum) with aux summed over every REAL
+        (stage, microbatch) pair — fill/drain ticks masked out.
+    Returns [M, B, ...] outputs, replicated over the stage axis
+    (plus the aux scalar when has_aux).
     """
     n_stage = mesh.shape[STAGE_AXIS]
     for leaf in jax.tree_util.tree_leaves(stage_params):
@@ -72,7 +84,8 @@ def pipeline_apply(stage_fn: StageFn, stage_params: PyTree, x: jax.Array,
         m = xs.shape[0]
         perm = [(i, (i + 1) % n_stage) for i in range(n_stage)]
 
-        def tick(act, t):
+        def tick(carry, t):
+            act, aux_sum = carry
             # Stage 0 injects microbatch t (clipped during drain ticks —
             # those outputs never reach the collected window); others
             # consume the activation ppermuted in on the previous tick.
@@ -81,28 +94,37 @@ def pipeline_apply(stage_fn: StageFn, stage_params: PyTree, x: jax.Array,
                                 xs, jnp.clip(t, 0, m - 1), keepdims=False),
                             act)
             out = stage_fn(params, inp)
+            if has_aux:
+                out, aux = out
+                # stage s processes microbatch (t - s): real iff it is
+                # in [0, m) — fill/drain ticks chew clipped garbage whose
+                # aux must not pollute the sum
+                real = ((t >= sid) & (t - sid < m)).astype(jnp.float32)
+                aux_sum = aux_sum + aux.astype(jnp.float32) * real
             nxt = lax.ppermute(out, STAGE_AXIS, perm)
-            return nxt, out
+            return (nxt, aux_sum), out
 
-        _, outs = lax.scan(tick, jnp.zeros_like(xs[0]),
-                           jnp.arange(m + n_stage - 1))
+        (_, aux_sum), outs = lax.scan(
+            tick, (jnp.zeros_like(xs[0]), jnp.float32(0.0)),
+            jnp.arange(m + n_stage - 1))
         # Microbatch j finishes on the last stage at tick j + P - 1.
         ys = outs[n_stage - 1:]
         # Zero everywhere but the last stage, then psum-broadcast so the
         # result is replicated (out_spec P() below).
         ys = jnp.where(sid == n_stage - 1, ys, jnp.zeros_like(ys))
-        return lax.psum(ys, STAGE_AXIS)
+        return lax.psum(ys, STAGE_AXIS), lax.psum(aux_sum, STAGE_AXIS)
 
     sharded = jax.shard_map(
         lane, mesh=mesh,
         in_specs=(P(STAGE_AXIS), P()),
-        out_specs=P(),
+        out_specs=(P(), P()),
         check_vma=False)
-    return sharded(stage_params, x)
+    ys, aux = sharded(stage_params, x)
+    return (ys, aux) if has_aux else ys
 
 
 def sequential_apply(stage_fn: StageFn, stage_params: PyTree,
-                     x: jax.Array) -> jax.Array:
+                     x: jax.Array, has_aux: bool = False):
     """Reference semantics: the same chain with no pipelining.
 
     stage_params leaves [P, ...]; x [M, B, ...]. Used by tests and as the
@@ -111,10 +133,14 @@ def sequential_apply(stage_fn: StageFn, stage_params: PyTree,
     n_stage = jax.tree_util.tree_leaves(stage_params)[0].shape[0]
 
     def one(mb):
-        act = mb
+        act, aux_sum = mb, jnp.float32(0.0)
         for s in range(n_stage):
             p = jax.tree_util.tree_map(lambda q: q[s], stage_params)
             act = stage_fn(p, act)
-        return act
+            if has_aux:
+                act, aux = act
+                aux_sum = aux_sum + aux.astype(jnp.float32)
+        return act, aux_sum
 
-    return jax.vmap(one)(x)
+    ys, auxes = jax.vmap(one)(x)
+    return (ys, auxes.sum()) if has_aux else ys

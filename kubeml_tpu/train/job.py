@@ -159,6 +159,14 @@ class TrainJob:
                 self.req.options.default_parallelism
             epochs = self.req.epochs
             opts = self.req.options
+            if opts.max_parallelism < 0:
+                raise KubeMLException(
+                    f"max_parallelism must be >= 0, got "
+                    f"{opts.max_parallelism}", 400)
+            if opts.max_parallelism > 0:
+                # the cap binds from epoch 1, not only at the first
+                # scheduler adjustment
+                parallelism = min(parallelism, opts.max_parallelism)
 
             last_ckpt_epoch = -1
             for epoch in range(epochs):

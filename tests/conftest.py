@@ -80,6 +80,8 @@ SLOW_TESTS = {
     "test_parallel_pp_ep.py::test_pipeline_grads_match",
     "test_parallel_pp_ep.py::test_moe_grads_finite",
     "test_parallel_pp_ep.py::test_pipeline_training_converges",
+    "test_parallel_pp_ep.py::test_pipeline_aux_matches_sequential",
+    "test_parallel_pp_ep.py::test_moe_trunk_pipelines",
     # distributed / deployment / control-plane long paths
     "test_distributed.py::test_kavg_round_over_multislice_mesh",
     "test_distributed_multiprocess.py::"

@@ -457,7 +457,9 @@ def test_job_parallelism_option_validation(setup):
 
 def test_max_parallelism_caps_scheduler_growth(setup):
     """options.max_parallelism stops the reference policy's unbounded
-    worker accretion (policy.go:75-90 floor-clamps at 1 only)."""
+    worker accretion (policy.go:75-90 floor-clamps at 1 only), binds
+    from epoch 1, and rejects negative values."""
+    from kubeml_tpu.api.errors import KubeMLException
     reg, store, model, mesh = setup
     task = make_task(job_id="capjob1", epochs=4, static=False)
     task.parameters.options.max_parallelism = 3
@@ -467,3 +469,16 @@ def test_max_parallelism_caps_scheduler_growth(setup):
                        request_parallelism=lambda t: t.parallelism + 1))
     record = job.train()
     assert record.data.parallelism == [2, 3, 3, 3]
+
+    # the cap binds on the INITIAL parallelism too
+    over = make_task(job_id="capjob2", epochs=1, parallelism=8)
+    over.parameters.options.max_parallelism = 3
+    rec2 = TrainJob(over, model, ToyDataset(), mesh,
+                    registry=reg).train()
+    assert rec2.data.parallelism == [3]
+
+    bad = make_task(job_id="capjob3", epochs=1)
+    bad.parameters.options.max_parallelism = -2
+    with pytest.raises(KubeMLException) as ei:
+        TrainJob(bad, model, ToyDataset(), mesh, registry=reg).train()
+    assert ei.value.status_code == 400

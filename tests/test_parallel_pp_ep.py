@@ -225,3 +225,104 @@ def test_moe_training_converges():
     for _ in range(80):
         params, opt, loss = step(params, opt)
     assert float(loss) < l0 * 0.7, (l0, float(loss))
+
+
+def test_pipeline_aux_matches_sequential():
+    """has_aux: per-stage scalar outputs accumulate over REAL
+    (stage, microbatch) pairs only — fill/drain garbage ticks masked —
+    and equal the sequential reference exactly."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from kubeml_tpu.parallel.mesh import make_mesh
+    from kubeml_tpu.parallel.pp import (pipeline_apply, sequential_apply,
+                                        stack_stage_params)
+
+    rng = np.random.RandomState(0)
+    P_, M, B, F = 4, 6, 2, 8
+    mesh = make_mesh(n_data=1, n_stage=P_)
+    stages = stack_stage_params([
+        {"w": jnp.asarray(rng.randn(F, F).astype(np.float32) / 3)}
+        for _ in range(P_)])
+    x = jnp.asarray(rng.randn(M, B, F).astype(np.float32))
+
+    def stage_fn(p, a):
+        out = jnp.tanh(a @ p["w"])
+        return out, (out ** 2).sum()  # nonzero aux per real tick
+
+    ys_ref, aux_ref = sequential_apply(stage_fn, stages, x, has_aux=True)
+    ys, aux = pipeline_apply(stage_fn, stages, x, mesh, has_aux=True)
+    np.testing.assert_allclose(np.asarray(ys), np.asarray(ys_ref),
+                               rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(float(aux), float(aux_ref), rtol=1e-5)
+    # grads flow through the aux path too
+    g = jax.grad(lambda s: pipeline_apply(
+        stage_fn, s, x, mesh, has_aux=True)[1])(stages)
+    g_ref = jax.grad(lambda s: sequential_apply(
+        stage_fn, s, x, has_aux=True)[1])(stages)
+    np.testing.assert_allclose(np.asarray(g["w"]), np.asarray(g_ref["w"]),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_moe_trunk_pipelines():
+    """Pipelined MoE (round 2, lifting the r1 restriction): the MoE
+    decoder trunk rides the stage pipeline with per-microbatch routing
+    capacity, equal to the per-microbatch sequential reference, with
+    the load-balance aux accumulated."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from kubeml_tpu.parallel.mesh import make_mesh
+    from tests.test_models_gpt import TinyMoE, make_lm_task
+
+    model = TinyMoE()
+    rng = np.random.RandomState(0)
+    B, T, M = 8, 16, 4
+    x = make_lm_task(rng, B)[:, :T]
+    variables = model.init_variables(jax.random.PRNGKey(0),
+                                     {"x": jnp.asarray(x)})
+    mesh = make_mesh(n_data=4, n_stage=2)
+    logits, aux = model.forward_pipelined(variables, jnp.asarray(x), mesh,
+                                          microbatches=M)
+    assert logits.shape == (B, T, 64)
+    assert np.isfinite(np.asarray(logits)).all()
+    assert float(aux) > 0.0  # load-balance loss accumulated
+
+    # per-microbatch sequential reference: same capacity semantics by
+    # construction -> near-exact parity (bf16 noise only)
+    from kubeml_tpu.models.gpt import DecoderBlock
+    module = model.module
+    block = DecoderBlock(module.hidden, module.heads, module.ffn, 0.0,
+                         module.dtype, n_experts=module.n_experts,
+                         moe_k=module.moe_k,
+                         capacity_factor=module.capacity_factor)
+    params = variables["params"]
+    emb = params["tok_embed"]["embedding"].astype(module.dtype)
+    h = emb[jnp.asarray(x)] + params["pos_embed"]["embedding"][
+        jnp.arange(T)].astype(module.dtype)[None]
+    h = h.reshape(M, B // M, T, module.hidden)
+
+    outs, aux_ref = [], 0.0
+    for mb in range(M):
+        a = h[mb]
+        ones = jnp.ones(a.shape[:2], jnp.float32)
+        for l in range(module.layers):
+            a, st = block.apply({"params": params[f"layer_{l}"]}, a,
+                                ones, False, mutable=["intermediates"])
+            # match the pipeline's carry dtype (activations ride the
+            # ring in the module compute dtype)
+            a = a.astype(module.dtype)
+            aux_ref += float(sum(jax.tree_util.tree_leaves(st)))
+        outs.append(a)
+    hr = jnp.stack(outs).reshape(B, T, module.hidden)
+    import flax.linen as nn
+    hr = nn.LayerNorm(dtype=jnp.float32).apply(
+        {"params": params["LayerNorm_0"]}, hr)
+    ref_logits = (hr.astype(module.dtype) @ emb.T).astype(jnp.float32)
+
+    np.testing.assert_allclose(np.asarray(logits), np.asarray(ref_logits),
+                               rtol=5e-2, atol=5e-2)
+    np.testing.assert_allclose(float(aux),
+                               aux_ref / (module.layers * M), rtol=1e-3)
