@@ -140,3 +140,29 @@ def test_serve_role_flags_parse():
     assert args.scheduler_url == "http://h:1" and args.standalone_jobs
     args = p.parse_args(["serve"])
     assert args.role == "all" and not args.standalone_jobs
+
+
+def test_env_spec_parser():
+    """';' separates pairs so VALUES may carry commas (device lists) —
+    the --job-partition grammar."""
+    from kubeml_tpu.utils.env import parse_env_spec
+    assert parse_env_spec("TPU_VISIBLE_DEVICES=0,1") == {
+        "TPU_VISIBLE_DEVICES": "0,1"}
+    assert parse_env_spec("A=1;B=x,y; C=z") == {
+        "A": "1", "B": "x,y", "C": "z"}
+    with pytest.raises(ValueError, match="KEY=VALUE"):
+        parse_env_spec("NOVALUE")
+
+
+def test_serve_job_partition_flag_parses():
+    from kubeml_tpu.cli.main import build_parser
+    p = build_parser()
+    args = p.parse_args(["serve", "--standalone-jobs",
+                         "--job-partition", "TPU_VISIBLE_DEVICES=0,1",
+                         "--job-partition",
+                         "TPU_VISIBLE_DEVICES=2,3;FOO=bar"])
+    assert args.job_partition == ["TPU_VISIBLE_DEVICES=0,1",
+                                  "TPU_VISIBLE_DEVICES=2,3;FOO=bar"]
+    from kubeml_tpu.utils.env import parse_env_spec
+    parts = [parse_env_spec(s) for s in args.job_partition]
+    assert parts[1] == {"TPU_VISIBLE_DEVICES": "2,3", "FOO": "bar"}
