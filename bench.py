@@ -48,6 +48,31 @@ BASELINE_TIMED_EPOCHS = 2  # the arm exists for the ratio, not the curve
 
 
 def main():
+    import subprocess
+    import sys
+
+    # fail FAST if the accelerator backend is unreachable (a wedged
+    # tunnel relay hangs the first device op indefinitely — observed on
+    # the axon relay, and the hang sits inside a C call so an in-process
+    # SIGALRM never fires): probe the backend in a SUBPROCESS with a
+    # hard timeout, turning an indefinite driver stall into a clear
+    # error exit before the heavy work starts.
+    try:
+        subprocess.run(
+            [sys.executable, "-c",
+             "import jax, numpy; "
+             "numpy.asarray(jax.numpy.ones((8, 8)).sum())"],
+            timeout=180, check=True, capture_output=True)
+    except subprocess.TimeoutExpired:
+        print("bench: accelerator backend unreachable (probe timed out "
+              "after 180s) — relay/tunnel wedged?", file=sys.stderr)
+        sys.exit(3)
+    except subprocess.CalledProcessError as e:
+        tail = (e.stderr or b"").decode(errors="replace").strip()
+        print("bench: backend probe failed:\n"
+              + "\n".join(tail.splitlines()[-8:]), file=sys.stderr)
+        sys.exit(3)
+
     import jax
     import jax.numpy as jnp
     import numpy as np
