@@ -53,16 +53,66 @@ def _block_attn(q, k, v, bias):
     return acc.astype(jnp.float32), m, l
 
 
+def _block_attn_flash(q, k, v, kv_mask, causal, interpret):
+    """The same per-block partials, computed by the pallas flash kernel
+    (ops/pallas) — O(block) VMEM and MXU-saturating tiles instead of the
+    materialized [Tq, Tk] score tensor. The kernel's saved row stats
+    reconstruct the un-normalized numerator: num = out * l.
+
+    kv_mask [B, Tk] (1 = attendable); causal applies the ALIGNED
+    diagonal mask (used for the local block only — ring off-diagonal
+    blocks express causality through kv_mask instead).
+    """
+    from kubeml_tpu.ops.pallas.flash_attention import (DEFAULT_BLOCK_K,
+                                                       DEFAULT_BLOCK_Q,
+                                                       _fa_forward)
+
+    B, T, H, D = q.shape
+    out, m_rows, l_rows = _fa_forward(
+        q, k, v, kv_mask.astype(jnp.float32), causal,
+        DEFAULT_BLOCK_Q, DEFAULT_BLOCK_K, interpret)
+    m = m_rows.reshape(B, H, T)
+    l = l_rows.reshape(B, H, T)
+    num = out.astype(jnp.float32) * l.transpose(0, 2, 1)[..., None]
+    return num, m, l
+
+
+def _merge_partials(acc, m, l, a_blk, m_blk, l_blk):
+    """Fold one block's (num, max, denom) into the running online-softmax
+    state — THE merge rule shared by the dense and flash block paths."""
+    new_m = jnp.maximum(m, m_blk)
+    old_scale = jnp.exp(m - new_m)              # [B, H, Tq]
+    blk_scale = jnp.exp(m_blk - new_m)
+    l = l * old_scale + l_blk * blk_scale
+    # scales are [B, H, Tq]; acc is [B, Tq, H, D]
+    acc = acc * old_scale.transpose(0, 2, 1)[..., None] + \
+        a_blk * blk_scale.transpose(0, 2, 1)[..., None]
+    return acc, new_m, l
+
+
 def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
                    q_pos: jax.Array, kv_pos: jax.Array,
                    kv_mask: jax.Array, causal: bool = False,
-                   axis_name: str = SEQ_AXIS) -> jax.Array:
+                   axis_name: str = SEQ_AXIS,
+                   use_flash: bool = False,
+                   interpret: bool = False) -> jax.Array:
     """Sequence-parallel attention body (call inside shard_map/jit).
 
     Per-device shapes: q/k/v [B, T_local, H, D]; q_pos/kv_pos [T_local]
     global token positions; kv_mask [B, T_local] 1 = real token. Returns
     the attention output for the local Q block, [B, T_local, H, D], equal
     to full attention over the global sequence.
+
+    use_flash swaps the per-block computation for the pallas flash
+    kernel (forward-only — the per-block pallas partials have no VJP;
+    training rings keep the differentiable dense blocks). The flash
+    path assumes the STANDARD contiguous shard layout (shard s holds
+    global positions [s*T_local, (s+1)*T_local) — what
+    ring_self_attention and the model modules construct): causality
+    then reduces to an aligned-diagonal mask on the local block plus a
+    whole-block keep/drop per ring step, so arbitrary q_pos/kv_pos are
+    not consulted. interpret runs the kernel in the pallas interpreter
+    (CPU tests).
     """
     n = lax.axis_size(axis_name)
     perm = [(i, (i + 1) % n) for i in range(n)]
@@ -77,24 +127,37 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
 
     # local KV block first, then n-1 rotate-and-accumulate steps — no
     # wasted final ppermute (each rotation's result is always consumed)
-    acc0, m0, l0 = _block_attn(q, k, v, bias_for(kv_pos, kv_mask))
+    if use_flash:
+        sid = lax.axis_index(axis_name)
+        acc0, m0, l0 = _block_attn_flash(q, k, v, kv_mask, causal,
+                                         interpret)
+    else:
+        acc0, m0, l0 = _block_attn(q, k, v, bias_for(kv_pos, kv_mask))
 
-    def step(carry, _):
+    def step(carry, s):
         acc, m, l, kb, vb, posb, maskb = carry
         kb, vb, posb, maskb = [
             lax.ppermute(t, axis_name, perm) for t in (kb, vb, posb, maskb)]
-        a_blk, m_blk, l_blk = _block_attn(q, kb, vb, bias_for(posb, maskb))
-        new_m = jnp.maximum(m, m_blk)
-        old_scale = jnp.exp(m - new_m)          # [B, H, Tq]
-        blk_scale = jnp.exp(m_blk - new_m)
-        l = l * old_scale + l_blk * blk_scale
-        # scales are [B, H, Tq]; acc is [B, Tq, H, D]
-        acc = acc * old_scale.transpose(0, 2, 1)[..., None] + \
-            a_blk * blk_scale.transpose(0, 2, 1)[..., None]
-        return (acc, new_m, l, kb, vb, posb, maskb), None
+        if use_flash:
+            eff_mask = maskb
+            if causal:
+                # after s rotations this device holds shard (sid - s)'s
+                # block: under the contiguous layout it is fully visible
+                # iff it sits strictly before this device's shard (the
+                # diagonal was step 0); a dropped block's all-masked
+                # partials carry m = NEG_INF and merge with weight zero
+                j = (sid - s) % n
+                eff_mask = maskb * (j < sid).astype(maskb.dtype)
+            a_blk, m_blk, l_blk = _block_attn_flash(
+                q, kb, vb, eff_mask, False, interpret)
+        else:
+            a_blk, m_blk, l_blk = _block_attn(q, kb, vb,
+                                              bias_for(posb, maskb))
+        acc, m, l = _merge_partials(acc, m, l, a_blk, m_blk, l_blk)
+        return (acc, m, l, kb, vb, posb, maskb), None
 
     (acc, m, l, *_), _ = lax.scan(
-        step, (acc0, m0, l0, k, v, kv_pos, kv_mask), None, length=n - 1)
+        step, (acc0, m0, l0, k, v, kv_pos, kv_mask), jnp.arange(1, n))
     # rows with zero real keys (all-pad) have l ~ n*exp(0)=0? No: fully
     # masked rows keep m = NEG_INF and l from exp(0)=1 terms per block, so
     # the division is finite; still guard for safety.
@@ -104,9 +167,13 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
 
 def ring_self_attention(q: jax.Array, k: jax.Array, v: jax.Array,
                         pad_mask: jax.Array, mesh: Mesh,
-                        causal: bool = False) -> jax.Array:
+                        causal: bool = False,
+                        use_flash: bool = False,
+                        interpret: bool = False) -> jax.Array:
     """Host-callable wrapper: shards [B, T, H, D] tensors over the mesh
     `seq` axis and runs ring_attention. T must divide by the seq-axis size.
+    use_flash routes each ring block through the pallas flash kernel
+    (forward-only; see ring_attention).
     """
     n = mesh.shape[SEQ_AXIS]
     B, T, H, D = q.shape
@@ -116,7 +183,8 @@ def ring_self_attention(q: jax.Array, k: jax.Array, v: jax.Array,
 
     def body(q, k, v, q_pos, kv_pos, kv_mask):
         return ring_attention(q, k, v, q_pos[0], kv_pos[0], kv_mask,
-                              causal=causal)
+                              causal=causal, use_flash=use_flash,
+                              interpret=interpret)
 
     seq_spec = P(None, SEQ_AXIS, None, None)
     sharded = jax.shard_map(
