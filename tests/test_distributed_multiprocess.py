@@ -94,3 +94,26 @@ def test_checkpoint_written_by_coordinator(dist_run):
     for k, leaf in zip(sorted(a.files, key=int),
                        jax.tree_util.tree_leaves(variables)):
         np.testing.assert_array_equal(a[k], np.asarray(leaf))
+
+
+def test_launcher_argument_validation():
+    """The launcher's mode rules: emulation needs no coordinator; real
+    multi-host mode requires --coordinator and --process-id; a missing
+    command errors."""
+    import tools.launch_distributed as ld
+
+    with pytest.raises(SystemExit):
+        ld.main(["--processes", "2"])  # no command
+    with pytest.raises(SystemExit):
+        ld.main(["--processes", "2", "--", "true"])  # real mode, no coord
+    with pytest.raises(SystemExit):  # real mode needs --process-id
+        ld.main(["--processes", "2", "--coordinator", "h:1", "--",
+                 "true"])
+    # emulation mode: spawns the command with the cluster env set
+    rc = ld.main(["--processes", "2", "--emulate-cpu", "1", "--",
+                  sys.executable, "-c",
+                  "import os; "
+                  "assert os.environ['KUBEML_NUM_PROCESSES'] == '2'; "
+                  "assert os.environ['JAX_NUM_CPU_DEVICES'] == '1'; "
+                  "assert 'KUBEML_COORDINATOR_ADDRESS' in os.environ"])
+    assert rc == 0
