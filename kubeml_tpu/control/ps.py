@@ -55,6 +55,7 @@ from kubeml_tpu.control.httpd import JsonService, Raw, Request, http_json
 from kubeml_tpu.data.registry import DatasetRegistry
 from kubeml_tpu.metrics.prom import MetricsRegistry
 from kubeml_tpu.models.base import InferenceInputError, KubeDataset
+from kubeml_tpu.parallel.distributed import CLUSTER_ENV_VARS
 from kubeml_tpu.parallel.mesh import make_mesh
 from kubeml_tpu.train.checkpoint import (checkpoint_saved_at,
                                          load_checkpoint)
@@ -355,10 +356,11 @@ class ParameterServer(JsonService):
         # the job child must NOT inherit the parent's jax.distributed
         # rank: on multi-host serve these vars hold the PARENT's
         # coordinator/rank, and a child re-joining as that rank hangs
-        # the cluster. Multi-host job processes get their own topology
-        # via job_env/partition env when wanted.
-        for var in ("KUBEML_COORDINATOR_ADDRESS", "KUBEML_NUM_PROCESSES",
-                    "KUBEML_PROCESS_ID"):
+        # the cluster (at best a 300s rendezvous timeout). This covers
+        # every family jobserver's initialize()/jax auto-detect triggers
+        # on, not just our own vars. Multi-host job processes get their
+        # own topology via job_env/partition env when wanted.
+        for var in CLUSTER_ENV_VARS:
             env.pop(var, None)
         env.update(self.job_env)
         if rec.partition is not None:

@@ -19,6 +19,7 @@ from __future__ import annotations
 import collections
 import logging
 import threading
+import time
 from typing import Deque, Optional
 
 from kubeml_tpu.api.errors import InvalidArgsError, KubeMLException
@@ -62,6 +63,9 @@ class Scheduler(JsonService):
         self.ps_url = ps_url
         self.policy = policy or ThroughputBasedPolicy()
         self.queue = SchedulerQueue()
+        # capacity-deferred tasks parked with a not-before stamp so the
+        # backoff applies per task, not to the whole scheduling loop
+        self._deferred: list = []  # [(not_before_monotonic, task)]
         self._stop = threading.Event()
         self._loop_thread: Optional[threading.Thread] = None
 
@@ -119,6 +123,14 @@ class Scheduler(JsonService):
 
     def _schedule_loop(self):
         while not self._stop.is_set():
+            # re-admit ripe deferred tasks (loop thread owns _deferred)
+            if self._deferred:
+                now = time.monotonic()
+                ripe = [t for nb, t in self._deferred if nb <= now]
+                self._deferred = [(nb, t) for nb, t in self._deferred
+                                  if nb > now]
+                for t in ripe:
+                    self.queue.push(t)
             task = self.queue.pop(timeout=0.5)
             if task is None:
                 continue
@@ -135,8 +147,10 @@ class Scheduler(JsonService):
                     logger.info("task %s deferred (%s); requeueing",
                                 task.job_id, e.message)
                     self.policy.task_finished(task.job_id)
-                    self._stop.wait(0.5)  # don't hot-spin against the PS
-                    self.queue.push(task)
+                    # park THIS task with a not-before backoff; other
+                    # queued tasks keep dispatching at full rate (an
+                    # inline sleep here would stall the whole loop)
+                    self._deferred.append((time.monotonic() + 0.5, task))
                 else:
                     logger.exception("scheduling task %s failed",
                                      task.job_id)

@@ -69,6 +69,15 @@ def ring_psum(x: jax.Array, axis_name: str, wire_dtype) -> jax.Array:
 
     chunks = lax.fori_loop(0, D - 1, rs_step, chunks)
 
+    # Lane identity: receivers will see every reduced chunk through one
+    # wire_dtype round-trip, so the owner must hold the same rounded
+    # value — otherwise the "replicated" output differs across lanes on
+    # the 1/D of elements each rank owns.
+    own = (r + 1) % D
+    owned = lax.dynamic_index_in_dim(chunks, own, 0, keepdims=False)
+    chunks = lax.dynamic_update_index_in_dim(
+        chunks, owned.astype(wire_dtype).astype(jnp.float32), own, 0)
+
     # all-gather: circulate the reduced chunks; at step s rank r sends
     # chunk (r + 1 - s) mod D (its reduced chunk at s=0, thereafter the
     # one it just received) and stores incoming chunk (r - s) mod D.

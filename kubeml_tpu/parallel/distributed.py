@@ -47,6 +47,25 @@ from kubeml_tpu.parallel.mesh import make_mesh
 
 logger = logging.getLogger("kubeml_tpu.distributed")
 
+# Every env-var family that can make a process believe it belongs to a
+# jax.distributed cluster — our own launcher vars plus everything
+# initialize()/_cluster_env_present auto-detects (jax / megascale /
+# TPU-pod / SLURM / OpenMPI). Kept HERE, next to the detection logic,
+# so detection and scrubbing (control/ps.py strips these from
+# standalone-job child envs) evolve together: a child inheriting its
+# parent's rank re-joins the parent's cluster and hangs it.
+CLUSTER_ENV_VARS = (
+    "KUBEML_COORDINATOR_ADDRESS", "KUBEML_NUM_PROCESSES",
+    "KUBEML_PROCESS_ID",
+    "JAX_COORDINATOR_ADDRESS", "JAX_NUM_PROCESSES", "JAX_PROCESS_ID",
+    "MEGASCALE_COORDINATOR_ADDRESS", "MEGASCALE_NUM_SLICES",
+    "MEGASCALE_SLICE_ID",
+    "TPU_WORKER_HOSTNAMES", "TPU_WORKER_ID",
+    "SLURM_NTASKS", "SLURM_PROCID", "SLURM_JOB_ID",
+    "OMPI_COMM_WORLD_SIZE", "OMPI_COMM_WORLD_RANK",
+)
+
+
 def _cluster_env_present() -> bool:
     """True when the environment indicates a MULTI-host cluster
     (jax.distributed auto-detects from these families). If so, a failed

@@ -111,8 +111,11 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     ring_self_attention and the model modules construct): causality
     then reduces to an aligned-diagonal mask on the local block plus a
     whole-block keep/drop per ring step, so arbitrary q_pos/kv_pos are
-    not consulted. interpret runs the kernel in the pallas interpreter
-    (CPU tests).
+    not consulted. A causal flash call whose positions VIOLATE that
+    layout poisons its output with NaN rather than silently computing
+    wrong attention (non-causal flash is layout-independent: softmax is
+    permutation-invariant over the masked key set). interpret runs the
+    kernel in the pallas interpreter (CPU tests).
     """
     n = lax.axis_size(axis_name)
     perm = [(i, (i + 1) % n) for i in range(n)]
@@ -131,6 +134,15 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
         sid = lax.axis_index(axis_name)
         acc0, m0, l0 = _block_attn_flash(q, k, v, kv_mask, causal,
                                          interpret)
+        if causal:
+            # the causal keep/drop below assumes the contiguous layout;
+            # a violating caller must get a LOUD failure (NaN), not
+            # silently wrong attention
+            expected = sid * q.shape[1] + jnp.arange(q.shape[1])
+            layout_ok = jnp.logical_and((q_pos == expected).all(),
+                                        (kv_pos == expected).all())
+        else:
+            layout_ok = jnp.bool_(True)
     else:
         acc0, m0, l0 = _block_attn(q, k, v, bias_for(kv_pos, kv_mask))
 
@@ -162,6 +174,8 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     # masked rows keep m = NEG_INF and l from exp(0)=1 terms per block, so
     # the division is finite; still guard for safety.
     out = acc / jnp.maximum(l, 1e-30).transpose(0, 2, 1)[..., None]
+    if use_flash:
+        out = jnp.where(layout_ok, out, jnp.nan)
     return out.astype(q.dtype)
 
 

@@ -194,8 +194,9 @@ class TrainJob:
                                               opts.max_parallelism)
 
                 val_loss, accuracy = float("nan"), float("nan")
-                if opts.validate_every > 0 and \
-                        (epoch + 1) % opts.validate_every == 0:
+                ran_validation = opts.validate_every > 0 and \
+                    (epoch + 1) % opts.validate_every == 0
+                if ran_validation:
                     val_loss, accuracy = self._validate(parallelism)
 
                 self.history.train_loss.append(train_loss)
@@ -221,7 +222,10 @@ class TrainJob:
                 if opts.checkpoint_every > 0:
                     want_ckpt = (epoch + 1) % opts.checkpoint_every == 0
                 elif opts.checkpoint_every == 0:
-                    want_ckpt = accuracy == accuracy  # a validation ran
+                    # explicit flag, not a NaN-accuracy proxy: a diverged
+                    # model's NaN validation must still checkpoint so the
+                    # mid-run-inference guarantee holds
+                    want_ckpt = ran_validation
                 else:
                     want_ckpt = False  # -1: final checkpoint only
                 if self.checkpoint and want_ckpt:

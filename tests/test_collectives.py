@@ -54,6 +54,22 @@ def test_ring_single_lane_passthrough():
     np.testing.assert_array_equal(np.asarray(out), x)
 
 
+@pytest.mark.parametrize("wire", [jnp.bfloat16, jnp.float32])
+def test_ring_lane_identity(mesh8, wire):
+    """The replicated out-spec contract: EVERY lane must hold the
+    bit-identical reduced value, including the 1/D chunk each rank owns
+    (which, pre-fix, the owner kept in unrounded f32 while everyone
+    else stored the wire-rounded copy)."""
+    x = np.random.RandomState(5).randn(8, 193).astype(np.float32)
+    per_lane = jax.jit(jax.shard_map(
+        lambda v: ring_psum(v, DATA_AXIS, wire)[None],
+        mesh=mesh8, in_specs=P(DATA_AXIS), out_specs=P(DATA_AXIS),
+        check_vma=False))(jnp.asarray(x))
+    out = np.asarray(per_lane, np.float32)            # [8, 193]
+    for lane in range(1, 8):
+        np.testing.assert_array_equal(out[lane], out[0])
+
+
 def test_ring_multidim_leaves(mesh8):
     """Weight-shaped (non-flat) leaves reduce correctly through the
     flatten/pad path."""

@@ -148,3 +148,45 @@ def test_ring_flash_causal():
 
 def test_ring_flash_causal_with_padding():
     _ring_flash_case(causal=True, ragged=True)
+
+
+def test_ring_flash_causal_noncontiguous_layout_poisons():
+    """A causal flash call whose q_pos/kv_pos violate the contiguous
+    shard layout must fail LOUDLY (NaN output), not silently compute
+    wrong attention (round-2 advisor finding)."""
+    import numpy as np
+    from jax.sharding import PartitionSpec as P
+
+    from kubeml_tpu.parallel.mesh import SEQ_AXIS, make_mesh
+    from kubeml_tpu.parallel.ring_attention import ring_attention
+
+    rng = np.random.RandomState(11)
+    B, T, H, D = 1, 32, 2, 4
+    q, k, v = (jnp.asarray(rng.randn(B, T, H, D).astype(np.float32))
+               for _ in range(3))
+    pad = jnp.ones((B, T), jnp.float32)
+    mesh = make_mesh(n_data=1, n_seq=4)
+    # a STRIDED (non-contiguous) position layout: shard s holds global
+    # positions s, s+4, s+8, ... — legal for the dense path
+    pos = jnp.arange(T).reshape(T // 4, 4).T.reshape(-1)
+
+    def body(q, k, v, pos, pad):
+        return ring_attention(q, k, v, pos, pos, pad, causal=True,
+                              use_flash=True, interpret=True)
+
+    out = jax.jit(jax.shard_map(
+        body, mesh=mesh,
+        in_specs=(P(None, SEQ_AXIS), P(None, SEQ_AXIS),
+                  P(None, SEQ_AXIS), P(SEQ_AXIS), P(None, SEQ_AXIS)),
+        out_specs=P(None, SEQ_AXIS), check_vma=False))(q, k, v, pos, pad)
+    assert np.isnan(np.asarray(out)).all(), \
+        "layout violation must poison the flash output"
+
+    # the contiguous layout stays finite through the same call path
+    out2 = jax.jit(jax.shard_map(
+        body, mesh=mesh,
+        in_specs=(P(None, SEQ_AXIS), P(None, SEQ_AXIS),
+                  P(None, SEQ_AXIS), P(SEQ_AXIS), P(None, SEQ_AXIS)),
+        out_specs=P(None, SEQ_AXIS), check_vma=False))(
+            q, k, v, jnp.arange(T), pad)
+    assert np.isfinite(np.asarray(out2)).all()

@@ -3,7 +3,8 @@
 import numpy as np
 import pytest
 
-from kubeml_tpu.api.errors import DataError, InvalidArgsError
+from kubeml_tpu.api.errors import (DataError, InvalidArgsError,
+                                   KubeMLException)
 from kubeml_tpu.data.loader import RoundLoader
 from kubeml_tpu.data.registry import DatasetRegistry
 from kubeml_tpu.models.base import KubeDataset
@@ -56,3 +57,95 @@ def test_checkpoint_replace_keeps_old_on_overwrite(tmp_path):
                     root=root)
     variables, _ = load_checkpoint("j1", root=root)
     np.testing.assert_array_equal(variables["params"]["w"], np.zeros(3))
+
+
+# ---------------------------------------------------------------- round-3
+# regressions for the round-2 advisor findings
+
+
+def test_cluster_env_scrub_covers_autodetect_families(monkeypatch):
+    """ps._start_standalone scrubs CLUSTER_ENV_VARS from job-child envs;
+    that list must cover EVERY family _cluster_env_present (and so
+    jobserver's initialize()) auto-detects, or a multi-host serve formed
+    from an uncovered family hands the child its parent's rank."""
+    from kubeml_tpu.parallel.distributed import (CLUSTER_ENV_VARS,
+                                                 _cluster_env_present)
+    from kubeml_tpu.control import ps as ps_mod
+    assert ps_mod.CLUSTER_ENV_VARS is CLUSTER_ENV_VARS  # one copy, shared
+
+    triggers = {
+        "KUBEML_COORDINATOR_ADDRESS": "10.0.0.1:1234",
+        "JAX_COORDINATOR_ADDRESS": "10.0.0.1:1234",
+        "MEGASCALE_COORDINATOR_ADDRESS": "10.0.0.1:1234",
+        "TPU_WORKER_HOSTNAMES": "host-a,host-b",
+        "SLURM_NTASKS": "4",
+        "OMPI_COMM_WORLD_SIZE": "4",
+    }
+    for var, value in triggers.items():
+        for v in triggers:
+            monkeypatch.delenv(v, raising=False)
+        monkeypatch.setenv(var, value)
+        assert _cluster_env_present(), var
+        assert var in CLUSTER_ENV_VARS, \
+            f"{var} triggers cluster autodetect but is not scrubbed"
+        monkeypatch.delenv(var)
+
+
+def test_deferred_task_does_not_stall_dispatch(monkeypatch):
+    """A 503-deferred task parks with a per-task not-before stamp; tasks
+    queued behind it keep dispatching immediately (pre-fix the loop slept
+    0.5s inline, degrading ALL dispatch to ~2 attempts/sec)."""
+    import threading
+    import time as _time
+
+    from kubeml_tpu.api.types import TrainOptions, TrainRequest, TrainTask
+    from kubeml_tpu.control import scheduler as sched_mod
+
+    dispatched = {}          # job_id -> [timestamps]
+    got_normal = threading.Event()
+    lock = threading.Lock()
+
+    def fake_http_json(method, url, body=None):
+        jid = body["job_id"]
+        with lock:
+            dispatched.setdefault(jid, []).append(_time.monotonic())
+        if jid == "defer001":
+            raise KubeMLException("all device partitions leased", 503)
+        got_normal.set()
+        return {"ok": True}
+
+    monkeypatch.setattr(sched_mod, "http_json", fake_http_json)
+    sched = sched_mod.Scheduler(ps_url="http://fake")
+    sched.start()
+    try:
+        req = TrainRequest(model_type="mlp", batch_size=16, epochs=1,
+                           dataset="d", lr=0.1,
+                           options=TrainOptions(default_parallelism=1,
+                                                static_parallelism=True))
+        sched.queue.push(TrainTask(job_id="defer001", parameters=req))
+        deadline = _time.monotonic() + 10
+        while _time.monotonic() < deadline:
+            with lock:
+                if dispatched.get("defer001"):
+                    break
+            _time.sleep(0.01)
+        assert dispatched.get("defer001"), "deferred task never attempted"
+
+        t_push = _time.monotonic()
+        sched.queue.push(TrainTask(job_id="normal01", parameters=req))
+        assert got_normal.wait(5), "normal task never dispatched"
+        latency = dispatched["normal01"][0] - t_push
+        assert latency < 0.35, \
+            f"dispatch stalled {latency:.2f}s behind a deferred task"
+
+        # ... and the deferred task itself retries after its backoff
+        deadline = _time.monotonic() + 10
+        while _time.monotonic() < deadline:
+            with lock:
+                if len(dispatched["defer001"]) >= 2:
+                    break
+            _time.sleep(0.02)
+        assert len(dispatched["defer001"]) >= 2, \
+            "deferred task was never retried"
+    finally:
+        sched.stop()
