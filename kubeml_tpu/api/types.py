@@ -51,6 +51,12 @@ class TrainOptions:
     n_model: int = 1
     n_seq: int = 1
     seq_impl: str = "ring"         # 'ring' | 'ulysses'
+    # TP execution strategy: 'gspmd' (NamedSharding placement, XLA
+    # inserts the collectives — parallel/tp.py) or 'manual' (explicit
+    # Megatron psums inside a fully-manual round — parallel/manual.py).
+    # TP+SP combined always runs manual (GSPMD cannot ride the
+    # fully-manual SP round); this flag picks the path for TP-only jobs.
+    tp_impl: str = "gspmd"         # 'gspmd' | 'manual'
     # net-new guard: cap on scheduler-driven parallelism growth. The
     # reference's throughput policy only floor-clamps at 1
     # (policy.go:75-90), so a long dynamic job monotonically accretes
@@ -71,6 +77,7 @@ class TrainOptions:
             "n_model": self.n_model,
             "n_seq": self.n_seq,
             "seq_impl": self.seq_impl,
+            "tp_impl": self.tp_impl,
             "max_parallelism": self.max_parallelism,
         }
 
@@ -88,6 +95,7 @@ class TrainOptions:
             n_model=int(d.get("n_model", 1)),
             n_seq=int(d.get("n_seq", 1)),
             seq_impl=d.get("seq_impl", "ring"),
+            tp_impl=d.get("tp_impl", "gspmd"),
             max_parallelism=int(d.get("max_parallelism", 0)),
         )
 

@@ -53,9 +53,10 @@ def cmd_train(args):
         _fail("--tensor-parallel/--seq-parallel must be >= 1")
     if args.max_parallelism < 0:
         _fail("--max-parallelism must be >= 0")
-    if args.tensor_parallel > 1 and args.seq_parallel > 1:
-        _fail("tensor and sequence parallelism cannot be combined in "
-              "one job yet; pick one")
+    if args.tensor_parallel > 1 and args.seq_parallel > 1 \
+            and args.seq_impl == "ulysses":
+        _fail("tensor parallelism composes with --seq-impl ring only "
+              "(ulysses re-shards the head axis the TP split owns)")
     k = -1 if args.sparse_avg else args.K
     client = _client(args)
     # pre-validation (cmd/train.go:89-148): dataset + function must exist
@@ -82,6 +83,7 @@ def cmd_train(args):
             n_model=args.tensor_parallel,
             n_seq=args.seq_parallel,
             seq_impl=args.seq_impl,
+            tp_impl=args.tp_impl,
             max_parallelism=args.max_parallelism))
     job_id = client.v1().networks().train(req)
     print(job_id)
@@ -326,6 +328,11 @@ def build_parser() -> argparse.ArgumentParser:
     t.add_argument("--seq-impl", choices=("ring", "ulysses"),
                    default="ring",
                    help="sequence-parallel attention implementation")
+    t.add_argument("--tp-impl", choices=("gspmd", "manual"),
+                   default="gspmd",
+                   help="tensor-parallel execution: GSPMD placement or "
+                        "explicit Megatron collectives (TP+SP combined "
+                        "always runs manual)")
     t.add_argument("--max-parallelism", type=int, default=0, metavar="N",
                    help="cap scheduler-driven parallelism growth at N "
                         "(0 = unbounded, reference parity)")

@@ -82,6 +82,21 @@ class KubeModel(abc.ABC):
         from kubeml_tpu.parallel.mesh import SEQ_AXIS
         self._module = self.module.clone(seq_axis=SEQ_AXIS, seq_impl=impl)
 
+    def enable_tensor_parallel(self) -> None:
+        """Switch the model's module into MANUAL tensor-parallel execution
+        (called by the job for fully-manual rounds — combined TP+SP).
+
+        Served by every family whose module takes a `tp_axis` field
+        (the transformer families — parallel/manual.py); others reject.
+        Distinct from `tp_rules` (GSPMD placement): manual TP runs inside
+        fully-manual shard_map rounds where GSPMD cannot."""
+        if not hasattr(self.module, "tp_axis"):
+            raise ValueError(
+                f"function {self.name or type(self).__name__!r} does not "
+                "support manual tensor parallelism")
+        from kubeml_tpu.parallel.mesh import MODEL_AXIS
+        self._module = self.module.clone(tp_axis=MODEL_AXIS)
+
     @abc.abstractmethod
     def build(self):
         """Return the flax nn.Module."""
@@ -95,13 +110,16 @@ class KubeModel(abc.ABC):
     @property
     def init_module(self):
         """The module used for variable init: the DENSE clone when the
-        model is in sequence-parallel mode — seq collectives only exist
-        inside shard_map, while init runs outside it (variable shapes
-        are identical either way)."""
+        model is in sequence- or tensor-parallel mode — the collectives
+        only exist inside shard_map, while init runs outside it
+        (variable shapes are identical either way)."""
         m = self.module
+        overrides = {}
         if getattr(m, "seq_axis", None) is not None:
-            return m.clone(seq_axis=None)
-        return m
+            overrides["seq_axis"] = None
+        if getattr(m, "tp_axis", None) is not None:
+            overrides["tp_axis"] = None
+        return m.clone(**overrides) if overrides else m
 
     # ------------------------------------------------------------- lifecycle
 

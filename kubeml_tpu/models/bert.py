@@ -17,6 +17,7 @@ TPU-first:
 
 from __future__ import annotations
 
+from functools import partial
 from typing import Optional
 
 import flax.linen as nn
@@ -46,20 +47,37 @@ class EncoderBlock(nn.Module):
     # (parallel/ulysses.py — needs heads % seq-axis == 0)
     seq_axis: Optional[str] = None
     seq_impl: str = "ring"
+    # set to the mesh model-axis name for MANUAL tensor parallelism: the
+    # block then runs inside a fully-manual shard_map with Megatron
+    # column/row-parallel matmuls and hand-placed psums
+    # (parallel/manual.py). Composes with seq_axis (ring impl).
+    tp_axis: Optional[str] = None
 
     @nn.compact
     def __call__(self, h, pad_mask, train: bool, pos=None):
         head_dim = self.hidden // self.heads
         x = nn.LayerNorm(dtype=jnp.float32)(h)
-        q = nn.DenseGeneral((self.heads, head_dim), dtype=self.dtype,
-                            name="q")(x)
-        k = nn.DenseGeneral((self.heads, head_dim), dtype=self.dtype,
-                            name="k")(x)
-        v = nn.DenseGeneral((self.heads, head_dim), dtype=self.dtype,
-                            name="v")(x)
+        if self.tp_axis is not None:
+            from kubeml_tpu.parallel.manual import (TPHeadsDense,
+                                                    validate_tp_geometry)
+            validate_tp_geometry(self.heads, self.ffn,
+                                 lax.axis_size(self.tp_axis))
+            mk_qkv = partial(TPHeadsDense, self.heads, head_dim,
+                             self.tp_axis, self.dtype)
+        else:
+            mk_qkv = partial(nn.DenseGeneral, (self.heads, head_dim),
+                             dtype=self.dtype)
+        q = mk_qkv(name="q")(x)
+        k = mk_qkv(name="k")(x)
+        v = mk_qkv(name="v")(x)
         if self.seq_impl not in ("ring", "ulysses"):  # static field
             raise ValueError(f"unknown seq_impl {self.seq_impl!r}; "
                              f"expected 'ring' or 'ulysses'")
+        if self.tp_axis is not None and self.seq_axis is not None \
+                and self.seq_impl == "ulysses":
+            raise ValueError(
+                "tensor parallelism composes with seq_impl='ring' only "
+                "(ulysses re-shards the head axis the TP split owns)")
         if self.seq_axis is not None and self.seq_impl == "ulysses":
             # long-context path B: two all-to-alls re-shard seq->heads,
             # stock full attention per head group (flash-eligible)
@@ -77,14 +95,28 @@ class EncoderBlock(nn.Module):
         else:
             # auto-dispatch: pallas flash kernel on TPU, jnp ref on CPU
             attn = masked_attention(q, k, v, pad_mask)
-        attn = nn.DenseGeneral(self.hidden, axis=(-2, -1), dtype=self.dtype,
-                               name="out")(attn)
-        attn = nn.Dropout(self.dropout, deterministic=not train)(attn)
-        h = h + attn
-        x = nn.LayerNorm(dtype=jnp.float32)(h)
-        x = nn.Dense(self.ffn, dtype=self.dtype)(x)
-        x = nn.gelu(x)
-        x = nn.Dense(self.hidden, dtype=self.dtype)(x)
+        if self.tp_axis is not None:
+            from kubeml_tpu.parallel.manual import (TPColumnDense,
+                                                    TPOutDense, TPRowDense)
+            attn = TPOutDense(self.heads, head_dim, self.hidden,
+                              self.tp_axis, self.dtype, name="out")(attn)
+            attn = nn.Dropout(self.dropout, deterministic=not train)(attn)
+            h = h + attn
+            x = nn.LayerNorm(dtype=jnp.float32)(h)
+            x = TPColumnDense(self.ffn, self.tp_axis, self.dtype,
+                              name="Dense_0")(x)
+            x = nn.gelu(x)
+            x = TPRowDense(self.hidden, self.ffn, self.tp_axis, self.dtype,
+                           name="Dense_1")(x)
+        else:
+            attn = nn.DenseGeneral(self.hidden, axis=(-2, -1),
+                                   dtype=self.dtype, name="out")(attn)
+            attn = nn.Dropout(self.dropout, deterministic=not train)(attn)
+            h = h + attn
+            x = nn.LayerNorm(dtype=jnp.float32)(h)
+            x = nn.Dense(self.ffn, dtype=self.dtype)(x)
+            x = nn.gelu(x)
+            x = nn.Dense(self.hidden, dtype=self.dtype)(x)
         x = nn.Dropout(self.dropout, deterministic=not train)(x)
         return h + x
 
@@ -101,6 +133,7 @@ class BertModule(nn.Module):
     dtype: jnp.dtype = jnp.bfloat16
     seq_axis: Optional[str] = None  # sequence-parallel mode (see below)
     seq_impl: str = "ring"          # 'ring' | 'ulysses'
+    tp_axis: Optional[str] = None   # manual tensor-parallel mode
 
     @nn.compact
     def __call__(self, x, train: bool = False):
@@ -132,7 +165,7 @@ class BertModule(nn.Module):
         for i in range(self.layers):
             h = EncoderBlock(self.hidden, self.heads, self.ffn, self.dropout,
                              self.dtype, seq_axis=self.seq_axis,
-                             seq_impl=self.seq_impl,
+                             seq_impl=self.seq_impl, tp_axis=self.tp_axis,
                              name=f"layer_{i}")(h, pad_mask, train,
                                                 pos=pos_ids)
         h = nn.LayerNorm(dtype=jnp.float32)(h)

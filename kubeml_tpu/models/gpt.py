@@ -26,6 +26,7 @@ exactly like the reference treats one sample.
 
 from __future__ import annotations
 
+from functools import partial
 from typing import Any, Optional
 
 import flax.linen as nn
@@ -55,11 +56,19 @@ class DecoderBlock(nn.Module):
     seq_impl: str = "ring"
     # KV-cache length for incremental decoding (None = no cache path)
     cache_len: Optional[int] = None
+    # mesh model-axis name for MANUAL tensor parallelism (Megatron
+    # column/row matmuls with hand-placed psums — parallel/manual.py);
+    # composes with seq_axis (ring impl)
+    tp_axis: Optional[str] = None
     # > 0: replace the dense FFN with a mixture-of-experts layer
     n_experts: int = 0
     moe_k: int = 2
     capacity_factor: float = 1.25
     ep_mesh: Any = None
+    # mesh expert-axis name for MANUAL expert parallelism (inside an
+    # already-manual shard_map, e.g. the GPipe pipeline — the GSPMD
+    # ep_mesh constraints cannot cross a manual region)
+    ep_axis: Optional[str] = None
 
     def _cached_attention(self, q, k, v, bias, offset):
         """Incremental decode: append this call's K/V into the block's
@@ -88,15 +97,31 @@ class DecoderBlock(nn.Module):
                  decode_bias=None, decode_offset=None):
         head_dim = self.hidden // self.heads
         x = nn.LayerNorm(dtype=jnp.float32)(h)
-        q = nn.DenseGeneral((self.heads, head_dim), dtype=self.dtype,
-                            name="q")(x)
-        k = nn.DenseGeneral((self.heads, head_dim), dtype=self.dtype,
-                            name="k")(x)
-        v = nn.DenseGeneral((self.heads, head_dim), dtype=self.dtype,
-                            name="v")(x)
+        if self.tp_axis is not None:
+            from kubeml_tpu.parallel.manual import (TPHeadsDense,
+                                                    validate_tp_geometry)
+            if decode_offset is not None:
+                raise ValueError("manual TP does not run the KV-cache "
+                                 "decode path; decode with the dense "
+                                 "module (same variables)")
+            validate_tp_geometry(self.heads, self.ffn,
+                                 lax.axis_size(self.tp_axis))
+            mk_qkv = partial(TPHeadsDense, self.heads, head_dim,
+                             self.tp_axis, self.dtype)
+        else:
+            mk_qkv = partial(nn.DenseGeneral, (self.heads, head_dim),
+                             dtype=self.dtype)
+        q = mk_qkv(name="q")(x)
+        k = mk_qkv(name="k")(x)
+        v = mk_qkv(name="v")(x)
         if self.seq_impl not in ("ring", "ulysses"):  # static field
             raise ValueError(f"unknown seq_impl {self.seq_impl!r}; "
                              f"expected 'ring' or 'ulysses'")
+        if self.tp_axis is not None and self.seq_axis is not None \
+                and self.seq_impl == "ulysses":
+            raise ValueError(
+                "tensor parallelism composes with seq_impl='ring' only "
+                "(ulysses re-shards the head axis the TP split owns)")
         if decode_offset is not None:
             attn = self._cached_attention(q, k, v, decode_bias,
                                           decode_offset)
@@ -113,15 +138,33 @@ class DecoderBlock(nn.Module):
                                   axis_name=self.seq_axis)
         else:
             attn = masked_attention(q, k, v, pad_mask, causal=True)
-        attn = nn.DenseGeneral(self.hidden, axis=(-2, -1), dtype=self.dtype,
-                               name="out")(attn)
+        if self.tp_axis is not None:
+            from kubeml_tpu.parallel.manual import TPOutDense
+            attn = TPOutDense(self.heads, head_dim, self.hidden,
+                              self.tp_axis, self.dtype, name="out")(attn)
+        else:
+            attn = nn.DenseGeneral(self.hidden, axis=(-2, -1),
+                                   dtype=self.dtype, name="out")(attn)
         attn = nn.Dropout(self.dropout, deterministic=not train)(attn)
         h = h + attn
         x = nn.LayerNorm(dtype=jnp.float32)(h)
         if self.n_experts > 0:
+            if self.tp_axis is not None:
+                raise ValueError("manual TP does not apply to MoE blocks "
+                                 "(experts shard over the expert axis "
+                                 "instead — ep_axis)")
             x = MoEFFN(self.hidden, self.ffn, self.n_experts,
                        k=self.moe_k, capacity_factor=self.capacity_factor,
-                       ep_mesh=self.ep_mesh, name="moe")(x, pad_mask)
+                       ep_mesh=self.ep_mesh, ep_axis=self.ep_axis,
+                       name="moe")(x, pad_mask)
+        elif self.tp_axis is not None:
+            from kubeml_tpu.parallel.manual import (TPColumnDense,
+                                                    TPRowDense)
+            x = TPColumnDense(self.ffn, self.tp_axis, self.dtype,
+                              name="Dense_0")(x)
+            x = nn.gelu(x)
+            x = TPRowDense(self.hidden, self.ffn, self.tp_axis, self.dtype,
+                           name="Dense_1")(x)
         else:
             x = nn.Dense(self.ffn, dtype=self.dtype)(x)
             x = nn.gelu(x)
@@ -142,6 +185,11 @@ class MoEFFN(nn.Module):
     k: int = 2
     capacity_factor: float = 1.25
     ep_mesh: Any = None  # jax Mesh: shard experts over its `expert` axis
+    # manual expert axis (mutually exclusive with ep_mesh): experts
+    # shard over an already-manual mesh axis with a hand-placed psum —
+    # parallel/manual.py ep_partial_ffn. This is what lets MoE blocks
+    # run expert-sharded INSIDE the GPipe pipeline's shard_map.
+    ep_axis: Optional[str] = None
 
     @nn.compact
     def __call__(self, h, pad_mask):
@@ -163,10 +211,37 @@ class MoEFFN(nn.Module):
         # pad tokens are excluded from routing and capacity entirely —
         # unlike the dense FFN (row-independent), an unmasked MoE would
         # let padding displace real tokens from expert slots
-        y, aux = moe_apply(params, h.reshape(B * T, D),
-                           mesh=self.ep_mesh, k=self.k,
-                           capacity_factor=self.capacity_factor,
-                           token_mask=pad_mask.reshape(B * T))
+        if self.ep_axis is not None:
+            if self.ep_mesh is not None:
+                raise ValueError("ep_axis (manual) and ep_mesh (GSPMD) "
+                                 "are mutually exclusive")
+            if e % lax.axis_size(self.ep_axis):
+                raise ValueError(
+                    f"{e} experts do not divide over a "
+                    f"{lax.axis_size(self.ep_axis)}-way expert axis")
+            import math as _math
+
+            from kubeml_tpu.parallel.ep import make_dispatch
+            from kubeml_tpu.parallel.manual import ep_partial_ffn
+            x = h.reshape(B * T, D)
+            t = x.shape[0]
+            capacity = max(1, _math.ceil((t / e) * self.capacity_factor))
+            # router/dispatch replicated on every expert lane (tokens are
+            # replicated over the expert axis in the pipeline); only the
+            # expert FFNs shard
+            logits = x.astype(jnp.float32) @ params["router"].astype(
+                jnp.float32)
+            dispatch, combine, aux = make_dispatch(
+                logits, capacity, self.k,
+                token_mask=pad_mask.reshape(B * T))
+            y = ep_partial_ffn(params["wi"], params["bi"], params["wo"],
+                               params["bo"], dispatch, combine, x,
+                               self.ep_axis, dtype=h.dtype)
+        else:
+            y, aux = moe_apply(params, h.reshape(B * T, D),
+                               mesh=self.ep_mesh, k=self.k,
+                               capacity_factor=self.capacity_factor,
+                               token_mask=pad_mask.reshape(B * T))
         self.sow("intermediates", "moe_aux", aux)
         return y.reshape(B, T, D).astype(h.dtype)
 
@@ -186,6 +261,8 @@ class GPTModule(nn.Module):
     moe_k: int = 2
     capacity_factor: float = 1.25
     ep_mesh: Any = None             # mesh whose `expert` axis shards experts
+    ep_axis: Optional[str] = None   # manual expert axis (see MoEFFN)
+    tp_axis: Optional[str] = None   # manual tensor-parallel mode
 
     @nn.compact
     def __call__(self, x, train: bool = False, decode: bool = False,
@@ -253,7 +330,8 @@ class GPTModule(nn.Module):
                              cache_len=cache_len,
                              n_experts=self.n_experts, moe_k=self.moe_k,
                              capacity_factor=self.capacity_factor,
-                             ep_mesh=self.ep_mesh,
+                             ep_mesh=self.ep_mesh, ep_axis=self.ep_axis,
+                             tp_axis=self.tp_axis,
                              name=f"layer_{i}")(h, pad_mask, train,
                                                 pos=pos_ids,
                                                 decode_bias=decode_bias,
@@ -563,25 +641,36 @@ class GPTMini(KubeModel):
         with B divisible by `microbatches`. Returns [B, T, vocab] logits
         equal to the dense forward up to bf16 noise.
 
-        MoE trunks pipeline too (round 2): experts are replicated per
-        stage (no ep_mesh), routing capacity is computed PER MICROBATCH
-        — the standard pipelined-MoE semantics, equal to the
-        per-microbatch sequential reference, NOT bit-equal to the
+        MoE trunks pipeline too (round 2): routing capacity is computed
+        PER MICROBATCH — the standard pipelined-MoE semantics, equal to
+        the per-microbatch sequential reference, NOT bit-equal to the
         full-batch dense forward — and the per-block load-balance
         losses accumulate across real ticks, so the call returns
         (logits, aux) with aux normalized like the dense loss
         (mean per layer per microbatch).
+
+        PP x EP (round 3): when the mesh also carries an expert axis
+        (> 1), each stage's expert FFNs shard over it with the MANUAL
+        expert path (parallel/manual.py ep_partial_ffn) — the pipeline's
+        shard_map is fully manual, so the hand-placed expert psum
+        composes where GSPMD ep_mesh constraints cannot. Routing stays
+        replicated per expert lane; only expert FLOPs shard. Requires
+        n_experts % expert-axis == 0.
         """
-        from kubeml_tpu.parallel.mesh import STAGE_AXIS
+        from kubeml_tpu.parallel.mesh import EXPERT_AXIS, STAGE_AXIS
         from kubeml_tpu.parallel.pp import (pipeline_apply,
                                             stack_stage_params)
 
         module = self.module
         if module.n_experts and module.ep_mesh is not None:
             raise ValueError(
-                "pipelined MoE runs with replicated experts per stage; "
-                "construct the model without ep_mesh (expert-axis "
-                "sharding does not compose with the stage shard_map)")
+                "pipelined MoE shards experts over the mesh expert axis "
+                "(manual path); construct the model without ep_mesh "
+                "(GSPMD constraints cannot cross the stage shard_map)")
+        n_expert = mesh.shape[EXPERT_AXIS]
+        if n_expert > 1 and not module.n_experts:
+            raise ValueError("the mesh has an expert axis but the model "
+                             "has no experts")
         n_stage = mesh.shape[STAGE_AXIS]
         L = module.layers
         if L % n_stage:
@@ -614,7 +703,9 @@ class GPTMini(KubeModel):
                                  0.0, module.dtype,
                                  n_experts=module.n_experts,
                                  moe_k=module.moe_k,
-                                 capacity_factor=module.capacity_factor)
+                                 capacity_factor=module.capacity_factor,
+                                 ep_axis=(EXPERT_AXIS if n_expert > 1
+                                          else None))
 
             def stage_fn(p, act):
                 ones = jnp.ones(act.shape[:2], jnp.float32)

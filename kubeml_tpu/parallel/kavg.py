@@ -135,7 +135,8 @@ class KAvgEngine:
     def __init__(self, mesh: Mesh, loss_fn: LossFn, metrics_fn: MetricsFn,
                  tx_factory: TxFactory, donate: bool = True,
                  merge_dtype: Any = None, unroll: int = 8,
-                 batch_seq_dims: Optional[Dict[str, int]] = None):
+                 batch_seq_dims: Optional[Dict[str, int]] = None,
+                 manual_inner: bool = False):
         """donate=True donates the input variables buffer to each
         train_round (frees a full model copy of HBM) — the caller must then
         always continue from the *returned* variables, never reuse the
@@ -170,7 +171,16 @@ class KAvgEngine:
         4x off on a 4-way seq mesh). The loss_fn must be seq-aware: its
         per-example loss must be invariant over `seq` (models do this
         with an internal psum — bert.py pools over the ring, gpt.py
-        reduces its token loss over the axis)."""
+        reduces its token loss over the axis).
+
+        manual_inner: run the round with ALL mesh axes manual +
+        check_vma=True even without seq-parallel batch sharding — the
+        mode for models executing MANUAL tensor parallelism
+        (parallel/manual.py: the model's own psums over the `model`
+        axis, vma inserting the gradient psums at the invariant
+        boundaries). Composes with batch_seq_dims (TP+SP in one round)
+        and with merge_dtype (a fully-manual sub-f32 psum is safe; only
+        the partial-manual one miscompiles)."""
         self.mesh = mesh
         self.loss_fn = loss_fn
         self.metrics_fn = metrics_fn
@@ -182,21 +192,19 @@ class KAvgEngine:
         self.batch_seq_dims = dict(batch_seq_dims or {})
         self._seq_train = (mesh.shape[SEQ_AXIS] > 1
                            and bool(self.batch_seq_dims))
+        self._full_manual = self._seq_train or bool(manual_inner)
         # compressed merges on meshes with Auto inner axes must ride the
         # ppermute ring: a sub-f32 lax.psum fatally miscompiles in the
-        # partially-manual partitioner (parallel/collectives.py)
+        # partially-manual partitioner (parallel/collectives.py). Fully-
+        # manual rounds (seq-parallel / manual-TP) psum directly.
         self._compressed_ring = (merge_dtype is not None
-                                 and mesh.size != self.n_lanes)
+                                 and mesh.size != self.n_lanes
+                                 and not self._full_manual)
         if merge_dtype is not None:
             if not jnp.issubdtype(jnp.dtype(merge_dtype), jnp.floating):
                 raise ValueError(
                     f"merge_dtype must be a floating dtype, got "
                     f"{jnp.dtype(merge_dtype)}")
-            if self._seq_train:
-                raise ValueError(
-                    "merge_dtype compression does not compose with "
-                    "sequence-parallel training (the vma-checked round) "
-                    "yet; use the f32 merge")
         self._train_cache: Dict[Any, Callable] = {}
         self._eval_cache: Dict[Any, Callable] = {}
 
@@ -220,14 +228,14 @@ class KAvgEngine:
         """
         if self.mesh.size == self.mesh.shape[DATA_AXIS]:
             return {}
-        if self._seq_train:
-            # seq-parallel training: ALL axes manual (leaving the unused
-            # axes Auto trips the same partial-manual partitioner bug as
-            # merge_dtype: "Invalid binary instruction opcode copy") and
-            # vma tracking ON — required for correct grads w.r.t. the
-            # replicated params (see __init__ docstring). Consequence:
-            # SP does not compose with GSPMD TP in one job (validated at
-            # the job layer).
+        if self._full_manual:
+            # seq-parallel and/or manual-TP training: ALL axes manual
+            # (leaving the unused axes Auto trips the same partial-manual
+            # partitioner bug as merge_dtype: "Invalid binary instruction
+            # opcode copy") and vma tracking ON — required for correct
+            # grads w.r.t. the replicated params (see __init__
+            # docstring). GSPMD TP cannot ride a fully-manual round; the
+            # job layer picks manual TP (parallel/manual.py) there.
             return dict(check_vma=True)
         return dict(axis_names={DATA_AXIS})
 
@@ -256,7 +264,7 @@ class KAvgEngine:
         mesh = self.mesh
         loss_fn = self.loss_fn
         tx_factory = self.tx_factory
-        seq_train = self._seq_train
+        full_manual = self._full_manual
 
         def run_chunk(variables, chunk, lr, epoch):
             """K masked local steps for one virtual worker.
@@ -268,7 +276,7 @@ class KAvgEngine:
             params = variables["params"]
             model_state = {k: v for k, v in variables.items() if k != "params"}
             opt_state = tx.init(params)  # fresh optimizer per sync round
-            if seq_train:
+            if full_manual:
                 # vma: the scan carry becomes data-varying after step 1
                 # (local steps genuinely diverge per lane), so the
                 # invariant round-start params must be pcast to varying

@@ -321,18 +321,29 @@ class TrainJob:
         n_model = max(1, int(opts.n_model))
         n_seq = max(1, int(opts.n_seq))
         self._tp_rules = None
+        self._manual_tp = False
         if n_model > 1 or n_seq > 1:
             if engine_kind != "kavg":
                 raise KubeMLException(
                     "tensor/sequence parallelism requires the kavg "
                     "engine", 400)
-            if n_model > 1 and n_seq > 1:
-                # the SP round runs fully manual (partial-manual meshes
-                # trip an XLA partitioner bug — parallel/kavg.py), which
-                # precludes GSPMD TP in the same program
+            tp_impl = getattr(opts, "tp_impl", "gspmd") or "gspmd"
+            if tp_impl not in ("gspmd", "manual"):
                 raise KubeMLException(
-                    "tensor and sequence parallelism cannot be combined "
-                    "in one job yet; pick one", 400)
+                    f"unknown tp_impl {tp_impl!r}; expected 'gspmd' or "
+                    "'manual'", 400)
+            if n_model > 1 and n_seq > 1:
+                # combined TP+SP always runs the manual path: the SP
+                # round is fully manual (partial-manual meshes trip an
+                # XLA partitioner bug — parallel/kavg.py), and GSPMD
+                # cannot ride a manual region. Round 2 rejected this
+                # combination; parallel/manual.py clears it.
+                tp_impl = "manual"
+                if opts.seq_impl == "ulysses":
+                    raise KubeMLException(
+                        "tensor parallelism composes with "
+                        "seq_impl='ring' only (ulysses re-shards the "
+                        "head axis the TP split owns)", 400)
             devices = list(self.mesh.devices.flatten())
             inner = n_model * n_seq
             if len(devices) % inner:
@@ -343,7 +354,13 @@ class TrainJob:
             self.mesh = make_mesh(n_data=len(devices) // inner,
                                   n_model=n_model, n_seq=n_seq,
                                   devices=devices)
-            if n_model > 1:
+            if n_model > 1 and tp_impl == "manual":
+                try:
+                    self.model.enable_tensor_parallel()
+                except ValueError as e:
+                    raise KubeMLException(str(e), 400)
+                self._manual_tp = True
+            elif n_model > 1:
                 self._tp_rules = self.model.tp_rules
                 if self._tp_rules is None:
                     raise KubeMLException(
@@ -363,9 +380,11 @@ class TrainJob:
                         f"function {self.req.model_type!r} enabled "
                         "sequence parallelism but declares no "
                         "seq_batch_dims", 400)
-            self._log("job %s mesh: data=%d model=%d seq=%d",
+            self._log("job %s mesh: data=%d model=%d seq=%d tp_impl=%s",
                       self.task.job_id, data_axis_size(self.mesh),
-                      n_model, n_seq)
+                      n_model, n_seq,
+                      "manual" if self._manual_tp
+                      else ("gspmd" if n_model > 1 else "-"))
 
         self._loader = RoundLoader(handle, self.dataset,
                                    n_lanes=data_axis_size(self.mesh),
@@ -377,7 +396,8 @@ class TrainJob:
             self.mesh, self.model.loss, self.model.metrics,
             self.model.configure_optimizers,
             batch_seq_dims=(self.model.seq_batch_dims
-                            if n_seq > 1 else None))
+                            if n_seq > 1 else None),
+            manual_inner=self._manual_tp)
         self._sync_engine = None
         self._sync_state = None
         if engine_kind == "syncdp":

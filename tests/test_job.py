@@ -419,6 +419,35 @@ def test_job_seq_parallel_gpt(tmp_home, mesh8):
     assert record.data.accuracy[-1] == record.data.accuracy[-1]
 
 
+def test_job_tensor_and_seq_parallel_combined(tmp_home, mesh8):
+    """Round 2's exclusion cleared at the job surface: --tensor-parallel 2
+    --seq-parallel 2 carves data=2 x model=2 x seq=2 and trains the
+    fully-manual round (Megatron psums + KV ring in one program)."""
+    from kubeml_tpu.parallel.mesh import (MODEL_AXIS, SEQ_AXIS,
+                                          data_axis_size)
+
+    reg = DatasetRegistry()
+    make_token_task(reg)
+    store = HistoryStore()
+    model = get_builtin("bert-tiny")()
+    task = make_task(job_id="tpspjob1", epochs=2, parallelism=2, k=1,
+                     batch=16, lr=1e-3)
+    task.parameters.model_type = "bert-tiny"
+    task.parameters.dataset = "toktask"
+    task.parameters.options.n_model = 2
+    task.parameters.options.n_seq = 2
+    job = TrainJob(task, model, TokenDataset(), mesh8, registry=reg,
+                   history_store=store)
+    record = job.train()
+    assert data_axis_size(job.mesh) == 2
+    assert job.mesh.shape[MODEL_AXIS] == 2
+    assert job.mesh.shape[SEQ_AXIS] == 2
+    assert job.model.module.tp_axis == MODEL_AXIS
+    assert job.model.module.seq_axis == SEQ_AXIS
+    assert record.data.train_loss[-1] < record.data.train_loss[0]
+    assert record.data.accuracy[-1] == record.data.accuracy[-1]  # validated
+
+
 def test_job_parallelism_option_validation(setup):
     """Clear 400s for every unsupported TP/SP combination."""
     from kubeml_tpu.api.errors import KubeMLException
@@ -438,11 +467,22 @@ def test_job_parallelism_option_validation(setup):
 
     # TP on a model with no rules
     expect_400(lambda o: setattr(o, "n_model", 2), match="tensor-parallel")
-    # TP and SP combined
-    def both(o):
+    # manual TP on a model without a tp_axis module
+    def manual_on_mlp(o):
+        o.n_model = 2
+        o.tp_impl = "manual"
+    expect_400(manual_on_mlp, match="manual tensor parallelism")
+    # TP + SP combined runs manual TP, which requires ring (not ulysses)
+    def both_ulysses(o):
         o.n_model = 2
         o.n_seq = 2
-    expect_400(both, m=get_builtin("bert-tiny")(), match="combined")
+        o.seq_impl = "ulysses"
+    expect_400(both_ulysses, m=get_builtin("bert-tiny")(), match="ring")
+    # unknown tp_impl
+    def bad_impl(o):
+        o.n_model = 2
+        o.tp_impl = "magic"
+    expect_400(bad_impl, m=get_builtin("bert-tiny")(), match="tp_impl")
     # syncdp + TP
     def sync_tp(o):
         o.engine = "syncdp"
