@@ -35,6 +35,32 @@ LENET_DIGITS_EPOCHS = 15
 LENET_DIGITS_LR = 0.1
 LENET_DIGITS_TTA_GOAL = 95.0
 
+# Matched-GLOBAL-batch study (round 3): the round-2 sweep compared
+# N-worker arms against N=1 at the SAME per-worker batch, which hands
+# the parallel arms N x the global batch — exactly the comparison the
+# reference's own global-batch-vs-acc figure warns about
+# (figures/paper/resnet34/global-batch-vs-acc.pdf: accuracy falls as
+# global batch grows). The fair local-SGD claims need coupled
+# (batch, parallelism) arms, so this grid is an explicit config LIST:
+#   - N=4 x b16 vs N=1 x b64: same sequential step count per epoch —
+#     isolates local-SGD data efficiency vs large-batch SGD;
+#   - N=4 x b16 vs N=1 x b16: same math per sample — isolates the
+#     engine's K-batched dispatch (wall-clock) advantage;
+#   - N=8 x b8 extends both axes.
+# 30 epochs (not 15): the sweep measures epochs-to-accuracy curves, not
+# just whether the fastest arm gets there.
+LENET_DIGITS_GBATCH_CONFIGS = [
+    {"batch": 64, "k": -1, "parallelism": 1},
+    {"batch": 16, "k": -1, "parallelism": 1},
+    {"batch": 16, "k": 8, "parallelism": 1},
+    {"batch": 16, "k": -1, "parallelism": 4},
+    {"batch": 16, "k": 8, "parallelism": 4},
+    {"batch": 16, "k": 4, "parallelism": 4},
+    {"batch": 8, "k": 8, "parallelism": 8},
+    {"batch": 8, "k": -1, "parallelism": 8},
+]
+LENET_DIGITS_GBATCH_EPOCHS = 30
+
 # ResNet/CIFAR-10: active grid of utils.py:18-28 (batch sweep, K=-1, p=8),
 # lr 0.1, 30 epochs (train.py:41-61). The reference uses ResNet-34; our
 # flagship config is ResNet-18 per BASELINE.json's north star, and the

@@ -38,6 +38,14 @@ GRIDS = {
                          tta=grids.LENET_DIGITS_TTA_GOAL,
                          function="lenet", dataset="digits",
                          shuffle=True, real="digits"),
+    # matched-global-batch local-SGD study (explicit config list — the
+    # fair N>1 comparison; see LENET_DIGITS_GBATCH_CONFIGS)
+    "lenet-digits-gbatch": dict(grid=grids.LENET_DIGITS_GBATCH_CONFIGS,
+                                epochs=grids.LENET_DIGITS_GBATCH_EPOCHS,
+                                lr=grids.LENET_DIGITS_LR,
+                                tta=grids.LENET_DIGITS_TTA_GOAL,
+                                function="lenet", dataset="digits",
+                                shuffle=True, real="digits"),
     "resnet": dict(grid=grids.RESNET_GRID, epochs=grids.RESNET_EPOCHS,
                    lr=grids.RESNET_LR, tta=grids.RESNET_TTA_GOAL,
                    function="resnet18", dataset="cifar10"),
@@ -152,7 +160,10 @@ def main(argv=None) -> int:
                 _register_synthetic(client, spec["dataset"],
                                     spec["function"])
 
-        configs = expand_grid(spec["grid"])
+        # a grid may be a dict of lists (cartesian product) or an
+        # explicit list of coupled configs (matched-global-batch arms)
+        configs = (list(spec["grid"]) if isinstance(spec["grid"], list)
+                   else expand_grid(spec["grid"]))
         if args.offset:
             configs = configs[args.offset:]
         if args.limit:

@@ -95,28 +95,30 @@ class EncoderBlock(nn.Module):
         else:
             # auto-dispatch: pallas flash kernel on TPU, jnp ref on CPU
             attn = masked_attention(q, k, v, pad_mask)
+        # one scaffolding path for both execution modes: only the three
+        # Dense constructors differ (manual-TP mirrors share the dense
+        # modules' param tree paths — checkpoint/merge parity)
         if self.tp_axis is not None:
             from kubeml_tpu.parallel.manual import (TPColumnDense,
                                                     TPOutDense, TPRowDense)
-            attn = TPOutDense(self.heads, head_dim, self.hidden,
-                              self.tp_axis, self.dtype, name="out")(attn)
-            attn = nn.Dropout(self.dropout, deterministic=not train)(attn)
-            h = h + attn
-            x = nn.LayerNorm(dtype=jnp.float32)(h)
-            x = TPColumnDense(self.ffn, self.tp_axis, self.dtype,
-                              name="Dense_0")(x)
-            x = nn.gelu(x)
-            x = TPRowDense(self.hidden, self.ffn, self.tp_axis, self.dtype,
-                           name="Dense_1")(x)
+            mk_out = partial(TPOutDense, self.heads, head_dim,
+                             self.hidden, self.tp_axis, self.dtype)
+            mk_d0 = partial(TPColumnDense, self.ffn, self.tp_axis,
+                            self.dtype)
+            mk_d1 = partial(TPRowDense, self.hidden, self.ffn,
+                            self.tp_axis, self.dtype)
         else:
-            attn = nn.DenseGeneral(self.hidden, axis=(-2, -1),
-                                   dtype=self.dtype, name="out")(attn)
-            attn = nn.Dropout(self.dropout, deterministic=not train)(attn)
-            h = h + attn
-            x = nn.LayerNorm(dtype=jnp.float32)(h)
-            x = nn.Dense(self.ffn, dtype=self.dtype)(x)
-            x = nn.gelu(x)
-            x = nn.Dense(self.hidden, dtype=self.dtype)(x)
+            mk_out = partial(nn.DenseGeneral, self.hidden, axis=(-2, -1),
+                             dtype=self.dtype)
+            mk_d0 = partial(nn.Dense, self.ffn, dtype=self.dtype)
+            mk_d1 = partial(nn.Dense, self.hidden, dtype=self.dtype)
+        attn = mk_out(name="out")(attn)
+        attn = nn.Dropout(self.dropout, deterministic=not train)(attn)
+        h = h + attn
+        x = nn.LayerNorm(dtype=jnp.float32)(h)
+        x = mk_d0(name="Dense_0")(x)
+        x = nn.gelu(x)
+        x = mk_d1(name="Dense_1")(x)
         x = nn.Dropout(self.dropout, deterministic=not train)(x)
         return h + x
 

@@ -138,13 +138,25 @@ class DecoderBlock(nn.Module):
                                   axis_name=self.seq_axis)
         else:
             attn = masked_attention(q, k, v, pad_mask, causal=True)
+        # one scaffolding path; only the Dense constructors differ per
+        # execution mode (manual-TP mirrors share the dense param tree
+        # paths — checkpoint/merge parity). MoE FFNs are their own path
+        # (experts shard over ep_axis/ep_mesh, never the TP split).
         if self.tp_axis is not None:
-            from kubeml_tpu.parallel.manual import TPOutDense
-            attn = TPOutDense(self.heads, head_dim, self.hidden,
-                              self.tp_axis, self.dtype, name="out")(attn)
+            from kubeml_tpu.parallel.manual import (TPColumnDense,
+                                                    TPOutDense, TPRowDense)
+            mk_out = partial(TPOutDense, self.heads, head_dim,
+                             self.hidden, self.tp_axis, self.dtype)
+            mk_d0 = partial(TPColumnDense, self.ffn, self.tp_axis,
+                            self.dtype)
+            mk_d1 = partial(TPRowDense, self.hidden, self.ffn,
+                            self.tp_axis, self.dtype)
         else:
-            attn = nn.DenseGeneral(self.hidden, axis=(-2, -1),
-                                   dtype=self.dtype, name="out")(attn)
+            mk_out = partial(nn.DenseGeneral, self.hidden, axis=(-2, -1),
+                             dtype=self.dtype)
+            mk_d0 = partial(nn.Dense, self.ffn, dtype=self.dtype)
+            mk_d1 = partial(nn.Dense, self.hidden, dtype=self.dtype)
+        attn = mk_out(name="out")(attn)
         attn = nn.Dropout(self.dropout, deterministic=not train)(attn)
         h = h + attn
         x = nn.LayerNorm(dtype=jnp.float32)(h)
@@ -157,18 +169,10 @@ class DecoderBlock(nn.Module):
                        k=self.moe_k, capacity_factor=self.capacity_factor,
                        ep_mesh=self.ep_mesh, ep_axis=self.ep_axis,
                        name="moe")(x, pad_mask)
-        elif self.tp_axis is not None:
-            from kubeml_tpu.parallel.manual import (TPColumnDense,
-                                                    TPRowDense)
-            x = TPColumnDense(self.ffn, self.tp_axis, self.dtype,
-                              name="Dense_0")(x)
-            x = nn.gelu(x)
-            x = TPRowDense(self.hidden, self.ffn, self.tp_axis, self.dtype,
-                           name="Dense_1")(x)
         else:
-            x = nn.Dense(self.ffn, dtype=self.dtype)(x)
+            x = mk_d0(name="Dense_0")(x)
             x = nn.gelu(x)
-            x = nn.Dense(self.hidden, dtype=self.dtype)(x)
+            x = mk_d1(name="Dense_1")(x)
         x = nn.Dropout(self.dropout, deterministic=not train)(x)
         return h + x
 
@@ -219,20 +223,16 @@ class MoEFFN(nn.Module):
                 raise ValueError(
                     f"{e} experts do not divide over a "
                     f"{lax.axis_size(self.ep_axis)}-way expert axis")
-            import math as _math
-
-            from kubeml_tpu.parallel.ep import make_dispatch
+            from kubeml_tpu.parallel.ep import route_tokens
             from kubeml_tpu.parallel.manual import ep_partial_ffn
             x = h.reshape(B * T, D)
-            t = x.shape[0]
-            capacity = max(1, _math.ceil((t / e) * self.capacity_factor))
-            # router/dispatch replicated on every expert lane (tokens are
-            # replicated over the expert axis in the pipeline); only the
-            # expert FFNs shard
-            logits = x.astype(jnp.float32) @ params["router"].astype(
-                jnp.float32)
-            dispatch, combine, aux = make_dispatch(
-                logits, capacity, self.k,
+            # routing is the SHARED preamble (parallel/ep.route_tokens),
+            # replicated on every expert lane — tokens are replicated
+            # over the expert axis in the pipeline; only the expert
+            # FFNs shard
+            dispatch, combine, aux = route_tokens(
+                params["router"], x, k=self.k,
+                capacity_factor=self.capacity_factor,
                 token_mask=pad_mask.reshape(B * T))
             y = ep_partial_ffn(params["wi"], params["bi"], params["wo"],
                                params["bo"], dispatch, combine, x,
@@ -835,6 +835,17 @@ class GPTMoEMini(GPTMini):
             "gpt-moe-mini does not compose expert routing with the "
             "seq-axis shard_map; use the dense gpt-mini for "
             "sequence-parallel jobs")
+
+    def enable_tensor_parallel(self) -> None:
+        # the module HAS a tp_axis field (shared DecoderBlock), so the
+        # base hasattr check would accept it and fail only at trace
+        # time inside the first round; reject at the job surface with
+        # the same rationale as tp_rules=None above
+        raise ValueError(
+            "gpt-moe-mini does not support tensor parallelism (the "
+            "Megatron split would leave the expert FFNs — the bulk of "
+            "the params — replicated); use expert parallelism "
+            "(ep_mesh) for this family")
 
     def build(self):
         return GPTModule(ffn=512, n_experts=8, ep_mesh=self.ep_mesh)

@@ -102,6 +102,24 @@ def make_dispatch(logits: jax.Array, capacity: int, k: int = 2,
     return dispatch, combine, aux
 
 
+def route_tokens(router: jax.Array, x: jax.Array, *, k: int = 2,
+                 capacity_factor: float = 1.25,
+                 token_mask: Optional[jax.Array] = None
+                 ) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """THE routing preamble — f32 router matmul, capacity formula, and
+    GShard dispatch — shared by `moe_apply` (GSPMD path) and the manual
+    expert path (models/gpt.py MoEFFN with ep_axis), so the two
+    execution strategies can never drift in routing semantics.
+
+    x: [T, d_model]; router: [d_model, E]. Returns (dispatch [T, E, C],
+    combine [T, E, C], aux_loss)."""
+    t = x.shape[0]
+    e = router.shape[1]
+    capacity = max(1, math.ceil((t / e) * capacity_factor))
+    logits = x.astype(jnp.float32) @ router.astype(jnp.float32)
+    return make_dispatch(logits, capacity, k, token_mask=token_mask)
+
+
 def moe_apply(params: Dict[str, jax.Array], x: jax.Array,
               mesh: Optional[Mesh] = None, *, k: int = 2,
               capacity_factor: float = 1.25,
@@ -118,19 +136,15 @@ def moe_apply(params: Dict[str, jax.Array], x: jax.Array,
     its fast path, with biases/params cast to match. token_mask [T]
     excludes padding from routing and capacity (see make_dispatch).
     """
-    t = x.shape[0]
-    e = params["router"].shape[1]
-    capacity = max(1, math.ceil((t / e) * capacity_factor))
-
     def on_expert_axis(arr):
         if mesh is None or mesh.shape[EXPERT_AXIS] == 1:
             return arr
         return jax.lax.with_sharding_constraint(
             arr, NamedSharding(mesh, P(EXPERT_AXIS)))
 
-    logits = x.astype(jnp.float32) @ params["router"].astype(jnp.float32)
-    dispatch, combine, aux = make_dispatch(logits, capacity, k,
-                                           token_mask=token_mask)
+    dispatch, combine, aux = route_tokens(
+        params["router"], x, k=k, capacity_factor=capacity_factor,
+        token_mask=token_mask)
 
     cdt = x.dtype
     expert_in = on_expert_axis(

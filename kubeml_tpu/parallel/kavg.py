@@ -294,6 +294,11 @@ class KAvgEngine:
                                        smask), has_aux=True)(params)
                 updates, new_opt = tx.update(grads, opt_state, params)
                 new_params = optax.apply_updates(params, updates)
+                # note: compiling an unmasked variant for all-real rounds
+                # was tried in round 3 and measured WITHIN NOISE on the
+                # v5e headline config — XLA fuses these selects into the
+                # optimizer-update chain, so they are effectively free;
+                # keep the single masked program
                 params = _select_tree(stmask, new_params, params)
                 model_state = _select_tree(stmask, new_state, model_state)
                 opt_state = _select_tree(stmask, new_opt, opt_state)

@@ -55,7 +55,17 @@ logger = logging.getLogger("kubeml_tpu.train")
 # Reduce a list of per-round device loss arrays in ONE dispatch: under
 # jit the list is a pytree of N leaves, so there is no per-element eager
 # expand_dims/concatenate dispatch (compiled once per round-count, cached).
+# Single-process form (bench.py uses it); the job builds a mesh-aware
+# variant whose output is REPLICATED so the host can read it back on a
+# multi-process cluster (the engine's loss_sums are data-axis-sharded,
+# which is not fully addressable from any one process).
 reduce_losses = jax.jit(lambda losses: jnp.stack(losses).sum(axis=0))
+
+
+def _make_loss_reducer(mesh):
+    from jax.sharding import NamedSharding, PartitionSpec
+    return jax.jit(lambda losses: jnp.stack(losses).sum(axis=0),
+                   out_shardings=NamedSharding(mesh, PartitionSpec()))
 
 
 @dataclasses.dataclass
@@ -386,6 +396,7 @@ class TrainJob:
                       "manual" if self._manual_tp
                       else ("gspmd" if n_model > 1 else "-"))
 
+        self._reduce_losses = _make_loss_reducer(self.mesh)
         self._loader = RoundLoader(handle, self.dataset,
                                    n_lanes=data_axis_size(self.mesh),
                                    seed=self.seed,
@@ -476,6 +487,18 @@ class TrainJob:
             from kubeml_tpu.parallel.tp import shard_variables
             self.variables = shard_variables(self.variables, self.mesh,
                                              self._tp_rules)
+        elif jax.process_count() > 1:
+            # multi-process cluster: init produced arrays committed to
+            # THIS process's local device; a global-mesh jit would have
+            # to reshard them cross-host (a collective outside any
+            # compiled program — observed to wedge on the CPU/Gloo
+            # backend). Hand the round host-side values instead: every
+            # process holds the same full array (same seed / same
+            # checkpoint bytes) and jit forms the global replicated
+            # array from local slices with no cross-host transfer —
+            # the dist_worker contract (tests/helpers/dist_worker_main).
+            self.variables = jax.tree_util.tree_map(np.asarray,
+                                                    self.variables)
 
     def _stage_batch(self, rb):
         """Runs in the prefetch thread: push the (large) batch leaves to
@@ -483,7 +506,19 @@ class TrainJob:
         r+1's host->device transfer with round r's compute. Masks/rngs
         stay host-side numpy — they are tiny, the job's abort check and
         RoundStats read them without a device readback, and round hooks
-        may mutate them (device-resident batch leaves are immutable)."""
+        may mutate them (device-resident batch leaves are immutable).
+
+        Multi-process clusters skip the committed staging entirely:
+        `jax.device_put` onto a cross-process NamedSharding runs a
+        sharding-consistency `process_allgather` INSIDE the call, and
+        that collective deadlocks when issued from this non-main thread
+        (observed on the CPU/Gloo cluster; faulthandler stacks pin both
+        ranks inside `multihost_utils.assert_equal`). Host arrays are
+        handed to the round instead — jit forms the global arrays from
+        local slices at dispatch, the proven dist_worker contract; the
+        prefetch thread still overlaps round ASSEMBLY with compute."""
+        if jax.process_count() > 1:
+            return rb
         batch = {k: jax.tree_util.tree_map(
             lambda a: jax.device_put(a, self._batch_sharding(k)), v)
             for k, v in rb.batch.items()}
@@ -505,7 +540,11 @@ class TrainJob:
         on the host, then stage batch-sharded over the data axis. Same
         prefetch-thread overlap as _stage_batch; masks stay host-side so
         round hooks (fault injection) can still mutate worker_mask
-        before dispatch."""
+        before dispatch. Multi-process: host reflow only, no committed
+        staging (same thread-deadlock hazard as _stage_batch)."""
+        if jax.process_count() > 1:
+            batch = jax.tree_util.tree_map(self._to_global, rb.batch)
+            return dataclasses.replace(rb, batch=batch)
         batch = jax.tree_util.tree_map(
             lambda a: jax.device_put(self._to_global(a),
                                      self._sync_batch_sharding), rb.batch)
@@ -559,7 +598,7 @@ class TrainJob:
             step_counts += stats.step_count * rb.worker_mask
             dev_losses.append(stats.loss_sum_device)
         with self.tracer.span("device_drain"):
-            loss_sums = np.asarray(reduce_losses(dev_losses)) \
+            loss_sums = np.asarray(self._reduce_losses(dev_losses)) \
                 if dev_losses else np.zeros(0)
         # per-worker epoch loss, then unweighted mean over workers that ran
         # (reference aggregation ml/pkg/train/util.go:82-98)
@@ -597,7 +636,7 @@ class TrainJob:
             real_steps += int((smask_global.sum(axis=1) > 0).sum())
             dev_losses.append(losses)
         with self.tracer.span("device_drain"):
-            loss_sums = np.asarray(reduce_losses(dev_losses)) \
+            loss_sums = np.asarray(self._reduce_losses(dev_losses)) \
                 if dev_losses else np.zeros(0)
         if real_steps == 0:  # zero-round epoch: _sync_state may still be None
             raise MergeError("epoch produced no training steps")

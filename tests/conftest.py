@@ -90,6 +90,10 @@ SLOW_TESTS = {
     "test_two_process_result_matches_single_process",
     "test_distributed_multiprocess.py::"
     "test_checkpoint_written_by_coordinator",
+    "test_distributed_multiprocess.py::"
+    "test_full_job_runs_across_two_processes",
+    "test_distributed_multiprocess.py::"
+    "test_full_job_matches_single_process",
     "test_role_deployment.py::test_split_role_processes_train",
     "test_standalone_jobs.py::test_standalone_stop",
     "test_standalone_jobs.py::test_standalone_train_updates_and_infer",

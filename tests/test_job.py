@@ -472,6 +472,10 @@ def test_job_parallelism_option_validation(setup):
         o.n_model = 2
         o.tp_impl = "manual"
     expect_400(manual_on_mlp, match="manual tensor parallelism")
+    # manual TP on MoE: curated 400, not a trace-time 500 (the module
+    # HAS a tp_axis field but the expert FFNs reject the split)
+    expect_400(manual_on_mlp, m=get_builtin("gpt-moe-mini")(),
+               match="expert")
     # TP + SP combined runs manual TP, which requires ring (not ulysses)
     def both_ulysses(o):
         o.n_model = 2

@@ -162,17 +162,18 @@ class TestTrainRound:
         np.testing.assert_allclose(a, b, rtol=2e-2, atol=2e-2)
 
     def test_compressed_merge_rejects_bad_configs(self, mesh4x2, rng):
-        """Non-float wire dtypes fail loudly; so does composing the
-        compressed merge with seq-parallel training (vma round)."""
+        """Non-float wire dtypes fail loudly. (Round 2 also rejected
+        compression x seq-parallel training here; round 3's fully-manual
+        rounds carry it — tests/test_manual_tp.py pins that path.)"""
         with pytest.raises(ValueError, match="floating"):
             KAvgEngine(mesh4x2, linear_loss, linear_metrics, sgd_factory,
                        donate=False, merge_dtype=jnp.int16)
         from kubeml_tpu.parallel.mesh import make_mesh
         seq_mesh = make_mesh(n_data=2, n_seq=2)
-        with pytest.raises(ValueError, match="sequence-parallel"):
-            KAvgEngine(seq_mesh, linear_loss, linear_metrics, sgd_factory,
-                       donate=False, merge_dtype=jnp.bfloat16,
-                       batch_seq_dims={"x": 0})
+        eng = KAvgEngine(seq_mesh, linear_loss, linear_metrics,
+                         sgd_factory, donate=False,
+                         merge_dtype=jnp.bfloat16, batch_seq_dims={"x": 0})
+        assert eng._full_manual and not eng._compressed_ring
 
     def test_step_mask_freezes_padded_steps(self, mesh8, rng):
         """Ragged chunks: a masked step must leave weights untouched."""
