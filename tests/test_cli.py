@@ -118,16 +118,20 @@ def test_cli_parallelism_flags(stack, capsys):
         run_cli(dep, "train", "-f", "mlp", "-d", "blobs", "-e", "1",
                 "--lr", "0.1", "--tensor-parallel", "0")
     assert ">= 1" in capsys.readouterr().err
+    # TP+SP combined is ACCEPTED since round 3 (manual path); only the
+    # ulysses impl is rejected (it re-shards the head axis TP owns)
     with pytest.raises(SystemExit):
         run_cli(dep, "train", "-f", "mlp", "-d", "blobs", "-e", "1",
                 "--lr", "0.1", "--tensor-parallel", "2",
-                "--seq-parallel", "2")
-    assert "combined" in capsys.readouterr().err
+                "--seq-parallel", "2", "--seq-impl", "ulysses")
+    assert "ring" in capsys.readouterr().err
     # wire round-trip
     from kubeml_tpu.api.types import TrainOptions
-    opts = TrainOptions(n_model=2, n_seq=1, seq_impl="ulysses")
+    opts = TrainOptions(n_model=2, n_seq=1, seq_impl="ulysses",
+                        tp_impl="manual")
     assert TrainOptions.from_dict(opts.to_dict()).n_model == 2
     assert TrainOptions.from_dict(opts.to_dict()).seq_impl == "ulysses"
+    assert TrainOptions.from_dict(opts.to_dict()).tp_impl == "manual"
 
 
 def test_serve_role_flags_parse():

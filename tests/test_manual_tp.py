@@ -298,6 +298,18 @@ def test_kavg_sp_compressed_merge():
         assert np.isfinite(np.asarray(leaf, np.float32)).all()
 
 
+def test_validate_tp_geometry():
+    """Pure-python geometry gate (the smoke-tier representative for this
+    subsystem — every other test here compiles multi-axis shard_maps)."""
+    from kubeml_tpu.parallel.manual import validate_tp_geometry
+
+    validate_tp_geometry(heads=4, ffn=512, n_model=2)
+    with pytest.raises(ValueError, match="heads do not divide"):
+        validate_tp_geometry(heads=3, ffn=512, n_model=2)
+    with pytest.raises(ValueError, match="FFN width"):
+        validate_tp_geometry(heads=4, ffn=511, n_model=2)
+
+
 def test_manual_tp_rejects_indivisible_heads(tp2_mesh):
     """3 heads on a 2-way model axis: readable trace-time error."""
     from kubeml_tpu.models.bert import BertModule
