@@ -255,6 +255,28 @@ def main():
                 {"pct_peak": round(100 * (2 * fl * K / secs_b / 1e12)
                                    / peak, 1),
                  "vs_fwd": round(secs_b / secs, 2)})
+
+        # split attribution: input-grad (transposed conv) vs filter-grad
+        # (the batch-spatial correlation) — they have very different
+        # TPU lowerings, and which one is slow decides where a custom
+        # kernel could pay
+        gx_fn = jax.grad(conv_loss)
+
+        def bwd_gx(i, x, w, gx_fn=gx_fn):
+            gx, _ = gx_fn((x, jnp.roll(w, i, axis=3)))
+            return gx.sum()
+
+        def bwd_gw(i, x, w, gx_fn=gx_fn):
+            _, gw = gx_fn((x, jnp.roll(w, i, axis=3)))
+            return gw.sum()
+
+        for tag, fn in (("gx", bwd_gx), ("gw", bwd_gw)):
+            # each runs fwd + ONE grad (DCE removes the other): fl for
+            # the fwd recompute + fl for the grad conv
+            s = _timed_scan(fn, K, x, w)
+            _report(f"conv_bwd_{tag}[{name}]", s, K, 2 * fl,
+                    {"pct_peak": round(100 * (2 * fl * K / s / 1e12)
+                                       / peak, 1)})
         del x
 
     # --- 6. whole model cross-check ----------------------------------

@@ -820,7 +820,16 @@ class GPTMoEMini(GPTMini):
 
     name = "gpt-moe-mini"
     aux_coef = 0.01
-    seq_batch_dims = None  # MoE routing is not seq-parallel (see below)
+    # round 3 lifts round 2's SP x MoE exclusion: sequences shard over
+    # the seq axis with PER-SHARD routing — each shard routes its local
+    # T/n tokens with capacity ceil((T_local/E) * factor), the standard
+    # distributed-MoE semantics (routing groups follow the device
+    # layout, exactly like the pipelined trunk routes per microbatch).
+    # Equal to the dense forward whenever no expert overflows; under
+    # overflow the drop pattern differs by grouping, not by correctness.
+    # Requires replicated experts (no GSPMD ep_mesh inside the manual
+    # seq shard_map).
+    seq_batch_dims = {"x": 0}
     # job-level TP stays rejected too: the Megatron table would shard
     # only the attention stack while the expert FFNs (the bulk of the
     # params, under 'moe') stay replicated — use ep_mesh expert
@@ -830,11 +839,19 @@ class GPTMoEMini(GPTMini):
     def __init__(self, ep_mesh=None):
         self.ep_mesh = ep_mesh
 
+    def _require_replicated_experts(self) -> None:
+        # check the MODULE's ep_mesh (what actually executes), not just
+        # the constructor arg — they can diverge after build()
+        if self.ep_mesh is not None or \
+                getattr(self.module, "ep_mesh", None) is not None:
+            raise ValueError(
+                "sequence-parallel MoE requires replicated experts: "
+                "GSPMD ep_mesh constraints cannot cross the manual "
+                "seq-axis shard_map (construct without ep_mesh)")
+
     def enable_seq_parallel(self, impl: str = "ring") -> None:
-        raise ValueError(
-            "gpt-moe-mini does not compose expert routing with the "
-            "seq-axis shard_map; use the dense gpt-mini for "
-            "sequence-parallel jobs")
+        self._require_replicated_experts()
+        super().enable_seq_parallel(impl)
 
     def enable_tensor_parallel(self) -> None:
         # the module HAS a tp_axis field (shared DecoderBlock), so the
@@ -857,11 +874,20 @@ class GPTMoEMini(GPTMini):
         sown = new_state.pop("intermediates", {})
         aux = sum(jax.tree_util.tree_leaves(sown)) / max(
             1, self.module.layers)
-        return _lm_per_example(logits, x) + self.aux_coef * aux, new_state
+        if self.module.seq_axis is not None:
+            # per-shard aux statistics average over the ring so the
+            # per-example loss is seq-INVARIANT (the vma-checked round's
+            # contract — see KAvgEngine.batch_seq_dims)
+            axis = self.module.seq_axis
+            aux = lax.psum(aux, axis) / lax.axis_size(axis)
+            per_ex = _lm_per_example_sp(logits, x, axis)
+        else:
+            per_ex = _lm_per_example(logits, x)
+        return per_ex + self.aux_coef * aux, new_state
 
     def forward_seq_parallel(self, variables, x, mesh, impl="ring"):
-        raise NotImplementedError(
-            "sequence-parallel MoE is not supported: per-shard routing "
-            "capacity and expert sharding constraints do not compose "
-            "with the seq-axis shard_map; use the dense gpt-mini for "
-            "seq-parallel forwards")
+        """Long-context MoE forward over the mesh `seq` axis with
+        PER-SHARD routing (class docstring). Requires replicated
+        experts; delegates to the dense family's ring/ulysses driver."""
+        self._require_replicated_experts()
+        return super().forward_seq_parallel(variables, x, mesh, impl)

@@ -51,6 +51,9 @@ SLOW_TESTS = {
     "test_models_text.py::test_bert_seq_parallel_matches_dense",
     "test_parallel_tp_sp.py::test_gpt_tp_forward_matches_replicated",
     "test_models_gpt.py::test_gpt_moe_ep_sharded_matches_unsharded",
+    "test_models_gpt.py::test_gpt_moe_seq_parallel_matches_dense",
+    "test_models_gpt.py::test_gpt_moe_seq_parallel_default_capacity_runs",
+    "test_models_gpt.py::test_gpt_moe_trains_seq_parallel",
     "test_models_gpt.py::test_gpt_pipelined_matches_dense",
     "test_models_text.py::test_bert_max_len_guard",
     # experiment harness grids
