@@ -63,6 +63,14 @@ class TrainOptions:
     # workers and re-lowers its round program at every change; 0 keeps
     # that parity behavior, N > 0 stops growth at N
     max_parallelism: int = 0
+    # net-new recovery: how many times the PS restarts a standalone job
+    # whose process dies without finishing (OOM-kill, segfault, host
+    # eviction), resuming from the job's own latest checkpoint with its
+    # history and topology restored. 0 disables (a dead process fails
+    # the job, the pre-r4 behavior). The reference survives pod death
+    # only within a single merge (util.go:144-166) and loses the job if
+    # its TrainJob pod dies; checkpoint-based restart closes that gap.
+    max_restarts: int = 1
 
     def to_dict(self) -> dict:
         return {
@@ -79,6 +87,7 @@ class TrainOptions:
             "seq_impl": self.seq_impl,
             "tp_impl": self.tp_impl,
             "max_parallelism": self.max_parallelism,
+            "max_restarts": self.max_restarts,
         }
 
     @classmethod
@@ -97,6 +106,7 @@ class TrainOptions:
             seq_impl=d.get("seq_impl", "ring"),
             tp_impl=d.get("tp_impl", "gspmd"),
             max_parallelism=int(d.get("max_parallelism", 0)),
+            max_restarts=int(d.get("max_restarts", 1)),
         )
 
 

@@ -4,6 +4,7 @@ Parity with ml/pkg/kubeml-cli/ (cmd/root.go:8-12 + cmd/*.go):
     kubeml train -f FN -d DS -e N -b N --lr F [--validate-every N]
                  [-p N] [--static] [-K N] [--sparse-avg] [--goal-accuracy F]
                  [--resume-from JOBID] [--checkpoint-every N]
+                 [--max-restarts N]
     kubeml infer -n JOBID --datafile FILE
     kubeml dataset create|delete|list
     kubeml fn create|delete|list
@@ -53,6 +54,8 @@ def cmd_train(args):
         _fail("--tensor-parallel/--seq-parallel must be >= 1")
     if args.max_parallelism < 0:
         _fail("--max-parallelism must be >= 0")
+    if args.max_restarts < 0:
+        _fail("--max-restarts must be >= 0")
     if args.tensor_parallel > 1 and args.seq_parallel > 1 \
             and args.seq_impl == "ulysses":
         _fail("tensor parallelism composes with --seq-impl ring only "
@@ -84,7 +87,8 @@ def cmd_train(args):
             n_seq=args.seq_parallel,
             seq_impl=args.seq_impl,
             tp_impl=args.tp_impl,
-            max_parallelism=args.max_parallelism))
+            max_parallelism=args.max_parallelism,
+            max_restarts=args.max_restarts))
     job_id = client.v1().networks().train(req)
     print(job_id)
 
@@ -336,6 +340,11 @@ def build_parser() -> argparse.ArgumentParser:
     t.add_argument("--max-parallelism", type=int, default=0, metavar="N",
                    help="cap scheduler-driven parallelism growth at N "
                         "(0 = unbounded, reference parity)")
+    t.add_argument("--max-restarts", type=int, default=1, metavar="N",
+                   help="restart a crashed standalone job process from "
+                        "its own checkpoint up to N times, resuming its "
+                        "epoch/history/topology (0 = a dead process "
+                        "fails the job)")
     t.set_defaults(fn=cmd_train)
 
     i = sub.add_parser("infer", help="run inference on a trained model")

@@ -82,9 +82,15 @@ class _JobRecord:
         self.partition: Optional[int] = None  # device-partition slot
         self.next_parallelism: Optional[int] = None
         self.update_event = threading.Event()
+        self.restarts = 0  # checkpoint-based crash restarts consumed
+        self.restarting = False  # watchdog respawn claimed, in progress
 
     def push_update(self, parallelism: int):
-        if self.proc is not None and self.url is None:
+        # standalone-ness is `job is None`, NOT `proc is not None`: a
+        # crash-restarting record has proc/url transiently None and must
+        # answer the 503 retry signal, not silently bank the update in
+        # the threaded-mode field nothing reads for it
+        if self.job is None and self.url is None:
             raise KubeMLException(
                 f"job {self.task.job_id} still starting", 503)
         if self.url is not None:
@@ -103,16 +109,6 @@ class _JobRecord:
             raise KubeMLException(
                 f"job {self.task.job_id} still starting", 503)
 
-    def join(self, timeout: Optional[float]) -> bool:
-        """True when the job is no longer running."""
-        if self.proc is not None:
-            try:
-                self.proc.wait(timeout)
-            except subprocess.TimeoutExpired:
-                return False
-            return True
-        self.thread.join(timeout)
-        return not self.thread.is_alive()
 
 
 class ParameterServer(JsonService):
@@ -336,8 +332,31 @@ class ParameterServer(JsonService):
                 self._busy_partitions.add(free[0])
             self.jobs[task.job_id] = rec
         self.metrics.running_total.inc("train")
-        task.state = "starting"
+        try:
+            self._spawn_standalone(rec)
+        except Exception:
+            with self._jobs_lock:
+                popped = self.jobs.pop(task.job_id, None)
+            if popped is not None:  # not already finished via /finish
+                self.metrics.running_total.inc("train", -1.0)
+            if rec.proc is not None:
+                # reap off-thread; the partition frees only once the
+                # terminated child is GONE (chips stay held until exit)
+                threading.Thread(target=self._reap, args=(rec,),
+                                 name=f"reap-{task.job_id}",
+                                 daemon=True).start()
+            else:
+                self._release_partition(rec)
+            raise
 
+    def _spawn_standalone(self, rec: _JobRecord) -> None:
+        """Spawn the per-job child process, wait for readiness, push the
+        task, and arm the crash watchdog. Shared by the first start and
+        the watchdog's checkpoint-based restart; a failed spawn cleans up
+        its own child process, while record/partition bookkeeping stays
+        with the caller."""
+        task = rec.task
+        task.state = "starting"
         tmp_dir = tempfile.mkdtemp(prefix=f"kubeml-job-{task.job_id}-")
         port_file = os.path.join(tmp_dir, "port")
         cmd = [sys.executable, "-m", "kubeml_tpu.train.jobserver",
@@ -386,17 +405,11 @@ class ParameterServer(JsonService):
                     time.sleep(delay)
                     delay = min(delay * 2, 5.0)
         except Exception:
-            with self._jobs_lock:
-                popped = self.jobs.pop(task.job_id, None)
-            if popped is not None:  # not already finished via /finish
-                self.metrics.running_total.inc("train", -1.0)
+            # terminate only; the CALLER owns reap/partition bookkeeping
+            # (a single reap path — double-reaping the same record could
+            # double-release its partition around a concurrent re-lease)
             if rec.proc is not None:
                 rec.proc.terminate()
-                threading.Thread(target=self._reap, args=(rec,),
-                                 name=f"reap-{task.job_id}",
-                                 daemon=True).start()
-            else:
-                self._release_partition(rec)
             raise
         finally:
             shutil.rmtree(tmp_dir, ignore_errors=True)
@@ -411,15 +424,51 @@ class ParameterServer(JsonService):
                          name=f"watch-{task.job_id}", daemon=True).start()
 
     def _watch_standalone(self, job_id: str, rec: _JobRecord):
-        rec.proc.wait()
+        proc = rec.proc
+        proc.wait()
+        rc = proc.returncode
+        # checkpoint-based recovery: a crashed job process (OOM-kill,
+        # segfault — the pod-death analogue of the reference's
+        # merge-with-survivors tolerance, util.go:144-166) restarts from
+        # its OWN latest checkpoint with history/epoch/parallelism
+        # restored (train/job.py resume-from-self), up to
+        # options.max_restarts times. Not eligible: an acknowledged
+        # /stop (a restart would undo the user's decision) or no
+        # checkpoint (nothing to resume) — those fail as before. The
+        # claim happens UNDER the jobs lock so a concurrent /finish
+        # observes either the dead incarnation or the respawn claim,
+        # never a half-restarted record.
+        opts = rec.task.parameters.options
         with self._jobs_lock:
-            still_registered = self.jobs.get(job_id) is rec
-        if still_registered:
-            logger.warning("job %s process exited without finishing "
-                           "(rc=%s)", job_id, rec.proc.returncode)
+            if self.jobs.get(job_id) is not rec:
+                return  # already deregistered via /finish
+            eligible = (rec.task.state != "stopping"
+                        and rec.restarts < opts.max_restarts
+                        and checkpoint_saved_at(job_id) is not None)
+            if eligible:
+                rec.restarts += 1
+                rec.proc = None
+                rec.url = None
+                rec.restarting = True
+                rec.task.parameters.resume_from = job_id
+        logger.warning("job %s process exited without finishing (rc=%s)",
+                       job_id, rc)
+        if not eligible:
             self._finish(job_id,
-                         error=f"job process exited unexpectedly "
-                               f"(rc={rec.proc.returncode})")
+                         error=f"job process exited unexpectedly (rc={rc})")
+            return
+        logger.warning("job %s: restarting from its checkpoint "
+                       "(restart %d/%d)", job_id, rec.restarts,
+                       opts.max_restarts)
+        try:
+            self._spawn_standalone(rec)  # re-arms the watchdog
+        except Exception as e:
+            rec.restarting = False
+            self._finish(job_id,
+                         error=f"job process crashed (rc={rc}) and "
+                               f"checkpoint restart failed: {e}")
+            return
+        rec.restarting = False
 
     def _wait_job_ready(self, proc: subprocess.Popen, port_file: str,
                         timeout: float = 120.0) -> str:
@@ -485,6 +534,15 @@ class ParameterServer(JsonService):
         """Clear per-job series + notify the scheduler
         (ps/api.go:266-327)."""
         with self._jobs_lock:
+            rec = self.jobs.get(job_id)
+            if rec is not None and rec.restarting:
+                # a finish racing the watchdog's respawn claim can only
+                # be the DEAD incarnation's last message (the respawned
+                # child does not exist yet): the restart owns the
+                # record. A genuinely-finished job's checkpoint is
+                # stamped completed, so the respawn resumes straight
+                # into completion and re-delivers its finish.
+                return
             rec = self.jobs.pop(job_id, None)
         if rec is None:
             return
@@ -520,17 +578,28 @@ class ParameterServer(JsonService):
             self._release_partition(rec)
 
     def _release_partition(self, rec: _JobRecord):
-        if rec.partition is None:
-            return
+        # atomic take-and-clear: concurrent releases (reaper + finish)
+        # must free the slot exactly once, or a second release could
+        # free a slot already re-leased to another job
         with self._jobs_lock:
-            self._busy_partitions.discard(rec.partition)
-        rec.partition = None
+            slot, rec.partition = rec.partition, None
+            if slot is not None:
+                self._busy_partitions.discard(slot)
 
     def wait_for_job(self, job_id: str, timeout: Optional[float] = None
                      ) -> bool:
-        """Test/experiment helper: join a job thread/process."""
-        with self._jobs_lock:
-            rec = self.jobs.get(job_id)
-        if rec is None:
-            return True
-        return rec.join(timeout)
+        """Test/experiment helper: wait until the job is done.
+
+        Polls the job index rather than joining one process/thread
+        handle: a crashed-and-restarting record keeps its registration
+        across incarnations (rec.proc is transiently None mid-restart),
+        so deregistration — not any single child's exit — is the "job
+        finished" signal."""
+        deadline = None if timeout is None else time.monotonic() + timeout
+        while True:
+            with self._jobs_lock:
+                if job_id not in self.jobs:
+                    return True
+            if deadline is not None and time.monotonic() > deadline:
+                return False
+            time.sleep(0.05)

@@ -260,19 +260,12 @@ class KAvgEngine:
 
     # ---------------------------------------------------------------- train
 
-    def _build_train_round(self, w_per_lane: int, batch_template=None,
-                           fuse: int = 1):
-        """Compile the sync-round program.
+    def _build_train_round(self, w_per_lane: int, batch_template=None):
+        """Compile the sync-round program: one sync round per dispatch.
 
-        fuse=1: one round per dispatch (the general path — elastic N,
-        chaos hooks, seq/manual rounds). fuse=R>1: R rounds execute in
-        ONE dispatch as a lax.scan over round inputs — host dispatch,
-        merge bookkeeping, and scheduling gaps amortize R-fold, worth
-        ~8% on the v5e headline config where a round is ~50 ms. The
-        fused program takes a TUPLE of R per-round batches (kept as
-        separate staged arrays so the prefetch thread's transfer overlap
-        is preserved; they stack on device) plus [R, ...]-stacked masks
-        and rngs.
+        A round is K masked local steps per virtual worker (lax.scan)
+        followed by the masked-psum merge; elastic N, chaos hooks, and
+        the seq/manual variants all flow through this one program.
         """
         mesh = self.mesh
         loss_fn = self.loss_fn

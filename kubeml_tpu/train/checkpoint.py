@@ -204,6 +204,26 @@ class AsyncCheckpointer:
                     self._cond.notify_all()
 
 
+def mark_checkpoint_completed(job_id: str, root: Optional[str] = None
+                              ) -> None:
+    """Stamp the published manifest `completed=True`, weights untouched.
+
+    Used when the last periodic save already captured the final model
+    state (so rewriting the weights would be redundant): the flag tells
+    a crash-recovery resume that the job's epochs are DONE — a process
+    killed between its final save and its /finish notification must
+    finish immediately on restart, not retrain. saved_at is preserved so
+    manifest-stamp caches (the PS infer cache) stay valid."""
+    path = os.path.join(root or _models_root(), job_id, "manifest.json")
+    with open(path) as f:
+        manifest = json.load(f)
+    manifest["completed"] = True
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(manifest, f)
+    os.replace(tmp, path)
+
+
 def checkpoint_saved_at(job_id: str, root: Optional[str] = None
                         ) -> Optional[float]:
     """The manifest's saved_at stamp, or None when absent/unreadable.

@@ -45,7 +45,9 @@ from kubeml_tpu.data.registry import DatasetRegistry
 from kubeml_tpu.models.base import KubeDataset, KubeModel
 from kubeml_tpu.parallel.kavg import KAvgEngine
 from kubeml_tpu.parallel.mesh import data_axis_size
-from kubeml_tpu.train.checkpoint import AsyncCheckpointer, save_checkpoint
+from kubeml_tpu.train.checkpoint import (AsyncCheckpointer,
+                                         mark_checkpoint_completed,
+                                         save_checkpoint)
 from kubeml_tpu.train.history import HistoryStore
 from kubeml_tpu.utils.env import limit_parallelism
 from kubeml_tpu.utils.trace import Tracer
@@ -116,6 +118,10 @@ class TrainJob:
         self.history = JobHistory()
         self.exit_err: Optional[str] = None
         self.variables = None
+        # first epoch index to run: nonzero only when crash-recovering
+        # from this job's OWN checkpoint (resume_from == job_id), where
+        # completed epochs are restored from the manifest and skipped
+        self._start_epoch = 0
 
     # ------------------------------------------------------------------ api
 
@@ -178,8 +184,16 @@ class TrainJob:
                 # scheduler adjustment
                 parallelism = min(parallelism, opts.max_parallelism)
 
+            if self._start_epoch:
+                # crash recovery: completed epochs restored from the
+                # checkpoint manifest (parallelism too — picked up by the
+                # task.parallelism read above); resume where it stopped
+                self._log("job %s resuming at epoch %d/%d (N=%d) from "
+                          "its own checkpoint", job_id,
+                          self._start_epoch + 1, epochs, parallelism)
+
             last_ckpt_epoch = -1
-            for epoch in range(epochs):
+            for epoch in range(self._start_epoch, epochs):
                 t0 = time.time()
                 used_parallelism = parallelism
                 train_loss = self._train_epoch(parallelism, epoch)
@@ -241,8 +255,10 @@ class TrainJob:
                 if self.checkpoint and want_ckpt:
                     # async: the device snapshot is immediate; the full
                     # readback + write happens off the epoch loop
-                    self._checkpointer.save(job_id, self.variables,
-                                            self._manifest(epoch=epoch + 1))
+                    self._checkpointer.save(
+                        job_id, self.variables,
+                        self._manifest(epoch=epoch + 1,
+                                       parallelism=parallelism))
                     last_ckpt_epoch = epoch + 1
 
                 if self.stop_event.is_set():
@@ -284,7 +300,17 @@ class TrainJob:
                               "attempting final save", job_id, e)
                 if ckpt_err is not None or \
                         last_ckpt_epoch != len(self.history.train_loss):
-                    save_checkpoint(job_id, self.variables, self._manifest())
+                    save_checkpoint(
+                        job_id, self.variables,
+                        self._manifest(epoch=len(self.history.train_loss),
+                                       parallelism=parallelism,
+                                       completed=True))
+                else:
+                    # the last periodic save already captured the final
+                    # state; stamp it completed so a crash before the
+                    # /finish notification resumes into "done", not a
+                    # retrain of finished epochs
+                    mark_checkpoint_completed(job_id)
             record = History(id=job_id, task=self.req, data=self.history)
             if self.history_store is not None:
                 self.history_store.save(record)
@@ -307,14 +333,26 @@ class TrainJob:
 
     # ------------------------------------------------------------ internals
 
-    def _manifest(self, epoch: Optional[int] = None) -> dict:
+    def _manifest(self, epoch: Optional[int] = None,
+                  parallelism: Optional[int] = None,
+                  completed: bool = False) -> dict:
         m = {
             "model": self.req.model_type,
             "function": self.req.function_name or self.req.model_type,
             "dataset": self.req.dataset,
         }
+        if completed:
+            m["completed"] = True
         if epoch is not None:
+            # mid-job snapshot: record everything crash recovery needs to
+            # resume THIS job where it stopped — completed-epoch count,
+            # per-epoch history so far (to_dict deep-copies the lists, so
+            # later epoch appends don't mutate a queued async save), and
+            # the parallelism negotiated for the NEXT epoch
             m["epoch"] = epoch
+            m["history"] = self.history.to_dict()
+            if parallelism is not None:
+                m["parallelism"] = parallelism
         return m
 
     def _init_model(self):
@@ -444,6 +482,30 @@ class TrainJob:
                 raise KubeMLException(
                     f"checkpoint {self.req.resume_from} holds function "
                     f"{ckpt_fn!r}, not {this_fn!r}", 400)
+            if self.req.resume_from == self.task.job_id and \
+                    (manifest.get("epoch") or manifest.get("completed")):
+                # crash recovery (the PS watchdog restarts a dead job
+                # process with resume_from = its own id): this is the
+                # SAME job continuing, not a warm start of a new one —
+                # restore the per-epoch history and completed-epoch
+                # count so the final record is continuous across the
+                # crash, and the parallelism negotiated for the next
+                # epoch so the surviving topology carries over. The
+                # reference tolerates pod death WITHIN a merge
+                # (util.go:144-166); process-level recovery is net-new.
+                self._start_epoch = int(manifest.get("epoch") or 0)
+                if manifest.get("completed"):
+                    # the crash hit between the final save and the
+                    # /finish notification: every epoch (incl. an
+                    # early-stopped run's) is done — resume straight
+                    # into completion, never retrain finished epochs
+                    self._start_epoch = max(self._start_epoch,
+                                            self.req.epochs)
+                if manifest.get("history"):
+                    self.history = JobHistory.from_dict(
+                        manifest["history"])
+                if manifest.get("parallelism"):
+                    self.task.parallelism = int(manifest["parallelism"])
 
         # init from one real batch, like the reference's init function
         # (network.py:174-189 runs user init then saves the state dict)

@@ -150,10 +150,13 @@ SLOW_TESTS = {
     "test_distributed_multiprocess.py::"
     "test_full_job_matches_single_process",
     "test_role_deployment.py::test_split_role_processes_train",
+    "test_distributed_multiprocess.py::"
+    "test_job_survives_rank_death_via_checkpoint_restart",
     "test_standalone_jobs.py::test_standalone_stop",
     "test_standalone_jobs.py::test_standalone_train_updates_and_infer",
     "test_standalone_jobs.py::test_dual_standalone_jobs_with_partitions",
     "test_standalone_jobs.py::test_crashed_job_process_releases_partition",
+    "test_standalone_jobs.py::test_crashed_job_restarts_from_checkpoint",
     "test_control_plane.py::test_dynamic_parallelism_through_scheduler",
     "test_control_plane.py::test_metrics_exposition_and_clearing",
     "test_control_plane.py::test_mid_job_inference",

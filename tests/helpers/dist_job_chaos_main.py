@@ -1,0 +1,144 @@
+"""Worker program for the 2-process TrainJob CHAOS test (VERDICT r3 item 2).
+
+Two phases, selected by $CHAOS_PHASE:
+
+  crash   — run the same full-TrainJob loop as dist_job_main.py, but at
+            the between-epoch scheduler callback AFTER epoch 2's
+            training (the second callback), each rank first waits for
+            its own epoch-1 checkpoint to be durable, then rank 1
+            SIGKILLs itself — the worker-process-death scenario. Rank 0
+            proceeds into the next epoch and blocks in the first
+            cross-process collective; the launcher's --fail-fast kills
+            it and reports the casualty.
+  resume  — relaunch the SAME job id with resume_from = its own id: the
+            TrainJob restores the completed epochs' history, epoch
+            index, and negotiated parallelism from the checkpoint
+            manifest and runs the job to completion. The final history
+            must be continuous across the crash.
+
+The reference survives function-pod death only within a single merge
+(ml/pkg/train/util.go:144-166) and loses the job when its TrainJob pod
+dies; checkpoint-based restart closes that gap at the process level.
+"""
+import faulthandler
+import json
+import os
+import signal
+import sys
+import time
+
+faulthandler.dump_traceback_later(120, repeat=True)
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__)))))
+
+from kubeml_tpu.parallel.distributed import initialize  # noqa: E402
+
+initialize()
+
+import jax  # noqa: E402
+
+JOB_ID = "distjobc"
+
+
+def main(outdir: str) -> None:
+    pid = jax.process_index()
+    phase = os.environ["CHAOS_PHASE"]
+    os.environ["KUBEML_TPU_HOME"] = os.path.join(outdir, f"p{pid}")
+
+    from kubeml_tpu.data.registry import DatasetRegistry
+    from kubeml_tpu.models import get_builtin
+    from kubeml_tpu.parallel.distributed import make_multislice_mesh
+    from kubeml_tpu.train.history import HistoryStore
+    from kubeml_tpu.train.job import JobCallbacks, TrainJob
+    from tests.test_job import ToyDataset, make_blobs, make_task
+
+    assert jax.process_count() == 2
+    mesh = make_multislice_mesh()
+    print(f"[rank {pid}] cluster up, phase={phase}", flush=True)
+
+    reg = DatasetRegistry()
+    if phase == "crash":  # resume reuses the home (and its dataset files)
+        make_blobs(reg)  # deterministic seed: identical data everywhere
+    store = HistoryStore()
+    model = get_builtin("mlp")(hidden=16, num_classes=4)
+
+    manifest_path = os.path.join(os.environ["KUBEML_TPU_HOME"], "models",
+                                 JOB_ID, "manifest.json")
+
+    def manifest_epoch() -> int:
+        try:
+            with open(manifest_path) as f:
+                return int(json.load(f).get("epoch") or 0)
+        except (OSError, ValueError):
+            return 0
+
+    if phase == "crash":
+        # full schedule 2 -> 4 -> 8; the crash lands at the SECOND
+        # between-epoch callback (after epoch 2's training, before its
+        # checkpoint), so the durable state at death is the epoch-1
+        # checkpoint carrying history[:1] and next-parallelism 4
+        schedule = iter([4, 8])
+        calls = {"n": 0}
+
+        def _req(task):
+            calls["n"] += 1
+            if calls["n"] == 2:
+                deadline = time.time() + 120
+                while manifest_epoch() < 1:
+                    assert time.time() < deadline, \
+                        "epoch-1 checkpoint never became durable"
+                    time.sleep(0.05)
+                if pid == 1:
+                    print(f"[rank {pid}] chaos: SIGKILL self", flush=True)
+                    sys.stdout.flush()
+                    os.kill(os.getpid(), signal.SIGKILL)
+            return next(schedule, None)
+
+        def _metrics(m):
+            # record the pre-crash epoch metrics for the parent test's
+            # continuity check (only epoch 1's reaches this point)
+            with open(os.path.join(outdir, f"crash_metrics_p{pid}.jsonl"),
+                      "a") as f:
+                f.write(json.dumps({"train_loss": float(m.train_loss),
+                                    "parallelism": m.parallelism}) + "\n")
+
+        task = make_task(job_id=JOB_ID, epochs=3, parallelism=2, k=2,
+                         batch=32, lr=0.1, static=False, validate_every=1)
+        job = TrainJob(task, model, ToyDataset(), mesh, registry=reg,
+                       history_store=store,
+                       callbacks=JobCallbacks(request_parallelism=_req,
+                                              publish_metrics=_metrics))
+        job.train()
+        raise AssertionError("crash phase completed without crashing")
+
+    # ---- resume phase
+    assert manifest_epoch() >= 1, "no durable checkpoint to resume from"
+    schedule = iter([8])
+    task = make_task(job_id=JOB_ID, epochs=3, parallelism=2, k=2,
+                     batch=32, lr=0.1, static=False, validate_every=1)
+    task.parameters.resume_from = JOB_ID
+    job = TrainJob(task, model, ToyDataset(), mesh, registry=reg,
+                   history_store=store,
+                   callbacks=JobCallbacks(
+                       request_parallelism=lambda t: next(schedule, None)))
+    record = job.train()
+
+    # continuous across the crash: all 3 epochs present, the scripted
+    # 2 -> 4 -> 8 trajectory intact (epoch 1 restored, N=4 carried over
+    # from the manifest)
+    assert len(record.data.train_loss) == 3, record.data.train_loss
+    assert record.data.parallelism == [2, 4, 8], record.data.parallelism
+
+    with open(os.path.join(outdir, f"resume_history_p{pid}.json"),
+              "w") as f:
+        json.dump({
+            "train_loss": [float(v) for v in record.data.train_loss],
+            "accuracy": [float(v) for v in record.data.accuracy],
+            "parallelism": list(record.data.parallelism),
+        }, f)
+    print(f"chaosproc {pid} OK", flush=True)
+
+
+if __name__ == "__main__":
+    main(sys.argv[1])

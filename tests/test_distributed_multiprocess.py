@@ -158,6 +158,61 @@ def test_full_job_runs_across_two_processes(dist_job_run):
         np.testing.assert_array_equal(a[k], b[k])
 
 
+def test_job_survives_rank_death_via_checkpoint_restart(tmp_path):
+    """Worker-process-death recovery across a REAL 2-process cluster
+    (VERDICT r3 item 2): rank 1 SIGKILLs itself mid-job (after the
+    epoch-1 checkpoint is durable), the --fail-fast launcher tears the
+    wounded cluster down, and a relaunch with resume_from = the job's
+    own id completes the job with one continuous history — the
+    restored pre-crash epoch metrics byte-identical to what the crashed
+    run recorded."""
+    import json
+
+    outdir = str(tmp_path)
+
+    def launch(phase, timeout):
+        return subprocess.run(
+            [sys.executable, "-m", "tools.launch_distributed",
+             "--processes", "2", "--emulate-cpu", "4", "--fail-fast",
+             "--", sys.executable,
+             os.path.join("tests", "helpers", "dist_job_chaos_main.py"),
+             outdir],
+            cwd=REPO, env=dict(os.environ, CHAOS_PHASE=phase),
+            capture_output=True, text=True, timeout=timeout)
+
+    crash = launch("crash", 600)
+    # the cluster must die nonzero (rank 1 SIGKILL, rank 0 torn down by
+    # the launcher), leaving a durable epoch-1 checkpoint on both ranks
+    assert crash.returncode != 0, f"crash phase exited 0:\n{crash.stdout}"
+    assert "chaos: SIGKILL self" in crash.stdout, crash.stdout[-4000:]
+    for pid in (0, 1):
+        with open(os.path.join(outdir, f"p{pid}", "models", "distjobc",
+                               "manifest.json")) as f:
+            m = json.load(f)
+        assert m["epoch"] == 1 and m["parallelism"] == 4, m
+
+    resume = launch("resume", 900)
+    assert resume.returncode == 0, \
+        f"resume failed:\n{resume.stdout[-6000:]}\n{resume.stderr[-2000:]}"
+    assert "[p0] chaosproc 0 OK" in resume.stdout
+    assert "[p1] chaosproc 1 OK" in resume.stdout
+
+    with open(os.path.join(outdir, "resume_history_p0.json")) as f:
+        h0 = json.load(f)
+    with open(os.path.join(outdir, "resume_history_p1.json")) as f:
+        h1 = json.load(f)
+    assert h0 == h1  # SPMD determinism holds across the restart too
+    assert h0["parallelism"] == [2, 4, 8]
+    assert len(h0["train_loss"]) == 3
+    # continuity: epoch 1's restored loss == what the crashed run
+    # actually published for epoch 1
+    with open(os.path.join(outdir, "crash_metrics_p0.jsonl")) as f:
+        crash_epochs = [json.loads(line) for line in f]
+    assert len(crash_epochs) == 1  # only epoch 1 completed pre-crash
+    assert h0["train_loss"][0] == crash_epochs[0]["train_loss"]
+    assert h0["parallelism"][0] == crash_epochs[0]["parallelism"]
+
+
 def test_full_job_matches_single_process(dist_job_run, tmp_home):
     """The cross-process job computes the same history as the identical
     job on a single-process 8-device mesh (same data, same scripted

@@ -180,6 +180,72 @@ def test_checkpoint_every_and_warm_start(setup, monkeypatch):
     assert warm_rec.data.train_loss[0] < cold_rec.data.train_loss[0]
 
 
+def test_resume_from_self_continues_job(setup):
+    """Crash-recovery resume (resume_from == own job id): the job
+    restores completed-epoch history, epoch index, and the negotiated
+    parallelism from the mid-job checkpoint manifest, then runs ONLY
+    the remaining epochs — one continuous history (the contract the PS
+    watchdog's checkpoint-based restart builds on)."""
+    from kubeml_tpu.train.checkpoint import save_checkpoint
+
+    reg, store, model, mesh = setup
+    first = TrainJob(make_task(job_id="resumejob1", epochs=2),
+                     model, ToyDataset(), mesh, registry=reg,
+                     history_store=store)
+    rec1 = first.train()
+
+    # re-publish the checkpoint as crash-time state: a mid-job manifest
+    # claiming 2 epochs done and N=5 negotiated for the next epoch
+    variables, manifest = load_checkpoint("resumejob1")
+    crafted = dict(manifest, epoch=2, history=rec1.data.to_dict(),
+                   parallelism=5)
+    crafted.pop("completed", None)  # mid-job state, not a finished one
+    save_checkpoint("resumejob1", variables, crafted)
+
+    task = make_task(job_id="resumejob1", epochs=4)
+    task.parameters.resume_from = "resumejob1"
+    job2 = TrainJob(task, get_builtin("mlp")(hidden=16, num_classes=4),
+                    ToyDataset(), mesh, registry=reg, history_store=store)
+    rec2 = job2.train()
+
+    assert job2._start_epoch == 2
+    assert len(rec2.data.train_loss) == 4
+    # restored epochs preserved verbatim; remaining epochs ran at the
+    # manifest's carried-over parallelism, not the task default
+    assert rec2.data.train_loss[:2] == rec1.data.train_loss
+    assert rec2.data.parallelism == [2, 2, 5, 5]
+    # training actually continued from the checkpoint weights
+    assert rec2.data.train_loss[2] < rec1.data.train_loss[0]
+
+
+def test_resume_from_self_completed_job_retrains_nothing(setup):
+    """A process killed between its final checkpoint and its /finish
+    notification leaves a manifest stamped completed=True; the restart
+    must resume straight into completion — full history, zero epochs
+    retrained — not rerun the job from its last epoch count."""
+    import json
+    import os
+
+    from kubeml_tpu.api.const import kubeml_home
+
+    reg, store, model, mesh = setup
+    first = TrainJob(make_task(job_id="donejob1", epochs=2), model,
+                     ToyDataset(), mesh, registry=reg, history_store=store)
+    rec1 = first.train()
+    with open(os.path.join(kubeml_home(), "models", "donejob1",
+                           "manifest.json")) as f:
+        assert json.load(f)["completed"] is True
+
+    task = make_task(job_id="donejob1", epochs=2)
+    task.parameters.resume_from = "donejob1"
+    job2 = TrainJob(task, get_builtin("mlp")(hidden=16, num_classes=4),
+                    ToyDataset(), mesh, registry=reg, history_store=store)
+    rec2 = job2.train()
+    assert job2._start_epoch == 2  # loop skipped entirely
+    assert rec2.data.train_loss == rec1.data.train_loss
+    assert rec2.data.accuracy == rec1.data.accuracy
+
+
 def test_job_shuffle_option(setup):
     """options.shuffle reaches the RoundLoader (job path of the loader
     regression tests): epoch document order differs between epochs and
@@ -225,7 +291,10 @@ def test_final_save_survives_periodic_failure(setup, monkeypatch):
     assert len(record.data.train_loss) == 2
     variables, manifest = load_checkpoint("flakyckpt1")
     assert manifest["model"] == "mlp"
-    assert manifest.get("epoch") is None  # the final (sync) save won
+    # the final (sync) save won: only it stamps completed=True (periodic
+    # saves never do — and all of them failed here anyway)
+    assert manifest.get("completed") is True
+    assert manifest.get("epoch") == 2
     # the periodic attempt ran (and failed) through the async writer;
     # the final save goes through job.py's direct import, unpatched
     assert calls["n"] >= 1

@@ -183,7 +183,8 @@ def test_crashed_job_process_releases_partition(partitioned_stack):
     req = TrainRequest(model_type="mlp", batch_size=16, epochs=50,
                        dataset="blobs", lr=0.05,
                        options=TrainOptions(default_parallelism=2, k=1,
-                                            static_parallelism=True))
+                                            static_parallelism=True,
+                                            max_restarts=0))
     job_id = client.v1().networks().train(req)
     deadline = time.time() + 240
     rec = None
@@ -194,7 +195,7 @@ def test_crashed_job_process_releases_partition(partitioned_stack):
             break
         time.sleep(0.1)
     assert rec is not None and rec.partition is not None
-    rec.proc.kill()  # simulated OOM-kill
+    rec.proc.kill()  # simulated OOM-kill; max_restarts=0 => must NOT respawn
     deadline = time.time() + 60
     while time.time() < deadline:
         with dep.ps._jobs_lock:
@@ -204,3 +205,82 @@ def test_crashed_job_process_releases_partition(partitioned_stack):
         time.sleep(0.1)
     assert gone
     assert not dep.ps._busy_partitions
+
+
+def test_crashed_job_restarts_from_checkpoint(standalone_stack, tmp_home):
+    """Checkpoint-based crash recovery (VERDICT r3 item 2): SIGKILL the
+    standalone job process mid-job, after at least one periodic
+    checkpoint is durable. The PS watchdog must respawn it with
+    resume_from = its own job id; the restarted process restores the
+    completed epochs' history from the checkpoint manifest and runs the
+    job to completion — one continuous history, state 'finished', and
+    the pre-crash epoch metrics preserved verbatim. The reference loses
+    the job when its TrainJob pod dies (tolerance exists only within a
+    merge, util.go:144-166)."""
+    import json
+    import os
+
+    dep, client, tmp_path = standalone_stack
+    paths = write_blob_files(tmp_path, n_train=4000)
+    client.v1().datasets().create(
+        "blobs", paths["xtr"], paths["ytr"], paths["xte"], paths["yte"])
+
+    epochs = 6
+    req = TrainRequest(model_type="mlp", batch_size=16, epochs=epochs,
+                       dataset="blobs", lr=0.05,
+                       options=TrainOptions(default_parallelism=2, k=1,
+                                            static_parallelism=True,
+                                            max_restarts=1))
+    job_id = client.v1().networks().train(req)
+
+    manifest_path = os.path.join(str(tmp_home), "models", job_id,
+                                 "manifest.json")
+
+    def manifest():
+        try:
+            with open(manifest_path) as f:
+                return json.load(f)
+        except (OSError, ValueError):
+            return {}
+
+    # wait for the child to be up AND a mid-job checkpoint to be durable
+    # (auto cadence: every validated epoch), then kill it mid-job
+    deadline = time.time() + 240
+    rec = None
+    while time.time() < deadline:
+        with dep.ps._jobs_lock:
+            rec = dep.ps.jobs.get(job_id)
+        if rec is None:  # finished before we got to kill it: test bug
+            raise AssertionError("job finished before the kill window")
+        if rec.proc is not None and 1 <= manifest().get("epoch", 0) < epochs:
+            break
+        time.sleep(0.05)
+    pre_crash = manifest()
+    assert pre_crash.get("history"), "mid-job manifest must carry history"
+    rec.proc.kill()  # the crash
+
+    # the SAME record must be respawned (not failed): restarts consumed,
+    # new child process, job still registered
+    deadline = time.time() + 120
+    while time.time() < deadline:
+        with dep.ps._jobs_lock:
+            alive = dep.ps.jobs.get(job_id)
+        if alive is not None and alive.restarts == 1:
+            break
+        if alive is None:
+            break  # may have already finished post-restart — checked below
+        time.sleep(0.1)
+
+    history = wait_history(client, job_id, timeout=300)
+    assert rec.restarts == 1, "watchdog did not restart the crashed job"
+    # one CONTINUOUS history across the crash: full epoch count, and the
+    # pre-crash epochs' metrics preserved verbatim from the manifest
+    assert len(history.data.train_loss) == epochs
+    saved = pre_crash["history"]["train_loss"]
+    assert history.data.train_loss[: len(saved)] == saved
+    assert dep.ps.wait_for_job(job_id, timeout=60)
+
+    # the finished model is inferable like any other
+    x = np.load(paths["xte"])[:3]
+    preds = client.v1().networks().infer(job_id, x.tolist())
+    assert len(preds) == 3

@@ -76,6 +76,12 @@ def main(argv=None) -> int:
     p.add_argument("--emulate-cpu", type=int, default=0, metavar="D",
                    help="spawn ALL N processes locally, each with D "
                         "virtual CPU devices")
+    p.add_argument("--fail-fast", action="store_true",
+                   help="when any rank exits nonzero, kill the remaining "
+                        "ranks instead of waiting (a dead rank leaves "
+                        "survivors blocked in a collective indefinitely; "
+                        "the supervisor, not a collective timeout, should "
+                        "tear the cluster down so recovery can restart it)")
     p.add_argument("command", nargs=argparse.REMAINDER,
                    help="command to run (prefix with --)")
     args = p.parse_args(argv)
@@ -120,10 +126,27 @@ def main(argv=None) -> int:
         procs.append(proc)
         threads.append(t)
 
+    import time as _time
     rc = 0
     try:
-        for proc in procs:
-            rc = proc.wait() or rc
+        if args.fail_fast:
+            live = list(procs)
+            while live:
+                for proc in list(live):
+                    code = proc.poll()
+                    if code is None:
+                        continue
+                    live.remove(proc)
+                    if code and not rc:
+                        # report the FIRST casualty's code, not the -9s
+                        # of the survivors this teardown is about to kill
+                        rc = code
+                        for other in live:
+                            other.kill()
+                _time.sleep(0.1)
+        else:
+            for proc in procs:
+                rc = proc.wait() or rc
     except KeyboardInterrupt:
         for proc in procs:
             proc.send_signal(signal.SIGINT)
