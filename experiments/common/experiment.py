@@ -68,6 +68,11 @@ class ExperimentResult:
             "max_accuracy": max(h.accuracy) if h.accuracy else None,
             "final_parallelism": (h.parallelism[-1]
                                   if h.parallelism else None),
+            # the full per-epoch trajectory: for dynamic (autoscale)
+            # runs the ±1 path the policy actually took is the result,
+            # not just its endpoint
+            "parallelism_trajectory": list(h.parallelism),
+            "epoch_durations_s": [round(d, 4) for d in h.epoch_duration],
         })
         for goal in tta_goals:
             row[f"tta{goal:g}_s"] = time_to_accuracy(self.history, goal)

@@ -52,6 +52,11 @@ class EncoderBlock(nn.Module):
     # column/row-parallel matmuls and hand-placed psums
     # (parallel/manual.py). Composes with seq_axis (ring impl).
     tp_axis: Optional[str] = None
+    # attention implementation: 'auto' (flash on TPU when tiling allows,
+    # for BOTH the dense path and the differentiable seq-parallel ring),
+    # 'flash', or 'reference'
+    attn_impl: str = "auto"
+    flash_interpret: bool = False  # pallas interpreter (CPU tests)
 
     @nn.compact
     def __call__(self, h, pad_mask, train: bool, pos=None):
@@ -84,17 +89,27 @@ class EncoderBlock(nn.Module):
             from kubeml_tpu.parallel.ulysses import ulysses_attention
             attn = ulysses_attention(q, k, v, kv_mask=pad_mask,
                                      causal=False,
-                                     axis_name=self.seq_axis)
+                                     axis_name=self.seq_axis,
+                                     impl=self.attn_impl,
+                                     interpret=self.flash_interpret)
         elif self.seq_axis is not None:
             # long-context path A: KV blocks rotate around the seq ring;
-            # full attention over the GLOBAL sequence, O(T_local^2) HBM
+            # O(block) HBM on the flash path, O(T_local^2) on reference
+            from kubeml_tpu.ops.attention import ring_flash_eligible
             from kubeml_tpu.parallel.ring_attention import ring_attention
+            use_flash = (ring_flash_eligible(q.shape[1])
+                         if self.attn_impl == "auto"
+                         else self.attn_impl == "flash")
             attn = ring_attention(q, k, v, q_pos=pos, kv_pos=pos,
                                   kv_mask=pad_mask, causal=False,
-                                  axis_name=self.seq_axis)
+                                  axis_name=self.seq_axis,
+                                  use_flash=use_flash,
+                                  interpret=self.flash_interpret)
         else:
             # auto-dispatch: pallas flash kernel on TPU, jnp ref on CPU
-            attn = masked_attention(q, k, v, pad_mask)
+            attn = masked_attention(q, k, v, pad_mask,
+                                    impl=self.attn_impl,
+                                    interpret=self.flash_interpret)
         # one scaffolding path for both execution modes: only the three
         # Dense constructors differ (manual-TP mirrors share the dense
         # modules' param tree paths — checkpoint/merge parity)
@@ -136,6 +151,8 @@ class BertModule(nn.Module):
     seq_axis: Optional[str] = None  # sequence-parallel mode (see below)
     seq_impl: str = "ring"          # 'ring' | 'ulysses'
     tp_axis: Optional[str] = None   # manual tensor-parallel mode
+    attn_impl: str = "auto"         # 'auto' | 'flash' | 'reference'
+    flash_interpret: bool = False   # pallas interpreter (CPU tests)
 
     @nn.compact
     def __call__(self, x, train: bool = False):
@@ -168,6 +185,8 @@ class BertModule(nn.Module):
             h = EncoderBlock(self.hidden, self.heads, self.ffn, self.dropout,
                              self.dtype, seq_axis=self.seq_axis,
                              seq_impl=self.seq_impl, tp_axis=self.tp_axis,
+                             attn_impl=self.attn_impl,
+                             flash_interpret=self.flash_interpret,
                              name=f"layer_{i}")(h, pad_mask, train,
                                                 pos=pos_ids)
         h = nn.LayerNorm(dtype=jnp.float32)(h)

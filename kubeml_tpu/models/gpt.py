@@ -69,6 +69,12 @@ class DecoderBlock(nn.Module):
     # already-manual shard_map, e.g. the GPipe pipeline — the GSPMD
     # ep_mesh constraints cannot cross a manual region)
     ep_axis: Optional[str] = None
+    # attention implementation: 'auto' (pallas flash kernel on TPU when
+    # the [local] sequence tiles, jnp reference otherwise — applies to
+    # BOTH the dense path and the seq-parallel ring, which is
+    # differentiable), 'flash', or 'reference'
+    attn_impl: str = "auto"
+    flash_interpret: bool = False  # pallas interpreter (CPU tests)
 
     def _cached_attention(self, q, k, v, bias, offset):
         """Incremental decode: append this call's K/V into the block's
@@ -128,16 +134,26 @@ class DecoderBlock(nn.Module):
         elif self.seq_axis is not None and self.seq_impl == "ulysses":
             from kubeml_tpu.parallel.ulysses import ulysses_attention
             attn = ulysses_attention(q, k, v, kv_mask=pad_mask,
-                                     causal=True, axis_name=self.seq_axis)
+                                     causal=True, axis_name=self.seq_axis,
+                                     impl=self.attn_impl,
+                                     interpret=self.flash_interpret)
         elif self.seq_axis is not None:
             # causal KV ring: blocks rotate with their positions, the
             # per-block bias keeps position ordering globally correct
+            from kubeml_tpu.ops.attention import ring_flash_eligible
             from kubeml_tpu.parallel.ring_attention import ring_attention
+            use_flash = (ring_flash_eligible(q.shape[1])
+                         if self.attn_impl == "auto"
+                         else self.attn_impl == "flash")
             attn = ring_attention(q, k, v, q_pos=pos, kv_pos=pos,
                                   kv_mask=pad_mask, causal=True,
-                                  axis_name=self.seq_axis)
+                                  axis_name=self.seq_axis,
+                                  use_flash=use_flash,
+                                  interpret=self.flash_interpret)
         else:
-            attn = masked_attention(q, k, v, pad_mask, causal=True)
+            attn = masked_attention(q, k, v, pad_mask, causal=True,
+                                    impl=self.attn_impl,
+                                    interpret=self.flash_interpret)
         # one scaffolding path; only the Dense constructors differ per
         # execution mode (manual-TP mirrors share the dense param tree
         # paths — checkpoint/merge parity). MoE FFNs are their own path
@@ -263,6 +279,8 @@ class GPTModule(nn.Module):
     ep_mesh: Any = None             # mesh whose `expert` axis shards experts
     ep_axis: Optional[str] = None   # manual expert axis (see MoEFFN)
     tp_axis: Optional[str] = None   # manual tensor-parallel mode
+    attn_impl: str = "auto"         # 'auto' | 'flash' | 'reference'
+    flash_interpret: bool = False   # pallas interpreter (CPU tests)
 
     @nn.compact
     def __call__(self, x, train: bool = False, decode: bool = False,
@@ -332,6 +350,8 @@ class GPTModule(nn.Module):
                              capacity_factor=self.capacity_factor,
                              ep_mesh=self.ep_mesh, ep_axis=self.ep_axis,
                              tp_axis=self.tp_axis,
+                             attn_impl=self.attn_impl,
+                             flash_interpret=self.flash_interpret,
                              name=f"layer_{i}")(h, pad_mask, train,
                                                 pos=pos_ids,
                                                 decode_bias=decode_bias,
@@ -705,7 +725,9 @@ class GPTMini(KubeModel):
                                  moe_k=module.moe_k,
                                  capacity_factor=module.capacity_factor,
                                  ep_axis=(EXPERT_AXIS if n_expert > 1
-                                          else None))
+                                          else None),
+                                 attn_impl=module.attn_impl,
+                                 flash_interpret=module.flash_interpret)
 
             def stage_fn(p, act):
                 ones = jnp.ones(act.shape[:2], jnp.float32)

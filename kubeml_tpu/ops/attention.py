@@ -76,23 +76,40 @@ def _flash_safe_context() -> bool:
     return am.empty or all(t == AxisType.Manual for t in am.axis_types)
 
 
+def _flash_tiles(T: int) -> bool:
+    """T tiles onto the flash kernel's grid: a multiple of 128 lanes, or
+    a single sublane-aligned block (T <= 128, T % 8 == 0)."""
+    return T % 128 == 0 or (T <= 128 and T % 8 == 0)
+
+
+def ring_flash_eligible(T_local: int) -> bool:
+    """Auto-dispatch rule for the flash-backed ring path — the same
+    TPU + tiling + Mosaic-partitionability rule as masked_attention's
+    'auto', evaluated on the LOCAL sequence block (the per-device ring
+    block is what the kernel runs on). Differentiable since round 4, so
+    training and inference share one rule."""
+    return jax.default_backend() == "tpu" and _flash_tiles(T_local) \
+        and _flash_safe_context()
+
+
 def masked_attention(q: jax.Array, k: jax.Array, v: jax.Array,
                      pad_mask: jax.Array, causal: bool = False,
-                     impl: str = "auto") -> jax.Array:
+                     impl: str = "auto",
+                     interpret: bool = False) -> jax.Array:
     """Self-attention with a [B, T] keep-mask — implementation dispatch.
 
     impl='auto' picks the pallas flash kernel on TPU when the sequence
     tiles cleanly (T a multiple of 128, or a single sublane-aligned block
     T <= 128 with T % 8 == 0), else the jnp reference path;
-    'flash'/'reference' force a path.
+    'flash'/'reference' force a path. interpret runs a forced flash path
+    in the pallas interpreter (CPU tests).
     """
     T = q.shape[1]
     if impl == "auto":
-        on_tpu = jax.default_backend() == "tpu"
-        tiles = T % 128 == 0 or (T <= 128 and T % 8 == 0)
-        impl = "flash" if on_tpu and tiles and _flash_safe_context() \
-            else "reference"
+        impl = "flash" if jax.default_backend() == "tpu" \
+            and _flash_tiles(T) and _flash_safe_context() else "reference"
     if impl == "flash":
         from kubeml_tpu.ops.pallas.flash_attention import flash_attention
-        return flash_attention(q, k, v, pad_mask, causal)
+        return flash_attention(q, k, v, pad_mask, causal,
+                               interpret=interpret)
     return multi_head_attention(q, k, v, composed_bias(pad_mask, causal, T))

@@ -153,6 +153,15 @@ def _fit_block(block: int, T: int) -> int:
     return b
 
 
+def _out_vma(*xs) -> frozenset:
+    """Varying-manual-axes for the kernel outputs: under a
+    check_vma=True shard_map (the K-avg engine's sequence-parallel
+    round) pallas_call requires an explicit `vma` on every out_shape;
+    the outputs vary over exactly the union of the inputs' axes.
+    Outside shard_map this is frozenset() — equivalent to the default."""
+    return frozenset().union(*(jax.typeof(x).vma for x in xs))
+
+
 def _to_bh(x, B, H, T, D):
     """[B, T, H, D] -> [B*H, T, D] (the kernels' grid layout)."""
     return x.transpose(0, 2, 1, 3).reshape(B * H, T, D)
@@ -173,6 +182,7 @@ def _fa_forward(q, k, v, pad_mask, causal: bool, block_q: int, block_k: int,
     # [B, 1, T]: the singleton middle dim keeps the VMEM block's last two
     # dims equal to the array dims (TPU tiling requirement for B > 1)
     mask = jnp.broadcast_to(pad_mask.astype(jnp.float32), (B, T))[:, None, :]
+    vma = _out_vma(q, k, v, pad_mask)
     row_spec = pl.BlockSpec((1, 1, bq), lambda bh, iq, jk: (bh, 0, iq),
                             memory_space=pltpu.VMEM)
 
@@ -197,9 +207,9 @@ def _fa_forward(q, k, v, pad_mask, causal: bool, block_q: int, block_k: int,
             row_spec,
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((B * H, T, D), q.dtype),
-            jax.ShapeDtypeStruct((B * H, 1, T), jnp.float32),
-            jax.ShapeDtypeStruct((B * H, 1, T), jnp.float32),
+            jax.ShapeDtypeStruct((B * H, T, D), q.dtype, vma=vma),
+            jax.ShapeDtypeStruct((B * H, 1, T), jnp.float32, vma=vma),
+            jax.ShapeDtypeStruct((B * H, 1, T), jnp.float32, vma=vma),
         ],
         scratch_shapes=[
             pltpu.VMEM((bq, D), jnp.float32),
@@ -319,6 +329,7 @@ def _fa_backward(q, k, v, pad_mask, out, m_rows, l_rows, g, causal,
     delta = (gb.astype(jnp.float32) * ob.astype(jnp.float32)
              ).sum(-1)[:, None, :]                          # [BH, 1, T]
     mask = jnp.broadcast_to(pad_mask.astype(jnp.float32), (B, T))[:, None, :]
+    vma = _out_vma(q, k, v, g, pad_mask)
 
     mask_spec = pl.BlockSpec((1, 1, bk), lambda bh, a, b: (bh // H, 0, b),
                              memory_space=pltpu.VMEM)
@@ -352,8 +363,8 @@ def _fa_backward(q, k, v, pad_mask, out, m_rows, l_rows, g, causal,
             pl.BlockSpec((1, bk, D), lambda bh, jk, iq: (bh, jk, 0),
                          memory_space=pltpu.VMEM),
         ],
-        out_shape=[jax.ShapeDtypeStruct((B * H, T, D), k.dtype),
-                   jax.ShapeDtypeStruct((B * H, T, D), v.dtype)],
+        out_shape=[jax.ShapeDtypeStruct((B * H, T, D), k.dtype, vma=vma),
+                   jax.ShapeDtypeStruct((B * H, T, D), v.dtype, vma=vma)],
         scratch_shapes=[pltpu.VMEM((bk, D), jnp.float32),
                         pltpu.VMEM((bk, D), jnp.float32)],
         interpret=interpret,
@@ -382,7 +393,7 @@ def _fa_backward(q, k, v, pad_mask, out, m_rows, l_rows, g, causal,
         ],
         out_specs=pl.BlockSpec((1, bq, D), lambda bh, iq, jk: (bh, iq, 0),
                                memory_space=pltpu.VMEM),
-        out_shape=jax.ShapeDtypeStruct((B * H, T, D), q.dtype),
+        out_shape=jax.ShapeDtypeStruct((B * H, T, D), q.dtype, vma=vma),
         scratch_shapes=[pltpu.VMEM((bq, D), jnp.float32)],
         interpret=interpret,
     )(mask, *row_args, kb, vb)

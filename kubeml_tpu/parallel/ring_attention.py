@@ -24,6 +24,8 @@ pallas flash kernel (ops/pallas) can replace it without touching the ring.
 
 from __future__ import annotations
 
+import functools
+
 import jax
 import jax.numpy as jnp
 from jax import lax
@@ -90,6 +92,124 @@ def _merge_partials(acc, m, l, a_blk, m_blk, l_blk):
     return acc, new_m, l
 
 
+# --------------------------------------------- differentiable flash ring
+#
+# The flash ring is a jax.custom_vjp: per-block pallas partials merged
+# across ring steps in the forward, and a BACKWARD ring that reuses the
+# flash backward kernels per block. The key identity making this exact:
+# the forward saves the GLOBAL per-row softmax stats (max m, normalizer
+# l, merged over all ring steps), and the global probability of any
+# (q row i, kv block j) entry is p_ij = exp(s_ij - m_i) / l_i — so the
+# per-block backward kernels, fed global stats instead of block-local
+# ones, produce exactly the global dQ/dK/dV contributions of that block,
+# and contributions just sum. dK/dV accumulators travel WITH their kv
+# block around the ring (picking up each device's contribution) and one
+# final ppermute returns them home; dQ accumulates locally.
+
+
+def _causal_step_mask(maskb, causal, sid, s, n):
+    """Visibility of the visiting kv block at ring step s — THE rule the
+    forward and backward rings must share (a divergence makes gradients
+    silently stop matching the forward). After s rotations this device
+    holds shard (sid - s)'s block: under the contiguous layout it is
+    fully visible iff it sits strictly before this device's shard (the
+    diagonal was step 0); a dropped block's all-masked partials carry
+    m = NEG_INF and merge (or backprop) with weight zero."""
+    if not causal:
+        return maskb
+    j = (sid - s) % n
+    return maskb * (j < sid).astype(maskb.dtype)
+
+
+def _ring_flash_core(q, k, v, kv_mask, causal, axis_name, interpret):
+    """Flash forward ring: returns (normalized out f32, m, l) with m/l
+    the GLOBAL row stats [B, H, Tq] the backward needs."""
+    n = lax.axis_size(axis_name)
+    sid = lax.axis_index(axis_name)
+    perm = [(i, (i + 1) % n) for i in range(n)]
+    acc0, m0, l0 = _block_attn_flash(q, k, v, kv_mask, causal, interpret)
+
+    def step(carry, s):
+        acc, m, l, kb, vb, maskb = carry
+        kb, vb, maskb = [lax.ppermute(t, axis_name, perm)
+                         for t in (kb, vb, maskb)]
+        eff_mask = _causal_step_mask(maskb, causal, sid, s, n)
+        a_blk, m_blk, l_blk = _block_attn_flash(q, kb, vb, eff_mask,
+                                                False, interpret)
+        acc, m, l = _merge_partials(acc, m, l, a_blk, m_blk, l_blk)
+        return (acc, m, l, kb, vb, maskb), None
+
+    (acc, m, l, *_), _ = lax.scan(step, (acc0, m0, l0, k, v, kv_mask),
+                                  jnp.arange(1, n))
+    out = acc / jnp.maximum(l, 1e-30).transpose(0, 2, 1)[..., None]
+    return out, m, l
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6))
+def _ring_flash(q, k, v, kv_mask, causal, axis_name, interpret):
+    out, _, _ = _ring_flash_core(q, k, v, kv_mask, causal, axis_name,
+                                 interpret)
+    return out.astype(q.dtype)
+
+
+def _ring_flash_fwd(q, k, v, kv_mask, causal, axis_name, interpret):
+    out, m, l = _ring_flash_core(q, k, v, kv_mask, causal, axis_name,
+                                 interpret)
+    out = out.astype(q.dtype)
+    return out, (q, k, v, kv_mask, out, m, l)
+
+
+def _ring_flash_bwd(causal, axis_name, interpret, res, g):
+    from kubeml_tpu.ops.pallas.flash_attention import (DEFAULT_BLOCK_K,
+                                                       DEFAULT_BLOCK_Q,
+                                                       _fa_backward)
+
+    q, k, v, kv_mask, out, m, l = res
+    n = lax.axis_size(axis_name)
+    sid = lax.axis_index(axis_name)
+    perm = [(i, (i + 1) % n) for i in range(n)]
+    B, T, H, D = q.shape
+    # the kernels' [BH, 1, T] row-stat layout, from the merged stats
+    m_rows = m.reshape(B * H, 1, T)
+    l_rows = l.reshape(B * H, 1, T)
+
+    def block_bwd(kb, vb, maskb, blk_causal):
+        # global-stats flash backward for ONE (local q, visiting kv)
+        # pair: delta is recomputed per call from (g, out) — cheap
+        # elementwise next to the kernels' matmuls
+        return _fa_backward(q, kb, vb, maskb, out, m_rows, l_rows, g,
+                            blk_causal, DEFAULT_BLOCK_Q, DEFAULT_BLOCK_K,
+                            interpret)
+
+    # diagonal (local) block first, mirroring the forward's step 0
+    dq0, dk0, dv0 = block_bwd(k, v, kv_mask, causal)
+    f32 = jnp.float32
+
+    def step(carry, s):
+        dq, kb, vb, maskb, dkb, dvb = carry
+        # dk/dv accumulators travel WITH their kv block
+        kb, vb, maskb, dkb, dvb = [
+            lax.ppermute(t, axis_name, perm)
+            for t in (kb, vb, maskb, dkb, dvb)]
+        eff_mask = _causal_step_mask(maskb, causal, sid, s, n)
+        dq_c, dk_c, dv_c = block_bwd(kb, vb, eff_mask, False)
+        return (dq + dq_c.astype(f32), kb, vb, maskb,
+                dkb + dk_c.astype(f32), dvb + dv_c.astype(f32)), None
+
+    carry = (dq0.astype(f32), k, v, kv_mask,
+             dk0.astype(f32), dv0.astype(f32))
+    (dq, _, _, _, dkb, dvb), _ = lax.scan(step, carry, jnp.arange(1, n))
+    # after n-1 rotations each kv block's accumulator sits one hop short
+    # of home: a final ppermute returns it to its owner
+    dkb = lax.ppermute(dkb, axis_name, perm)
+    dvb = lax.ppermute(dvb, axis_name, perm)
+    return (dq.astype(q.dtype), dkb.astype(k.dtype),
+            dvb.astype(v.dtype), jnp.zeros_like(kv_mask))
+
+
+_ring_flash.defvjp(_ring_flash_fwd, _ring_flash_bwd)
+
+
 def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
                    q_pos: jax.Array, kv_pos: jax.Array,
                    kv_mask: jax.Array, causal: bool = False,
@@ -104,21 +224,39 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     to full attention over the global sequence.
 
     use_flash swaps the per-block computation for the pallas flash
-    kernel (forward-only — the per-block pallas partials have no VJP;
-    training rings keep the differentiable dense blocks). The flash
-    path assumes the STANDARD contiguous shard layout (shard s holds
-    global positions [s*T_local, (s+1)*T_local) — what
-    ring_self_attention and the model modules construct): causality
-    then reduces to an aligned-diagonal mask on the local block plus a
-    whole-block keep/drop per ring step, so arbitrary q_pos/kv_pos are
-    not consulted. A causal flash call whose positions VIOLATE that
-    layout poisons its output with NaN rather than silently computing
-    wrong attention (non-causal flash is layout-independent: softmax is
-    permutation-invariant over the masked key set). interpret runs the
-    kernel in the pallas interpreter (CPU tests).
+    kernel and is fully DIFFERENTIABLE (since round 4): the forward
+    merges per-block kernel partials across ring steps, and a custom
+    backward ring feeds the merged global row stats to the flash
+    backward kernels per block (see _ring_flash), so long-context
+    TRAINING gets the kernel too. The flash path assumes the STANDARD
+    contiguous shard layout (shard s holds global positions
+    [s*T_local, (s+1)*T_local) — what ring_self_attention and the model
+    modules construct): causality then reduces to an aligned-diagonal
+    mask on the local block plus a whole-block keep/drop per ring step,
+    so arbitrary q_pos/kv_pos are not consulted. A causal flash call
+    whose positions VIOLATE that layout poisons its output with NaN
+    rather than silently computing wrong attention (non-causal flash is
+    layout-independent: softmax is permutation-invariant over the
+    masked key set). interpret runs the kernel in the pallas
+    interpreter (CPU tests).
     """
     n = lax.axis_size(axis_name)
     perm = [(i, (i + 1) % n) for i in range(n)]
+
+    if use_flash:
+        if causal:
+            # the causal keep/drop inside the flash ring assumes the
+            # contiguous layout; a violating caller must get a LOUD
+            # failure (NaN), not silently wrong attention
+            sid = lax.axis_index(axis_name)
+            expected = sid * q.shape[1] + jnp.arange(q.shape[1])
+            layout_ok = jnp.logical_and((q_pos == expected).all(),
+                                        (kv_pos == expected).all())
+        else:
+            layout_ok = jnp.bool_(True)
+        out = _ring_flash(q, k, v, kv_mask.astype(jnp.float32), causal,
+                          axis_name, interpret)
+        return jnp.where(layout_ok, out, jnp.nan).astype(q.dtype)
 
     def bias_for(kv_pos_blk, kv_mask_blk):
         bias = (1.0 - kv_mask_blk.astype(jnp.float32)) * NEG_INF
@@ -130,41 +268,14 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
 
     # local KV block first, then n-1 rotate-and-accumulate steps — no
     # wasted final ppermute (each rotation's result is always consumed)
-    if use_flash:
-        sid = lax.axis_index(axis_name)
-        acc0, m0, l0 = _block_attn_flash(q, k, v, kv_mask, causal,
-                                         interpret)
-        if causal:
-            # the causal keep/drop below assumes the contiguous layout;
-            # a violating caller must get a LOUD failure (NaN), not
-            # silently wrong attention
-            expected = sid * q.shape[1] + jnp.arange(q.shape[1])
-            layout_ok = jnp.logical_and((q_pos == expected).all(),
-                                        (kv_pos == expected).all())
-        else:
-            layout_ok = jnp.bool_(True)
-    else:
-        acc0, m0, l0 = _block_attn(q, k, v, bias_for(kv_pos, kv_mask))
+    acc0, m0, l0 = _block_attn(q, k, v, bias_for(kv_pos, kv_mask))
 
     def step(carry, s):
         acc, m, l, kb, vb, posb, maskb = carry
         kb, vb, posb, maskb = [
             lax.ppermute(t, axis_name, perm) for t in (kb, vb, posb, maskb)]
-        if use_flash:
-            eff_mask = maskb
-            if causal:
-                # after s rotations this device holds shard (sid - s)'s
-                # block: under the contiguous layout it is fully visible
-                # iff it sits strictly before this device's shard (the
-                # diagonal was step 0); a dropped block's all-masked
-                # partials carry m = NEG_INF and merge with weight zero
-                j = (sid - s) % n
-                eff_mask = maskb * (j < sid).astype(maskb.dtype)
-            a_blk, m_blk, l_blk = _block_attn_flash(
-                q, kb, vb, eff_mask, False, interpret)
-        else:
-            a_blk, m_blk, l_blk = _block_attn(q, kb, vb,
-                                              bias_for(posb, maskb))
+        a_blk, m_blk, l_blk = _block_attn(q, kb, vb,
+                                          bias_for(posb, maskb))
         acc, m, l = _merge_partials(acc, m, l, a_blk, m_blk, l_blk)
         return (acc, m, l, kb, vb, posb, maskb), None
 
@@ -174,8 +285,6 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     # masked rows keep m = NEG_INF and l from exp(0)=1 terms per block, so
     # the division is finite; still guard for safety.
     out = acc / jnp.maximum(l, 1e-30).transpose(0, 2, 1)[..., None]
-    if use_flash:
-        out = jnp.where(layout_ok, out, jnp.nan)
     return out.astype(q.dtype)
 
 
@@ -186,8 +295,8 @@ def ring_self_attention(q: jax.Array, k: jax.Array, v: jax.Array,
                         interpret: bool = False) -> jax.Array:
     """Host-callable wrapper: shards [B, T, H, D] tensors over the mesh
     `seq` axis and runs ring_attention. T must divide by the seq-axis size.
-    use_flash routes each ring block through the pallas flash kernel
-    (forward-only; see ring_attention).
+    use_flash routes each ring block through the pallas flash kernel,
+    forward AND backward (see ring_attention / _ring_flash).
     """
     n = mesh.shape[SEQ_AXIS]
     B, T, H, D = q.shape

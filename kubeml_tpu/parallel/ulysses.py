@@ -44,7 +44,8 @@ __all__ = ["ulysses_attention", "ulysses_self_attention"]
 def ulysses_attention(q: jax.Array, k: jax.Array, v: jax.Array,
                       kv_mask: jax.Array, causal: bool = False,
                       axis_name: str = SEQ_AXIS,
-                      impl: str = "auto") -> jax.Array:
+                      impl: str = "auto",
+                      interpret: bool = False) -> jax.Array:
     """Sequence-parallel attention body (call inside shard_map/jit).
 
     Per-device shapes: q/k/v [B, T_local, H, D] (the local block of a
@@ -75,7 +76,8 @@ def ulysses_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     qg, kg, vg = seq_to_heads(q), seq_to_heads(k), seq_to_heads(v)
     # the full-sequence keep-mask is tiny ([B, T]); gather it outright
     mask_g = lax.all_gather(kv_mask, axis_name, axis=1, tiled=True)
-    out = masked_attention(qg, kg, vg, mask_g, causal=causal, impl=impl)
+    out = masked_attention(qg, kg, vg, mask_g, causal=causal, impl=impl,
+                           interpret=interpret)
     return heads_to_seq(out)
 
 

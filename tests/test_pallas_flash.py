@@ -150,6 +150,108 @@ def test_ring_flash_causal_with_padding():
     _ring_flash_case(causal=True, ragged=True)
 
 
+def _ring_flash_grad_case(causal, ragged):
+    """Grads of the flash-backed ring (per-block kernel partials merged
+    across ring steps, custom backward ring with global row stats) must
+    equal the dense differentiable ring's — the round-3 VERDICT item
+    that makes long-context TRAINING use the pallas kernel."""
+    import numpy as np
+
+    from kubeml_tpu.parallel.mesh import make_mesh
+    from kubeml_tpu.parallel.ring_attention import ring_self_attention
+
+    rng = np.random.RandomState(13)
+    B, T, H, D = 2, 32, 4, 8
+    q, k, v = (jnp.asarray(rng.randn(B, T, H, D).astype(np.float32))
+               for _ in range(3))
+    pad = np.ones((B, T), np.float32)
+    if ragged:
+        pad[0, 20:] = 0.0
+        pad[1, 5:9] = 0.0
+    pad = jnp.asarray(pad)
+    mesh = make_mesh(n_data=1, n_seq=4)
+    # weighted-sum loss (not plain sum): a nonuniform cotangent
+    # exercises the dq/dk/dv paths with distinct per-row signals
+    w = jnp.asarray(rng.randn(B, T, H, D).astype(np.float32))
+
+    def loss(use_flash):
+        def f(q, k, v):
+            out = ring_self_attention(q, k, v, pad, mesh, causal=causal,
+                                      use_flash=use_flash, interpret=True)
+            return (out * w).sum()
+        return f
+
+    g_dense = jax.grad(loss(False), argnums=(0, 1, 2))(q, k, v)
+    g_flash = jax.grad(loss(True), argnums=(0, 1, 2))(q, k, v)
+    for name, a, b in zip("qkv", g_dense, g_flash):
+        assert np.isfinite(np.asarray(b)).all(), f"d{name} not finite"
+        np.testing.assert_allclose(np.asarray(b), np.asarray(a),
+                                   rtol=2e-4, atol=2e-4,
+                                   err_msg=f"d{name} mismatch")
+
+
+def test_ring_flash_grads_match_dense_ring():
+    _ring_flash_grad_case(causal=False, ragged=True)
+
+
+def test_ring_flash_grads_match_dense_ring_causal():
+    _ring_flash_grad_case(causal=True, ragged=False)
+
+
+def test_ring_flash_grads_match_dense_ring_causal_ragged():
+    _ring_flash_grad_case(causal=True, ragged=True)
+
+
+def test_ring_flash_training_round_matches_dense():
+    """A FULL K-avg sequence-parallel training round with the
+    flash-backed ring (attn_impl='flash') produces the same merged
+    variables and round loss as the dense ring — long-context TRAINING
+    runs the pallas kernel end to end through the engine path."""
+    import numpy as np
+    import optax
+
+    from kubeml_tpu.parallel.kavg import KAvgEngine
+    from kubeml_tpu.parallel.mesh import make_mesh
+    from tests.test_models_gpt import VOCAB, TinyGPT
+
+    rng = np.random.RandomState(3)
+    W, S, B, T = 2, 2, 4, 32
+    x = rng.randint(1, VOCAB, size=(W, S, B, T)).astype(np.int32)
+    x[0, 0, 0, 20:] = 0  # ragged padding crossing the shard boundary
+    batch = {"x": jnp.asarray(x)}
+    masks = dict(sample_mask=np.ones((W, S, B), np.float32),
+                 step_mask=np.ones((W, S), np.float32),
+                 worker_mask=np.ones(W, np.float32))
+    rngs = rng.randint(0, 2**31, size=(W, S, 2)).astype(np.uint32)
+    mesh = make_mesh(n_data=2, n_seq=2, devices=jax.devices()[:4])
+
+    model0 = TinyGPT()
+    variables = model0.init_variables(jax.random.PRNGKey(0),
+                                      {"x": jnp.asarray(x[0, 0])})
+
+    def run(attn_impl):
+        model = TinyGPT()
+        model.enable_seq_parallel("ring")
+        # dropout 0 for determinism; interpret: pallas interpreter on CPU
+        model._module = model.module.clone(
+            dropout=0.0, attn_impl=attn_impl, flash_interpret=True)
+        eng = KAvgEngine(mesh, model.loss, model.metrics,
+                         lambda lr, e: optax.sgd(lr), donate=False,
+                         batch_seq_dims=model.seq_batch_dims)
+        out, stats = eng.train_round(variables, batch, rngs=rngs, lr=1e-2,
+                                     epoch=0, **masks)
+        return out, float(np.asarray(stats.loss_sum).sum())
+
+    ref, loss_ref = run("reference")
+    fl, loss_fl = run("flash")
+    assert abs(loss_ref - loss_fl) < 1e-3 * max(1.0, abs(loss_ref))
+    for a, b in zip(jax.tree_util.tree_leaves(ref),
+                    jax.tree_util.tree_leaves(fl)):
+        assert np.isfinite(np.asarray(b)).all()
+        np.testing.assert_allclose(np.asarray(b), np.asarray(a),
+                                   rtol=5e-3, atol=5e-4)
+
+
 def test_ring_flash_causal_noncontiguous_layout_poisons():
     """A causal flash call whose q_pos/kv_pos violate the contiguous
     shard layout must fail LOUDLY (NaN output), not silently compute
