@@ -50,6 +50,11 @@ class TrainOptions:
     # devices; data-axis size = devices / (n_model * n_seq).
     n_model: int = 1
     n_seq: int = 1
+    # net-new: expert parallelism for MoE functions — experts shard over
+    # the mesh expert axis inside the fully-manual round
+    # (parallel/manual.py ep_partial_ffn). Requires n_seq > 1 (the
+    # manual round is the SP round; GSPMD ep_mesh covers EP-only).
+    n_expert: int = 1
     seq_impl: str = "ring"         # 'ring' | 'ulysses'
     # TP execution strategy: 'gspmd' (NamedSharding placement, XLA
     # inserts the collectives — parallel/tp.py) or 'manual' (explicit
@@ -84,6 +89,7 @@ class TrainOptions:
             "shuffle": self.shuffle,
             "n_model": self.n_model,
             "n_seq": self.n_seq,
+            "n_expert": self.n_expert,
             "seq_impl": self.seq_impl,
             "tp_impl": self.tp_impl,
             "max_parallelism": self.max_parallelism,
@@ -103,6 +109,7 @@ class TrainOptions:
             shuffle=d.get("shuffle", False),
             n_model=int(d.get("n_model", 1)),
             n_seq=int(d.get("n_seq", 1)),
+            n_expert=int(d.get("n_expert", 1)),
             seq_impl=d.get("seq_impl", "ring"),
             tp_impl=d.get("tp_impl", "gspmd"),
             max_parallelism=int(d.get("max_parallelism", 0)),

@@ -50,8 +50,10 @@ def cmd_train(args):
         _fail(f"batch size must be in (0, {MAX_BATCH_SIZE}]")
     if args.epochs <= 0:
         _fail("epochs must be positive")
-    if args.tensor_parallel < 1 or args.seq_parallel < 1:
-        _fail("--tensor-parallel/--seq-parallel must be >= 1")
+    if args.tensor_parallel < 1 or args.seq_parallel < 1 \
+            or args.expert_parallel < 1:
+        _fail("--tensor-parallel/--seq-parallel/--expert-parallel "
+              "must be >= 1")
     if args.max_parallelism < 0:
         _fail("--max-parallelism must be >= 0")
     if args.max_restarts < 0:
@@ -85,6 +87,7 @@ def cmd_train(args):
             shuffle=args.shuffle,
             n_model=args.tensor_parallel,
             n_seq=args.seq_parallel,
+            n_expert=args.expert_parallel,
             seq_impl=args.seq_impl,
             tp_impl=args.tp_impl,
             max_parallelism=args.max_parallelism,
@@ -329,6 +332,10 @@ def build_parser() -> argparse.ArgumentParser:
     t.add_argument("--seq-parallel", type=int, default=1, metavar="S",
                    help="ring/ulysses sequence parallelism over the "
                         "mesh seq axis (transformer families)")
+    t.add_argument("--expert-parallel", type=int, default=1, metavar="E",
+                   help="shard MoE experts over the mesh expert axis "
+                        "inside the manual round (MoE families; "
+                        "requires --seq-parallel > 1)")
     t.add_argument("--seq-impl", choices=("ring", "ulysses"),
                    default="ring",
                    help="sequence-parallel attention implementation")

@@ -97,6 +97,44 @@ class KubeModel(abc.ABC):
         from kubeml_tpu.parallel.mesh import MODEL_AXIS
         self._module = self.module.clone(tp_axis=MODEL_AXIS)
 
+    def enable_expert_parallel(self) -> None:
+        """Switch the model's module into MANUAL expert-parallel execution
+        inside the engine's fully-manual round (called by the job when
+        --expert-parallel > 1; composes with sequence parallelism).
+
+        Only MoE families (a module with an `ep_axis` field AND experts)
+        serve this; everything else rejects with a clear message."""
+        if not getattr(self.module, "n_experts", 0) or \
+                not hasattr(self.module, "ep_axis"):
+            raise ValueError(
+                f"function {self.name or type(self).__name__!r} has no "
+                "experts to shard (expert parallelism applies to MoE "
+                "families like gpt-moe-mini)")
+        if getattr(self.module, "ep_mesh", None) is not None:
+            raise ValueError(
+                "manual expert parallelism (--expert-parallel) and GSPMD "
+                "ep_mesh are mutually exclusive (construct without "
+                "ep_mesh)")
+        if getattr(self.module, "ep_impl", "replicated") != "replicated":
+            # the vma-checked training round requires the loss to be
+            # expert-axis-INVARIANT; only the replicated-token dispatch
+            # (ep_partial_ffn's psum) provides that. 'alltoall' serves
+            # the pipelined/forward paths — reject rather than silently
+            # override the constructed configuration
+            raise ValueError(
+                "the expert-parallel training round requires "
+                "ep_impl='replicated' (the expert psum keeps the loss "
+                "expert-axis-invariant); ep_impl='alltoall' serves the "
+                "pipelined and forward paths only")
+        from kubeml_tpu.parallel.mesh import EXPERT_AXIS
+        # 'replicated' dispatch (ep_partial_ffn): the psum over the
+        # expert axis makes activations and loss expert-axis-INVARIANT,
+        # which the vma-checked training round requires; the same vma
+        # backward that assembles manual-TP gradients then psums each
+        # lane's partial expert-weight grads, keeping replicated params
+        # in lockstep (parallel/manual.py design notes)
+        self._module = self.module.clone(ep_axis=EXPERT_AXIS)
+
     @abc.abstractmethod
     def build(self):
         """Return the flax nn.Module."""
@@ -119,6 +157,8 @@ class KubeModel(abc.ABC):
             overrides["seq_axis"] = None
         if getattr(m, "tp_axis", None) is not None:
             overrides["tp_axis"] = None
+        if getattr(m, "ep_axis", None) is not None:
+            overrides["ep_axis"] = None
         return m.clone(**overrides) if overrides else m
 
     # ------------------------------------------------------------- lifecycle

@@ -248,7 +248,9 @@ class BertTiny(ClassifierModel):
             raise ValueError(
                 f"sequence length {x.shape[1]} not divisible by the "
                 f"seq-axis size {n_seq}")
-        key = (mesh, x.shape[1] // n_seq, impl)
+        # module in the key: a clone (attn_impl, ...) must not silently
+        # reuse the previous configuration's compiled program
+        key = (self.module, mesh, x.shape[1] // n_seq, impl)
         if not hasattr(self, "_sp_cache"):
             self._sp_cache = {}
         if key not in self._sp_cache:

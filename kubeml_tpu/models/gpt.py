@@ -69,6 +69,7 @@ class DecoderBlock(nn.Module):
     # already-manual shard_map, e.g. the GPipe pipeline — the GSPMD
     # ep_mesh constraints cannot cross a manual region)
     ep_axis: Optional[str] = None
+    ep_impl: str = "replicated"    # 'replicated' | 'alltoall' (MoEFFN)
     # attention implementation: 'auto' (pallas flash kernel on TPU when
     # the [local] sequence tiles, jnp reference otherwise — applies to
     # BOTH the dense path and the seq-parallel ring, which is
@@ -184,7 +185,7 @@ class DecoderBlock(nn.Module):
             x = MoEFFN(self.hidden, self.ffn, self.n_experts,
                        k=self.moe_k, capacity_factor=self.capacity_factor,
                        ep_mesh=self.ep_mesh, ep_axis=self.ep_axis,
-                       name="moe")(x, pad_mask)
+                       ep_impl=self.ep_impl, name="moe")(x, pad_mask)
         else:
             x = mk_d0(name="Dense_0")(x)
             x = nn.gelu(x)
@@ -210,10 +211,22 @@ class MoEFFN(nn.Module):
     # parallel/manual.py ep_partial_ffn. This is what lets MoE blocks
     # run expert-sharded INSIDE the GPipe pipeline's shard_map.
     ep_axis: Optional[str] = None
+    # manual-axis execution strategy: 'replicated' routes all tokens on
+    # every lane and psums partial outputs (ep_partial_ffn — simple,
+    # bandwidth-fine at small activations); 'alltoall' shards tokens
+    # and router math over the expert axis too, exchanging slot
+    # payloads with two all_to_alls (ep_alltoall_ffn — the scale-up
+    # path; per-shard routing capacity, the SP x MoE semantics)
+    ep_impl: str = "replicated"
 
     @nn.compact
     def __call__(self, h, pad_mask):
         from kubeml_tpu.parallel.ep import moe_apply
+        if self.ep_impl not in ("replicated", "alltoall"):
+            # validated on EVERY path (incl. GSPMD/dense, which ignore
+            # the field) so a typo surfaces where it was written
+            raise ValueError(f"unknown ep_impl {self.ep_impl!r}; "
+                             "expected 'replicated' or 'alltoall'")
         d, f, e = self.d_model, self.d_ff, self.n_experts
         scale_in = 1.0 / np.sqrt(d)
         scale_out = 1.0 / np.sqrt(f)
@@ -240,19 +253,54 @@ class MoEFFN(nn.Module):
                     f"{e} experts do not divide over a "
                     f"{lax.axis_size(self.ep_axis)}-way expert axis")
             from kubeml_tpu.parallel.ep import route_tokens
-            from kubeml_tpu.parallel.manual import ep_partial_ffn
             x = h.reshape(B * T, D)
-            # routing is the SHARED preamble (parallel/ep.route_tokens),
-            # replicated on every expert lane — tokens are replicated
-            # over the expert axis in the pipeline; only the expert
-            # FFNs shard
-            dispatch, combine, aux = route_tokens(
-                params["router"], x, k=self.k,
-                capacity_factor=self.capacity_factor,
-                token_mask=pad_mask.reshape(B * T))
-            y = ep_partial_ffn(params["wi"], params["bi"], params["wo"],
-                               params["bo"], dispatch, combine, x,
-                               self.ep_axis, dtype=h.dtype)
+            if self.ep_impl == "alltoall":
+                # token-sharded scale-up path: each lane routes ITS
+                # 1/n token slice (per-shard capacity), exchanges slot
+                # payloads with its experts' lanes, and the final
+                # all_gather restores the replicated activation the
+                # surrounding (replicated-token) trunk expects
+                from kubeml_tpu.parallel.manual import ep_alltoall_ffn
+                nl = lax.axis_size(self.ep_axis)
+                if (B * T) % nl:
+                    raise ValueError(
+                        f"{B * T} tokens do not divide over a "
+                        f"{nl}-way expert axis (ep_impl='alltoall')")
+                tl = (B * T) // nl
+                start = lax.axis_index(self.ep_axis) * tl
+                x_local = lax.dynamic_slice_in_dim(x, start, tl)
+                mask_local = lax.dynamic_slice_in_dim(
+                    pad_mask.reshape(B * T), start, tl)
+                dispatch, combine, aux = route_tokens(
+                    params["router"], x_local, k=self.k,
+                    capacity_factor=self.capacity_factor,
+                    token_mask=mask_local)
+                # per-shard aux averaged over lanes: the loss must stay
+                # expert-axis-invariant like the replicated path's
+                aux = jax.tree_util.tree_map(
+                    lambda a: lax.psum(a, self.ep_axis) / nl, aux)
+                y_local = ep_alltoall_ffn(
+                    params["wi"], params["bi"], params["wo"],
+                    params["bo"], dispatch, combine, x_local,
+                    self.ep_axis, dtype=h.dtype)
+                y = lax.all_gather(y_local, self.ep_axis, axis=0,
+                                   tiled=True)
+            elif self.ep_impl == "replicated":
+                from kubeml_tpu.parallel.manual import ep_partial_ffn
+                # routing is the SHARED preamble
+                # (parallel/ep.route_tokens), replicated on every
+                # expert lane — tokens are replicated over the expert
+                # axis in the pipeline; only the expert FFNs shard
+                dispatch, combine, aux = route_tokens(
+                    params["router"], x, k=self.k,
+                    capacity_factor=self.capacity_factor,
+                    token_mask=pad_mask.reshape(B * T))
+                y = ep_partial_ffn(params["wi"], params["bi"],
+                                   params["wo"], params["bo"], dispatch,
+                                   combine, x, self.ep_axis,
+                                   dtype=h.dtype)
+            else:  # membership validated at the top of __call__
+                raise AssertionError(self.ep_impl)
         else:
             y, aux = moe_apply(params, h.reshape(B * T, D),
                                mesh=self.ep_mesh, k=self.k,
@@ -278,6 +326,7 @@ class GPTModule(nn.Module):
     capacity_factor: float = 1.25
     ep_mesh: Any = None             # mesh whose `expert` axis shards experts
     ep_axis: Optional[str] = None   # manual expert axis (see MoEFFN)
+    ep_impl: str = "replicated"     # 'replicated' | 'alltoall' (MoEFFN)
     tp_axis: Optional[str] = None   # manual tensor-parallel mode
     attn_impl: str = "auto"         # 'auto' | 'flash' | 'reference'
     flash_interpret: bool = False   # pallas interpreter (CPU tests)
@@ -349,6 +398,7 @@ class GPTModule(nn.Module):
                              n_experts=self.n_experts, moe_k=self.moe_k,
                              capacity_factor=self.capacity_factor,
                              ep_mesh=self.ep_mesh, ep_axis=self.ep_axis,
+                             ep_impl=self.ep_impl,
                              tp_axis=self.tp_axis,
                              attn_impl=self.attn_impl,
                              flash_interpret=self.flash_interpret,
@@ -715,7 +765,10 @@ class GPTMini(KubeModel):
                              "batches")
 
         moe = bool(module.n_experts)
-        key = (mesh, M)
+        # the module is part of the key: a clone (ep_impl, attn_impl,
+        # ...) must not silently reuse the previous configuration's
+        # compiled program (flax modules hash by configuration)
+        key = (module, mesh, M)
         if not hasattr(self, "_pp_cache"):
             self._pp_cache = {}
         if key not in self._pp_cache:
@@ -726,6 +779,7 @@ class GPTMini(KubeModel):
                                  capacity_factor=module.capacity_factor,
                                  ep_axis=(EXPERT_AXIS if n_expert > 1
                                           else None),
+                                 ep_impl=module.ep_impl,
                                  attn_impl=module.attn_impl,
                                  flash_interpret=module.flash_interpret)
 
@@ -807,7 +861,9 @@ class GPTMini(KubeModel):
         if x.shape[1] % n_seq:
             raise ValueError(f"sequence length {x.shape[1]} not divisible "
                              f"by the seq-axis size {n_seq}")
-        key = (mesh, x.shape[1] // n_seq, impl)
+        # module in the key for the same reason as _pp_cache: clones
+        # must not reuse a stale compiled program
+        key = (self.module, mesh, x.shape[1] // n_seq, impl)
         if not hasattr(self, "_sp_cache"):
             self._sp_cache = {}
         if key not in self._sp_cache:
@@ -849,8 +905,11 @@ class GPTMoEMini(GPTMini):
     # layout, exactly like the pipelined trunk routes per microbatch).
     # Equal to the dense forward whenever no expert overflows; under
     # overflow the drop pattern differs by grouping, not by correctness.
-    # Requires replicated experts (no GSPMD ep_mesh inside the manual
-    # seq shard_map).
+    # GSPMD ep_mesh cannot cross the manual seq shard_map; round 4 adds
+    # the MANUAL expert axis instead (enable_expert_parallel /
+    # --expert-parallel): experts shard inside the same manual round
+    # via ep_partial_ffn, exactly matching the replicated-expert round
+    # (tests/test_parallel_pp_ep.py::test_kavg_sp_ep_round_matches_sp_only).
     seq_batch_dims = {"x": 0}
     # job-level TP stays rejected too: the Megatron table would shard
     # only the attention stack while the expert FFNs (the bulk of the

@@ -208,8 +208,8 @@ def ep_partial_ffn(params_wi, params_bi, params_wo, params_bo,
     experts, runs only their FFNs, combines only their slots, and the
     psum over the expert axis assembles the full output — expert FLOPs
     shard, tokens stay replicated (correct and bandwidth-fine at the
-    per-stage activation sizes the pipelined MoE trunk carries; a
-    token-sharded all-to-all variant is the scale-up path).
+    per-stage activation sizes the pipelined MoE trunk carries; the
+    token-sharded scale-up path is ep_alltoall_ffn below).
 
     dispatch/combine: [T, E, C] from parallel.ep.make_dispatch.
     x: [T, d_model]. Returns y [T, d_model] (model-axis invariant).
@@ -227,3 +227,48 @@ def ep_partial_ffn(params_wi, params_bi, params_wo, params_bo,
     out = jnp.einsum("ecf,efd->ecd", h, wo) + bo[:, None, :]
     y_part = jnp.einsum("tec,ecd->td", comb, out)
     return lax.psum(y_part, axis_name)
+
+
+def ep_alltoall_ffn(params_wi, params_bi, params_wo, params_bo,
+                    dispatch, combine, x, axis_name: str,
+                    dtype=jnp.bfloat16) -> jax.Array:
+    """Token-SHARDED expert-parallel GShard FFN — the scale-up path
+    ep_partial_ffn documents (and production MoE's standard form).
+
+    Each lane holds a [T_local, d] token shard routed LOCALLY
+    (dispatch/combine [T_local, E, C_local] over the FULL expert set
+    with per-shard capacity) and its E/n local experts' weights. Two
+    tiled `lax.all_to_all` exchanges move token slot payloads to their
+    experts' lanes and back, so tokens, router math, and expert FLOPs
+    ALL shard n-fold — no replicated-token psum, and the wire cost is
+    2 x [E, C_local, d] slot traffic instead of a full [T, d]
+    all-reduce. Per-shard routing equals global routing whenever no
+    expert overflows (the same grouping semantics as sequence-parallel
+    MoE, models/gpt.py — under overflow the drop PATTERN differs, not
+    correctness).
+
+    Returns y_local [T_local, d]: the lane's own tokens, fully
+    combined (each token's slots all returned home — no psum needed).
+    """
+    wi = axis_slice(params_wi, axis_name, 0).astype(dtype)   # [E/n, d, f]
+    bi = axis_slice(params_bi, axis_name, 0).astype(dtype)
+    wo = axis_slice(params_wo, axis_name, 0).astype(dtype)
+    bo = axis_slice(params_bo, axis_name, 0).astype(dtype)
+    disp = dispatch.astype(dtype)                            # [Tl, E, Cl]
+    comb = combine.astype(dtype)
+
+    # this lane's slot payloads for EVERY expert
+    expert_in = jnp.einsum("tec,td->ecd", disp, x.astype(dtype))
+    # exchange 1: send expert block j to lane j; receive every lane's
+    # slots for OUR E/n experts, stacked along capacity -> [E/n, n*Cl, d]
+    # (tiled all_to_all places peer j's piece at block j of the concat
+    # axis, so capacity block j = lane j's slots)
+    recv = lax.all_to_all(expert_in, axis_name, split_axis=0,
+                          concat_axis=1, tiled=True)
+    h = jax.nn.gelu(jnp.einsum("ecd,edf->ecf", recv, wi) + bi[:, None, :])
+    out = jnp.einsum("ecf,efd->ecd", h, wo) + bo[:, None, :]
+    # exchange 2 (inverse): capacity block j returns to lane j; expert
+    # blocks re-stack in lane-major = global-expert order -> [E, Cl, d]
+    back = lax.all_to_all(out, axis_name, split_axis=1, concat_axis=0,
+                          tiled=True)
+    return jnp.einsum("tec,ecd->td", comb, back)

@@ -365,11 +365,18 @@ class TrainJob:
                 f"unknown training engine {engine_kind!r}; "
                 f"expected 'kavg' or 'syncdp'", 400)
 
-        # ---- inner mesh axes (job-level TP / SP; net-new vs reference)
+        # ---- inner mesh axes (job-level TP / SP / EP; net-new vs ref)
         n_model = max(1, int(opts.n_model))
         n_seq = max(1, int(opts.n_seq))
+        n_expert = max(1, int(getattr(opts, "n_expert", 1)))
         self._tp_rules = None
         self._manual_tp = False
+        if n_expert > 1 and n_seq < 2:
+            raise KubeMLException(
+                "--expert-parallel requires --seq-parallel > 1: manual "
+                "expert sharding runs inside the fully-manual "
+                "sequence-parallel round (EP without SP is served by "
+                "GSPMD ep_mesh at the model level)", 400)
         if n_model > 1 or n_seq > 1:
             if engine_kind != "kavg":
                 raise KubeMLException(
@@ -393,15 +400,15 @@ class TrainJob:
                         "seq_impl='ring' only (ulysses re-shards the "
                         "head axis the TP split owns)", 400)
             devices = list(self.mesh.devices.flatten())
-            inner = n_model * n_seq
+            inner = n_model * n_seq * n_expert
             if len(devices) % inner:
                 raise KubeMLException(
                     f"{len(devices)} devices not divisible by the "
-                    f"requested model x seq factor {inner}", 400)
+                    f"requested model x seq x expert factor {inner}", 400)
             from kubeml_tpu.parallel.mesh import make_mesh
             self.mesh = make_mesh(n_data=len(devices) // inner,
                                   n_model=n_model, n_seq=n_seq,
-                                  devices=devices)
+                                  n_expert=n_expert, devices=devices)
             if n_model > 1 and tp_impl == "manual":
                 try:
                     self.model.enable_tensor_parallel()
@@ -428,9 +435,26 @@ class TrainJob:
                         f"function {self.req.model_type!r} enabled "
                         "sequence parallelism but declares no "
                         "seq_batch_dims", 400)
-            self._log("job %s mesh: data=%d model=%d seq=%d tp_impl=%s",
+            if n_expert > 1:
+                # SP x EP (round 4, the matrix's last exclusion):
+                # experts shard over the mesh expert axis through the
+                # manual expert path inside the same fully-manual round
+                try:
+                    self.model.enable_expert_parallel()
+                except ValueError as e:
+                    raise KubeMLException(str(e), 400)
+                n_experts = int(getattr(self.model.module,
+                                        "n_experts", 0))
+                if n_experts % n_expert:
+                    # reject up front like every sibling misconfig —
+                    # not as a trace-time abort after data loading
+                    raise KubeMLException(
+                        f"{n_experts} experts do not divide over a "
+                        f"{n_expert}-way expert axis", 400)
+            self._log("job %s mesh: data=%d model=%d seq=%d expert=%d "
+                      "tp_impl=%s",
                       self.task.job_id, data_axis_size(self.mesh),
-                      n_model, n_seq,
+                      n_model, n_seq, n_expert,
                       "manual" if self._manual_tp
                       else ("gspmd" if n_model > 1 else "-"))
 

@@ -162,6 +162,10 @@ SLOW_TESTS = {
     "test_control_plane.py::test_mid_job_inference",
     "test_cli.py::test_cli_full_flow",
     "test_job.py::test_checkpoint_every_and_warm_start",
+    "test_job.py::test_job_seq_and_expert_parallel_moe",
+    "test_parallel_pp_ep.py::test_kavg_sp_ep_round_matches_sp_only",
+    "test_parallel_pp_ep.py::test_ep_alltoall_ffn_matches_dense",
+    "test_parallel_pp_ep.py::test_moe_pipeline_alltoall_matches_replicated",
     "test_pallas_flash.py::test_flash_grads_match_reference",
     "test_pallas_flash.py::test_ring_flash_grads_match_dense_ring",
     "test_pallas_flash.py::test_ring_flash_grads_match_dense_ring_causal",

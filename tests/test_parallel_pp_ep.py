@@ -362,6 +362,159 @@ def test_moe_trunk_pipelines_expert_sharded():
     np.testing.assert_allclose(float(aux), float(ref_aux), rtol=1e-3)
 
 
+def test_ep_alltoall_ffn_matches_dense():
+    """Token-sharded expert dispatch (VERDICT r3 item 7): inside a
+    4-way manual expert axis, ep_alltoall_ffn — local routing, two
+    tiled all_to_alls moving slot payloads to the experts and back —
+    equals the dense full-expert math applied per token shard."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import PartitionSpec as P
+
+    from kubeml_tpu.parallel.ep import route_tokens
+    from kubeml_tpu.parallel.manual import ep_alltoall_ffn
+    from kubeml_tpu.parallel.mesh import EXPERT_AXIS, make_mesh
+
+    rng = np.random.RandomState(5)
+    n, Tl, d, f, E = 4, 16, 8, 16, 8
+    x = jnp.asarray(rng.randn(n * Tl, d).astype(np.float32))
+    mask = np.ones(n * Tl, np.float32)
+    mask[10:14] = 0.0  # pad tokens inside shard 0
+    mask = jnp.asarray(mask)
+    router = jnp.asarray(rng.randn(d, E).astype(np.float32) * 0.3)
+    wi = jnp.asarray(rng.randn(E, d, f).astype(np.float32) * 0.2)
+    bi = jnp.asarray(rng.randn(E, f).astype(np.float32) * 0.1)
+    wo = jnp.asarray(rng.randn(E, f, d).astype(np.float32) * 0.2)
+    bo = jnp.asarray(rng.randn(E, d).astype(np.float32) * 0.1)
+    mesh = make_mesh(n_data=1, n_expert=n)
+
+    def body(x_l, m_l, router, wi, bi, wo, bo):
+        disp, comb, _ = route_tokens(router, x_l, k=2,
+                                     capacity_factor=2.0, token_mask=m_l)
+        return ep_alltoall_ffn(wi, bi, wo, bo, disp, comb, x_l,
+                               EXPERT_AXIS, dtype=jnp.float32)
+
+    y = jax.jit(jax.shard_map(
+        body, mesh=mesh,
+        in_specs=(P(EXPERT_AXIS), P(EXPERT_AXIS), P(), P(), P(), P(), P()),
+        out_specs=P(EXPERT_AXIS), check_vma=False))(
+        x, mask, router, wi, bi, wo, bo)
+
+    # dense reference: the same local routing + FULL expert set, one
+    # token shard at a time
+    refs = []
+    for i in range(n):
+        x_l = x[i * Tl:(i + 1) * Tl]
+        disp, comb, _ = route_tokens(router, x_l, k=2, capacity_factor=2.0,
+                                     token_mask=mask[i * Tl:(i + 1) * Tl])
+        ein = jnp.einsum("tec,td->ecd", disp, x_l)
+        hh = jax.nn.gelu(jnp.einsum("ecd,edf->ecf", ein, wi)
+                         + bi[:, None, :])
+        out = jnp.einsum("ecf,efd->ecd", hh, wo) + bo[:, None, :]
+        refs.append(jnp.einsum("tec,ecd->td", comb, out))
+    ref = jnp.concatenate(refs, axis=0)
+    np.testing.assert_allclose(np.asarray(y), np.asarray(ref),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_moe_pipeline_alltoall_matches_replicated():
+    """Model-level: the pipelined expert-sharded MoE trunk with
+    ep_impl='alltoall' (token-sharded dispatch) equals the replicated-
+    token ep_partial_ffn path at overflow-free capacity — per-shard
+    routing changes the slot GROUPING, not the combine, when nothing
+    drops."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from kubeml_tpu.parallel.mesh import make_mesh
+    from tests.test_models_gpt import TinyMoE, make_lm_task
+
+    rng = np.random.RandomState(0)
+    B, T, M = 8, 16, 4
+
+    class RoomyMoE(TinyMoE):
+        # capacity 4x: no expert overflows under either grouping, so
+        # the two dispatch strategies must agree exactly
+        def build(self):
+            m = super().build()
+            return m.clone(capacity_factor=4.0)
+
+    x = make_lm_task(rng, B)[:, :T]
+    model = RoomyMoE()
+    variables = model.init_variables(jax.random.PRNGKey(0),
+                                     {"x": jnp.asarray(x)})
+    ep_mesh = make_mesh(n_data=2, n_stage=2, n_expert=2)
+    ref_logits, _ = model.forward_pipelined(
+        variables, jnp.asarray(x), ep_mesh, microbatches=M)
+
+    # SAME model instance: the pp cache keys on the module config, so
+    # the clone must compile a fresh program, not reuse the replicated
+    # path's (regression guard for the cache-key fix)
+    model._module = model.module.clone(ep_impl="alltoall")
+    logits, _ = model.forward_pipelined(
+        variables, jnp.asarray(x), ep_mesh, microbatches=M)
+    np.testing.assert_allclose(np.asarray(logits), np.asarray(ref_logits),
+                               rtol=5e-2, atol=5e-2)
+
+
+def test_kavg_sp_ep_round_matches_sp_only():
+    """One K-avg SP training round with experts ALSO sharded over a
+    2-way expert axis (SP x EP — round 4's last matrix cell) produces
+    the same merged variables as the SP-only round with replicated
+    experts: routing runs on expert-replicated tokens, ep_partial_ffn's
+    psum assembles the identical FFN output, and the vma backward psums
+    each lane's partial expert-weight grads."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    from kubeml_tpu.parallel.kavg import KAvgEngine
+    from kubeml_tpu.parallel.mesh import make_mesh
+    from tests.test_models_gpt import VOCAB, TinyMoE
+
+    rng = np.random.RandomState(4)
+    W, S, B, T = 2, 2, 4, 32
+    start = rng.randint(1, VOCAB - 1, size=(W * S * B, 1))
+    x = ((start + np.arange(T)[None, :] - 1) % (VOCAB - 1) + 1) \
+        .astype(np.int32).reshape(W, S, B, T)
+    batch = {"x": jnp.asarray(x)}
+    masks = dict(sample_mask=np.ones((W, S, B), np.float32),
+                 step_mask=np.ones((W, S), np.float32),
+                 worker_mask=np.ones(W, np.float32))
+    rngs = rng.randint(0, 2**31, size=(W, S, 2)).astype(np.uint32)
+
+    model0 = TinyMoE()
+    variables = model0.init_variables(jax.random.PRNGKey(0),
+                                      {"x": jnp.asarray(x[0, 0])})
+
+    def run(mesh, enable_ep):
+        model = TinyMoE()
+        model._module = model.module.clone(dropout=0.0)
+        model.enable_seq_parallel("ring")
+        if enable_ep:
+            model.enable_expert_parallel()
+        eng = KAvgEngine(mesh, model.loss, model.metrics,
+                         lambda lr, e: optax.sgd(lr), donate=False,
+                         batch_seq_dims=model.seq_batch_dims)
+        out, stats = eng.train_round(variables, batch, rngs=rngs,
+                                     lr=1e-2, epoch=0, **masks)
+        return out, float(np.asarray(stats.loss_sum).sum())
+
+    ref, loss_ref = run(
+        make_mesh(n_data=2, n_seq=2, devices=jax.devices()[:4]), False)
+    ep, loss_ep = run(
+        make_mesh(n_data=2, n_seq=2, n_expert=2), True)
+
+    assert abs(loss_ref - loss_ep) < 1e-3 * max(1.0, abs(loss_ref))
+    for a, b in zip(jax.tree_util.tree_leaves(ref),
+                    jax.tree_util.tree_leaves(ep)):
+        np.testing.assert_allclose(np.asarray(b), np.asarray(a),
+                                   rtol=5e-3, atol=5e-4)
+
+
 def test_moe_pipeline_rejects_indivisible_experts():
     import jax
     import jax.numpy as jnp

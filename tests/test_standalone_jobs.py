@@ -225,7 +225,11 @@ def test_crashed_job_restarts_from_checkpoint(standalone_stack, tmp_home):
     client.v1().datasets().create(
         "blobs", paths["xtr"], paths["ytr"], paths["xte"], paths["yte"])
 
-    epochs = 6
+    # enough epochs that the window between the FIRST durable checkpoint
+    # and job completion stays seconds wide even when post-compile
+    # epochs run in ~0.2 s (measured flaky at epochs=6 under CPU
+    # contention: the job finished before the kill landed)
+    epochs = 30
     req = TrainRequest(model_type="mlp", batch_size=16, epochs=epochs,
                        dataset="blobs", lr=0.05,
                        options=TrainOptions(default_parallelism=2, k=1,
