@@ -167,8 +167,6 @@ SLOW_TESTS = {
     "test_parallel_pp_ep.py::test_ep_alltoall_ffn_matches_dense",
     "test_parallel_pp_ep.py::test_moe_pipeline_alltoall_matches_replicated",
     "test_pallas_flash.py::test_flash_grads_match_reference",
-    "test_pallas_flash.py::test_ring_flash_grads_match_dense_ring",
-    "test_pallas_flash.py::test_ring_flash_grads_match_dense_ring_causal",
     "test_pallas_flash.py::"
     "test_ring_flash_grads_match_dense_ring_causal_ragged",
     "test_pallas_flash.py::test_ring_flash_training_round_matches_dense",
@@ -177,14 +175,56 @@ SLOW_TESTS = {
 }
 
 
+# Nightly tier (round 4): the full tier was outgrowing CI's 45-minute
+# cap (~39 min measured). These are the heaviest tests whose coverage
+# is REPRESENTED by a faster sibling that stays in the CI tier — each
+# entry names its stand-in. CI runs `-m "not nightly"`; the nightly
+# workflow (and any local `pytest tests/`... with `-m ""`) runs all.
+# Nightly tests are also slow-marked, so the smoke tier is unaffected.
+NIGHTLY_TESTS = {
+    # job-level TP+SP / SP carving: stood in for by
+    # test_job_seq_and_expert_parallel_moe (seq+expert carving, same
+    # code path) + the engine-level combined tests in test_manual_tp
+    "test_job.py::test_job_tensor_and_seq_parallel_combined",
+    "test_job.py::test_job_seq_parallel_gpt",
+    # vision engine convergence: bench.py measures the same round on
+    # hardware every round; test_lenet_learns keeps a convergence run
+    "test_models_vision.py::test_resnet18_engine_round",
+    # resnet50 forward shape: resnet18/32/vgg11 shape tests remain
+    "test_models_vision.py::test_forward_shapes[resnet50-64]",
+    # flash-ring grads: the causal+ragged superset case and the full
+    # training-round parity stay in the CI tier
+    "test_pallas_flash.py::test_ring_flash_grads_match_dense_ring",
+    "test_pallas_flash.py::test_ring_flash_grads_match_dense_ring_causal",
+    # function-registry end-to-end: the lenet example test keeps the
+    # registry path; GPT training is covered by test_gpt_learns
+    "test_examples.py::test_gpt_example_trains_end_to_end",
+    # TP through the full control plane: control-plane train covered by
+    # test_end_to_end_train_infer, TP job by test_job_tensor_parallel_bert
+    "test_control_plane.py::test_tensor_parallel_job_through_controller",
+    # text sweep harness: the lstm grid arm stays
+    "test_experiments.py::test_baseline_text_grids_run[bert]",
+    # manual-TP suite: grads-match + bert training + tp_sp_combined
+    # (bert) remain; the gpt combined variant and the TP compressed
+    # merge (sp compressed merge remains) move out
+    "test_manual_tp.py::test_kavg_trains_tp_sp_combined_gpt",
+    "test_manual_tp.py::test_kavg_manual_tp_compressed_merge",
+    # SP x MoE training: the replicated-expert SP round runs as the
+    # reference arm INSIDE test_kavg_sp_ep_round_matches_sp_only
+    "test_models_gpt.py::test_gpt_moe_trains_seq_parallel",
+}
+
+
 def pytest_collection_modifyitems(config, items):
     matched = set()
     for item in items:
         # node id relative to tests/: "<file>::<name>[<param>]"
         nodeid = item.nodeid.split("/")[-1]
-        if nodeid in SLOW_TESTS:
+        if nodeid in SLOW_TESTS or nodeid in NIGHTLY_TESTS:
             matched.add(nodeid)
             item.add_marker(pytest.mark.slow)
+        if nodeid in NIGHTLY_TESTS:
+            item.add_marker(pytest.mark.nightly)
     # a stale entry (renamed/removed test) would silently put a slow
     # test back into the smoke tier — make it a collection error instead.
     # Only enforced on whole-file collection (no ::nodeid selection, no
@@ -193,11 +233,12 @@ def pytest_collection_modifyitems(config, items):
         return
     collected_files = {item.nodeid.split("/")[-1].split("::")[0]
                        for item in items}
-    stale = {t for t in SLOW_TESTS - matched
+    stale = {t for t in (SLOW_TESTS | NIGHTLY_TESTS) - matched
              if t.split("::")[0] in collected_files}
     if stale:
         raise pytest.UsageError(
-            f"SLOW_TESTS entries match no collected test: {sorted(stale)}")
+            f"SLOW_TESTS/NIGHTLY_TESTS entries match no collected test: "
+            f"{sorted(stale)}")
 
 
 @pytest.fixture(scope="session")
