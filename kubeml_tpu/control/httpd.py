@@ -8,6 +8,7 @@ Kept deliberately tiny — the control plane was never the hot path.
 
 from __future__ import annotations
 
+import http.client
 import json
 import logging
 import re
@@ -199,3 +200,11 @@ def http_json(method: str, url: str, body: Any = None,
         check_error(e.code, e.read())
     except urllib.error.URLError as e:
         raise KubeMLException(f"cannot reach {url}: {e.reason}", 503)
+    except (http.client.HTTPException, OSError) as e:
+        # transport-level failures urllib does not wrap (e.g.
+        # RemoteDisconnected when the peer dies mid-request) must map to
+        # the same retryable 503 envelope as unreachable hosts — the
+        # PS's retried /start push (and every other caller with retry
+        # logic) keys on KubeMLException, and a raw exception here would
+        # escape those loops and fail the operation on one hiccup
+        raise KubeMLException(f"cannot reach {url}: {e}", 503)

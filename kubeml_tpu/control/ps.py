@@ -471,10 +471,17 @@ class ParameterServer(JsonService):
         rec.restarting = False
 
     def _wait_job_ready(self, proc: subprocess.Popen, port_file: str,
-                        timeout: float = 120.0) -> str:
+                        timeout: Optional[float] = None) -> str:
         """Poll for the child's bound port, then its /health — the
         reference's waitForPodRunning loop (job_pod.go:18-62; longer
-        timeout here because the child pays JAX import + backend init)."""
+        timeout here because the child pays JAX import + backend init).
+        KUBEML_JOB_START_TIMEOUT overrides the 120 s default — hosts
+        under heavy CPU load (or cold container caches) can push a
+        child's JAX init past it, which would fail the start (and
+        consume a crash-restart attempt) spuriously."""
+        if timeout is None:
+            timeout = float(os.environ.get("KUBEML_JOB_START_TIMEOUT",
+                                           120.0))
         deadline = time.monotonic() + timeout
         while not os.path.exists(port_file):
             if proc.poll() is not None:

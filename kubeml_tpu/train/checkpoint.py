@@ -71,22 +71,44 @@ def save_checkpoint(job_id: str, variables: PyTree, manifest: dict,
     manifest = dict(manifest, job_id=job_id, saved_at=time.time())
     with open(os.path.join(tmp, "manifest.json"), "w") as f:
         json.dump(manifest, f)
-    # atomic-ish replace: move the old checkpoint aside before publishing so
-    # there is no window with neither old nor new present
+    # crash-safe publish: at EVERY instant either the current dir or
+    # .old holds a complete checkpoint (readers fall back to .old —
+    # _resolve_dir), so a SIGKILL anywhere in this sequence costs at
+    # most one save, never all recovery state. The .old cleanup happens
+    # strictly inside the isdir(d) branch: in the fallback state
+    # (d missing after a previous mid-publish crash) .old IS the only
+    # good copy and must survive until the new dir is published.
     old = d + ".old"
-    if os.path.isdir(old):
-        shutil.rmtree(old)
     if os.path.isdir(d):
+        if os.path.isdir(old):
+            shutil.rmtree(old)
         os.rename(d, old)
     os.rename(tmp, d)
     shutil.rmtree(old, ignore_errors=True)
     return d
 
 
+def _resolve_dir(job_id: str, root: Optional[str]) -> str:
+    """The directory holding the job's newest DURABLE checkpoint.
+
+    save_checkpoint's publish is two renames (current -> .old, then
+    tmp -> current); a crash landing between them leaves no current
+    directory but a fully-valid .old — falling back to it means a crash
+    mid-checkpoint costs at most one epoch of recovery state, never all
+    of it (the watchdog's restart eligibility and resume-from-self both
+    read through here)."""
+    d = os.path.join(root or _models_root(), job_id)
+    if os.path.isfile(os.path.join(d, "manifest.json")):
+        return d
+    old = d + ".old"
+    if os.path.isfile(os.path.join(old, "manifest.json")):
+        return old
+    return d  # missing everywhere: callers raise JobNotFound
+
+
 def load_checkpoint(job_id: str, root: Optional[str] = None
                     ) -> Tuple[PyTree, dict]:
-    root = root or _models_root()
-    d = os.path.join(root, job_id)
+    d = _resolve_dir(job_id, root)
     if not os.path.isfile(os.path.join(d, "manifest.json")):
         raise JobNotFoundError(job_id)
     with open(os.path.join(d, "manifest.json")) as f:
@@ -214,7 +236,7 @@ def mark_checkpoint_completed(job_id: str, root: Optional[str] = None
     killed between its final save and its /finish notification must
     finish immediately on restart, not retrain. saved_at is preserved so
     manifest-stamp caches (the PS infer cache) stay valid."""
-    path = os.path.join(root or _models_root(), job_id, "manifest.json")
+    path = os.path.join(_resolve_dir(job_id, root), "manifest.json")
     with open(path) as f:
         manifest = json.load(f)
     manifest["completed"] = True
@@ -231,7 +253,7 @@ def checkpoint_saved_at(job_id: str, root: Optional[str] = None
     The cheap freshness probe for caches: save_checkpoint writes a
     monotonically newer time.time() into every manifest, so comparing
     saved_at is immune to filesystem mtime granularity."""
-    d = os.path.join(root or _models_root(), job_id)
+    d = _resolve_dir(job_id, root)
     try:
         with open(os.path.join(d, "manifest.json")) as f:
             return json.load(f).get("saved_at")
@@ -242,8 +264,9 @@ def checkpoint_saved_at(job_id: str, root: Optional[str] = None
 def delete_checkpoint(job_id: str, root: Optional[str] = None) -> None:
     root = root or _models_root()
     d = os.path.join(root, job_id)
-    if os.path.isdir(d):
-        shutil.rmtree(d)
+    for path in (d, d + ".old", d + ".tmp"):
+        if os.path.isdir(path):
+            shutil.rmtree(path)
 
 
 def list_checkpoints(root: Optional[str] = None) -> list:

@@ -157,6 +157,9 @@ SLOW_TESTS = {
     "test_standalone_jobs.py::test_dual_standalone_jobs_with_partitions",
     "test_standalone_jobs.py::test_crashed_job_process_releases_partition",
     "test_standalone_jobs.py::test_crashed_job_restarts_from_checkpoint",
+    "test_standalone_jobs.py::test_restart_budget_exhausted_fails_job",
+    "test_pallas_flash.py::"
+    "test_ulysses_flash_training_round_matches_reference",
     "test_control_plane.py::test_dynamic_parallelism_through_scheduler",
     "test_control_plane.py::test_metrics_exposition_and_clearing",
     "test_control_plane.py::test_mid_job_inference",

@@ -133,3 +133,39 @@ def test_async_error_surfaces_on_wait(tmp_path):
     ck.save("j5", {"params": {"w": jnp.zeros(1)}}, {})
     ck.wait()
     load_checkpoint("j5", root=str(tmp_path))
+
+
+def test_mid_publish_crash_falls_back_to_previous(tmp_path):
+    """save_checkpoint publishes via two renames (current -> .old, then
+    tmp -> current); a SIGKILL landing between them must not lose ALL
+    recovery state — loads and the watchdog's saved_at probe fall back
+    to the intact .old checkpoint (at most one epoch of state lost)."""
+    import os
+    import shutil
+
+    from kubeml_tpu.train.checkpoint import (checkpoint_saved_at,
+                                             delete_checkpoint)
+
+    root = str(tmp_path)
+    save_checkpoint("jx", {"params": {"w": jnp.arange(3.0)}},
+                    {"model": "m", "epoch": 1}, root=root)
+    # simulate the crash window: the current dir was renamed aside and
+    # the new one never landed
+    os.rename(os.path.join(root, "jx"), os.path.join(root, "jx.old"))
+
+    assert checkpoint_saved_at("jx", root=root) is not None
+    loaded, manifest = load_checkpoint("jx", root=root)
+    assert manifest["epoch"] == 1
+    np.testing.assert_array_equal(
+        np.asarray(loaded["params"]["w"]), np.arange(3.0))
+
+    # the next successful save supersedes the fallback...
+    save_checkpoint("jx", {"params": {"w": jnp.arange(3.0) + 1}},
+                    {"model": "m", "epoch": 2}, root=root)
+    _, manifest = load_checkpoint("jx", root=root)
+    assert manifest["epoch"] == 2
+    # ...and delete removes every variant incl. leftovers
+    shutil.copytree(os.path.join(root, "jx"), os.path.join(root, "jx.tmp"))
+    delete_checkpoint("jx", root=root)
+    assert not any(os.path.exists(os.path.join(root, p))
+                   for p in ("jx", "jx.old", "jx.tmp"))

@@ -202,22 +202,20 @@ def test_ring_flash_grads_match_dense_ring_causal_ragged():
     _ring_flash_grad_case(causal=True, ragged=True)
 
 
-def test_ring_flash_training_round_matches_dense():
-    """A FULL K-avg sequence-parallel training round with the
-    flash-backed ring (attn_impl='flash') produces the same merged
-    variables and round loss as the dense ring — long-context TRAINING
-    runs the pallas kernel end to end through the engine path."""
+def _sp_flash_training_round_case(seq_impl, make_x):
+    """One K-avg SP training round, flash vs reference attention: the
+    merged variables and round loss must match to bf16 tolerance. The
+    single comparison harness for both SP modes (ring / ulysses)."""
     import numpy as np
     import optax
 
     from kubeml_tpu.parallel.kavg import KAvgEngine
     from kubeml_tpu.parallel.mesh import make_mesh
-    from tests.test_models_gpt import VOCAB, TinyGPT
+    from tests.test_models_gpt import TinyGPT
 
     rng = np.random.RandomState(3)
     W, S, B, T = 2, 2, 4, 32
-    x = rng.randint(1, VOCAB, size=(W, S, B, T)).astype(np.int32)
-    x[0, 0, 0, 20:] = 0  # ragged padding crossing the shard boundary
+    x = make_x(rng, W, S, B, T)
     batch = {"x": jnp.asarray(x)}
     masks = dict(sample_mask=np.ones((W, S, B), np.float32),
                  step_mask=np.ones((W, S), np.float32),
@@ -231,7 +229,7 @@ def test_ring_flash_training_round_matches_dense():
 
     def run(attn_impl):
         model = TinyGPT()
-        model.enable_seq_parallel("ring")
+        model.enable_seq_parallel(seq_impl)
         # dropout 0 for determinism; interpret: pallas interpreter on CPU
         model._module = model.module.clone(
             dropout=0.0, attn_impl=attn_impl, flash_interpret=True)
@@ -250,6 +248,44 @@ def test_ring_flash_training_round_matches_dense():
         assert np.isfinite(np.asarray(b)).all()
         np.testing.assert_allclose(np.asarray(b), np.asarray(a),
                                    rtol=5e-3, atol=5e-4)
+
+
+def test_ring_flash_training_round_matches_dense():
+    """A FULL K-avg sequence-parallel training round with the
+    flash-backed ring (attn_impl='flash') produces the same merged
+    variables and round loss as the dense ring — long-context TRAINING
+    runs the pallas kernel end to end through the engine path."""
+    import numpy as np
+
+    from tests.test_models_gpt import VOCAB
+
+    def make_x(rng, W, S, B, T):
+        x = rng.randint(1, VOCAB, size=(W, S, B, T)).astype(np.int32)
+        x[0, 0, 0, 20:] = 0  # ragged padding crossing the shard boundary
+        return x
+
+    _sp_flash_training_round_case("ring", make_x)
+
+
+def test_ulysses_flash_training_round_matches_reference():
+    """Ulysses + flash in the vma-checked engine round: the all-to-all
+    re-shards seq->heads and the gathered-heads attention runs the
+    pallas kernel (attn_impl='flash'); merged variables and round loss
+    must equal the reference-attention round. Pins the kernel's vma
+    annotations for the gathered layout — a path that would otherwise
+    only surface on TPU hardware."""
+    import numpy as np
+
+    from tests.test_models_gpt import VOCAB
+
+    def make_x(rng, W, S, B, T):
+        # pad-free ascending runs (ulysses has no per-block pad path to
+        # exercise; the ring case carries the ragged-padding coverage)
+        start = rng.randint(1, VOCAB - 1, size=(W * S * B, 1))
+        return ((start + np.arange(T)[None, :] - 1) % (VOCAB - 1) + 1) \
+            .astype(np.int32).reshape(W, S, B, T)
+
+    _sp_flash_training_round_case("ulysses", make_x)
 
 
 def test_ring_flash_causal_noncontiguous_layout_poisons():

@@ -24,6 +24,10 @@ from tests.test_control_plane import wait_history, write_blob_files
 @pytest.fixture()
 def standalone_stack(tmp_path, tmp_home, mesh8, monkeypatch):
     monkeypatch.setenv("STANDALONE_JOBS", "true")
+    # CI runs many JAX processes concurrently; a child's import/init can
+    # exceed the 120 s production default, which would fail the start
+    # (or eat a chaos test's restart budget) spuriously
+    monkeypatch.setenv("KUBEML_JOB_START_TIMEOUT", "600")
     dep = start_deployment(mesh=mesh8)
     assert dep.ps.standalone_jobs
     client = KubemlClient(dep.controller_url)
@@ -207,6 +211,48 @@ def test_crashed_job_process_releases_partition(partitioned_stack):
     assert not dep.ps._busy_partitions
 
 
+# ------------------------------------------- crash-injection machinery
+#
+# Shared by the recovery chaos tests below. Kill windows are kept tens
+# of seconds wide through n_train sizing (~1 s/epoch x tens of epochs):
+# at 0.2 s/epoch the job could finish before a load-starved poll thread
+# landed the kill (measured flaky under a concurrent full-tier run).
+
+
+def _read_manifest(tmp_home, job_id) -> dict:
+    import json
+    import os
+    try:
+        with open(os.path.join(str(tmp_home), "models", job_id,
+                               "manifest.json")) as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return {}
+
+
+def _kill_in_window(dep, tmp_home, job_id, epochs, expect_restarts=0,
+                    timeout=240.0):
+    """Wait for the job's incarnation `expect_restarts` to be fully
+    RUNNING (task state 'running' — a kill between readiness and the
+    /start push would hit a child that never received its task) with a
+    durable MID-JOB checkpoint (1 <= manifest epoch < epochs), then
+    SIGKILL it. Returns the record."""
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        with dep.ps._jobs_lock:
+            rec = dep.ps.jobs.get(job_id)
+        assert rec is not None, "job ended before the kill window"
+        if rec.restarts == expect_restarts and rec.proc is not None \
+                and rec.url is not None \
+                and rec.task.state == "running" and \
+                1 <= _read_manifest(tmp_home, job_id).get("epoch", 0) \
+                < epochs:
+            rec.proc.kill()
+            return rec
+        time.sleep(0.05)
+    raise AssertionError("kill window never opened")
+
+
 def test_crashed_job_restarts_from_checkpoint(standalone_stack, tmp_home):
     """Checkpoint-based crash recovery (VERDICT r3 item 2): SIGKILL the
     standalone job process mid-job, after at least one periodic
@@ -217,51 +263,27 @@ def test_crashed_job_restarts_from_checkpoint(standalone_stack, tmp_home):
     the pre-crash epoch metrics preserved verbatim. The reference loses
     the job when its TrainJob pod dies (tolerance exists only within a
     merge, util.go:144-166)."""
-    import json
-    import os
-
     dep, client, tmp_path = standalone_stack
     paths = write_blob_files(tmp_path, n_train=4000)
     client.v1().datasets().create(
         "blobs", paths["xtr"], paths["ytr"], paths["xte"], paths["yte"])
 
-    # enough epochs that the window between the FIRST durable checkpoint
-    # and job completion stays seconds wide even when post-compile
-    # epochs run in ~0.2 s (measured flaky at epochs=6 under CPU
-    # contention: the job finished before the kill landed)
     epochs = 30
     req = TrainRequest(model_type="mlp", batch_size=16, epochs=epochs,
                        dataset="blobs", lr=0.05,
                        options=TrainOptions(default_parallelism=2, k=1,
                                             static_parallelism=True,
-                                            max_restarts=1))
+                                            max_restarts=1,
+                                            # no goal-accuracy early
+                                            # stop: a fast-converging
+                                            # run must not end before
+                                            # the kill lands
+                                            goal_accuracy=200.0))
     job_id = client.v1().networks().train(req)
 
-    manifest_path = os.path.join(str(tmp_home), "models", job_id,
-                                 "manifest.json")
-
-    def manifest():
-        try:
-            with open(manifest_path) as f:
-                return json.load(f)
-        except (OSError, ValueError):
-            return {}
-
-    # wait for the child to be up AND a mid-job checkpoint to be durable
-    # (auto cadence: every validated epoch), then kill it mid-job
-    deadline = time.time() + 240
-    rec = None
-    while time.time() < deadline:
-        with dep.ps._jobs_lock:
-            rec = dep.ps.jobs.get(job_id)
-        if rec is None:  # finished before we got to kill it: test bug
-            raise AssertionError("job finished before the kill window")
-        if rec.proc is not None and 1 <= manifest().get("epoch", 0) < epochs:
-            break
-        time.sleep(0.05)
-    pre_crash = manifest()
+    rec = _kill_in_window(dep, tmp_home, job_id, epochs)  # the crash
+    pre_crash = _read_manifest(tmp_home, job_id)
     assert pre_crash.get("history"), "mid-job manifest must carry history"
-    rec.proc.kill()  # the crash
 
     # the SAME record must be respawned (not failed): restarts consumed,
     # new child process, job still registered
@@ -288,3 +310,42 @@ def test_crashed_job_restarts_from_checkpoint(standalone_stack, tmp_home):
     x = np.load(paths["xte"])[:3]
     preds = client.v1().networks().infer(job_id, x.tolist())
     assert len(preds) == 3
+
+
+def test_restart_budget_exhausted_fails_job(standalone_stack, tmp_home):
+    """A second crash beyond max_restarts=1 must FAIL the job (no
+    infinite respawn loop): the watchdog consumes its one restart on
+    the first kill, and the second kill deregisters the job with the
+    unexpected-exit error."""
+    dep, client, tmp_path = standalone_stack
+    paths = write_blob_files(tmp_path, n_train=20000)
+    client.v1().datasets().create(
+        "blobs", paths["xtr"], paths["ytr"], paths["xte"], paths["yte"])
+    epochs = 40
+    req = TrainRequest(model_type="mlp", batch_size=16, epochs=epochs,
+                       dataset="blobs", lr=0.05,
+                       options=TrainOptions(default_parallelism=2, k=1,
+                                            static_parallelism=True,
+                                            max_restarts=1,
+                                            # no goal-accuracy early
+                                            # stop: a fast-converging
+                                            # run must not end before
+                                            # the kill lands
+                                            goal_accuracy=200.0))
+    job_id = client.v1().networks().train(req)
+
+    # first kill: consumed by the one restart; second: budget exhausted
+    _kill_in_window(dep, tmp_home, job_id, epochs, expect_restarts=0)
+    rec = _kill_in_window(dep, tmp_home, job_id, epochs,
+                          expect_restarts=1)
+
+    # the job must deregister as FAILED — no third incarnation
+    assert dep.ps.wait_for_job(job_id, timeout=120)
+    assert rec.restarts == 1
+    # and it never wrote a completed history (the run was cut short)
+    from kubeml_tpu.api.errors import KubeMLException
+    try:
+        h = client.v1().histories().get(job_id)
+        assert len(h.data.train_loss) < epochs
+    except KubeMLException:
+        pass  # no history at all is the expected common case
