@@ -61,6 +61,15 @@ LENET_DIGITS_GBATCH_CONFIGS = [
 ]
 LENET_DIGITS_GBATCH_EPOCHS = 30
 
+# REAL-data dynamic-parallelism arm: one config, static=False — the live
+# throughput policy drives N between epochs over genuine digit images
+# (the real-data sibling of the RESNET50 synthetic autoscale arm).
+LENET_DIGITS_AUTOSCALE_GRID = {
+    "batch": [32],
+    "k": [8],
+    "parallelism": [4],
+}
+
 # ResNet/CIFAR-10: active grid of utils.py:18-28 (batch sweep, K=-1, p=8),
 # lr 0.1, 30 epochs (train.py:41-61). The reference uses ResNet-34; our
 # flagship config is ResNet-18 per BASELINE.json's north star, and the

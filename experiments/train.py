@@ -46,6 +46,14 @@ GRIDS = {
                                 tta=grids.LENET_DIGITS_TTA_GOAL,
                                 function="lenet", dataset="digits",
                                 shuffle=True, real="digits"),
+    # REAL-data dynamic-parallelism arm: the live throughput policy
+    # driving a genuine-image job (the real-data sibling of the
+    # resnet50 synthetic autoscale run — docs/performance.md)
+    "lenet-digits-autoscale": dict(
+        grid=grids.LENET_DIGITS_AUTOSCALE_GRID,
+        epochs=grids.LENET_DIGITS_EPOCHS, lr=grids.LENET_DIGITS_LR,
+        tta=grids.LENET_DIGITS_TTA_GOAL, function="lenet",
+        dataset="digits", shuffle=True, real="digits", static=False),
     "resnet": dict(grid=grids.RESNET_GRID, epochs=grids.RESNET_EPOCHS,
                    lr=grids.RESNET_LR, tta=grids.RESNET_TTA_GOAL,
                    function="resnet18", dataset="cifar10"),
