@@ -1,10 +1,10 @@
 """Text/transformer on-chip benchmarks — BASELINE.json configs 4-5 plus
-the flash-kernel model-level delta.
+the flash-kernel model-level delta and the KV-cache decode path.
 
-Three measurements, bench.py-grade methodology (synthetic token data on
+Four measurements, bench.py-grade methodology (synthetic token data on
 device, warmup epochs outside the timed window, readback-synchronized
-timing — never block_until_ready on tunneled backends, fresh rngs per
-round so no executable+input cache can serve a repeat):
+timing — never block_until_ready on tunneled backends, fresh inputs per
+iteration so no executable+input cache can serve a repeat):
 
   lstm   — 2-layer LSTM classifier through the REAL K-avg engine round
            (BASELINE config 4: recurrent lax.scan step under jit).
@@ -15,9 +15,12 @@ round so no executable+input cache can serve a repeat):
            at long context (default T=2048) with attn_impl='flash' vs
            'reference' — the first hardware quantification of the
            pallas kernel's end-to-end training worth.
+  generate — KV-cache decode throughput (models/gpt.py generate):
+           prefill once, then the jitted single-token decode scan —
+           the inference hot path's tokens/sec.
 
 Usage:
-    python -m experiments.bench_text [--which lstm,bert,flash]
+    python -m experiments.bench_text [--which lstm,bert,flash,generate]
         [--out results/text-bench-v5e.jsonl] [--seq 2048]
 
 Appends one JSON row per measurement; prints each row as it lands.
@@ -181,9 +184,57 @@ def bench_flash_delta(family: str, T: int, batch: int,
     }
 
 
+def bench_generate(T_prompt: int = 128, n_new: int = 512,
+                   batch: int = 8, iters: int = 3) -> dict:
+    """KV-cache decode throughput: prefill once, then the jitted
+    single-token decode scan (models/gpt.py generate) — the inference
+    hot path. Tokens/sec counts GENERATED tokens only; generate()
+    returns host arrays, so each call is readback-synchronized by
+    construction."""
+    import jax
+    import numpy as np
+
+    from kubeml_tpu.models.gpt import GPTMini, GPTModule
+
+    class _BenchGPT(GPTMini):
+        def build(self):
+            return GPTModule(vocab_size=8192, max_len=T_prompt + n_new,
+                             hidden=256, layers=4, heads=4, ffn=1024,
+                             dropout=0.0)
+
+    jnp = jax.numpy
+    model = _BenchGPT()
+    rng = np.random.RandomState(0)
+    prompts = rng.randint(1, 8192, size=(batch, T_prompt)).astype(np.int32)
+    variables = model.init_variables(jax.random.PRNGKey(0),
+                                     {"x": jnp.asarray(prompts)})
+
+    # fresh prompts per iter (cache-busting), generated OUTSIDE the
+    # timed window so host-side randint never lands in the measurement
+    fresh = [rng.randint(1, 8192, size=(batch, T_prompt)).astype(np.int32)
+             for _ in range(iters)]
+    model.generate(variables, prompts, max_new_tokens=n_new)  # compile
+    t0 = time.perf_counter()
+    for p in fresh:
+        out = model.generate(variables, p, max_new_tokens=n_new)
+    elapsed = time.perf_counter() - t0
+    assert out.shape == (batch, T_prompt + n_new)
+    new_tokens = iters * batch * n_new
+    return {
+        "bench": "gpt_kvcache_decode", "prompt_len": T_prompt,
+        "new_tokens": n_new, "batch": batch,
+        "decode_tokens_per_sec": round(new_tokens / elapsed, 1),
+        # the timed window spans prefill + decode per call; the
+        # per-step figure amortizes the (short) prefill over the
+        # decode steps — name it accordingly
+        "ms_per_generated_token": round(
+            elapsed / (iters * n_new) * 1e3, 4),
+    }
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser()
-    ap.add_argument("--which", default="lstm,bert,flash")
+    ap.add_argument("--which", default="lstm,bert,flash,generate")
     ap.add_argument("--out", default=None)
     ap.add_argument("--seq", type=int, default=2048,
                     help="context length for the flash delta arm")
@@ -207,6 +258,8 @@ def main(argv=None) -> int:
     if "flash" in which:
         rows.append(bench_flash_delta("gpt", args.seq, args.flash_batch))
         rows.append(bench_flash_delta("bert", args.seq, args.flash_batch))
+    if "generate" in which:
+        rows.append(bench_generate())
 
     for row in rows:
         print(json.dumps(row))
