@@ -59,6 +59,8 @@ SLOW_TESTS = {
     # experiment harness grids
     "test_experiments.py::test_baseline_text_grids_run[bert]",
     "test_experiments.py::test_baseline_text_grids_run[lstm]",
+    "test_experiments.py::test_bench_text_engine_arm_runs",
+    "test_experiments.py::test_bench_text_generate_arm_runs",
     "test_experiments.py::test_single_node_baseline_arm",
     # examples (full end-to-end function runs)
     "test_examples.py::test_gpt_example_trains_end_to_end",

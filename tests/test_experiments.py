@@ -149,3 +149,30 @@ def test_lenet_digits_grid_registered():
     assert spec["dataset"] == "digits" and spec["shuffle"] is True
     assert spec["grid"] is grids.LENET_DIGITS_GRID
     assert spec["tta"] == 95.0
+
+
+def test_bench_text_engine_arm_runs():
+    """The committed text benchmark harness (experiments/bench_text.py,
+    the lstm/bert BASELINE rows in results/) keeps running — tiny
+    shapes, API/shape bitrot guard, not a measurement."""
+    from experiments.bench_text import bench_engine_text
+
+    # workers must be a multiple of the mesh data-axis size (8 virtual
+    # devices under the test conftest)
+    row = bench_engine_text("lstm", k=2, batch=8, seq_len=16, vocab=500,
+                            workers=8, epoch_samples=64, timed_epochs=1)
+    assert row["bench"] == "lstm_engine_throughput"
+    assert row["samples_per_sec_per_chip"] > 0
+    # both fields are independently rounded to 1 decimal; compare loosely
+    assert row["tokens_per_sec_per_chip"] == pytest.approx(
+        row["samples_per_sec_per_chip"] * 16, rel=0.05)
+
+
+def test_bench_text_generate_arm_runs():
+    """Decode-throughput arm bitrot guard (tiny shapes)."""
+    from experiments.bench_text import bench_generate
+
+    row = bench_generate(T_prompt=8, n_new=8, batch=2, iters=1)
+    assert row["bench"] == "gpt_kvcache_decode"
+    assert row["decode_tokens_per_sec"] > 0
+    assert row["ms_per_generated_token"] > 0
