@@ -144,6 +144,7 @@ class ParameterServer(JsonService):
         self._busy_partitions: set = set()
         self.jobs: Dict[str, _JobRecord] = {}
         self._jobs_lock = threading.RLock()
+        self._stopping = False  # set by stop(); gates spawns/restarts
         self._infer_cache: "collections.OrderedDict" = \
             collections.OrderedDict()
         self._infer_cache_lock = threading.Lock()
@@ -362,16 +363,30 @@ class ParameterServer(JsonService):
         cmd = [sys.executable, "-m", "kubeml_tpu.train.jobserver",
                "--job-id", task.job_id, "--ps-url", self.url,
                "--port-file", port_file]
+        mirror_cpu = 0
         if self._mesh is not None:
             # explicit mesh: size hint + (tests) mirror a virtual-CPU view
             from kubeml_tpu.parallel.mesh import data_axis_size
             cmd += ["--mesh-data", str(data_axis_size(self._mesh))]
             devs = self._mesh.devices.ravel()
             if devs[0].platform == "cpu":
-                cmd += ["--virtual-cpu-devices", str(len(devs))]
+                mirror_cpu = len(devs)
+                cmd += ["--virtual-cpu-devices", str(mirror_cpu)]
         if self.scheduler_url:
             cmd += ["--scheduler-url", self.scheduler_url]
         env = dict(os.environ)
+        if mirror_cpu:
+            # a CPU-mirrored child must be CPU-targeted AT INTERPRETER
+            # START, not merely retargeted after import: the container
+            # sitecustomize eagerly initializes the accelerator backend
+            # first, which (a) on a TPU host would transiently steal the
+            # single-process-exclusive chip from a real TPU job and (b)
+            # blocks indefinitely when the relay is still reaping a
+            # SIGKILLed sibling's session — observed as chaos-test
+            # children stuck in backend init with the watchdog's restart
+            # then failing on the readiness timeout
+            from kubeml_tpu.testing import virtual_cpu_env
+            env.update(virtual_cpu_env(mirror_cpu))
         # the job child must NOT inherit the parent's jax.distributed
         # rank: on multi-host serve these vars hold the PARENT's
         # coordinator/rank, and a child re-joining as that rank hangs
@@ -414,6 +429,22 @@ class ParameterServer(JsonService):
         finally:
             shutil.rmtree(tmp_dir, ignore_errors=True)
         task.state = "running"
+        # a stop() that raced this spawn cleared the job index while the
+        # child was coming up: the child now holds a task nobody tracks —
+        # terminate (and properly reap) it instead of leaking an orphan
+        # that trains to completion against a dead endpoint. Keyed on
+        # _stopping ONLY: a merely-absent record is the documented
+        # fast-/finish race (an immediately-finishing child popped its
+        # own record) and must not fail a job that actually ran.
+        with self._jobs_lock:
+            raced_stop = self._stopping
+        if raced_stop:
+            rec.proc.terminate()
+            threading.Thread(target=self._reap, args=(rec,),
+                             name=f"reap-{task.job_id}",
+                             daemon=True).start()
+            raise KubeMLException(
+                "parameter server is shutting down", 503)
         # watchdog: a child that dies WITHOUT posting /finish (OOM-kill,
         # segfault) must not pin its record — or its device partition —
         # forever. proc.wait() here races the normal finish path safely:
@@ -442,7 +473,8 @@ class ParameterServer(JsonService):
         with self._jobs_lock:
             if self.jobs.get(job_id) is not rec:
                 return  # already deregistered via /finish
-            eligible = (rec.task.state != "stopping"
+            eligible = (not self._stopping
+                        and rec.task.state != "stopping"
                         and rec.restarts < opts.max_restarts
                         and checkpoint_saved_at(job_id) is not None)
             if eligible:
@@ -592,6 +624,30 @@ class ParameterServer(JsonService):
             slot, rec.partition = rec.partition, None
             if slot is not None:
                 self._busy_partitions.discard(slot)
+
+    def stop(self):
+        """Shut the HTTP server down AND terminate standalone job
+        children — a dying PS must not leak orphan job processes (they
+        outlive the deployment, keep retrying metric pushes against a
+        dead endpoint, and hold inherited stdio pipes open, which
+        blocks any parent waiting on those streams). The reference's
+        analogue is pod garbage collection on PS teardown."""
+        super().stop()
+        with self._jobs_lock:
+            self._stopping = True  # no further spawns or crash-restarts
+            recs = list(self.jobs.values())
+            self.jobs.clear()
+        for rec in recs:
+            if rec.proc is not None and rec.proc.poll() is None:
+                rec.proc.terminate()
+        for rec in recs:
+            if rec.proc is not None:
+                try:
+                    rec.proc.wait(10.0)
+                except subprocess.TimeoutExpired:
+                    rec.proc.kill()
+                    rec.proc.wait()
+            self._release_partition(rec)
 
     def wait_for_job(self, job_id: str, timeout: Optional[float] = None
                      ) -> bool:

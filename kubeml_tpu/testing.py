@@ -12,6 +12,17 @@ extension API) recipe.
 from __future__ import annotations
 
 
+def virtual_cpu_env(n: int) -> dict:
+    """Env vars that make a CHILD python process CPU-targeted at
+    interpreter start (before its sitecustomize can eagerly grab the
+    accelerator): the one copy of the recipe for every launcher that
+    spawns CPU-emulated children (PS standalone spawns, the distributed
+    launcher's --emulate-cpu, demo tools, test fixtures). JAX-free —
+    safe to import from processes that must not initialize a backend."""
+    return {"PALLAS_AXON_POOL_IPS": "", "JAX_PLATFORMS": "cpu",
+            "JAX_NUM_CPU_DEVICES": str(n)}
+
+
 def ensure_virtual_cpu_devices(n: int) -> None:
     """Make `jax.devices()` return at least n CPU devices (idempotent)."""
     import jax

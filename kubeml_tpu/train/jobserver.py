@@ -183,6 +183,12 @@ def main(argv=None):
     args = p.parse_args(argv)
 
     logging.basicConfig(level=logging.INFO)
+    # a wedged child (backend init, collective, IO) is otherwise a
+    # silent readiness-timeout for the PS: dump every thread's stack to
+    # stderr periodically so the parent's captured output shows WHERE
+    # (same discipline as the distributed test workers)
+    import faulthandler
+    faulthandler.dump_traceback_later(120, repeat=True)
     if args.virtual_cpu_devices:
         from kubeml_tpu.parallel.distributed import _cluster_env_present
         if _cluster_env_present():
@@ -215,7 +221,27 @@ def main(argv=None):
             f.write(str(port))
         os.replace(tmp, args.port_file)  # atomic: parent never reads partial
     logger.info("job server %s on port %d", args.job_id, port)
-    server.finished.wait()
+    # bounded wait for the task: a child whose parent died (or whose
+    # /start push was lost) must not linger as an idle orphan forever —
+    # observed exactly that when a PS teardown raced a crash-restart's
+    # /start push. Once training starts, the wait is unbounded (the job
+    # itself decides when it is finished).
+    start_timeout = float(os.environ.get("KUBEML_JOB_START_TIMEOUT",
+                                         120.0)) + 180.0
+    while not server.finished.wait(timeout=30.0):
+        if server._job is not None:
+            if start_timeout is not None:
+                start_timeout = None  # task arrived: wait indefinitely
+                # the watchdog dumps exist to diagnose a wedged START;
+                # a healthy long-running job must not flood stderr with
+                # all-thread tracebacks every two minutes
+                faulthandler.cancel_dump_traceback_later()
+        elif start_timeout is not None:
+            start_timeout -= 30.0
+            if start_timeout <= 0:
+                logger.error("job server %s received no task within the "
+                             "start window; exiting", args.job_id)
+                break
     server.stop()
 
 

@@ -160,6 +160,8 @@ SLOW_TESTS = {
     "test_standalone_jobs.py::test_crashed_job_process_releases_partition",
     "test_standalone_jobs.py::test_crashed_job_restarts_from_checkpoint",
     "test_standalone_jobs.py::test_restart_budget_exhausted_fails_job",
+    "test_standalone_jobs.py::"
+    "test_two_crashes_two_restarts_continuous_history",
     "test_pallas_flash.py::"
     "test_ulysses_flash_training_round_matches_reference",
     "test_control_plane.py::test_dynamic_parallelism_through_scheduler",

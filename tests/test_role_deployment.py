@@ -35,13 +35,12 @@ def _wait_health(url, proc, timeout=90):
 
 def test_split_role_processes_train(tmp_home, tmp_path):
     env = dict(os.environ)
+    from kubeml_tpu.testing import virtual_cpu_env
     env.update({
         "KUBEML_TPU_HOME": os.environ["KUBEML_TPU_HOME"],
         "PYTHONPATH": REPO + os.pathsep + env.get("PYTHONPATH", ""),
         # force the virtual CPU backend in the children (the PS trains)
-        "PALLAS_AXON_POOL_IPS": "",
-        "JAX_PLATFORMS": "cpu",
-        "JAX_NUM_CPU_DEVICES": "8",
+        **virtual_cpu_env(8),
     })
     ports = {r: find_free_port() for r in
              ("storage", "ps", "scheduler", "controller")}

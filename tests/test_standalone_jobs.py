@@ -110,10 +110,10 @@ def partitioned_stack(tmp_path, tmp_home, monkeypatch):
     """Standalone PS with TWO device-partition slots, each exposing its
     own 2-virtual-CPU-device view to the job process (the single-chip
     stand-in for per-job TPU_VISIBLE_DEVICES pinning)."""
-    part = {"PALLAS_AXON_POOL_IPS": "", "JAX_PLATFORMS": "cpu",
-            "JAX_NUM_CPU_DEVICES": "2"}
+    from kubeml_tpu.testing import virtual_cpu_env
     dep = start_deployment(mesh=None, standalone_jobs=True,
-                           job_partitions=[dict(part), dict(part)])
+                           job_partitions=[virtual_cpu_env(2),
+                                           virtual_cpu_env(2)])
     client = KubemlClient(dep.controller_url)
     yield dep, client, tmp_path
     dep.stop()
@@ -231,22 +231,34 @@ def _read_manifest(tmp_home, job_id) -> dict:
 
 
 def _kill_in_window(dep, tmp_home, job_id, epochs, expect_restarts=0,
-                    timeout=240.0):
+                    timeout=240.0, min_epoch=1):
     """Wait for the job's incarnation `expect_restarts` to be fully
     RUNNING (task state 'running' — a kill between readiness and the
     /start push would hit a child that never received its task) with a
-    durable MID-JOB checkpoint (1 <= manifest epoch < epochs), then
-    SIGKILL it. Returns the record."""
+    durable MID-JOB checkpoint (min_epoch <= manifest epoch < epochs),
+    then SIGKILL it. min_epoch > 1 lets chained-crash tests require the
+    CURRENT incarnation to have checkpointed (not just the previous
+    one's leftover manifest). Returns the record."""
     deadline = time.time() + timeout
+    seen = False
     while time.time() < deadline:
         with dep.ps._jobs_lock:
             rec = dep.ps.jobs.get(job_id)
-        assert rec is not None, "job ended before the kill window"
+        if rec is None:
+            # BEFORE the job ever registered this is just the scheduler's
+            # asynchronous dispatch not having run yet (the queue loop
+            # picks the task moments after submit — a fast poll can beat
+            # it); AFTER it registered, a vanished record means the job
+            # ended and the test's premise is broken
+            assert not seen, "job ended before the kill window"
+            time.sleep(0.05)
+            continue
+        seen = True
         if rec.restarts == expect_restarts and rec.proc is not None \
                 and rec.url is not None \
                 and rec.task.state == "running" and \
-                1 <= _read_manifest(tmp_home, job_id).get("epoch", 0) \
-                < epochs:
+                min_epoch <= _read_manifest(tmp_home, job_id
+                                            ).get("epoch", 0) < epochs:
             rec.proc.kill()
             return rec
         time.sleep(0.05)
@@ -310,6 +322,47 @@ def test_crashed_job_restarts_from_checkpoint(standalone_stack, tmp_home):
     x = np.load(paths["xte"])[:3]
     preds = client.v1().networks().infer(job_id, x.tolist())
     assert len(preds) == 3
+
+
+def test_two_crashes_two_restarts_continuous_history(standalone_stack,
+                                                     tmp_home):
+    """max_restarts=2 survives TWO crashes: the second restart resumes
+    from the checkpoint the FIRST restarted incarnation wrote (chained
+    resume-from-self), and the final history is one continuous run."""
+    dep, client, tmp_path = standalone_stack
+    paths = write_blob_files(tmp_path, n_train=20000)
+    client.v1().datasets().create(
+        "blobs", paths["xtr"], paths["ytr"], paths["xte"], paths["yte"])
+    epochs = 40
+    req = TrainRequest(model_type="mlp", batch_size=16, epochs=epochs,
+                       dataset="blobs", lr=0.05,
+                       options=TrainOptions(default_parallelism=2, k=1,
+                                            static_parallelism=True,
+                                            max_restarts=2,
+                                            goal_accuracy=200.0))
+    job_id = client.v1().networks().train(req)
+
+    _kill_in_window(dep, tmp_home, job_id, epochs, expect_restarts=0)
+    first_crash_epoch = _read_manifest(tmp_home, job_id).get("epoch", 0)
+    assert first_crash_epoch >= 1
+    # require the RESTARTED incarnation to have checkpointed past the
+    # first crash's manifest before the second kill, so the third
+    # incarnation genuinely resumes from incarnation #2's checkpoint
+    # (chained recovery), not a single-hop resume of the first one
+    rec = _kill_in_window(dep, tmp_home, job_id, epochs,
+                          expect_restarts=1,
+                          min_epoch=first_crash_epoch + 1)
+    second_crash = _read_manifest(tmp_home, job_id)
+    assert second_crash.get("epoch", 0) > first_crash_epoch
+
+    history = wait_history(client, job_id, timeout=420)
+    assert rec.restarts == 2
+    assert len(history.data.train_loss) == epochs
+    # the third incarnation's restored prefix equals what was durable
+    # at the second crash — history chained across BOTH restarts
+    saved = second_crash["history"]["train_loss"]
+    assert history.data.train_loss[: len(saved)] == saved
+    assert dep.ps.wait_for_job(job_id, timeout=120)
 
 
 def test_restart_budget_exhausted_fails_job(standalone_stack, tmp_home):

@@ -40,9 +40,8 @@ def main(argv=None) -> int:
     if args.partition:
         partitions = [parse_env_spec(spec) for spec in args.partition]
     else:
-        cpu = {"PALLAS_AXON_POOL_IPS": "", "JAX_PLATFORMS": "cpu",
-               "JAX_NUM_CPU_DEVICES": "2"}
-        partitions = [dict(cpu), dict(cpu)]
+        from kubeml_tpu.testing import virtual_cpu_env
+        partitions = [virtual_cpu_env(2), virtual_cpu_env(2)]
         print("no --partition given: using two 2-virtual-CPU-device "
               "slots (single-chip fallback)")
 

@@ -104,12 +104,10 @@ def main(argv=None) -> int:
 
     if args.emulate_cpu > 0:
         ranks = range(args.processes)
-        base_env.update(
-            # the sitecustomize eagerly grabs the TPU backend; an empty
-            # pool-IPs var disables it so the CPU retarget works
-            PALLAS_AXON_POOL_IPS="",
-            JAX_PLATFORMS="cpu",
-            JAX_NUM_CPU_DEVICES=str(args.emulate_cpu))
+        # the one shared recipe for CPU-targeting a child before its
+        # sitecustomize can grab the accelerator (JAX-free import)
+        from kubeml_tpu.testing import virtual_cpu_env
+        base_env.update(virtual_cpu_env(args.emulate_cpu))
     else:
         if args.process_id is None:
             p.error("--process-id is required in real multi-host mode")
