@@ -93,7 +93,8 @@ class KubemlExperiment:
                      batch: int, lr: float, parallelism: int, k: int,
                      static: bool = True, validate_every: int = 1,
                      goal_accuracy: float = 100.0,
-                     shuffle: bool = False) -> TrainRequest:
+                     shuffle: bool = False,
+                     max_parallelism: int = 0) -> TrainRequest:
         return TrainRequest(
             model_type=function, function_name=function, dataset=dataset,
             epochs=epochs, batch_size=batch, lr=lr,
@@ -101,7 +102,8 @@ class KubemlExperiment:
                                  static_parallelism=static,
                                  validate_every=validate_every, k=k,
                                  goal_accuracy=goal_accuracy,
-                                 shuffle=shuffle))
+                                 shuffle=shuffle,
+                                 max_parallelism=max_parallelism))
 
     def run(self, req: TrainRequest, config: Optional[Dict] = None
             ) -> ExperimentResult:

@@ -68,6 +68,10 @@ LENET_DIGITS_AUTOSCALE_GRID = {
     "batch": [32],
     "k": [8],
     "parallelism": [4],
+    # the cap doubles as the PINNED round shape (train/job.py elastic
+    # shape pinning): N moves only through the worker mask, so the
+    # policy's ±1 steps are recompile-free
+    "max_parallelism": [8],
 }
 
 # ResNet/CIFAR-10: active grid of utils.py:18-28 (batch sweep, K=-1, p=8),
@@ -94,6 +98,10 @@ RESNET50_GRID = {
     "batch": [128, 64],
     "k": [-1],
     "parallelism": [4],
+    # capped autoscale: W pins at 8; k=-1 means S still tracks N, each
+    # N's program a one-time persistently-cached compile excluded from
+    # the policy's timing (data/loader.py epoch_rounds)
+    "max_parallelism": [8],
 }
 RESNET50_EPOCHS = 30
 RESNET50_LR = 0.05

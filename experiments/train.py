@@ -184,7 +184,8 @@ def main(argv=None) -> int:
                 epochs=epochs, batch=cfg["batch"], lr=spec["lr"],
                 parallelism=cfg["parallelism"], k=cfg["k"],
                 static=spec.get("static", True),
-                shuffle=spec.get("shuffle", False))
+                shuffle=spec.get("shuffle", False),
+                max_parallelism=cfg.get("max_parallelism", 0))
             res = exp.run(req, config={"function": spec["function"],
                                        "dataset": spec["dataset"],
                                        "epochs": epochs, "lr": spec["lr"],

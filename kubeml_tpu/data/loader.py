@@ -173,10 +173,25 @@ class RoundLoader:
 
     def __init__(self, handle: DatasetHandle, dataset: KubeDataset,
                  n_lanes: int, seed: int = 0, shuffle: bool = False,
-                 use_native: bool = True):
+                 use_native: bool = True, w_floor: int = 0,
+                 s_floor: int = 0):
+        """w_floor/s_floor: minimum round-tensor shape [W, S, ...] —
+        the ELASTIC-parallelism contract. An elastic job pins these to
+        the largest shape any parallelism can need (W from the cap or
+        the high-water mark, S from the N=1 plan), so a parallelism
+        change alters only MASK CONTENTS, never array shapes, and the
+        engine's jitted round compiles once for the job's lifetime
+        instead of once per N (the 20-200 s per-±1 recompiles of
+        results/*-autoscale-v5e.jsonl). Both are grow-only high-water
+        marks: once a shape has been seen, later smaller plans keep it.
+        Masked-out slots cost compute (the program still runs their
+        steps), so callers should size w_floor from the real expected
+        range, not an arbitrary huge cap."""
         self.handle = handle
         self.dataset = dataset
         self.n_lanes = n_lanes
+        self.w_floor = w_floor
+        self.s_floor = s_floor
         self.shuffle = shuffle
         self._root_rng = np.random.SeedSequence(seed)
         # The C++ assembler implements exactly the identity-transform,
@@ -202,8 +217,22 @@ class RoundLoader:
         All rounds share the same [W, S_max, B] shape so the engine compiles
         once per (parallelism, K, batch) configuration.
         """
-        W = _pad_workers(plan.num_workers, self.n_lanes)
-        S = max((r.max_steps for r in plan.rounds), default=0)
+        W = max(_pad_workers(plan.num_workers, self.n_lanes),
+                _pad_workers(self.w_floor, self.n_lanes))
+        S = max(max((r.max_steps for r in plan.rounds), default=0),
+                self.s_floor)
+        if plan.k != -1:
+            # K-step rounds: S ~= K independent of N (only tiny-shard
+            # raggedness shrinks it), so pinning [W, S] costs nothing
+            # at steady state and makes every N one program. Sparse
+            # averaging (k == -1) is the opposite — S is the whole
+            # shard, shrinking ~1/N, so each N compiles its own
+            # program REGARDLESS of W; pinning W there would buy zero
+            # compile reduction while paying masked compute forever.
+            # Hence both high-water marks are K-step-only (a k=-1
+            # caller passes w_floor=0 and shapes simply track N).
+            self.w_floor = W  # grow-only: a -N step never reshapes
+            self.s_floor = S
         B = plan.batch_size
         x_mm, y_mm = self.handle.train_arrays()
         perm = None

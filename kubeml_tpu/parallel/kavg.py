@@ -70,11 +70,18 @@ class RoundStats:
     """
 
     def __init__(self, loss_sum_device: jax.Array, step_count: np.ndarray,
-                 sample_count: np.ndarray, contributors: float):
+                 sample_count: np.ndarray, contributors: float,
+                 compiled: bool = False):
         self.loss_sum_device = loss_sum_device    # [W] device array
         self.step_count = step_count              # [W] real local steps
         self.sample_count = sample_count          # [W] real samples
         self.contributors = contributors          # workers merged
+        # True when this dispatch built (traced + XLA-compiled) a new
+        # round program — the job subtracts such rounds from the epoch
+        # duration it reports to the throughput policy, so compile time
+        # is never read as throughput signal (policy.go:50-94 assumes
+        # epoch time ~= steady state; on TPU only non-compile rounds are)
+        self.compiled = compiled
         self._loss_sum: Optional[np.ndarray] = None
 
     @property
@@ -400,7 +407,8 @@ class KAvgEngine:
         lead = jax.tree_util.tree_leaves(batch)[0]
         key = (w_per_lane, tuple(lead.shape[1:3]),
                jax.tree_util.tree_structure(batch))
-        if key not in self._train_cache:
+        compiled = key not in self._train_cache
+        if compiled:
             self._train_cache[key] = self._build_train_round(
                 w_per_lane, batch_template=batch)
 
@@ -418,6 +426,7 @@ class KAvgEngine:
             step_count=np.asarray(step_mask).sum(axis=1),
             sample_count=np.asarray(sample_mask).sum(axis=(1, 2)),
             contributors=float(np.asarray(worker_mask).sum()),
+            compiled=compiled,
         )
         return avg, stats
 

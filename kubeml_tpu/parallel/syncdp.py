@@ -85,6 +85,10 @@ class SyncDPEngine:
         self._cache: Dict[Any, Callable] = {}
         self._opt_specs: Optional[PyTree] = None
         self._param_specs: Optional[PyTree] = None
+        # mirrors RoundStats.compiled (parallel/kavg.py): True when the
+        # most recent train_steps built a new program — the job excludes
+        # such rounds from the duration the throughput policy sees
+        self.last_compiled = False
 
     # ----------------------------------------------------------------- state
 
@@ -190,7 +194,8 @@ class SyncDPEngine:
                 f"data-axis size {self.n_lanes}")
         key = (tuple(lead.shape[:2]),
                jax.tree_util.tree_structure(batch))
-        if key not in self._cache:
+        self.last_compiled = key not in self._cache
+        if self.last_compiled:
             batch_sh = jax.tree_util.tree_map(
                 lambda _: NamedSharding(self.mesh, P(None, DATA_AXIS)),
                 batch)

@@ -122,6 +122,14 @@ class TrainJob:
         # from this job's OWN checkpoint (resume_from == job_id), where
         # completed epochs are restored from the manifest and skipped
         self._start_epoch = 0
+        # compile-aware policy timing (elastic parallelism): EMA of a
+        # steady (non-compiling) round's dispatch time, and the current
+        # epoch's estimated compile overhead — subtracted from the
+        # duration reported to the throughput policy so the 1.05/1.2
+        # rules act on steady-state throughput, never on XLA compiles
+        self._steady_round_ema: Optional[float] = None
+        self._compile_overhead_s = 0.0
+        self._elastic = False
 
     # ------------------------------------------------------------------ api
 
@@ -198,7 +206,13 @@ class TrainJob:
                 used_parallelism = parallelism
                 train_loss = self._train_epoch(parallelism, epoch)
                 elapsed = time.time() - t0
-                self.task.elapsed_time_s = elapsed
+                # the policy sees STEADY-STATE duration: compile time
+                # (one-time per program, persistently cached) is not
+                # throughput signal — policy.go:50-94 assumed epoch
+                # time ~= steady state because Fission functions never
+                # compile; on TPU that assumption must be engineered
+                self.task.elapsed_time_s = max(
+                    0.0, elapsed - self._compile_overhead_s)
                 self.task.parallelism = parallelism
 
                 # dynamic parallelism: ask the scheduler between epochs
@@ -459,10 +473,43 @@ class TrainJob:
                       else ("gspmd" if n_model > 1 else "-"))
 
         self._reduce_losses = _make_loss_reducer(self.mesh)
+        # ---- recompile-free elastic parallelism ----
+        # An elastic job pins the round-tensor shape so a parallelism
+        # change alters mask CONTENTS, not array shapes: W is fixed at
+        # the lane-padded cap (or grows monotonically when uncapped),
+        # and S high-waters from the first epoch's plan. One round
+        # program per job lifetime instead of one per N — the 20-200 s
+        # per-±1 XLA recompiles that dominated the round-4 autoscale
+        # trajectories (results/*-autoscale-v5e.jsonl) never happen.
+        # The persistent compile cache covers what shape pinning can't
+        # (cross-process restarts, the one residual reshape of a
+        # below-start down-step).
+        from kubeml_tpu.utils.env import enable_compile_cache
+        enable_compile_cache()
+        self._elastic = not opts.static_parallelism
+        self._eval_parallelism = 0
+        w_floor = 0
+        if self._elastic:
+            D = data_axis_size(self.mesh)
+            n0 = max(1, int(self.task.parallelism
+                            or opts.default_parallelism))
+            target = opts.max_parallelism if opts.max_parallelism > 0 \
+                else n0
+            padded = ((max(target, n0) + D - 1) // D) * D
+            # eval always pins (the test split spreads over all W
+            # workers — no masked compute, one program for the job);
+            # TRAIN pins W only for K-step rounds: sparse averaging
+            # (k=-1) compiles per-N regardless (S is the whole shard,
+            # ~1/N), so a pinned W there would buy zero compile
+            # reduction while paying cap/N x masked compute forever
+            self._eval_parallelism = padded
+            if opts.k != -1:
+                w_floor = padded
         self._loader = RoundLoader(handle, self.dataset,
                                    n_lanes=data_axis_size(self.mesh),
                                    seed=self.seed,
-                                   shuffle=opts.shuffle)
+                                   shuffle=opts.shuffle,
+                                   w_floor=w_floor)
         # the K-avg engine always exists: it runs kavg training AND the
         # eval rounds for both engines (weighted-metrics fan-out)
         self._engine = KAvgEngine(
@@ -654,6 +701,39 @@ class TrainJob:
                     f"round {rb.round_index}: no workers contributed")
             yield rb
 
+    def _note_round_times(self, round_times) -> None:
+        """Derive this epoch's compile overhead from per-round dispatch
+        times + compiled flags (RoundStats.compiled). XLA compiles run
+        synchronously inside the dispatch call, so a compiling round's
+        dispatch time ~= compile time; steady dispatches are ms. The
+        overhead — compiling dispatches minus what a steady dispatch
+        would have cost — is subtracted from the epoch duration the
+        throughput policy sees (train() below). When every round of an
+        epoch compiled (1-round epochs are common on small datasets)
+        the steady estimate carries over from earlier epochs via an
+        EMA, which is sound because shape pinning makes every round of
+        an elastic job the SAME program with the same per-round cost."""
+        steady = [dt for dt, c in round_times if not c]
+        spikes = [dt for dt, c in round_times if c]
+        est = float(np.mean(steady)) if steady else self._steady_round_ema
+        if spikes:
+            # with no steady sample anywhere yet (the job's very first
+            # dispatch), treat a steady dispatch as ~0: async dispatch
+            # is milliseconds, so a compiling round's dispatch time IS
+            # compile time to first order. This matters because the
+            # policy's prev==0.0 branch (policy.py:51-54) records the
+            # FIRST post-epoch elapsed as its throughput reference —
+            # left raw, a compile-inflated epoch 1 would hand every
+            # later epoch a trivial <= 1.05x pass and a spurious +1.
+            self._compile_overhead_s = max(
+                0.0, sum(spikes) - (est or 0.0) * len(spikes))
+        else:
+            self._compile_overhead_s = 0.0
+        if steady:
+            m = float(np.mean(steady))
+            self._steady_round_ema = m if self._steady_round_ema is None \
+                else 0.5 * self._steady_round_ema + 0.5 * m
+
     def _train_epoch(self, parallelism: int, epoch: int) -> float:
         if self._sync_engine is not None:
             return self._train_epoch_syncdp(parallelism, epoch)
@@ -669,13 +749,16 @@ class TrainJob:
         # which fully determines the device contributor count.
         dev_losses = []
         step_counts = np.zeros(0)
+        round_times = []  # (dispatch seconds, compiled?) per round
         # depth=1: the staging transform makes queued rounds
         # device-resident, so keep at most ~3 rounds of HBM in flight
         for rb in self._epoch_round_iter(plan, epoch, self._stage_batch):
             with self.tracer.span("dispatch"):
+                t_r = time.time()
                 self.variables, stats = self._engine.train_round(
                     self.variables, rb.batch, rb.sample_mask, rb.step_mask,
                     rb.worker_mask, rb.rngs, lr=self.req.lr, epoch=epoch)
+                round_times.append((time.time() - t_r, stats.compiled))
             if step_counts.size == 0:
                 step_counts = np.zeros(len(stats.step_count))
             # count only merged workers' steps: a masked-out worker (lost
@@ -683,6 +766,7 @@ class TrainJob:
             # reference's average-over-responders (util.go:82-98)
             step_counts += stats.step_count * rb.worker_mask
             dev_losses.append(stats.loss_sum_device)
+        self._note_round_times(round_times)
         with self.tracer.span("device_drain"):
             loss_sums = np.asarray(self._reduce_losses(dev_losses)) \
                 if dev_losses else np.zeros(0)
@@ -707,6 +791,7 @@ class TrainJob:
                                  self.req.batch_size)
         dev_losses = []
         real_steps = 0
+        round_times = []
         for rb in self._epoch_round_iter(plan, epoch,
                                          self._stage_batch_sync):
             smask = (rb.sample_mask * rb.step_mask[:, :, None]
@@ -716,11 +801,15 @@ class TrainJob:
                 self._sync_state = self._sync_engine.init_state(
                     self.variables)
             with self.tracer.span("dispatch"):
+                t_r = time.time()
                 self._sync_state, losses = self._sync_engine.train_steps(
                     self._sync_state, rb.batch, smask_global,
                     rb.rngs[0], lr=self.req.lr, epoch=epoch)
+                round_times.append((time.time() - t_r,
+                                    self._sync_engine.last_compiled))
             real_steps += int((smask_global.sum(axis=1) > 0).sum())
             dev_losses.append(losses)
+        self._note_round_times(round_times)
         with self.tracer.span("device_drain"):
             loss_sums = np.asarray(self._reduce_losses(dev_losses)) \
                 if dev_losses else np.zeros(0)
@@ -736,6 +825,14 @@ class TrainJob:
     def _validate(self, parallelism: int):
         if self._handle.test_samples == 0:
             return float("nan"), float("nan")
+        if self._elastic:
+            # evaluate at the PINNED worker count, not the current N:
+            # datapoint-weighted aggregation (sum of per-example metrics
+            # / n — util.go:100-122) is invariant to how the test split
+            # is partitioned, so this changes no result, and it keeps
+            # validation on ONE compiled program across every
+            # parallelism the policy visits
+            parallelism = max(parallelism, self._eval_parallelism)
         batch, sample_mask = self._loader.eval_batches(
             parallelism, self.req.batch_size)
         out = self._engine.eval_round(self.variables, batch, sample_mask)

@@ -689,3 +689,99 @@ def test_max_parallelism_caps_scheduler_growth(setup):
     with pytest.raises(KubeMLException) as ei:
         TrainJob(bad, model, ToyDataset(), mesh, registry=reg).train()
     assert ei.value.status_code == 400
+
+
+def test_elastic_shape_pinning_single_program(setup):
+    """Recompile-free elastic N: with a max_parallelism cap, every ±1
+    the policy takes reuses ONE compiled round program (W pinned at the
+    lane-padded cap, N expressed through the worker mask) and ONE eval
+    program — the fix for the 20-200 s per-±1 recompiles that dominated
+    the round-4 autoscale trajectories."""
+    from kubeml_tpu.parallel.mesh import make_mesh
+    reg, store, model, _ = setup
+    # a 1-lane mesh so lane padding can't mask the effect: without the
+    # pin, W would track N exactly and every ±1 would be a new program
+    mesh1 = make_mesh(n_data=1, devices=jax.devices()[:1])
+    schedule = iter([3, 4, 3, 2, 4])
+    task = make_task(job_id="elastic1", epochs=6, static=False)
+    task.parameters.options.max_parallelism = 4
+    job = TrainJob(task, model, ToyDataset(), mesh1, registry=reg,
+                   history_store=store,
+                   callbacks=JobCallbacks(
+                       request_parallelism=lambda t: next(schedule, None)))
+    record = job.train()
+    assert record.data.parallelism == [2, 3, 4, 3, 2, 4]
+    # one train program, one eval program — across FIVE parallelism moves
+    assert len(job._engine._train_cache) == 1
+    assert len(job._engine._eval_cache) == 1
+    # pinned W is the lane-padded cap; training still converges
+    assert job._loader.w_floor == 4
+    assert record.data.accuracy[-1] > 60.0
+
+
+def test_elastic_uncapped_grow_only_shapes(setup):
+    """Without a cap, W is a grow-only high-water mark: scale-downs
+    never reshape (no recompile), only crossing a new maximum does."""
+    from kubeml_tpu.parallel.mesh import make_mesh
+    reg, store, model, _ = setup
+    mesh1 = make_mesh(n_data=1, devices=jax.devices()[:1])
+    schedule = iter([4, 2, 4, 3])
+    task = make_task(job_id="elastic2", epochs=5, static=False)
+    job = TrainJob(task, model, ToyDataset(), mesh1, registry=reg,
+                   callbacks=JobCallbacks(
+                       request_parallelism=lambda t: next(schedule, None)))
+    record = job.train()
+    assert record.data.parallelism == [2, 4, 2, 4, 3]
+    # two shapes ever: W=2 (start) and W=4 (first growth); the 4->2->4
+    # moves reuse the W=4 program
+    assert len(job._engine._train_cache) == 2
+    assert job._loader.w_floor == 4
+
+
+def test_policy_elapsed_excludes_compile(setup):
+    """The duration reported to the throughput policy subtracts compile
+    spikes (RoundStats.compiled), falling back to the cross-epoch EMA
+    when every round of an epoch compiled (1-round epochs)."""
+    reg, store, model, mesh = setup
+    job = TrainJob(make_task(), model, ToyDataset(), mesh, registry=reg)
+    # epoch 1: no steady sample yet — a steady dispatch is ~0 (async
+    # dispatch is ms), so the whole spike counts as compile; otherwise
+    # the policy's prev==0.0 branch would record a compile-inflated
+    # reference time and grant every later epoch a spurious +1
+    job._note_round_times([(5.0, True)])
+    assert job._compile_overhead_s == 5.0
+    # steady rounds establish the EMA
+    job._note_round_times([(0.02, False), (0.04, False)])
+    assert job._compile_overhead_s == 0.0
+    assert abs(job._steady_round_ema - 0.03) < 1e-9
+    # mixed epoch: spike minus the would-have-been steady cost
+    job._note_round_times([(4.0, True), (0.03, False)])
+    assert abs(job._compile_overhead_s - (4.0 - 0.03)) < 1e-6
+    # all-compiled epoch: the EMA stands in for the steady estimate
+    job._note_round_times([(2.0, True)])
+    assert abs(job._compile_overhead_s - (2.0 - job._steady_round_ema)) \
+        < 1e-6
+
+
+def test_loader_shape_floors(setup):
+    """RoundLoader w_floor/s_floor semantics: pinned W, grow-only
+    high-water, and S tracking N for sparse averaging (k=-1)."""
+    from kubeml_tpu.data.loader import RoundLoader
+    reg, store, model, mesh = setup
+    handle = reg.get("blobs")
+    ld = RoundLoader(handle, ToyDataset(), n_lanes=1, w_floor=8)
+    rb = next(iter(ld.epoch_rounds(ld.plan(2, 2, 32), epoch=0)))
+    assert rb.batch["x"].shape[0] == 8          # W pinned at the floor
+    assert rb.worker_mask.sum() == 2            # N through the mask only
+    s_at_k2 = rb.batch["x"].shape[1]
+    # a later smaller plan keeps the shape (grow-only)
+    rb2 = next(iter(ld.epoch_rounds(ld.plan(1, 2, 32), epoch=1)))
+    assert rb2.batch["x"].shape[:2] == (8, s_at_k2)
+    # sparse averaging: S tracks N (no high-water) so the pinned shape
+    # never pays whole-shard masked compute at the cap
+    ld2 = RoundLoader(handle, ToyDataset(), n_lanes=1, w_floor=4)
+    s1 = next(iter(ld2.epoch_rounds(ld2.plan(1, -1, 32),
+                                    epoch=0))).batch["x"].shape[1]
+    s4 = next(iter(ld2.epoch_rounds(ld2.plan(4, -1, 32),
+                                    epoch=1))).batch["x"].shape[1]
+    assert s4 < s1
