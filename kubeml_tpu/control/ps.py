@@ -470,13 +470,21 @@ class ParameterServer(JsonService):
         # observes either the dead incarnation or the respawn claim,
         # never a half-restarted record.
         opts = rec.task.parameters.options
+        # probe the checkpoint BEFORE taking the jobs lock: the probe is
+        # filesystem IO (manifest open + parse) and every control-plane
+        # handler contends on this lock — a slow/hung filesystem must
+        # not stall /start, /finish, /update and metrics for all jobs.
+        # The probe result can only go stale in the benign direction (a
+        # checkpoint appearing between probe and claim), and the cheap
+        # in-memory conditions are re-evaluated under the lock.
+        has_checkpoint = checkpoint_saved_at(job_id) is not None
         with self._jobs_lock:
             if self.jobs.get(job_id) is not rec:
                 return  # already deregistered via /finish
             eligible = (not self._stopping
                         and rec.task.state != "stopping"
                         and rec.restarts < opts.max_restarts
-                        and checkpoint_saved_at(job_id) is not None)
+                        and has_checkpoint)
             if eligible:
                 rec.restarts += 1
                 rec.proc = None
@@ -640,6 +648,12 @@ class ParameterServer(JsonService):
         for rec in recs:
             if rec.proc is not None and rec.proc.poll() is None:
                 rec.proc.terminate()
+            elif rec.job is not None:
+                # threaded-mode jobs must stop too: the record is gone
+                # from the index, so without the signal the in-process
+                # training thread would keep dispatching rounds (and
+                # writing checkpoints) against a stopped PS
+                rec.job.stop()
         for rec in recs:
             if rec.proc is not None:
                 try:
@@ -647,6 +661,11 @@ class ParameterServer(JsonService):
                 except subprocess.TimeoutExpired:
                     rec.proc.kill()
                     rec.proc.wait()
+            elif rec.thread is not None and rec.thread.is_alive():
+                # bounded: the stop event is checked per-epoch, so a
+                # long epoch may outlive this join — daemon threads
+                # can't block interpreter exit either way
+                rec.thread.join(10.0)
             self._release_partition(rec)
 
     def wait_for_job(self, job_id: str, timeout: Optional[float] = None

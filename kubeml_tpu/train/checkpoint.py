@@ -108,14 +108,35 @@ def _resolve_dir(job_id: str, root: Optional[str]) -> str:
 
 def load_checkpoint(job_id: str, root: Optional[str] = None
                     ) -> Tuple[PyTree, dict]:
-    d = _resolve_dir(job_id, root)
-    if not os.path.isfile(os.path.join(d, "manifest.json")):
-        raise JobNotFoundError(job_id)
-    with open(os.path.join(d, "manifest.json")) as f:
-        manifest = json.load(f)
-    with np.load(os.path.join(d, "weights.npz")) as z:
-        variables = _unflatten({k: z[k] for k in z.files})
-    return variables, manifest
+    # one retry on read failure: a cross-process reader that resolved
+    # the .old fallback just before the writer's final rmtree(old) can
+    # catch a half-deleted directory — after the publish completes, the
+    # current dir is valid again, so a single re-resolve recovers. A
+    # checkpoint that is missing EVERYWHERE raises immediately (no
+    # retry tax on the common not-found path).
+    for attempt in (0, 1):
+        d = _resolve_dir(job_id, root)
+        if not os.path.isfile(os.path.join(d, "manifest.json")):
+            if attempt:
+                raise JobNotFoundError(job_id)
+            # _resolve_dir's choice may have been deleted between the
+            # resolve and this check (the same mid-publish race as
+            # below) — re-resolve once before declaring not-found
+            time.sleep(0.05)
+            continue
+        try:
+            with open(os.path.join(d, "manifest.json")) as f:
+                manifest = json.load(f)
+            with np.load(os.path.join(d, "weights.npz")) as z:
+                variables = _unflatten({k: z[k] for k in z.files})
+            return variables, manifest
+        except (OSError, ValueError) as e:
+            if attempt:
+                raise
+            logger.warning(
+                "checkpoint read for %s raced a publish (%s); retrying",
+                job_id, e)
+            time.sleep(0.05)
 
 
 class AsyncCheckpointer:
@@ -252,13 +273,28 @@ def checkpoint_saved_at(job_id: str, root: Optional[str] = None
 
     The cheap freshness probe for caches: save_checkpoint writes a
     monotonically newer time.time() into every manifest, so comparing
-    saved_at is immune to filesystem mtime granularity."""
-    d = _resolve_dir(job_id, root)
-    try:
-        with open(os.path.join(d, "manifest.json")) as f:
-            return json.load(f).get("saved_at")
-    except (OSError, ValueError):
-        return None
+    saved_at is immune to filesystem mtime granularity.
+
+    Reads retry once on failure (same publish race as load_checkpoint):
+    a transient half-deleted .old must not make the crash watchdog
+    spuriously deem a job checkpoint-less — and therefore restart-
+    ineligible — at the exact moment a valid checkpoint exists."""
+    base = os.path.join(root or _models_root(), job_id)
+    for attempt in (0, 1):
+        d = _resolve_dir(job_id, root)
+        try:
+            with open(os.path.join(d, "manifest.json")) as f:
+                return json.load(f).get("saved_at")
+        except (OSError, ValueError):
+            if attempt:
+                return None
+            # missing EVERYWHERE (checked against the primary and .old
+            # paths, not the possibly-stale resolved one) is the common
+            # no-checkpoint answer — no retry tax; anything else could
+            # be the mid-publish race, so re-resolve once
+            if not os.path.isdir(base) and not os.path.isdir(base + ".old"):
+                return None
+            time.sleep(0.05)
 
 
 def delete_checkpoint(job_id: str, root: Optional[str] = None) -> None:

@@ -186,9 +186,16 @@ def main(argv=None):
     # a wedged child (backend init, collective, IO) is otherwise a
     # silent readiness-timeout for the PS: dump every thread's stack to
     # stderr periodically so the parent's captured output shows WHERE
-    # (same discipline as the distributed test workers)
+    # (same discipline as the distributed test workers). The period is
+    # tied to the start window so a healthy-but-slow start (heavy host
+    # load can push JAX init to minutes) produces at most ~one dump
+    # before either the task arrives or the PS gives up — not a
+    # traceback flood every two minutes
     import faulthandler
-    faulthandler.dump_traceback_later(120, repeat=True)
+    start_window = float(os.environ.get("KUBEML_JOB_START_TIMEOUT",
+                                        120.0)) + 180.0
+    faulthandler.dump_traceback_later(max(60.0, start_window / 2),
+                                      repeat=True)
     if args.virtual_cpu_devices:
         from kubeml_tpu.parallel.distributed import _cluster_env_present
         if _cluster_env_present():
@@ -226,8 +233,7 @@ def main(argv=None):
     # observed exactly that when a PS teardown raced a crash-restart's
     # /start push. Once training starts, the wait is unbounded (the job
     # itself decides when it is finished).
-    start_timeout = float(os.environ.get("KUBEML_JOB_START_TIMEOUT",
-                                         120.0)) + 180.0
+    start_timeout = start_window  # parsed once, above
     while not server.finished.wait(timeout=30.0):
         if server._job is not None:
             if start_timeout is not None:
