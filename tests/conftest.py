@@ -153,7 +153,7 @@ SLOW_TESTS = {
     "test_full_job_matches_single_process",
     "test_role_deployment.py::test_split_role_processes_train",
     "test_distributed_multiprocess.py::"
-    "test_job_survives_rank_death_via_checkpoint_restart",
+    "test_job_survives_rank_death_via_supervisor_restart",
     "test_standalone_jobs.py::test_standalone_stop",
     "test_standalone_jobs.py::test_standalone_train_updates_and_infer",
     "test_standalone_jobs.py::test_dual_standalone_jobs_with_partitions",

@@ -42,6 +42,7 @@ Examples:
 from __future__ import annotations
 
 import argparse
+import json
 import os
 import signal
 import socket
@@ -60,6 +61,22 @@ def _stream(proc: subprocess.Popen, rank: int) -> None:
     for line in proc.stdout:
         sys.stdout.write(f"[p{rank}] {line.decode(errors='replace')}")
         sys.stdout.flush()
+
+
+def _checkpoint_durable(root: str, job_id: str) -> bool:
+    """JAX-free mirror of train/checkpoint._resolve_dir + saved_at: does
+    `root` hold a complete checkpoint for `job_id` (current or the
+    mid-publish .old fallback)? The supervisor must not import jax — on
+    a TPU host the chips belong to the worker processes."""
+    base = os.path.join(root, job_id)
+    for d in (base, base + ".old"):
+        try:
+            with open(os.path.join(d, "manifest.json")) as f:
+                if json.load(f).get("saved_at") is not None:
+                    return True
+        except (OSError, ValueError):
+            continue
+    return False
 
 
 def main(argv=None) -> int:
@@ -82,9 +99,32 @@ def main(argv=None) -> int:
                         "survivors blocked in a collective indefinitely; "
                         "the supervisor, not a collective timeout, should "
                         "tear the cluster down so recovery can restart it)")
+    p.add_argument("--max-restarts", type=int, default=0, metavar="R",
+                   help="SUPERVISOR mode (with --fail-fast): after a "
+                        "nonzero teardown, relaunch the whole cluster up "
+                        "to R times with KUBEML_RESTART_COUNT incremented "
+                        "— the worker contract for resuming its job from "
+                        "its own checkpoint (resume_from = job id), the "
+                        "distributed counterpart of the PS watchdog's "
+                        "checkpoint restart (control/ps.py). Eligibility "
+                        "mirrors the watchdog: budget not exhausted, not "
+                        "interrupted, and (when --restart-job is given) a "
+                        "durable checkpoint on every --checkpoint-root")
+    p.add_argument("--restart-job", default=None, metavar="JOB_ID",
+                   help="job id whose durable checkpoint gates a restart")
+    p.add_argument("--checkpoint-root", action="append", default=[],
+                   metavar="DIR",
+                   help="models dir(s) probed for --restart-job's "
+                        "checkpoint (repeatable: one per rank home); a "
+                        "restart needs ALL of them — SPMD ranks "
+                        "checkpoint in lockstep, so a missing one means "
+                        "the crash predates durable state")
     p.add_argument("command", nargs=argparse.REMAINDER,
                    help="command to run (prefix with --)")
     args = p.parse_args(argv)
+    if args.max_restarts and not args.fail_fast:
+        p.error("--max-restarts requires --fail-fast (without teardown "
+                "a wounded cluster never returns control to restart)")
 
     cmd = args.command
     if cmd and cmd[0] == "--":
@@ -92,18 +132,15 @@ def main(argv=None) -> int:
     if not cmd:
         p.error("no command given (append: -- python your_script.py ...)")
 
-    coordinator = args.coordinator
-    if coordinator is None:
-        if args.emulate_cpu <= 0:
-            p.error("--coordinator is required outside --emulate-cpu mode")
-        coordinator = f"localhost:{_free_port()}"
+    auto_coordinator = args.coordinator is None
+    if auto_coordinator and args.emulate_cpu <= 0:
+        p.error("--coordinator is required outside --emulate-cpu mode")
 
     base_env = dict(os.environ,
-                    KUBEML_COORDINATOR_ADDRESS=coordinator,
                     KUBEML_NUM_PROCESSES=str(args.processes))
 
     if args.emulate_cpu > 0:
-        ranks = range(args.processes)
+        ranks = list(range(args.processes))
         # the one shared recipe for CPU-targeting a child before its
         # sitecustomize can grab the accelerator (JAX-free import)
         from kubeml_tpu.testing import virtual_cpu_env
@@ -113,46 +150,83 @@ def main(argv=None) -> int:
             p.error("--process-id is required in real multi-host mode")
         ranks = [args.process_id]
 
-    procs = []
-    threads = []
-    for rank in ranks:
-        env = dict(base_env, KUBEML_PROCESS_ID=str(rank))
-        proc = subprocess.Popen(cmd, env=env, stdout=subprocess.PIPE,
-                                stderr=subprocess.STDOUT)
-        t = threading.Thread(target=_stream, args=(proc, rank), daemon=True)
-        t.start()
-        procs.append(proc)
-        threads.append(t)
-
     import time as _time
-    rc = 0
-    try:
-        if args.fail_fast:
-            live = list(procs)
-            while live:
-                for proc in list(live):
-                    code = proc.poll()
-                    if code is None:
-                        continue
-                    live.remove(proc)
-                    if code and not rc:
-                        # report the FIRST casualty's code, not the -9s
-                        # of the survivors this teardown is about to kill
-                        rc = code
-                        for other in live:
-                            other.kill()
-                _time.sleep(0.1)
-        else:
+    interrupted = False
+
+    def run_once(attempt: int) -> int:
+        """One cluster incarnation: spawn every rank, wait (or poll with
+        fail-fast teardown), return the first casualty's exit code."""
+        nonlocal interrupted
+        # a fresh coordinator port per incarnation: the dead
+        # coordinator's socket can linger in TIME_WAIT and fail the
+        # restart's bind (auto-assigned / emulation mode only — an
+        # explicit --coordinator is the operator's to manage)
+        coordinator = (f"localhost:{_free_port()}" if auto_coordinator
+                       else args.coordinator)
+        env0 = dict(base_env, KUBEML_COORDINATOR_ADDRESS=coordinator,
+                    KUBEML_RESTART_COUNT=str(attempt))
+        procs, threads = [], []
+        for rank in ranks:
+            env = dict(env0, KUBEML_PROCESS_ID=str(rank))
+            proc = subprocess.Popen(cmd, env=env, stdout=subprocess.PIPE,
+                                    stderr=subprocess.STDOUT)
+            t = threading.Thread(target=_stream, args=(proc, rank),
+                                 daemon=True)
+            t.start()
+            procs.append(proc)
+            threads.append(t)
+
+        rc = 0
+        try:
+            if args.fail_fast:
+                live = list(procs)
+                while live:
+                    for proc in list(live):
+                        code = proc.poll()
+                        if code is None:
+                            continue
+                        live.remove(proc)
+                        if code and not rc:
+                            # report the FIRST casualty's code, not the
+                            # -9s of the survivors this teardown is
+                            # about to kill
+                            rc = code
+                            for other in live:
+                                other.kill()
+                    _time.sleep(0.1)
+            else:
+                for proc in procs:
+                    rc = proc.wait() or rc
+        except KeyboardInterrupt:
+            # the watchdog's "acknowledged stop" rule: an operator
+            # interrupt must never be undone by a supervisor restart
+            interrupted = True
+            for proc in procs:
+                proc.send_signal(signal.SIGINT)
             for proc in procs:
                 rc = proc.wait() or rc
-    except KeyboardInterrupt:
-        for proc in procs:
-            proc.send_signal(signal.SIGINT)
-        for proc in procs:
-            rc = proc.wait() or rc
-    for t in threads:
-        t.join(timeout=5)
-    return rc
+        for t in threads:
+            t.join(timeout=5)
+        return rc
+
+    attempt = 0
+    while True:
+        rc = run_once(attempt)
+        if rc == 0 or interrupted or attempt >= args.max_restarts:
+            return rc
+        if args.restart_job and args.checkpoint_root and not all(
+                _checkpoint_durable(root, args.restart_job)
+                for root in args.checkpoint_root):
+            sys.stderr.write(
+                f"supervisor: rank failed (rc={rc}) but job "
+                f"{args.restart_job} has no durable checkpoint on every "
+                "rank — nothing to resume, giving up\n")
+            return rc
+        attempt += 1
+        sys.stderr.write(
+            f"supervisor: cluster died (rc={rc}); relaunching with "
+            f"KUBEML_RESTART_COUNT={attempt} "
+            f"(restart {attempt}/{args.max_restarts})\n")
 
 
 if __name__ == "__main__":
