@@ -50,11 +50,20 @@ class TrainOptions:
     # devices; data-axis size = devices / (n_model * n_seq).
     n_model: int = 1
     n_seq: int = 1
-    # net-new: expert parallelism for MoE functions — experts shard over
-    # the mesh expert axis inside the fully-manual round
-    # (parallel/manual.py ep_partial_ffn). Requires n_seq > 1 (the
-    # manual round is the SP round; GSPMD ep_mesh covers EP-only).
+    # net-new: expert parallelism for MoE functions. Inside a manual
+    # round (with n_seq > 1 or n_stage > 1) experts shard over the mesh
+    # expert axis via parallel/manual.py ep_partial_ffn; standalone
+    # (plain DP x EP) the GSPMD ep_mesh path shards them with XLA-
+    # inserted token all-to-alls (parallel/ep.moe_apply).
     n_expert: int = 1
+    # net-new: GPipe pipeline parallelism — the decoder trunk splits
+    # into n_stage groups of consecutive layers over the mesh stage
+    # axis, microbatches ppermuting along the ICI ring (parallel/pp.py
+    # pipeline_lane inside the fully-manual round). GPT family only.
+    n_stage: int = 1
+    # microbatch count for the pipeline (0 = auto: 2 * n_stage); must
+    # divide the per-worker batch size
+    pp_microbatches: int = 0
     seq_impl: str = "ring"         # 'ring' | 'ulysses'
     # TP execution strategy: 'gspmd' (NamedSharding placement, XLA
     # inserts the collectives — parallel/tp.py) or 'manual' (explicit
@@ -90,6 +99,8 @@ class TrainOptions:
             "n_model": self.n_model,
             "n_seq": self.n_seq,
             "n_expert": self.n_expert,
+            "n_stage": self.n_stage,
+            "pp_microbatches": self.pp_microbatches,
             "seq_impl": self.seq_impl,
             "tp_impl": self.tp_impl,
             "max_parallelism": self.max_parallelism,
@@ -110,6 +121,8 @@ class TrainOptions:
             n_model=int(d.get("n_model", 1)),
             n_seq=int(d.get("n_seq", 1)),
             n_expert=int(d.get("n_expert", 1)),
+            n_stage=int(d.get("n_stage", 1)),
+            pp_microbatches=int(d.get("pp_microbatches", 0)),
             seq_impl=d.get("seq_impl", "ring"),
             tp_impl=d.get("tp_impl", "gspmd"),
             max_parallelism=int(d.get("max_parallelism", 0)),

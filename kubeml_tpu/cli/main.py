@@ -51,9 +51,14 @@ def cmd_train(args):
     if args.epochs <= 0:
         _fail("epochs must be positive")
     if args.tensor_parallel < 1 or args.seq_parallel < 1 \
-            or args.expert_parallel < 1:
-        _fail("--tensor-parallel/--seq-parallel/--expert-parallel "
-              "must be >= 1")
+            or args.expert_parallel < 1 or args.pipeline_parallel < 1:
+        _fail("--tensor-parallel/--seq-parallel/--expert-parallel/"
+              "--pipeline-parallel must be >= 1")
+    if args.pp_microbatches < 0:
+        _fail("--pp-microbatches must be >= 0")
+    if args.pipeline_parallel > 1 and \
+            (args.tensor_parallel > 1 or args.seq_parallel > 1):
+        _fail("--pipeline-parallel composes with --expert-parallel only")
     if args.max_parallelism < 0:
         _fail("--max-parallelism must be >= 0")
     if args.max_restarts < 0:
@@ -88,6 +93,8 @@ def cmd_train(args):
             n_model=args.tensor_parallel,
             n_seq=args.seq_parallel,
             n_expert=args.expert_parallel,
+            n_stage=args.pipeline_parallel,
+            pp_microbatches=args.pp_microbatches,
             seq_impl=args.seq_impl,
             tp_impl=args.tp_impl,
             max_parallelism=args.max_parallelism,
@@ -334,8 +341,22 @@ def build_parser() -> argparse.ArgumentParser:
                         "mesh seq axis (transformer families)")
     t.add_argument("--expert-parallel", type=int, default=1, metavar="E",
                    help="shard MoE experts over the mesh expert axis "
-                        "inside the manual round (MoE families; "
-                        "requires --seq-parallel > 1)")
+                        "(MoE families): alone via GSPMD token "
+                        "all-to-alls; with --seq-parallel or "
+                        "--pipeline-parallel via the manual expert "
+                        "path inside the same round")
+    t.add_argument("--pipeline-parallel", type=int, default=1,
+                   metavar="P",
+                   help="GPipe pipeline parallelism over the mesh "
+                        "stage axis: the decoder trunk splits into P "
+                        "groups of consecutive layers, microbatches "
+                        "ppermuting along ICI (GPT family; composes "
+                        "with --expert-parallel)")
+    t.add_argument("--pp-microbatches", type=int, default=0, metavar="M",
+                   help="pipeline microbatch count (default 0 = auto: "
+                        "2 x stages); must divide the batch size — "
+                        "more microbatches shrink the (P-1)/(M+P-1) "
+                        "bubble")
     t.add_argument("--seq-impl", choices=("ring", "ulysses"),
                    default="ring",
                    help="sequence-parallel attention implementation")

@@ -97,6 +97,17 @@ class KubeModel(abc.ABC):
         from kubeml_tpu.parallel.mesh import MODEL_AXIS
         self._module = self.module.clone(tp_axis=MODEL_AXIS)
 
+    def enable_pipeline_parallel(self, n_stage: int,
+                                 microbatches: int = 0) -> None:
+        """Route TRAINING through a GPipe pipeline over the mesh `stage`
+        axis (called by the job when --pipeline-parallel > 1). Served by
+        families with a uniform pipelineable trunk (the GPT family);
+        everything else rejects with a clear message."""
+        raise ValueError(
+            f"function {self.name or type(self).__name__!r} does not "
+            "support pipeline parallelism (requires a uniform "
+            "pipelineable trunk — the GPT family)")
+
     def enable_expert_parallel(self) -> None:
         """Switch the model's module into MANUAL expert-parallel execution
         inside the engine's fully-manual round (called by the job when
@@ -134,6 +145,27 @@ class KubeModel(abc.ABC):
         # lane's partial expert-weight grads, keeping replicated params
         # in lockstep (parallel/manual.py design notes)
         self._module = self.module.clone(ep_axis=EXPERT_AXIS)
+
+    def enable_expert_parallel_gspmd(self, mesh) -> None:
+        """GSPMD expert parallelism for rounds whose inner axes stay
+        AUTO — plain DP x EP, no SP/PP (called by the job when
+        --expert-parallel > 1 without a manual round). The module's
+        ep_mesh sharding constraints lay the expert-major intermediates
+        over the mesh `expert` axis and XLA's SPMD partitioner
+        materializes the token all-to-alls inside each DP lane
+        (parallel/ep.moe_apply); the K-avg weight merge still psums
+        over `data` only."""
+        if not getattr(self.module, "n_experts", 0) or \
+                not hasattr(self.module, "ep_mesh"):
+            raise ValueError(
+                f"function {self.name or type(self).__name__!r} has no "
+                "experts to shard (expert parallelism applies to MoE "
+                "families like gpt-moe-mini)")
+        if getattr(self.module, "ep_axis", None) is not None:
+            raise ValueError(
+                "manual expert parallelism (ep_axis) and GSPMD ep_mesh "
+                "are mutually exclusive")
+        self._module = self.module.clone(ep_mesh=mesh)
 
     @abc.abstractmethod
     def build(self):

@@ -512,13 +512,144 @@ class GPTMini(KubeModel):
         With the module in seq-parallel mode (inside the engine's
         vma-checked round) x is the LOCAL [B, T/n] block and the loss
         reduces over the ring — identical value on every shard, equal to
-        the dense loss."""
+        the dense loss. In pipeline-parallel mode
+        (enable_pipeline_parallel) the decoder trunk runs the GPipe
+        body over the mesh `stage` axis instead."""
         x = batch["x"]
+        if getattr(self, "_pp_microbatches", 0):
+            per_ex, aux = self._pp_forward_loss(variables, x, rng)
+            return per_ex, {}
         logits, new_state = self.apply_train(variables, x, rng)
         if self.module.seq_axis is not None:
             return _lm_per_example_sp(logits, x, self.module.seq_axis), \
                 new_state
         return _lm_per_example(logits, x), new_state
+
+    # --------------------------------------------- pipeline-parallel training
+
+    def enable_pipeline_parallel(self, n_stage: int,
+                                 microbatches: int = 0) -> None:
+        """Route TRAINING through the GPipe pipeline body over the mesh
+        `stage` axis (called by the job for --pipeline-parallel > 1).
+
+        The module stays DENSE: the loss stacks the per-layer params
+        in-trace and each stage dynamic-slices its L/P consecutive
+        layers via `lax.axis_index` — tree paths/shapes identical to
+        the dense model (the manual-TP design, parallel/manual.py), so
+        checkpoints, the K-avg merge, and inference apply unchanged.
+        Runs inside the engine's all-axes-manual vma-checked round; vma
+        backward assembles the stage psums for the replicated stacked
+        params. Composes with expert parallelism (the blocks' ep_axis
+        path — MoE trunks pipeline with per-microbatch routing), not
+        with --seq-parallel/--tensor-parallel."""
+        if self.module.seq_axis is not None or \
+                getattr(self.module, "tp_axis", None) is not None:
+            raise ValueError(
+                "pipeline parallelism composes with expert parallelism "
+                "only (not --seq-parallel/--tensor-parallel)")
+        L = self.module.layers
+        if L % n_stage:
+            raise ValueError(
+                f"{L} layers do not split over a {n_stage}-stage axis")
+        self._pp_microbatches = int(microbatches) or 2 * int(n_stage)
+
+    def _pp_forward_loss(self, variables, x, rng):
+        """Pipelined per-sequence loss: embed/head replicated on every
+        stage (they change activation shape — parallel/pp.py docstring),
+        the L decoder blocks pipelined as `stage`-axis groups of L/P
+        consecutive layers, pad masks and per-microbatch dropout keys
+        riding along as pipeline consts. Equal to the dense loss up to
+        bf16 noise (MoE: per-microbatch routing capacity, the standard
+        pipelined-MoE semantics of forward_pipelined)."""
+        from kubeml_tpu.parallel.manual import axis_slice
+        from kubeml_tpu.parallel.mesh import STAGE_AXIS
+        from kubeml_tpu.parallel.pp import pipeline_lane
+
+        module = self.module
+        params = variables["params"]
+        B, T = x.shape
+        if T > module.max_len:
+            raise InferenceInputError(
+                f"sequence length {T} exceeds max_len {module.max_len}")
+        n_stage = lax.axis_size(STAGE_AXIS)
+        per = module.layers // n_stage
+        M = self._pp_microbatches
+        if B % M:
+            raise ValueError(
+                f"batch {B} not divisible by {M} microbatches")
+        moe = bool(module.n_experts)
+        pad_mask = (x != PAD_ID).astype(jnp.float32)
+        emb = params["tok_embed"]["embedding"].astype(module.dtype)
+        h = emb[x] + params["pos_embed"]["embedding"][
+            jnp.arange(T)].astype(module.dtype)[None]
+        k_embed, k_blocks = jax.random.split(rng)
+        if module.dropout > 0.0:  # the dense path's post-embed dropout
+            keep = jax.random.bernoulli(k_embed, 1.0 - module.dropout,
+                                        h.shape)
+            h = jnp.where(keep, h / (1.0 - module.dropout), 0.0).astype(
+                module.dtype)
+
+        block = DecoderBlock(module.hidden, module.heads, module.ffn,
+                             module.dropout, module.dtype,
+                             n_experts=module.n_experts,
+                             moe_k=module.moe_k,
+                             capacity_factor=module.capacity_factor,
+                             ep_axis=module.ep_axis, ep_impl=module.ep_impl,
+                             attn_impl=module.attn_impl,
+                             flash_interpret=module.flash_interpret)
+
+        def stage_fn(p, act, const):
+            mask, kdata = const  # [B/M, T] pad mask, [2] key data
+            key = jax.random.wrap_key_data(kdata)
+            sid = lax.axis_index(STAGE_AXIS)
+            # vma-matching zero: aux accumulates stage-varying values
+            aux0 = (act.ravel()[0].astype(jnp.float32) * 0.0)
+
+            def body(carry, xs_l):
+                a, aux = carry
+                pj, j = xs_l
+                # dropout key unique per (microbatch, global layer)
+                kj = jax.random.fold_in(key, sid * per + j)
+                if moe:
+                    out, st = block.apply(
+                        {"params": pj}, a, mask, True,
+                        rngs={"dropout": kj}, mutable=["intermediates"])
+                    out = out.astype(a.dtype)
+                    aux = aux + jnp.asarray(
+                        sum(jax.tree_util.tree_leaves(st)), jnp.float32)
+                else:
+                    out = block.apply({"params": pj}, a, mask, True,
+                                      rngs={"dropout": kj})
+                return (out, aux), None
+
+            (act, aux), _ = lax.scan(body, (act, aux0),
+                                     (p, jnp.arange(per)))
+            return (act, aux) if moe else act
+
+        # [L, ...] stacked layer params; this stage slices its group —
+        # replicated full-size params, exactly the manual-TP layout
+        stacked = jax.tree_util.tree_map(
+            lambda *xs: jnp.stack(xs, axis=0),
+            *[params[f"layer_{i}"] for i in range(module.layers)])
+        local = jax.tree_util.tree_map(
+            lambda leaf: axis_slice(leaf, STAGE_AXIS, 0), stacked)
+
+        keys = jax.random.key_data(jax.random.split(k_blocks, M))
+        hm = h.reshape(M, B // M, T, module.hidden)
+        masks = pad_mask.reshape(M, B // M, T)
+        ys, aux = pipeline_lane(stage_fn, local, hm, STAGE_AXIS,
+                                has_aux=moe, consts=(masks, keys),
+                                vma=True)
+        h = ys.reshape(B, T, module.hidden)
+        h = nn.LayerNorm(dtype=jnp.float32).apply(
+            {"params": params["LayerNorm_0"]}, h)
+        logits = (h.astype(module.dtype) @ emb.T).astype(jnp.float32)
+        per_ex = _lm_per_example(logits, x)
+        if moe:
+            # mean per layer per microbatch — the pipelined analog of
+            # the dense loss's sum(sown)/layers (forward_pipelined)
+            per_ex = per_ex + self.aux_coef * aux / (module.layers * M)
+        return per_ex, aux
 
     def metrics(self, variables, batch):
         x = batch["x"]
@@ -934,6 +1065,21 @@ class GPTMoEMini(GPTMini):
         self._require_replicated_experts()
         super().enable_seq_parallel(impl)
 
+    def enable_pipeline_parallel(self, n_stage: int,
+                                 microbatches: int = 0) -> None:
+        # same constraint as SP: GSPMD ep_mesh constraints cannot cross
+        # the manual stage shard_map — PP x EP uses the manual expert
+        # axis (enable_expert_parallel) instead
+        if self.ep_mesh is not None or \
+                getattr(self.module, "ep_mesh", None) is not None:
+            raise ValueError(
+                "pipelined MoE requires replicated or manual-axis "
+                "experts: GSPMD ep_mesh constraints cannot cross the "
+                "manual stage shard_map (construct without ep_mesh; "
+                "combine --pipeline-parallel with --expert-parallel "
+                "for expert sharding)")
+        super().enable_pipeline_parallel(n_stage, microbatches)
+
     def enable_tensor_parallel(self) -> None:
         # the module HAS a tp_axis field (shared DecoderBlock), so the
         # base hasattr check would accept it and fail only at trace
@@ -950,6 +1096,11 @@ class GPTMoEMini(GPTMini):
 
     def loss(self, variables, batch, rng, sample_mask):
         x = batch["x"]
+        if getattr(self, "_pp_microbatches", 0):
+            # pipelined MoE trunk: _pp_forward_loss already folds the
+            # aux_coef-weighted load-balance aux into per_ex
+            per_ex, _ = self._pp_forward_loss(variables, x, rng)
+            return per_ex, {}
         logits, new_state = self.apply_train(
             variables, x, rng, extra_mutable=("intermediates",))
         sown = new_state.pop("intermediates", {})

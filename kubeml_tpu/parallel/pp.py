@@ -56,6 +56,81 @@ def stack_stage_params(params_list: Sequence[PyTree]) -> PyTree:
         lambda *xs: jnp.stack(xs, axis=0), *params_list)
 
 
+def pipeline_lane(stage_fn: StageFn, local_params: PyTree, xs: jax.Array,
+                  axis_name: str = STAGE_AXIS, has_aux: bool = False,
+                  consts: PyTree = None, vma: bool = False):
+    """The per-stage GPipe body, callable INSIDE an existing manual
+    region (an engine's all-axes-manual training round) as well as from
+    `pipeline_apply`'s own shard_map below.
+
+    local_params: THIS stage's parameters (already sliced — via
+        shard_map in_specs, or `manual.axis_slice` over the stacked
+        layer dim when the caller keeps params replicated).
+    xs: [M, B, ...] microbatches, replicated across stages.
+    consts: optional pytree of per-microbatch constants with leading
+        [M] (pad masks, rng key data); stage s at tick t receives the
+        slice for the microbatch it is chewing (t - s, clipped) and
+        stage_fn is called as stage_fn(params, act, const).
+    vma: True inside check_vma=True rounds — the stage-invariant inputs
+        are pcast to varying so the tick scan's carry types line up;
+        the final psums return stage-INVARIANT outputs either way,
+        which is exactly what the vma-checked round requires of a loss.
+
+    Returns (outputs [M, B, ...], aux_sum) — both replicated over the
+    stage axis; aux_sum is 0.0 unless has_aux.
+    """
+    n_stage = lax.axis_size(axis_name)
+    sid = lax.axis_index(axis_name)
+    m = xs.shape[0]
+    if vma:
+        xs = lax.pcast(xs, axis_name, to="varying")
+        if consts is not None:
+            consts = jax.tree_util.tree_map(
+                lambda c: lax.pcast(c, axis_name, to="varying"), consts)
+    perm = [(i, (i + 1) % n_stage) for i in range(n_stage)]
+    # scalar zero derived from xs so its vma matches the varying aux
+    # accumulated into it (a literal 0.0 would be invariant and fail
+    # the scan's carry-type check under check_vma=True)
+    zero = (xs.ravel()[0].astype(jnp.float32) * 0.0)
+
+    def tick(carry, t):
+        act, aux_sum = carry
+        # Stage 0 injects microbatch t (clipped during drain ticks —
+        # those outputs never reach the collected window); others
+        # consume the activation ppermuted in on the previous tick.
+        inp = jnp.where(sid == 0,
+                        lax.dynamic_index_in_dim(
+                            xs, jnp.clip(t, 0, m - 1), keepdims=False),
+                        act)
+        mb = jnp.clip(t - sid, 0, m - 1)  # microbatch this stage chews
+        if consts is not None:
+            const = jax.tree_util.tree_map(
+                lambda c: lax.dynamic_index_in_dim(c, mb, keepdims=False),
+                consts)
+            out = stage_fn(local_params, inp, const)
+        else:
+            out = stage_fn(local_params, inp)
+        if has_aux:
+            out, aux = out
+            # stage s processes microbatch (t - s): real iff it is
+            # in [0, m) — fill/drain ticks chew clipped garbage whose
+            # aux must not pollute the sum
+            real = ((t >= sid) & (t - sid < m)).astype(jnp.float32)
+            aux_sum = aux_sum + aux.astype(jnp.float32) * real
+        nxt = lax.ppermute(out, axis_name, perm)
+        return (nxt, aux_sum), out
+
+    (_, aux_sum), outs = lax.scan(
+        tick, (jnp.zeros_like(xs[0]), zero),
+        jnp.arange(m + n_stage - 1))
+    # Microbatch j finishes on the last stage at tick j + P - 1.
+    ys = outs[n_stage - 1:]
+    # Zero everywhere but the last stage, then psum-broadcast so the
+    # result is replicated across stages.
+    ys = jnp.where(sid == n_stage - 1, ys, jnp.zeros_like(ys))
+    return lax.psum(ys, axis_name), lax.psum(aux_sum, axis_name)
+
+
 def pipeline_apply(stage_fn: StageFn, stage_params: PyTree, x: jax.Array,
                    mesh: Mesh, has_aux: bool = False):
     """Run x through P pipeline stages with microbatch pipelining.
@@ -80,39 +155,8 @@ def pipeline_apply(stage_fn: StageFn, stage_params: PyTree, x: jax.Array,
     def lane(params, xs):
         # params leaves arrive sliced to [1, ...] for this stage.
         params = jax.tree_util.tree_map(lambda p: p[0], params)
-        sid = lax.axis_index(STAGE_AXIS)
-        m = xs.shape[0]
-        perm = [(i, (i + 1) % n_stage) for i in range(n_stage)]
-
-        def tick(carry, t):
-            act, aux_sum = carry
-            # Stage 0 injects microbatch t (clipped during drain ticks —
-            # those outputs never reach the collected window); others
-            # consume the activation ppermuted in on the previous tick.
-            inp = jnp.where(sid == 0,
-                            lax.dynamic_index_in_dim(
-                                xs, jnp.clip(t, 0, m - 1), keepdims=False),
-                            act)
-            out = stage_fn(params, inp)
-            if has_aux:
-                out, aux = out
-                # stage s processes microbatch (t - s): real iff it is
-                # in [0, m) — fill/drain ticks chew clipped garbage whose
-                # aux must not pollute the sum
-                real = ((t >= sid) & (t - sid < m)).astype(jnp.float32)
-                aux_sum = aux_sum + aux.astype(jnp.float32) * real
-            nxt = lax.ppermute(out, STAGE_AXIS, perm)
-            return (nxt, aux_sum), out
-
-        (_, aux_sum), outs = lax.scan(
-            tick, (jnp.zeros_like(xs[0]), jnp.float32(0.0)),
-            jnp.arange(m + n_stage - 1))
-        # Microbatch j finishes on the last stage at tick j + P - 1.
-        ys = outs[n_stage - 1:]
-        # Zero everywhere but the last stage, then psum-broadcast so the
-        # result is replicated (out_spec P() below).
-        ys = jnp.where(sid == n_stage - 1, ys, jnp.zeros_like(ys))
-        return lax.psum(ys, STAGE_AXIS), lax.psum(aux_sum, STAGE_AXIS)
+        return pipeline_lane(stage_fn, params, xs, STAGE_AXIS,
+                             has_aux=has_aux)
 
     sharded = jax.shard_map(
         lane, mesh=mesh,

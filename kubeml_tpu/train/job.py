@@ -379,23 +379,25 @@ class TrainJob:
                 f"unknown training engine {engine_kind!r}; "
                 f"expected 'kavg' or 'syncdp'", 400)
 
-        # ---- inner mesh axes (job-level TP / SP / EP; net-new vs ref)
+        # ---- inner mesh axes (job-level TP / SP / PP / EP; net-new)
         n_model = max(1, int(opts.n_model))
         n_seq = max(1, int(opts.n_seq))
         n_expert = max(1, int(getattr(opts, "n_expert", 1)))
+        n_stage = max(1, int(getattr(opts, "n_stage", 1)))
         self._tp_rules = None
         self._manual_tp = False
-        if n_expert > 1 and n_seq < 2:
+        self._pp = False
+        self._gspmd_ep = False
+        if n_stage > 1 and (n_model > 1 or n_seq > 1):
             raise KubeMLException(
-                "--expert-parallel requires --seq-parallel > 1: manual "
-                "expert sharding runs inside the fully-manual "
-                "sequence-parallel round (EP without SP is served by "
-                "GSPMD ep_mesh at the model level)", 400)
-        if n_model > 1 or n_seq > 1:
+                "--pipeline-parallel composes with --expert-parallel "
+                "only (the pipelined trunk owns the layer split that "
+                "--tensor-parallel/--seq-parallel would reshard)", 400)
+        if n_model > 1 or n_seq > 1 or n_stage > 1 or n_expert > 1:
             if engine_kind != "kavg":
                 raise KubeMLException(
-                    "tensor/sequence parallelism requires the kavg "
-                    "engine", 400)
+                    "tensor/sequence/pipeline/expert parallelism "
+                    "requires the kavg engine", 400)
             tp_impl = getattr(opts, "tp_impl", "gspmd") or "gspmd"
             if tp_impl not in ("gspmd", "manual"):
                 raise KubeMLException(
@@ -414,15 +416,17 @@ class TrainJob:
                         "seq_impl='ring' only (ulysses re-shards the "
                         "head axis the TP split owns)", 400)
             devices = list(self.mesh.devices.flatten())
-            inner = n_model * n_seq * n_expert
+            inner = n_model * n_seq * n_stage * n_expert
             if len(devices) % inner:
                 raise KubeMLException(
                     f"{len(devices)} devices not divisible by the "
-                    f"requested model x seq x expert factor {inner}", 400)
+                    "requested model x seq x stage x expert factor "
+                    f"{inner}", 400)
             from kubeml_tpu.parallel.mesh import make_mesh
             self.mesh = make_mesh(n_data=len(devices) // inner,
                                   n_model=n_model, n_seq=n_seq,
-                                  n_expert=n_expert, devices=devices)
+                                  n_stage=n_stage, n_expert=n_expert,
+                                  devices=devices)
             if n_model > 1 and tp_impl == "manual":
                 try:
                     self.model.enable_tensor_parallel()
@@ -449,12 +453,37 @@ class TrainJob:
                         f"function {self.req.model_type!r} enabled "
                         "sequence parallelism but declares no "
                         "seq_batch_dims", 400)
-            if n_expert > 1:
-                # SP x EP (round 4, the matrix's last exclusion):
-                # experts shard over the mesh expert axis through the
-                # manual expert path inside the same fully-manual round
+            if n_stage > 1:
+                # GPipe through the job (round 5): the loss runs the
+                # pipeline body over the mesh stage axis inside the
+                # fully-manual round (parallel/pp.pipeline_lane)
+                mb = int(getattr(opts, "pp_microbatches", 0))
+                if mb < 0:
+                    raise KubeMLException(
+                        "pp_microbatches must be >= 0", 400)
                 try:
-                    self.model.enable_expert_parallel()
+                    self.model.enable_pipeline_parallel(n_stage, mb)
+                except ValueError as e:
+                    raise KubeMLException(str(e), 400)
+                mb = self.model._pp_microbatches
+                if self.req.batch_size % mb:
+                    raise KubeMLException(
+                        f"batch size {self.req.batch_size} not "
+                        f"divisible by {mb} pipeline microbatches", 400)
+                self._pp = True
+            if n_expert > 1:
+                # three expert-sharding routes by round type:
+                #   SP x EP / PP x EP — the manual expert axis inside
+                #   the fully-manual round (ep_partial_ffn psum);
+                #   plain DP x EP (round 5) — GSPMD ep_mesh, inner
+                #   axes stay Auto and XLA materializes the token
+                #   all-to-alls inside each DP lane
+                try:
+                    if n_seq > 1 or n_stage > 1:
+                        self.model.enable_expert_parallel()
+                    else:
+                        self.model.enable_expert_parallel_gspmd(self.mesh)
+                        self._gspmd_ep = True
                 except ValueError as e:
                     raise KubeMLException(str(e), 400)
                 n_experts = int(getattr(self.model.module,
@@ -465,12 +494,14 @@ class TrainJob:
                     raise KubeMLException(
                         f"{n_experts} experts do not divide over a "
                         f"{n_expert}-way expert axis", 400)
-            self._log("job %s mesh: data=%d model=%d seq=%d expert=%d "
-                      "tp_impl=%s",
+            self._log("job %s mesh: data=%d model=%d seq=%d stage=%d "
+                      "expert=%d tp_impl=%s ep=%s",
                       self.task.job_id, data_axis_size(self.mesh),
-                      n_model, n_seq, n_expert,
+                      n_model, n_seq, n_stage, n_expert,
                       "manual" if self._manual_tp
-                      else ("gspmd" if n_model > 1 else "-"))
+                      else ("gspmd" if n_model > 1 else "-"),
+                      "gspmd" if self._gspmd_ep
+                      else ("manual" if n_expert > 1 else "-"))
 
         self._reduce_losses = _make_loss_reducer(self.mesh)
         # ---- recompile-free elastic parallelism ----
@@ -517,7 +548,7 @@ class TrainJob:
             self.model.configure_optimizers,
             batch_seq_dims=(self.model.seq_batch_dims
                             if n_seq > 1 else None),
-            manual_inner=self._manual_tp)
+            manual_inner=self._manual_tp or self._pp)
         self._sync_engine = None
         self._sync_state = None
         if engine_kind == "syncdp":

@@ -540,17 +540,20 @@ def test_job_seq_and_expert_parallel_moe(tmp_home, mesh8):
     assert record.data.accuracy[-1] == record.data.accuracy[-1]
 
 
-def test_job_expert_parallel_requires_seq(tmp_home, mesh8):
-    """EP without SP is rejected up front with the GSPMD pointer (the
-    manual expert path needs the fully-manual SP round)."""
+def test_job_expert_parallel_alone_rejects_non_moe(tmp_home, mesh8):
+    """Round 5 lifts the EP-requires-SP restriction: --expert-parallel
+    alone now routes to the GSPMD ep_mesh path, so a function without
+    experts gets the model-surface rejection (as a 400), not a
+    requires-seq-parallel error."""
     reg = DatasetRegistry()
     make_blobs(reg)
     task = make_task(job_id="eponly1", epochs=1)
     task.parameters.options.n_expert = 2
     job = TrainJob(task, get_builtin("mlp")(hidden=16, num_classes=4),
                    ToyDataset(), mesh8, registry=reg)
-    with pytest.raises(KubeMLException, match="expert-parallel requires"):
+    with pytest.raises(KubeMLException, match="no experts to shard") as ei:
         job.train()
+    assert ei.value.status_code == 400
 
 
 def test_job_expert_parallel_rejects_non_moe(tmp_home, mesh8):
@@ -785,3 +788,171 @@ def test_loader_shape_floors(setup):
     s4 = next(iter(ld2.epoch_rounds(ld2.plan(4, -1, 32),
                                     epoch=1))).batch["x"].shape[1]
     assert s4 < s1
+
+
+def _lm_registry(name="pplm", n_train=128, n_test=32, T=16, seed=0):
+    """Tiny learnable LM dataset (ascending token runs) + its dataset
+    class, for the pipeline/expert job-surface tests."""
+    class LMDataset(KubeDataset):
+        dataset = name
+
+        def transform_train(self, data, labels):
+            return {"x": data}
+
+        transform_test = transform_train
+
+    reg = DatasetRegistry()
+    rng = np.random.RandomState(seed)
+
+    def split(n):
+        start = rng.randint(1, 63, size=(n, 1))
+        seq = (start + np.arange(T)[None, :] - 1) % 63 + 1
+        return seq.astype(np.int32), np.zeros(n, np.int32)
+
+    if name not in [d.name for d in reg.list()]:
+        reg.create(name, *split(n_train), *split(n_test))
+    return reg, LMDataset()
+
+
+def test_job_pipeline_parallel_matches_dense(tmp_home):
+    """--pipeline-parallel at the job surface (round 5): data=4 x
+    stage=2 trains the GPT trunk through the GPipe body inside the
+    fully-manual round, and the merged history MATCHES the unpipelined
+    job on an equal-lane mesh (same seed, same plan, dropout 0) —
+    GPipe through the TrainJob is semantics-preserving, not just
+    convergent."""
+    import jax as _jax
+
+    from kubeml_tpu.parallel.mesh import (STAGE_AXIS, data_axis_size,
+                                          make_mesh)
+    from tests.test_models_gpt import TinyGPT
+
+    def run(n_stage, job_id):
+        reg, ds = _lm_registry()
+        task = make_task(job_id=job_id, epochs=2, parallelism=2, k=1,
+                         batch=8, lr=3e-3)
+        task.parameters.model_type = "gpt-mini"
+        task.parameters.dataset = "pplm"
+        task.parameters.options.n_stage = n_stage
+        mesh = make_mesh(n_data=4, n_stage=n_stage)
+        job = TrainJob(task, TinyGPT(), ds, mesh, registry=reg)
+        return job, job.train()
+
+    pp_job, pp_rec = run(2, "ppjob1")
+    assert data_axis_size(pp_job.mesh) == 4
+    assert pp_job.mesh.shape[STAGE_AXIS] == 2
+    assert pp_job.model._pp_microbatches == 4  # auto: 2 x stages
+    dense_job, dense_rec = run(1, "ppjob2")
+    # TinyGPT is dropout-0 and the plans/rngs are seed-identical, so
+    # the two jobs differ only by pipelined vs dense trunk execution
+    np.testing.assert_allclose(pp_rec.data.train_loss,
+                               dense_rec.data.train_loss,
+                               rtol=2e-3, atol=2e-3)
+    np.testing.assert_allclose(pp_rec.data.accuracy,
+                               dense_rec.data.accuracy,
+                               rtol=2e-2, atol=0.5)
+    assert pp_rec.data.train_loss[-1] < pp_rec.data.train_loss[0]
+
+
+def test_job_pipeline_parallel_with_experts(tmp_home, mesh8):
+    """PP x EP at the job surface: --pipeline-parallel 2
+    --expert-parallel 2 carves data=2 x stage=2 x expert=2; the MoE
+    trunk pipelines with experts sharded over the expert axis
+    (ep_partial_ffn inside the same manual round)."""
+    from kubeml_tpu.parallel.mesh import (EXPERT_AXIS, STAGE_AXIS,
+                                          data_axis_size)
+    from tests.test_models_gpt import TinyMoE
+
+    reg, ds = _lm_registry()
+    task = make_task(job_id="ppepjob1", epochs=2, parallelism=2, k=1,
+                     batch=8, lr=3e-3)
+    task.parameters.model_type = "gpt-moe-mini"
+    task.parameters.dataset = "pplm"
+    task.parameters.options.n_stage = 2
+    task.parameters.options.n_expert = 2
+    job = TrainJob(task, TinyMoE(), ds, mesh8, registry=reg)
+    record = job.train()
+    assert data_axis_size(job.mesh) == 2
+    assert job.mesh.shape[STAGE_AXIS] == 2
+    assert job.mesh.shape[EXPERT_AXIS] == 2
+    assert job.model.module.ep_axis == EXPERT_AXIS
+    assert record.data.train_loss[-1] < record.data.train_loss[0]
+
+
+def test_job_dp_ep_gspmd_matches_replicated(tmp_home):
+    """Plain DP x EP (round 5, no SP/PP required): --expert-parallel 2
+    alone takes the GSPMD ep_mesh route — inner axes stay Auto and XLA
+    materializes the token all-to-alls inside each DP lane — and the
+    history matches the replicated-expert job on an equal-lane mesh."""
+    from kubeml_tpu.parallel.mesh import (EXPERT_AXIS, data_axis_size,
+                                          make_mesh)
+    from tests.test_models_gpt import TinyMoE
+
+    def run(n_expert, job_id):
+        reg, ds = _lm_registry()
+        task = make_task(job_id=job_id, epochs=2, parallelism=2, k=1,
+                         batch=8, lr=3e-3)
+        task.parameters.model_type = "gpt-moe-mini"
+        task.parameters.dataset = "pplm"
+        task.parameters.options.n_expert = n_expert
+        mesh = make_mesh(n_data=4, n_expert=n_expert)
+        job = TrainJob(task, TinyMoE(), ds, mesh, registry=reg)
+        return job, job.train()
+
+    ep_job, ep_rec = run(2, "dpepjob1")
+    assert data_axis_size(ep_job.mesh) == 4
+    assert ep_job.mesh.shape[EXPERT_AXIS] == 2
+    assert ep_job.model.module.ep_mesh is ep_job.mesh
+    _, dense_rec = run(1, "dpepjob2")
+    np.testing.assert_allclose(ep_rec.data.train_loss,
+                               dense_rec.data.train_loss,
+                               rtol=2e-3, atol=2e-3)
+    assert ep_rec.data.train_loss[-1] < ep_rec.data.train_loss[0]
+
+
+def test_job_pipeline_parallel_misconfigs(tmp_home, mesh8):
+    """PP misconfigs fail as 400s at the job surface, not trace-time
+    explosions: unsupported family, SP/TP composition, indivisible
+    microbatches, indivisible layers."""
+    from tests.test_models_gpt import TinyGPT
+
+    def expect_400(mutate, model=None, dataset=None, match=""):
+        reg, ds = _lm_registry()
+        if model is None:
+            make_blobs(reg)
+            model, ds = get_builtin("mlp")(hidden=16, num_classes=4), \
+                ToyDataset()
+            dsname = "blobs"
+        else:
+            dsname = "pplm"
+        task = make_task(job_id="ppbad", epochs=1, parallelism=2, k=1,
+                         batch=8)
+        task.parameters.dataset = dsname
+        mutate(task.parameters.options, task.parameters)
+        job = TrainJob(task, model, ds or dataset, mesh8, registry=reg)
+        with pytest.raises(KubeMLException, match=match) as ei:
+            job.train()
+        assert ei.value.status_code == 400
+
+    # family without a pipelineable trunk
+    expect_400(lambda o, r: setattr(o, "n_stage", 2),
+               match="does not support pipeline")
+    # PP + SP rejected up front
+    def pp_sp(o, r):
+        o.n_stage = 2
+        o.n_seq = 2
+    expect_400(pp_sp, model=TinyGPT(), match="composes with")
+    # microbatches must divide the batch
+    def bad_mb(o, r):
+        o.n_stage = 2
+        o.pp_microbatches = 3
+    expect_400(bad_mb, model=TinyGPT(), match="microbatches")
+    # layers must split over the stage axis (TinyGPT has 2 layers)
+    def bad_layers(o, r):
+        o.n_stage = 4
+    expect_400(bad_layers, model=TinyGPT(), match="layers")
+    # syncdp cannot host the manual pipeline round
+    def pp_sync(o, r):
+        o.n_stage = 2
+        o.engine = "syncdp"
+    expect_400(pp_sync, model=TinyGPT(), match="kavg")
