@@ -45,6 +45,12 @@ STEPS_PER_ROUND = 8   # K local steps per sync round
 EPOCH_SAMPLES = 50_000  # CIFAR-10 train split
 TIMED_EPOCHS = 3
 BASELINE_TIMED_EPOCHS = 2  # the arm exists for the ratio, not the curve
+# sync rounds per engine dispatch — the job's --rounds-per-dispatch
+# option (KAvgEngine.train_rounds: identical math, merges preserved).
+# 4 measured best on the tunneled v5e (results/round_probe_v5e.jsonl:
+# ~+2.7% over per-round dispatch; 8 regressed); the epoch tail that
+# does not fill a group dispatches singly, exactly as the job does.
+ROUNDS_PER_DISPATCH = 4
 
 
 def main():
@@ -100,6 +106,14 @@ def main():
     engine = KAvgEngine(mesh, model.loss, model.metrics,
                         model.configure_optimizers)
 
+    R = ROUNDS_PER_DISPATCH
+    groups, tail = divmod(rounds_per_epoch, R)
+    gbatch = {k: jnp.asarray(np.broadcast_to(
+        np.asarray(v), (R,) + np.asarray(v).shape).copy())
+        for k, v in (("x", x), ("y", y))}
+    gmasks = {k: np.broadcast_to(v, (R,) + v.shape).copy()
+              for k, v in masks.items()}
+
     def round_(variables, epoch):
         # fresh rng values each round: identical (executable, inputs)
         # submissions can be served from a cache on some backends
@@ -107,14 +121,23 @@ def main():
         return engine.train_round(variables, batch, rngs=rngs, lr=0.1,
                                   epoch=epoch, **masks)
 
+    def rounds_(variables, epoch):
+        rngs = rng.randint(0, 2**31, size=(R, W, S, 2)).astype(np.uint32)
+        return engine.train_rounds(variables, gbatch, rngs=rngs, lr=0.1,
+                                   epoch=epoch, **gmasks)
+
     from kubeml_tpu.train.job import reduce_losses  # the production reducer
 
     def epoch(variables, e):
-        """One epoch, exactly as TrainJob dispatches it: rounds enqueue
-        back-to-back, losses stay on device and reduce in one jitted
-        stack+sum dispatch, ONE readback at the end."""
+        """One epoch, exactly as TrainJob dispatches it with
+        --rounds-per-dispatch 4: full groups in one train_rounds
+        dispatch each, the tail singly, losses on device, reduced in
+        one jitted stack+sum dispatch, ONE readback at the end."""
         dev_losses = []
-        for _ in range(rounds_per_epoch):
+        for _ in range(groups):
+            variables, stats = rounds_(variables, e)
+            dev_losses.append(stats.loss_sum_device.sum(axis=0))
+        for _ in range(tail):
             variables, stats = round_(variables, e)
             dev_losses.append(stats.loss_sum_device)
         loss = np.asarray(reduce_losses(dev_losses))  # the epoch sync point

@@ -56,6 +56,8 @@ def cmd_train(args):
               "--pipeline-parallel must be >= 1")
     if args.pp_microbatches < 0:
         _fail("--pp-microbatches must be >= 0")
+    if args.rounds_per_dispatch < 1:
+        _fail("--rounds-per-dispatch must be >= 1")
     if args.pipeline_parallel > 1 and \
             (args.tensor_parallel > 1 or args.seq_parallel > 1):
         _fail("--pipeline-parallel composes with --expert-parallel only")
@@ -95,6 +97,7 @@ def cmd_train(args):
             n_expert=args.expert_parallel,
             n_stage=args.pipeline_parallel,
             pp_microbatches=args.pp_microbatches,
+            rounds_per_dispatch=args.rounds_per_dispatch,
             seq_impl=args.seq_impl,
             tp_impl=args.tp_impl,
             max_parallelism=args.max_parallelism,
@@ -357,6 +360,13 @@ def build_parser() -> argparse.ArgumentParser:
                         "2 x stages); must divide the batch size — "
                         "more microbatches shrink the (P-1)/(M+P-1) "
                         "bubble")
+    t.add_argument("--rounds-per-dispatch", type=int, default=1,
+                   metavar="R",
+                   help="sync rounds executed per engine dispatch "
+                        "(identical math, merges preserved); > 1 "
+                        "amortizes per-round submission overhead on "
+                        "high-latency backends (~2-3% measured on "
+                        "tunneled v5e)")
     t.add_argument("--seq-impl", choices=("ring", "ulysses"),
                    default="ring",
                    help="sequence-parallel attention implementation")

@@ -33,6 +33,55 @@ from kubeml_tpu.models.base import KubeDataset
 
 
 @dataclasses.dataclass
+class RoundGroup:
+    """R consecutive sync rounds stacked for ONE engine dispatch
+    (KAvgEngine.train_rounds): every RoundBatch field gains a leading
+    [R] round axis. Produced by `group_rounds`; consumed by the job's
+    grouped epoch path (kubeml_tpu/train/job.py) to cut per-round
+    dispatch overhead on high-latency backends."""
+
+    batch: Dict[str, "np.ndarray"]  # leaves [R, W, S, B, ...]
+    sample_mask: "np.ndarray"       # [R, W, S, B]
+    step_mask: "np.ndarray"         # [R, W, S]
+    worker_mask: "np.ndarray"       # [R, W]
+    rngs: "np.ndarray"              # [R, W, S, 2]
+    rounds: int
+
+
+def group_rounds(rounds: Iterator["RoundBatch"], r: int
+                 ) -> Iterator[object]:
+    """Stack consecutive RoundBatches into RoundGroups of r rounds.
+
+    The tail (fewer than r rounds left) is yielded as plain
+    RoundBatches — padding a group with fully-masked rounds is NOT a
+    no-op (a zero-contributor merge zeroes the model; the job aborts on
+    those — job.go:188-193), so short groups must never be faked.
+    Zero-contributor rounds raise MergeError here, preserving the
+    per-round abort contract the ungrouped path enforces. Runs inside
+    prefetch_rounds' feeder thread, so the np.stack copies overlap
+    device compute."""
+    from kubeml_tpu.api.errors import MergeError
+
+    buf = []
+    for rb in rounds:
+        if rb.worker_mask.sum() < 1:
+            raise MergeError(
+                f"round {rb.round_index}: no workers contributed")
+        buf.append(rb)
+        if len(buf) == r:
+            yield RoundGroup(
+                batch={k: np.stack([b.batch[k] for b in buf])
+                       for k in buf[0].batch},
+                sample_mask=np.stack([b.sample_mask for b in buf]),
+                step_mask=np.stack([b.step_mask for b in buf]),
+                worker_mask=np.stack([b.worker_mask for b in buf]),
+                rngs=np.stack([b.rngs for b in buf]),
+                rounds=r)
+            buf = []
+    yield from buf  # tail rounds dispatch singly
+
+
+@dataclasses.dataclass
 class RoundBatch:
     """Everything KAvgEngine.train_round needs for one sync round.
 

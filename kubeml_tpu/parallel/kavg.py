@@ -267,13 +267,12 @@ class KAvgEngine:
 
     # ---------------------------------------------------------------- train
 
-    def _build_train_round(self, w_per_lane: int, batch_template=None):
-        """Compile the sync-round program: one sync round per dispatch.
-
-        A round is K masked local steps per virtual worker (lax.scan)
-        followed by the masked-psum merge; elastic N, chaos hooks, and
-        the seq/manual variants all flow through this one program.
-        """
+    def _make_lane_fn(self, w_per_lane: int):
+        """Build the per-lane sync-round body shared by the one-round
+        and R-round programs: K masked local steps per virtual worker
+        (lax.scan) followed by the masked-psum merge; elastic N, chaos
+        hooks, and the seq/manual variants all flow through this one
+        body."""
         mesh = self.mesh
         loss_fn = self.loss_fn
         tx_factory = self.tx_factory
@@ -380,8 +379,12 @@ class KAvgEngine:
             avg = jax.tree_util.tree_map(merge_leaf, contrib, variables)
             return avg, jnp.stack(loss_sums)
 
+        return lane_fn
+
+    def _build_train_round(self, w_per_lane: int, batch_template=None):
+        """Compile the sync-round program: one sync round per dispatch."""
         sharded = jax.shard_map(
-            lane_fn, mesh=mesh,
+            self._make_lane_fn(w_per_lane), mesh=self.mesh,
             in_specs=(P(), self._batch_in_specs(batch_template),
                       P(DATA_AXIS), P(DATA_AXIS),
                       P(DATA_AXIS), P(DATA_AXIS), P(), P()),
@@ -389,6 +392,83 @@ class KAvgEngine:
             **self._shmap_kwargs())
         donate = (0,) if self.donate else ()
         return jax.jit(sharded, donate_argnums=donate)
+
+    def _build_train_rounds(self, w_per_lane: int, batch_template=None):
+        """Compile the R-round program: a lax.scan of the SAME per-lane
+        round body, R sync rounds (merges between them preserved) in ONE
+        dispatch. Identical math to R single-round dispatches; what it
+        buys is R x fewer submissions — on tunneled/high-latency
+        backends per-round dispatch costs host work + wire latency that
+        a ~50 ms round cannot fully hide (experiments/round_probe.py
+        quantifies it). R is baked into the program via the leading axis
+        of every non-variables input."""
+        lane_fn = self._make_lane_fn(w_per_lane)
+
+        def multi_lane(variables, batch, sample_mask, step_mask,
+                       worker_mask, rngs, lr, epoch):
+            def one(vars_, xs):
+                b, sm, stm, wm, rg = xs
+                return lane_fn(vars_, b, sm, stm, wm, rg, lr, epoch)
+
+            return lax.scan(one, variables,
+                            (batch, sample_mask, step_mask, worker_mask,
+                             rngs))
+
+        def lift(spec: P) -> P:
+            return P(None, *spec)
+
+        batch_specs = self._batch_in_specs(batch_template)
+        batch_specs = (jax.tree_util.tree_map(lift, batch_specs)
+                       if isinstance(batch_specs, dict)
+                       else lift(batch_specs))
+        sharded = jax.shard_map(
+            multi_lane, mesh=self.mesh,
+            in_specs=(P(), batch_specs,
+                      lift(P(DATA_AXIS)), lift(P(DATA_AXIS)),
+                      lift(P(DATA_AXIS)), lift(P(DATA_AXIS)), P(), P()),
+            out_specs=(P(), lift(P(DATA_AXIS))),
+            **self._shmap_kwargs())
+        donate = (0,) if self.donate else ()
+        return jax.jit(sharded, donate_argnums=donate)
+
+    def train_rounds(self, variables: PyTree, batch: PyTree,
+                     sample_mask: np.ndarray, step_mask: np.ndarray,
+                     worker_mask: np.ndarray, rngs: np.ndarray,
+                     lr: float, epoch: int) -> Tuple[PyTree, RoundStats]:
+        """Execute R consecutive sync rounds in ONE dispatch.
+
+        Same contract as train_round with a leading round axis R on
+        every array: batch leaves [R, W, S, B, ...], sample_mask
+        [R, W, S, B], step_mask [R, W, S], worker_mask [R, W], rngs
+        [R, W, S, 2]. Merges run between rounds exactly as in R
+        single-round dispatches. Stats come back per round:
+        loss_sum_device [R, W], step_count/sample_count [R, W]."""
+        R, W = int(step_mask.shape[0]), int(step_mask.shape[1])
+        if W % self.n_lanes:
+            raise ValueError(f"W={W} not a multiple of lanes={self.n_lanes}")
+        w_per_lane = W // self.n_lanes
+        lead = jax.tree_util.tree_leaves(batch)[0]
+        key = ("multi", R, w_per_lane, tuple(lead.shape[2:4]),
+               jax.tree_util.tree_structure(batch))
+        compiled = key not in self._train_cache
+        if compiled:
+            self._train_cache[key] = self._build_train_rounds(
+                w_per_lane, batch_template=batch)
+        avg, loss_sums = self._train_cache[key](
+            variables, batch,
+            jnp.asarray(sample_mask, jnp.float32),
+            jnp.asarray(step_mask, jnp.float32),
+            jnp.asarray(worker_mask, jnp.float32),
+            jnp.asarray(rngs, jnp.uint32),
+            jnp.float32(lr), jnp.int32(epoch))
+        stats = RoundStats(
+            loss_sum_device=loss_sums,
+            step_count=np.asarray(step_mask).sum(axis=2),
+            sample_count=np.asarray(sample_mask).sum(axis=(2, 3)),
+            contributors=float(np.asarray(worker_mask).sum()),
+            compiled=compiled,
+        )
+        return avg, stats
 
     def train_round(self, variables: PyTree, batch: PyTree,
                     sample_mask: np.ndarray, step_mask: np.ndarray,

@@ -40,7 +40,8 @@ import numpy as np
 from kubeml_tpu.api.errors import KubeMLException, MergeError
 from kubeml_tpu.api.types import (History, JobHistory, MetricUpdate,
                                   TrainTask)
-from kubeml_tpu.data.loader import RoundLoader, prefetch_rounds
+from kubeml_tpu.data.loader import (RoundGroup, RoundLoader, group_rounds,
+                                    prefetch_rounds)
 from kubeml_tpu.data.registry import DatasetRegistry
 from kubeml_tpu.models.base import KubeDataset, KubeModel
 from kubeml_tpu.parallel.kavg import KAvgEngine
@@ -714,17 +715,59 @@ class TrainJob:
                                      self._sync_batch_sharding), rb.batch)
         return dataclasses.replace(rb, batch=batch)
 
-    def _epoch_round_iter(self, plan, epoch, transform):
+    def _rounds_per_dispatch(self) -> int:
+        """How many sync rounds ride one engine dispatch (train_rounds).
+
+        > 1 cuts per-round submission overhead — measured worth ~2-3%
+        of headline throughput on the tunneled v5e
+        (experiments/round_probe.py, results/round_probe_v5e.jsonl) —
+        with identical math (merges between rounds preserved). Grouping
+        is skipped where per-round host control is the point: fault-
+        injection hooks (per-round mask mutation), multi-process
+        clusters (host-array staging), and sequence-parallel batches
+        (per-key staged shardings)."""
+        R = max(1, int(getattr(self.req.options, "rounds_per_dispatch",
+                               1)))
+        if R > 1 and (self.round_hook is not None
+                      or jax.process_count() > 1
+                      or self._engine.batch_seq_dims):
+            return 1
+        return R
+
+    def _stage_group(self, rg):
+        """Prefetch-thread staging for a RoundGroup: the stacked batch
+        leaves go to device sharded over `data` on the ROUND-INTERIOR
+        worker dim (leading dim is the round axis)."""
+        if not isinstance(rg, RoundGroup):
+            return self._stage_batch(rg)  # tail rounds stay single
+        from jax.sharding import NamedSharding, PartitionSpec
+        from kubeml_tpu.parallel.mesh import DATA_AXIS
+        sh = NamedSharding(self.mesh, PartitionSpec(None, DATA_AXIS))
+        batch = {k: jax.tree_util.tree_map(
+            lambda a: jax.device_put(a, sh), v)
+            for k, v in rg.batch.items()}
+        return dataclasses.replace(rg, batch=batch)
+
+    def _epoch_round_iter(self, plan, epoch, transform, group: int = 1):
         """Shared round-iteration scaffold for both engines: prefetch
         with device staging, apply the fault-injection hook, abort on
-        zero contributors (job.go:188-193)."""
-        rounds = iter(prefetch_rounds(self._loader.epoch_rounds(plan, epoch),
-                                      depth=1, transform=transform))
+        zero contributors (job.go:188-193). group > 1 stacks that many
+        consecutive rounds into RoundGroups for one-dispatch execution
+        (group_rounds enforces the zero-contributor abort per round;
+        hooks and grouping are mutually exclusive —
+        _rounds_per_dispatch)."""
+        source = self._loader.epoch_rounds(plan, epoch)
+        if group > 1:
+            source = group_rounds(source, group)
+        rounds = iter(prefetch_rounds(source, depth=1, transform=transform))
         while True:
             with self.tracer.span("data_wait"):
                 rb = next(rounds, None)
             if rb is None:
                 return
+            if isinstance(rb, RoundGroup):
+                yield rb
+                continue
             if self.round_hook is not None:
                 rb = self.round_hook(rb)
             if rb.worker_mask.sum() < 1:
@@ -781,9 +824,27 @@ class TrainJob:
         dev_losses = []
         step_counts = np.zeros(0)
         round_times = []  # (dispatch seconds, compiled?) per round
+        group = self._rounds_per_dispatch()
         # depth=1: the staging transform makes queued rounds
         # device-resident, so keep at most ~3 rounds of HBM in flight
-        for rb in self._epoch_round_iter(plan, epoch, self._stage_batch):
+        for rb in self._epoch_round_iter(plan, epoch, self._stage_group,
+                                         group=group):
+            if isinstance(rb, RoundGroup):
+                with self.tracer.span("dispatch"):
+                    t_r = time.time()
+                    self.variables, stats = self._engine.train_rounds(
+                        self.variables, rb.batch, rb.sample_mask,
+                        rb.step_mask, rb.worker_mask, rb.rngs,
+                        lr=self.req.lr, epoch=epoch)
+                    round_times.append((time.time() - t_r, stats.compiled))
+                if step_counts.size == 0:
+                    step_counts = np.zeros(stats.step_count.shape[1])
+                step_counts += (stats.step_count * rb.worker_mask
+                                ).sum(axis=0)
+                # one tiny eager sum per GROUP keeps the reducer's leaf
+                # shapes uniform with single rounds ([W])
+                dev_losses.append(stats.loss_sum_device.sum(axis=0))
+                continue
             with self.tracer.span("dispatch"):
                 t_r = time.time()
                 self.variables, stats = self._engine.train_round(

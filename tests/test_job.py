@@ -956,3 +956,29 @@ def test_job_pipeline_parallel_misconfigs(tmp_home, mesh8):
         o.n_stage = 2
         o.engine = "syncdp"
     expect_400(pp_sync, model=TinyGPT(), match="kavg")
+
+
+def test_job_rounds_per_dispatch_matches_ungrouped(setup):
+    """--rounds-per-dispatch R trains IDENTICALLY to per-round dispatch
+    (merges preserved between rounds; tail rounds dispatch singly) —
+    the option exists to amortize submission overhead, never to change
+    math."""
+    reg, store, model, mesh = setup
+
+    def run(job_id, rpd):
+        task = make_task(job_id=job_id, epochs=2, parallelism=3, k=2,
+                         batch=32)
+        task.parameters.options.rounds_per_dispatch = rpd
+        m = get_builtin("mlp")(hidden=16, num_classes=4)
+        job = TrainJob(task, m, ToyDataset(), mesh, registry=reg)
+        return job.train()
+
+    # parallelism 3 on 800 samples / b32 / k2: several rounds per epoch
+    # with a non-multiple tail for the grouped arm
+    plain = run("rpd1", 1)
+    grouped = run("rpd2", 3)
+    np.testing.assert_allclose(grouped.data.train_loss,
+                               plain.data.train_loss, rtol=1e-5,
+                               atol=1e-6)
+    np.testing.assert_allclose(grouped.data.accuracy, plain.data.accuracy,
+                               rtol=1e-5, atol=1e-5)

@@ -276,3 +276,56 @@ class TestEvalRound:
         np.testing.assert_allclose(out["loss"],
                                    (per_ex * sample_mask).sum() / n, rtol=1e-5)
         assert out["n"] == n
+
+
+def test_train_rounds_matches_sequential_single_rounds(mesh8, rng):
+    """R rounds in ONE dispatch (train_rounds) computes exactly the
+    same averaged weights and per-round losses as R single-round
+    dispatches — the multi-round program exists only to cut dispatch
+    overhead (experiments/round_probe.py), never to change math."""
+    W, S, B, R = 8, 3, 4, 3
+    w0 = rng.randn(D).astype(np.float32)
+    batches = [make_round_data(rng, W, S, B) for _ in range(R)]
+    rngs = rng.randint(0, 2**31, size=(R, W, S, 2)).astype(np.uint32)
+    masks = np.ones((R, W, S, B), np.float32)
+    smask = np.ones((R, W, S), np.float32)
+    # round 1 masks out two workers; round 2 a ragged step — the stats
+    # and merges must stay per-round exact
+    wmask = np.ones((R, W), np.float32)
+    wmask[1, :2] = 0.0
+    smask[2, 3, -1] = 0.0
+
+    seq = KAvgEngine(mesh8, linear_loss, linear_metrics, sgd_factory,
+                     donate=False)
+    v_seq = {"params": {"w": jnp.asarray(w0)}}
+    seq_losses = []
+    for r in range(R):
+        xs, ys = batches[r]
+        v_seq, stats = seq.train_round(
+            v_seq, {"x": jnp.asarray(xs), "y": jnp.asarray(ys)},
+            sample_mask=masks[r], step_mask=smask[r],
+            worker_mask=wmask[r], rngs=rngs[r], lr=0.05, epoch=0)
+        seq_losses.append(stats.loss_sum)
+
+    multi = KAvgEngine(mesh8, linear_loss, linear_metrics, sgd_factory,
+                       donate=False)
+    xs_all = np.stack([b[0] for b in batches])
+    ys_all = np.stack([b[1] for b in batches])
+    v_multi, mstats = multi.train_rounds(
+        {"params": {"w": jnp.asarray(w0)}},
+        {"x": jnp.asarray(xs_all), "y": jnp.asarray(ys_all)},
+        sample_mask=masks, step_mask=smask, worker_mask=wmask,
+        rngs=rngs, lr=0.05, epoch=0)
+
+    np.testing.assert_allclose(np.asarray(v_multi["params"]["w"]),
+                               np.asarray(v_seq["params"]["w"]),
+                               rtol=1e-6, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(mstats.loss_sum_device),
+                               np.stack(seq_losses), rtol=1e-5, atol=1e-5)
+    assert mstats.step_count.shape == (R, W)
+    # one compiled program regardless of R repeats
+    v2, st2 = multi.train_rounds(
+        v_multi, {"x": jnp.asarray(xs_all), "y": jnp.asarray(ys_all)},
+        sample_mask=masks, step_mask=smask, worker_mask=wmask,
+        rngs=rngs, lr=0.05, epoch=0)
+    assert not st2.compiled
