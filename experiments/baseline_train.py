@@ -110,8 +110,21 @@ def main(argv=None) -> int:
     ap.add_argument("--digits", action="store_true",
                     help="train on the REAL digits arm "
                          "(experiments/data.py) instead of synthetic")
+    ap.add_argument("--tta", type=float, default=None, metavar="GOAL",
+                    help="record time-to-accuracy at GOAL%% (cumulative "
+                         "TRAINING seconds until validation accuracy "
+                         "first reaches GOAL — the same epoch_duration "
+                         "accounting as the engine arm's "
+                         "time_to_accuracy, experiments/common/"
+                         "experiment.py)")
     ap.add_argument("--out", default=None)
     args = ap.parse_args(argv)
+
+    # same persistent-compile-cache treatment as the engine arm
+    # (TrainJob enables it): TTA comparisons must not hand either arm a
+    # one-time-per-host compile the other amortizes
+    from kubeml_tpu.utils.env import enable_compile_cache
+    enable_compile_cache()
 
     from experiments.train import make_synthetic_split
 
@@ -142,6 +155,14 @@ def main(argv=None) -> int:
                "samples_per_sec": round(epoch_samples / mean_epoch_s, 1),
                "final_train_loss": rows[-1]["train_loss"],
                "max_accuracy": max(r["accuracy"] for r in rows)}
+    if args.tta is not None:
+        elapsed, tta = 0.0, None
+        for r in rows:
+            elapsed += r["epoch_s"]
+            if r["accuracy"] >= args.tta:
+                tta = round(elapsed, 3)
+                break
+        summary[f"tta{args.tta:g}_s"] = tta
     print(json.dumps(summary))
     if args.out:
         with open(args.out, "w") as f:
