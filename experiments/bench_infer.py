@@ -1,0 +1,130 @@
+"""Concurrent /infer benchmark — the PS serving path under load.
+
+The reference's inference is a vestigial single-shot function invocation
+(scheduler/api.go:119-162, live RedisAI tensors, gone at job end). This
+framework serves from checkpoints through the PS `/infer` endpoint
+(control/ps.py): a ThreadingHTTPServer, a (stamp-keyed) deserialized-
+checkpoint LRU, and — round 5 — the InferBatcher, which stacks
+concurrent same-shape requests into one device call.
+
+Measured here, all against a REAL ParameterServer over HTTP on this
+host's accelerator:
+
+  for k in {1, 4, 16} concurrent clients x N requests each:
+      requests/sec, samples/sec, latency p50/p95
+  with the micro-batcher ON (default) and OFF (KUBEML_INFER_BATCH=0)
+
+Usage:
+    python -m experiments.bench_infer [--out results/infer-bench-v5e.jsonl]
+        [--requests 40] [--batch 8]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import threading
+import time
+
+
+def _percentile(xs, q):
+    xs = sorted(xs)
+    return xs[min(len(xs) - 1, int(q / 100.0 * len(xs)))]
+
+
+def run_server_and_measure(batching: bool, requests: int, batch: int,
+                           clients=(1, 4, 16)) -> list:
+    import numpy as np
+
+    from kubeml_tpu.control.httpd import http_json
+    from kubeml_tpu.control.ps import ParameterServer
+    from kubeml_tpu.models import get_builtin
+    from kubeml_tpu.train.checkpoint import save_checkpoint
+
+    os.environ["KUBEML_INFER_BATCH"] = "1" if batching else "0"
+    import jax
+
+    model = get_builtin("lenet")()
+    x0 = np.random.RandomState(0).rand(batch, 28, 28, 1).astype(
+        np.float32)
+    variables = model.init_variables(jax.random.PRNGKey(0), {"x": x0})
+    save_checkpoint("inferbench-lenet", variables,
+                    {"model": "lenet", "function": "lenet"})
+
+    ps = ParameterServer(port=0)
+    ps.start()
+    rows = []
+    try:
+        url = f"{ps.url}/infer"
+        payload = {"model_id": "inferbench-lenet", "data": x0.tolist()}
+        http_json("POST", url, payload)  # warm: LRU load + first apply
+
+        for k in clients:
+            lat = []
+            lat_lock = threading.Lock()
+            rng = np.random.RandomState(7)
+            bodies = [
+                {"model_id": "inferbench-lenet",
+                 "data": rng.rand(batch, 28, 28, 1).astype(
+                     np.float32).tolist()}
+                for _ in range(k)]
+
+            def worker(body):
+                mine = []
+                for _ in range(requests):
+                    t0 = time.perf_counter()
+                    out = http_json("POST", url, body)
+                    mine.append(time.perf_counter() - t0)
+                    assert len(out["predictions"]) == batch
+                with lat_lock:
+                    lat.extend(mine)
+
+            threads = [threading.Thread(target=worker, args=(b,))
+                       for b in bodies]
+            t0 = time.perf_counter()
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            elapsed = time.perf_counter() - t0
+            n = k * requests
+            row = {
+                "bench": "ps_infer_concurrent",
+                "batching": batching, "clients": k,
+                "requests": n, "req_batch": batch,
+                "requests_per_sec": round(n / elapsed, 1),
+                "samples_per_sec": round(n * batch / elapsed, 1),
+                "latency_p50_ms": round(_percentile(lat, 50) * 1e3, 2),
+                "latency_p95_ms": round(_percentile(lat, 95) * 1e3, 2),
+            }
+            rows.append(row)
+            print(json.dumps(row), flush=True)
+    finally:
+        ps.stop()
+    return rows
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--requests", type=int, default=40,
+                    help="requests per client")
+    ap.add_argument("--batch", type=int, default=8,
+                    help="samples per request")
+    ap.add_argument("--out", default=None)
+    args = ap.parse_args(argv)
+
+    rows = []
+    for batching in (False, True):
+        rows += run_server_and_measure(batching, args.requests,
+                                       args.batch)
+    if args.out:
+        with open(args.out, "a") as f:
+            for row in rows:
+                f.write(json.dumps(row) + "\n")
+    return 0
+
+
+if __name__ == "__main__":
+    import sys
+    sys.exit(main())

@@ -66,6 +66,109 @@ from kubeml_tpu.train.job import JobCallbacks, TrainJob
 logger = logging.getLogger("kubeml_tpu.ps")
 
 
+class _InferSlot:
+    __slots__ = ("arr", "event", "result", "error")
+
+    def __init__(self, arr):
+        self.arr = arr
+        self.event = threading.Event()
+        self.result = None
+        self.error = None
+
+
+class InferBatcher:
+    """Micro-batches concurrent /infer requests into one device call.
+
+    Serving depth the reference never had (its /infer is a single-shot
+    function invocation — scheduler/api.go:119-162): on TPU a
+    single-request stream leaves the chip idle between tiny dispatches,
+    so requests that arrive within `window_s` for the same
+    (model, sample-shape) group are stacked along the batch dim and
+    served by ONE model.infer call, then scattered back — the classic
+    leader/follower micro-batcher. The leader pays the window (a few
+    ms — small against any model call) of extra latency; followers
+    ride free. Stacked batches pad to the next power of two (repeating
+    the last row) so jitted inference paths see a handful of bucket
+    shapes instead of one program per concurrency level. Oversized
+    collections are served in max_batch chunks by the same leader.
+
+    Disable with KUBEML_INFER_BATCH=0 (requests then run unbatched)."""
+
+    def __init__(self, window_s: float = 0.003, max_batch: int = 64):
+        self.window_s = window_s
+        self.max_batch = max_batch
+        self._lock = threading.Lock()
+        self._groups: Dict[tuple, list] = {}
+        self._last_arrival: Dict[tuple, float] = {}
+
+    @staticmethod
+    def enabled() -> bool:
+        return os.environ.get("KUBEML_INFER_BATCH", "").lower() not in (
+            "0", "false", "no")
+
+    def submit(self, key: tuple, arr, run):
+        """run(stacked_batch) -> stacked predictions; returns this
+        request's slice. Exceptions from the batched call propagate to
+        every member."""
+        slot = _InferSlot(arr)
+        now = time.monotonic()
+        with self._lock:
+            grp = self._groups.get(key)
+            leader = grp is None
+            if leader:
+                grp = self._groups[key] = []
+            grp.append(slot)
+            # dense-traffic detector: a leader only pays the collection
+            # window when another request for this key arrived recently
+            # (within 8 windows); sparse/single-stream traffic serves
+            # immediately — no latency tax when there is nothing to
+            # batch with
+            dense = (now - self._last_arrival.get(key, 0.0)
+                     < 8 * self.window_s)
+            self._last_arrival[key] = now
+        if not leader:
+            # follower: the leader serves us (bounded wait: a crashed
+            # leader must not hang the request forever)
+            if not slot.event.wait(timeout=60.0):
+                raise KubeMLException("batched inference timed out", 500)
+            if slot.error is not None:
+                raise slot.error
+            return slot.result
+        if dense:
+            time.sleep(self.window_s)  # collection window
+        with self._lock:
+            collected = self._groups.pop(key)
+        for i in range(0, len(collected), self.max_batch):
+            batch = collected[i:i + self.max_batch]
+            try:
+                lens = [len(s.arr) for s in batch]
+                stacked = (batch[0].arr if len(batch) == 1
+                           else np.concatenate([s.arr for s in batch]))
+                total = len(stacked)
+                padded = 1 << (total - 1).bit_length()  # next pow2 bucket
+                if padded > total:
+                    stacked = np.concatenate(
+                        [stacked, np.repeat(stacked[-1:], padded - total,
+                                            axis=0)])
+                preds = np.asarray(run(stacked))[:total]
+                off = 0
+                for s, n in zip(batch, lens):
+                    s.result = preds[off:off + n]
+                    off += n
+                for s in batch:
+                    s.event.set()
+            except BaseException as e:
+                # later chunks still get served — a bad first chunk
+                # must not strand their followers in the 60 s wait
+                for s in batch:
+                    s.error = e
+                    s.event.set()
+        own = collected[0]
+        if own.error is not None:
+            raise own.error
+        return own.result
+
+
 class _JobRecord:
     """A running job: either a thread of this process (job + thread set)
     or a standalone child process (proc + url set)."""
@@ -148,6 +251,8 @@ class ParameterServer(JsonService):
         self._infer_cache: "collections.OrderedDict" = \
             collections.OrderedDict()
         self._infer_cache_lock = threading.Lock()
+        self._infer_batcher = InferBatcher() if InferBatcher.enabled() \
+            else None
         self.metrics = MetricsRegistry()
         self.fn_registry = FunctionRegistry()
         self.ds_registry = DatasetRegistry()
@@ -225,7 +330,18 @@ class ParameterServer(JsonService):
                 from e
         model, variables = self._load_for_infer(model_id)
         try:
-            preds = model.infer(variables, arr)
+            if self._infer_batcher is not None and arr.ndim >= 1 \
+                    and len(arr) > 0:
+                # concurrent requests for the same (model, sample
+                # shape) stack into one device call — the leader's
+                # model/variables serve the whole group (same model_id
+                # + the LRU's saved_at freshness keying)
+                key = (model_id, arr.shape[1:], str(arr.dtype))
+                preds = self._infer_batcher.submit(
+                    key, np.asarray(arr),
+                    lambda stacked: model.infer(variables, stacked))
+            else:
+                preds = model.infer(variables, arr)
         except InferenceInputError as e:
             # model-library input rejections (e.g. prompt/sequence longer
             # than max_len) are client errors, not server faults:
