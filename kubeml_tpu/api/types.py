@@ -59,11 +59,19 @@ class TrainOptions:
     # net-new: GPipe pipeline parallelism — the decoder trunk splits
     # into n_stage groups of consecutive layers over the mesh stage
     # axis, microbatches ppermuting along the ICI ring (parallel/pp.py
-    # pipeline_lane inside the fully-manual round). GPT family only.
+    # pipeline_lane inside the fully-manual round). Transformer
+    # families (GPT incl. MoE, BERT).
     n_stage: int = 1
     # microbatch count for the pipeline (0 = auto: 2 * n_stage); must
     # divide the per-worker batch size
     pp_microbatches: int = 0
+    # net-new: FSDP (ZeRO-3) for the syncdp engine — parameters AND
+    # optimizer state shard over the data axis (each chip stores 1/D of
+    # the model; GSPMD all-gathers a layer's weights at its use site and
+    # reduce-scatters the grads back — parallel/syncdp.py). Requires
+    # engine='syncdp'; the kavg engine's semantics (per-round weight
+    # average of full replicas) preclude parameter sharding.
+    fsdp: bool = False
     # net-new: sync rounds executed per engine dispatch
     # (KAvgEngine.train_rounds — identical math, merges preserved);
     # > 1 amortizes per-round submission overhead, measured worth ~2-3%
@@ -109,6 +117,7 @@ class TrainOptions:
             "n_expert": self.n_expert,
             "n_stage": self.n_stage,
             "pp_microbatches": self.pp_microbatches,
+            "fsdp": self.fsdp,
             "rounds_per_dispatch": self.rounds_per_dispatch,
             "seq_impl": self.seq_impl,
             "tp_impl": self.tp_impl,
@@ -132,6 +141,7 @@ class TrainOptions:
             n_expert=int(d.get("n_expert", 1)),
             n_stage=int(d.get("n_stage", 1)),
             pp_microbatches=int(d.get("pp_microbatches", 0)),
+            fsdp=bool(d.get("fsdp", False)),
             rounds_per_dispatch=int(d.get("rounds_per_dispatch", 1)),
             seq_impl=d.get("seq_impl", "ring"),
             tp_impl=d.get("tp_impl", "gspmd"),

@@ -58,6 +58,8 @@ def cmd_train(args):
         _fail("--pp-microbatches must be >= 0")
     if args.rounds_per_dispatch < 1:
         _fail("--rounds-per-dispatch must be >= 1")
+    if args.fsdp and args.engine != "syncdp":
+        _fail("--fsdp requires --engine syncdp")
     if args.pipeline_parallel > 1 and \
             (args.tensor_parallel > 1 or args.seq_parallel > 1):
         _fail("--pipeline-parallel composes with --expert-parallel only")
@@ -97,6 +99,7 @@ def cmd_train(args):
             n_expert=args.expert_parallel,
             n_stage=args.pipeline_parallel,
             pp_microbatches=args.pp_microbatches,
+            fsdp=args.fsdp,
             rounds_per_dispatch=args.rounds_per_dispatch,
             seq_impl=args.seq_impl,
             tp_impl=args.tp_impl,
@@ -353,13 +356,19 @@ def build_parser() -> argparse.ArgumentParser:
                    help="GPipe pipeline parallelism over the mesh "
                         "stage axis: the decoder trunk splits into P "
                         "groups of consecutive layers, microbatches "
-                        "ppermuting along ICI (GPT family; composes "
-                        "with --expert-parallel)")
+                        "ppermuting along ICI (transformer families; "
+                        "composes with --expert-parallel)")
     t.add_argument("--pp-microbatches", type=int, default=0, metavar="M",
                    help="pipeline microbatch count (default 0 = auto: "
                         "2 x stages); must divide the batch size — "
                         "more microbatches shrink the (P-1)/(M+P-1) "
                         "bubble")
+    t.add_argument("--fsdp", action="store_true",
+                   help="ZeRO-3 / FSDP: shard parameters AND optimizer "
+                        "state over the data axis (each chip stores 1/D "
+                        "of the model; GSPMD all-gathers weights at use "
+                        "and reduce-scatters grads). Requires "
+                        "--engine syncdp")
     t.add_argument("--rounds-per-dispatch", type=int, default=1,
                    metavar="R",
                    help="sync rounds executed per engine dispatch "

@@ -101,12 +101,13 @@ class KubeModel(abc.ABC):
                                  microbatches: int = 0) -> None:
         """Route TRAINING through a GPipe pipeline over the mesh `stage`
         axis (called by the job when --pipeline-parallel > 1). Served by
-        families with a uniform pipelineable trunk (the GPT family);
-        everything else rejects with a clear message."""
+        families with a uniform pipelineable trunk (the transformer
+        families); everything else rejects with a clear message."""
         raise ValueError(
             f"function {self.name or type(self).__name__!r} does not "
             "support pipeline parallelism (requires a uniform "
-            "pipelineable trunk — the GPT family)")
+            "pipelineable trunk — the transformer families: GPT, "
+            "BERT)")
 
     def enable_expert_parallel(self) -> None:
         """Switch the model's module into MANUAL expert-parallel execution

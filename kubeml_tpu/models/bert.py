@@ -224,6 +224,111 @@ class BertTiny(ClassifierModel):
     def configure_optimizers(self, lr, epoch):
         return optax.adamw(lr, weight_decay=0.01)
 
+    # --------------------------------------------- pipeline-parallel training
+
+    def enable_pipeline_parallel(self, n_stage: int,
+                                 microbatches: int = 0) -> None:
+        """Route TRAINING through the GPipe body over the mesh `stage`
+        axis (--pipeline-parallel; same design as the GPT family,
+        models/gpt.py): the encoder trunk splits into stage-axis groups
+        of L/P consecutive blocks, the module stays DENSE (per-layer
+        params stacked in-trace, each stage axis_slices its group —
+        tree paths/shapes unchanged, so checkpoints/merge/inference
+        apply as-is), and vma backward assembles the stage psums."""
+        if self.module.seq_axis is not None or \
+                getattr(self.module, "tp_axis", None) is not None:
+            raise ValueError(
+                "pipeline parallelism composes with expert parallelism "
+                "only (not --seq-parallel/--tensor-parallel)")
+        L = self.module.layers
+        if L % n_stage:
+            raise ValueError(
+                f"{L} layers do not split over a {n_stage}-stage axis")
+        self._pp_microbatches = int(microbatches) or 2 * int(n_stage)
+
+    def loss(self, variables, batch, rng, sample_mask):
+        if getattr(self, "_pp_microbatches", 0):
+            return self._pp_forward_loss(variables, batch, rng)
+        return super().loss(variables, batch, rng, sample_mask)
+
+    def _pp_forward_loss(self, variables, batch, rng):
+        """Pipelined classifier loss: embed + final LN/pool/head run
+        replicated on every stage; the L encoder blocks pipeline with
+        pad masks and per-microbatch dropout keys riding as consts.
+        Equal to the dense loss up to bf16 noise (pinned by
+        tests/test_job.py's PP-vs-dense BERT history parity)."""
+        from kubeml_tpu.parallel.manual import axis_slice
+        from kubeml_tpu.parallel.mesh import STAGE_AXIS
+        from kubeml_tpu.parallel.pp import pipeline_lane
+
+        module = self.module
+        params = variables["params"]
+        x = batch["x"]
+        B, T = x.shape
+        if T > module.max_len:
+            raise InferenceInputError(
+                f"sequence length {T} exceeds max_len {module.max_len}")
+        n_stage = lax.axis_size(STAGE_AXIS)
+        per = module.layers // n_stage
+        M = self._pp_microbatches
+        if B % M:
+            raise ValueError(
+                f"batch {B} not divisible by {M} microbatches")
+        pad_mask = (x != PAD_ID).astype(jnp.float32)
+        emb = params["tok_embed"]["embedding"].astype(module.dtype)
+        h = emb[x] + params["pos_embed"]["embedding"][
+            jnp.arange(T)].astype(module.dtype)[None]
+        k_embed, k_blocks = jax.random.split(rng)
+        if module.dropout > 0.0:  # the dense path's post-embed dropout
+            keep = jax.random.bernoulli(k_embed, 1.0 - module.dropout,
+                                        h.shape)
+            h = jnp.where(keep, h / (1.0 - module.dropout), 0.0).astype(
+                module.dtype)
+
+        block = EncoderBlock(module.hidden, module.heads, module.ffn,
+                             module.dropout, module.dtype,
+                             attn_impl=module.attn_impl,
+                             flash_interpret=module.flash_interpret)
+
+        def stage_fn(p, act, const):
+            mask, kdata = const  # [B/M, T] pad mask, [2] key data
+            key = jax.random.wrap_key_data(kdata)
+            sid = lax.axis_index(STAGE_AXIS)
+
+            def body(a, xs_l):
+                pj, j = xs_l
+                kj = jax.random.fold_in(key, sid * per + j)
+                return block.apply({"params": pj}, a, mask, True,
+                                   rngs={"dropout": kj}), None
+
+            act, _ = lax.scan(body, act, (p, jnp.arange(per)))
+            return act
+
+        stacked = jax.tree_util.tree_map(
+            lambda *xs: jnp.stack(xs, axis=0),
+            *[params[f"layer_{i}"] for i in range(module.layers)])
+        local = jax.tree_util.tree_map(
+            lambda leaf: axis_slice(leaf, STAGE_AXIS, 0), stacked)
+
+        keys = jax.random.key_data(jax.random.split(k_blocks, M))
+        hm = h.reshape(M, B // M, T, module.hidden)
+        masks = pad_mask.reshape(M, B // M, T)
+        ys, _ = pipeline_lane(stage_fn, local, hm, STAGE_AXIS,
+                              consts=(masks, keys), vma=True)
+        h = ys.reshape(B, T, module.hidden)
+        h = nn.LayerNorm(dtype=jnp.float32).apply(
+            {"params": params["LayerNorm_0"]}, h)
+        # masked mean-pool + classifier head, replicated (dense parity)
+        num = (h * pad_mask[..., None]).sum(axis=1)
+        den = pad_mask.sum(axis=1)
+        pooled = num / jnp.maximum(den, 1.0)[..., None]
+        logits = nn.Dense(module.num_classes, dtype=module.dtype).apply(
+            {"params": params["classifier"]},
+            pooled.astype(module.dtype)).astype(jnp.float32)
+        per_ex = optax.softmax_cross_entropy_with_integer_labels(
+            logits, batch["y"])
+        return per_ex, {}
+
     def forward_seq_parallel(self, variables, x, mesh, impl="ring"):
         """Long-context forward over the mesh `seq` axis.
 

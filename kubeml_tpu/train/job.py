@@ -552,10 +552,17 @@ class TrainJob:
             manual_inner=self._manual_tp or self._pp)
         self._sync_engine = None
         self._sync_state = None
+        if getattr(opts, "fsdp", False) and engine_kind != "syncdp":
+            raise KubeMLException(
+                "--fsdp requires --engine syncdp: the K-avg round's "
+                "semantics (per-round weight average of full replicas) "
+                "preclude parameter sharding; ZeRO-3 lives in the "
+                "per-step gradient-averaging engine", 400)
         if engine_kind == "syncdp":
             from kubeml_tpu.parallel.syncdp import SyncDPEngine
             self._sync_engine = SyncDPEngine(
-                self.mesh, self.model.loss, self.model.configure_optimizers)
+                self.mesh, self.model.loss, self.model.configure_optimizers,
+                fsdp=bool(getattr(opts, "fsdp", False)))
         from jax.sharding import NamedSharding, PartitionSpec
         from kubeml_tpu.parallel.kavg import seq_batch_spec
         from kubeml_tpu.parallel.mesh import DATA_AXIS
