@@ -380,3 +380,17 @@ def test_concurrent_infer_through_ps(stack):
     for t in threads:
         t.join()
     assert all(o == expect for o in outs)
+
+
+def test_train_options_wire_roundtrip_round5_fields():
+    """The round-5 TrainOptions fields survive the REST wire format
+    (to_dict/from_dict) — a field that serializes but doesn't parse
+    would silently train with defaults on the far side."""
+    from kubeml_tpu.api.types import TrainOptions
+
+    opts = TrainOptions(default_parallelism=3, n_stage=2,
+                        pp_microbatches=6, fsdp=True,
+                        rounds_per_dispatch=4, n_expert=2,
+                        max_parallelism=8, max_restarts=2)
+    rt = TrainOptions.from_dict(opts.to_dict())
+    assert rt == opts
