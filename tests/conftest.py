@@ -219,6 +219,11 @@ NIGHTLY_TESTS = {
     # SP x MoE training: the replicated-expert SP round runs as the
     # reference arm INSIDE test_kavg_sp_ep_round_matches_sp_only
     "test_models_gpt.py::test_gpt_moe_trains_seq_parallel",
+    # chained two-crash supervised recovery: the one-crash supervised
+    # test (test_job_survives_rank_death_via_supervisor_restart) keeps
+    # the crash->supervisor-restart->resume path in the CI tier
+    "test_distributed_multiprocess.py::"
+    "test_two_crashes_two_supervised_restarts",
 }
 
 

@@ -1,23 +1,29 @@
-"""Worker program for the 2-process TrainJob CHAOS test.
+"""Worker program for the 2-process TrainJob CHAOS tests.
 
-ONE phase — the supervisor owns recovery (VERDICT r4 item 2). The
+ONE launch — the supervisor owns recovery (VERDICT r4 item 2). The
 incarnation is selected by the launcher's restart counter
-($KUBEML_RESTART_COUNT, tools/launch_distributed.py supervisor mode):
+($KUBEML_RESTART_COUNT, tools/launch_distributed.py supervisor mode),
+and $CHAOS_CRASHES (default 1) sets how many incarnations crash before
+one runs to completion:
 
-  0 (first launch) — run the full-TrainJob loop (same as
-       dist_job_main.py); at the between-epoch scheduler callback AFTER
-       epoch 2's training (the second callback), each rank first waits
-       for its own epoch-1 checkpoint to be durable, then rank 1
-       SIGKILLs itself — the worker-process-death scenario. Rank 0
-       proceeds into the next epoch and blocks in the first
-       cross-process collective; the launcher's --fail-fast kills it,
-       and the SUPERVISOR relaunches the cluster.
-  >0 (supervisor restart) — resume the SAME job id from its own
-       checkpoint: the TrainJob restores the completed epochs' history,
-       epoch index, and negotiated parallelism from the manifest and
-       runs the job to completion. The final history must be continuous
-       across the crash. No human (or test harness) issues the resume —
-       that is the point.
+  incarnation i < CHAOS_CRASHES — run the full-TrainJob loop; at THIS
+       incarnation's second between-epoch scheduler callback, each rank
+       first waits for one epoch of NEW checkpoint progress to be
+       durable (manifest epoch >= this incarnation's start epoch + 1 —
+       the same make-progress-between-crashes discipline as the
+       single-process chained test), then rank 1 SIGKILLs itself. Rank
+       0 blocks in the next cross-process collective; --fail-fast
+       tears the cluster down and the SUPERVISOR relaunches it.
+  incarnation i >= CHAOS_CRASHES — resume from the job's own
+       checkpoint and run to completion; the final history must be
+       continuous across EVERY crash. No human (or test harness)
+       issues any resume — that is the point.
+
+$CHAOS_EPOCHS (default 3) sizes the job; a crashing incarnation
+starting at epoch s needs epochs >= s + 3 so it has two callbacks.
+The scripted parallelism trajectory is 2 -> 4 -> 8 -> 8 ... (value
+for epoch s+1 delivered at the callback after epoch s); a resumed
+incarnation continues the trajectory from its manifest epoch.
 
 The reference survives function-pod death only within a single merge
 (ml/pkg/train/util.go:144-166) and relies on k8s re-creating the
@@ -48,6 +54,8 @@ JOB_ID = "distjobc"
 def main(outdir: str) -> None:
     pid = jax.process_index()
     incarnation = int(os.environ.get("KUBEML_RESTART_COUNT", "0"))
+    crashes = int(os.environ.get("CHAOS_CRASHES", "1"))
+    epochs = int(os.environ.get("CHAOS_EPOCHS", "3"))
     os.environ["KUBEML_TPU_HOME"] = os.path.join(outdir, f"p{pid}")
 
     from kubeml_tpu.data.registry import DatasetRegistry
@@ -77,34 +85,42 @@ def main(outdir: str) -> None:
         except (OSError, ValueError):
             return 0
 
-    task = make_task(job_id=JOB_ID, epochs=3, parallelism=2, k=2,
-                     batch=32, lr=0.1, static=False, validate_every=1)
+    # scripted trajectory: epoch s trains at traj_full[s]
+    traj_full = [2, 4] + [8] * (epochs - 2)
+    start = 0 if incarnation == 0 else manifest_epoch()
+    # callback after epoch s delivers traj_full[s + 1]
+    schedule = iter(traj_full[start + 1:])
 
-    if incarnation == 0:
-        # full schedule 2 -> 4 -> 8; the crash lands at the SECOND
-        # between-epoch callback (after epoch 2's training, before its
-        # checkpoint), so the durable state at death is the epoch-1
-        # checkpoint carrying history[:1] and next-parallelism 4
-        schedule = iter([4, 8])
+    task = make_task(job_id=JOB_ID, epochs=epochs, parallelism=2, k=2,
+                     batch=32, lr=0.1, static=False, validate_every=1)
+    if incarnation > 0:
+        assert start >= 1, "no durable checkpoint to resume from"
+        task.parameters.resume_from = JOB_ID
+
+    if incarnation < crashes:
+        # crash at THIS incarnation's second callback, after one epoch
+        # of NEW durable checkpoint progress (manifest >= start + 1):
+        # every crash-restart cycle advances the recoverable state
         calls = {"n": 0}
 
         def _req(task):
             calls["n"] += 1
             if calls["n"] == 2:
                 deadline = time.time() + 120
-                while manifest_epoch() < 1:
+                while manifest_epoch() < start + 1:
                     assert time.time() < deadline, \
-                        "epoch-1 checkpoint never became durable"
+                        "post-crash checkpoint never became durable"
                     time.sleep(0.05)
                 if pid == 1:
-                    print(f"[rank {pid}] chaos: SIGKILL self", flush=True)
+                    print(f"[rank {pid}] chaos: SIGKILL self "
+                          f"(incarnation {incarnation})", flush=True)
                     sys.stdout.flush()
                     os.kill(os.getpid(), signal.SIGKILL)
             return next(schedule, None)
 
         def _metrics(m):
-            # record the pre-crash epoch metrics for the parent test's
-            # continuity check (only epoch 1's reaches this point)
+            # record pre-crash epoch metrics for the parent test's
+            # continuity check (epochs completed BEFORE the crash point)
             with open(os.path.join(outdir, f"crash_metrics_p{pid}.jsonl"),
                       "a") as f:
                 f.write(json.dumps({"train_loss": float(m.train_loss),
@@ -115,23 +131,22 @@ def main(outdir: str) -> None:
                        callbacks=JobCallbacks(request_parallelism=_req,
                                               publish_metrics=_metrics))
         job.train()
-        raise AssertionError("first incarnation completed without crashing")
+        raise AssertionError(
+            f"incarnation {incarnation} completed without crashing")
 
-    # ---- supervisor-restart incarnation: resume from own checkpoint
-    assert manifest_epoch() >= 1, "no durable checkpoint to resume from"
-    schedule = iter([8])
-    task.parameters.resume_from = JOB_ID
+    # ---- final incarnation: resume and run to completion
     job = TrainJob(task, model, ToyDataset(), mesh, registry=reg,
                    history_store=store,
                    callbacks=JobCallbacks(
                        request_parallelism=lambda t: next(schedule, None)))
     record = job.train()
 
-    # continuous across the crash: all 3 epochs present, the scripted
-    # 2 -> 4 -> 8 trajectory intact (epoch 1 restored, N=4 carried over
-    # from the manifest)
-    assert len(record.data.train_loss) == 3, record.data.train_loss
-    assert record.data.parallelism == [2, 4, 8], record.data.parallelism
+    # continuous across every crash: all epochs present, the scripted
+    # trajectory intact (earlier epochs restored from the manifest, the
+    # negotiated parallelism carried over)
+    assert len(record.data.train_loss) == epochs, record.data.train_loss
+    assert record.data.parallelism == traj_full[:epochs], \
+        record.data.parallelism
 
     with open(os.path.join(outdir, f"resume_history_p{pid}.json"),
               "w") as f:

@@ -260,3 +260,50 @@ def test_full_job_matches_single_process(dist_job_run, tmp_home):
                                rtol=1e-4, atol=1e-5)
     np.testing.assert_allclose(record.data.accuracy, h0["accuracy"],
                                rtol=1e-4, atol=1e-4)
+
+
+def test_two_crashes_two_supervised_restarts(tmp_path):
+    """CHAINED recovery with no human in the loop: rank 1 SIGKILLs
+    itself in the first incarnation AND again in the supervisor's first
+    restart — each crash only after one epoch of NEW durable checkpoint
+    progress — and the second restart completes the 4-epoch job with
+    one continuous history. The multi-process analogue of
+    test_standalone_jobs.py::test_two_crashes_two_restarts_continuous_history."""
+    import json
+
+    outdir = str(tmp_path)
+    run = subprocess.run(
+        [sys.executable, "-m", "tools.launch_distributed",
+         "--processes", "2", "--emulate-cpu", "4", "--fail-fast",
+         "--max-restarts", "2", "--restart-job", "distjobc",
+         "--checkpoint-root", os.path.join(outdir, "p0", "models"),
+         "--checkpoint-root", os.path.join(outdir, "p1", "models"),
+         "--", sys.executable,
+         os.path.join("tests", "helpers", "dist_job_chaos_main.py"),
+         outdir],
+        cwd=REPO,
+        env=dict(os.environ, CHAOS_CRASHES="2", CHAOS_EPOCHS="4"),
+        capture_output=True, text=True, timeout=2400)
+    assert run.returncode == 0, \
+        f"chained supervised run failed:\n{run.stdout[-6000:]}\n" \
+        f"{run.stderr[-3000:]}"
+    assert run.stdout.count("chaos: SIGKILL self") == 2, \
+        run.stdout[-4000:]
+    assert run.stderr.count("supervisor: cluster died") == 2, \
+        run.stderr[-2000:]
+    assert "[p0] chaosproc 0 OK" in run.stdout
+
+    with open(os.path.join(outdir, "resume_history_p0.json")) as f:
+        h0 = json.load(f)
+    with open(os.path.join(outdir, "resume_history_p1.json")) as f:
+        h1 = json.load(f)
+    assert h0 == h1
+    assert h0["parallelism"] == [2, 4, 8, 8]
+    assert len(h0["train_loss"]) == 4
+    # continuity across BOTH crashes: epoch 1 published by incarnation
+    # 0, epoch 2 by incarnation 1 — the final history restores both
+    with open(os.path.join(outdir, "crash_metrics_p0.jsonl")) as f:
+        crash_epochs = [json.loads(line) for line in f]
+    assert [c["parallelism"] for c in crash_epochs] == [2, 4]
+    assert h0["train_loss"][0] == crash_epochs[0]["train_loss"]
+    assert h0["train_loss"][1] == crash_epochs[1]["train_loss"]
