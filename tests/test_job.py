@@ -1063,3 +1063,22 @@ def test_job_pipeline_parallel_bert_matches_dense(tmp_home):
     np.testing.assert_allclose(pp_rec.data.accuracy,
                                dense_rec.data.accuracy,
                                rtol=2e-2, atol=0.5)
+
+
+def test_enable_compile_cache_repoints_per_home(monkeypatch, tmp_path):
+    """enable_compile_cache follows $KUBEML_TPU_HOME (test isolation:
+    each home gets its own cache dir, not first-caller-wins) and
+    honors the KUBEML_COMPILE_CACHE=0 opt-out."""
+    from kubeml_tpu.utils import env as env_mod
+
+    monkeypatch.setenv("KUBEML_TPU_HOME", str(tmp_path / "h1"))
+    monkeypatch.delenv("KUBEML_COMPILE_CACHE", raising=False)
+    assert env_mod.enable_compile_cache() is True
+    assert jax.config.jax_compilation_cache_dir == \
+        str(tmp_path / "h1" / "compile_cache")
+    monkeypatch.setenv("KUBEML_TPU_HOME", str(tmp_path / "h2"))
+    assert env_mod.enable_compile_cache() is True
+    assert jax.config.jax_compilation_cache_dir == \
+        str(tmp_path / "h2" / "compile_cache")
+    monkeypatch.setenv("KUBEML_COMPILE_CACHE", "0")
+    assert env_mod.enable_compile_cache() is False
