@@ -101,6 +101,18 @@ class TrainOptions:
     # only within a single merge (util.go:144-166) and loses the job if
     # its TrainJob pod dies; checkpoint-based restart closes that gap.
     max_restarts: int = 1
+    # net-new (on-device round assembly, data/device_cache.py): keep the
+    # train split resident in HBM and feed rounds [W, S, B] int32 gather
+    # indices instead of materialized batches. 'auto' enables it when
+    # the job is structurally eligible (single process, no seq/pipeline/
+    # manual-TP round, identity transform_train or a
+    # transform_train_device hook) AND the per-chip footprint fits
+    # device_cache_mb; 'on' forces it for eligible jobs regardless of
+    # the budget (ineligible jobs get a 400); 'off' keeps host staging.
+    device_cache: str = "auto"
+    # per-chip HBM budget (MB) for the cached split under
+    # device_cache='auto'; above it the job falls back to host staging
+    device_cache_mb: int = 512
 
     def to_dict(self) -> dict:
         return {
@@ -123,6 +135,8 @@ class TrainOptions:
             "tp_impl": self.tp_impl,
             "max_parallelism": self.max_parallelism,
             "max_restarts": self.max_restarts,
+            "device_cache": self.device_cache,
+            "device_cache_mb": self.device_cache_mb,
         }
 
     @classmethod
@@ -147,6 +161,8 @@ class TrainOptions:
             tp_impl=d.get("tp_impl", "gspmd"),
             max_parallelism=int(d.get("max_parallelism", 0)),
             max_restarts=int(d.get("max_restarts", 1)),
+            device_cache=d.get("device_cache", "auto"),
+            device_cache_mb=int(d.get("device_cache_mb", 512)),
         )
 
 

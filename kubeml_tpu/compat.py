@@ -1,0 +1,127 @@
+"""Installed-JAX compatibility shim.
+
+The codebase is written against the modern shard_map surface —
+``jax.shard_map`` with ``check_vma``/``axis_names`` kwargs,
+``lax.pcast``, ``jax.typeof(x).vma``, ``jax.sharding.AxisType`` — while
+the container may ship an older JAX (0.4.x) where shard_map lives in
+``jax.experimental.shard_map`` with ``(check_rep, auto)`` kwargs and
+varying-manual-axes (vma) tracking does not exist at all. This module is
+the ONE translation layer: every call site imports from here and stays
+written against the modern API, and the mapping to the legacy surface
+lives in exactly one place.
+
+Legacy mapping:
+
+- ``check_vma`` has no legacy equivalent (vma tracking doesn't exist);
+  it is dropped, and ``check_rep`` is forced False — the legacy
+  replication check predates the masked-psum merge/pallas idioms used
+  here and rejects valid programs.
+- ``axis_names={...}`` (manual axes) becomes the complement:
+  ``auto = frozenset(mesh.axis_names) - axis_names``.
+- ``lax.pcast(x, axis, to='varying')`` is an identity on legacy JAX:
+  without vma tracking there is no invariant/varying type distinction
+  for the cast to mediate, so the scan-carry types it fixes up already
+  match.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Set
+
+import jax
+
+HAS_NATIVE_SHARD_MAP = hasattr(jax, "shard_map")
+HAS_PCAST = hasattr(jax.lax, "pcast")
+HAS_VMA = hasattr(jax, "typeof")
+
+
+def shard_map(f, *, mesh, in_specs, out_specs,
+              check_vma: Optional[bool] = None,
+              axis_names: Optional[Set[Any]] = None):
+    """``jax.shard_map`` with modern kwargs on any installed JAX.
+
+    ``axis_names`` is the MANUAL axis set (modern convention); omitted
+    means all mesh axes are manual.
+    """
+    if HAS_NATIVE_SHARD_MAP:
+        kw = {}
+        if check_vma is not None:
+            kw["check_vma"] = check_vma
+        if axis_names is not None:
+            kw["axis_names"] = set(axis_names)
+        return jax.shard_map(f, mesh=mesh, in_specs=in_specs,
+                             out_specs=out_specs, **kw)
+    from jax.experimental.shard_map import shard_map as _legacy
+    kw = {"check_rep": False}
+    if axis_names is not None:
+        kw["auto"] = frozenset(set(mesh.axis_names) - set(axis_names))
+    return _legacy(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                   **kw)
+
+
+def axis_size(axis_name) -> int:
+    """``lax.axis_size`` on modern JAX; on legacy JAX ``psum(1, axis)``,
+    which constant-folds to the same static int inside any manual-axis
+    body (the only place either spelling is legal)."""
+    if hasattr(jax.lax, "axis_size"):
+        return jax.lax.axis_size(axis_name)
+    return jax.lax.psum(1, axis_name)
+
+
+def pcast(x, axis_name, *, to: str):
+    """``lax.pcast`` when the installed JAX tracks vma; identity when it
+    does not (there is no type distinction to cast between)."""
+    if HAS_PCAST:
+        return jax.lax.pcast(x, axis_name, to=to)
+    return x
+
+
+def typeof_vma(x) -> frozenset:
+    """``jax.typeof(x).vma`` — the varying-manual-axes of a traced value
+    — or ``frozenset()`` on vma-less JAX (equivalent to 'not varying',
+    which matches the legacy semantics where everything is untyped)."""
+    if HAS_VMA:
+        return jax.typeof(x).vma
+    return frozenset()
+
+
+def shape_dtype_struct(shape, dtype, *, vma=frozenset()):
+    """``jax.ShapeDtypeStruct`` with a ``vma`` annotation where the
+    installed JAX supports one (pallas ``out_shape`` under a
+    check_vma=True shard_map requires it); dropped on vma-less JAX."""
+    if HAS_VMA:
+        return jax.ShapeDtypeStruct(shape, dtype, vma=vma)
+    return jax.ShapeDtypeStruct(shape, dtype)
+
+
+def flash_safe_context() -> bool:
+    """Whether a pallas (Mosaic) kernel may be emitted here: fully-manual
+    shard_map bodies and plain jit with no surrounding mesh are safe;
+    any Auto (GSPMD-managed) axis in scope is not ("Mosaic kernels
+    cannot be automatically partitioned").
+
+    Modern JAX exposes the abstract mesh's per-axis types directly. On
+    legacy JAX there is no equivalent introspection, so fall back to the
+    physical mesh context: no surrounding `with mesh:` context means
+    plain jit (safe); under a mesh context, require every mesh axis to
+    be bound as a manual axis frame (fully-manual shard_map body).
+    Anything unintrospectable answers False — the cost is a reference-
+    path fallback, never a miscompile.
+    """
+    try:
+        from jax.sharding import AxisType, get_abstract_mesh
+        am = get_abstract_mesh()
+        return am.empty or all(t == AxisType.Manual for t in am.axis_types)
+    except ImportError:
+        pass
+    try:
+        from jax._src.mesh import thread_resources
+        phys = thread_resources.env.physical_mesh
+        if phys.empty:
+            return True
+        from jax._src import core as _core
+        frames = _core.thread_local_state.trace_state.axis_env
+        manual = {getattr(fr, "name", None) for fr in frames}
+        return all(a in manual for a in phys.axis_names)
+    except Exception:
+        return False

@@ -100,6 +100,23 @@ class InferBatcher:
         self._lock = threading.Lock()
         self._groups: Dict[tuple, list] = {}
         self._last_arrival: Dict[tuple, float] = {}
+        self._next_evict = 0.0
+
+    def _evict_stale(self, now: float) -> None:
+        """Drop `_last_arrival` entries idle past the dense-traffic
+        horizon (call with `_lock` held). The detector only reads back
+        8 windows, so anything older is dead weight — without eviction
+        a long-lived PS serving many (model, shape) groups grows this
+        dict one entry per key it ever saw, forever. Amortized: one
+        sweep per ~4 horizons, not per request."""
+        horizon = 8 * self.window_s
+        if now < self._next_evict:
+            return
+        self._next_evict = now + 4 * horizon
+        cutoff = now - horizon
+        for key in [k for k, t in self._last_arrival.items()
+                    if t < cutoff]:
+            del self._last_arrival[key]
 
     @staticmethod
     def enabled() -> bool:
@@ -126,6 +143,7 @@ class InferBatcher:
             dense = (now - self._last_arrival.get(key, 0.0)
                      < 8 * self.window_s)
             self._last_arrival[key] = now
+            self._evict_stale(now)
         if not leader:
             # follower: the leader serves us (bounded wait: a crashed
             # leader must not hang the request forever)

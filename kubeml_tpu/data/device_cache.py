@@ -1,0 +1,247 @@
+"""HBM-resident training-set cache for index-fed sync rounds.
+
+The host-staged data path re-ships every round's `[W, S, B, ...]` pixel
+tensor host->device, every round, even though for epoch-style training
+the dataset is STATIC across rounds — the only thing that changes per
+round is WHICH samples each worker sees. This module inverts that:
+upload the train split to device memory once per job, and let every
+round dispatch carry only `[W, S, B]` int32 gather indices (plus the
+masks, which were always tiny). The engine's lane body gathers its
+samples from the cached shard before the existing K-step scan
+(parallel/kavg.py train_round_indexed; parallel/syncdp.py
+train_steps_indexed); merge and masking semantics are untouched.
+
+Per-round dispatch payload collapses from megabytes of pixels to
+kilobytes of indices — CIFAR-10 at the headline config is ~6.3 MB of
+f32 pixels per round vs ~64 KB of indices — and the saving compounds
+with `rounds_per_dispatch` grouping (an R-round group carries only
+`[R, W, S, B]` indices).
+
+Two device layouts:
+
+  sharded     one contiguous per-lane slab `[D, L, ...]` over the mesh
+              `data` axis — lane d holds exactly the sample range its
+              workers' doc shards cover (contiguous because
+              split_minibatches assigns contiguous doc ranges in worker
+              order and shard_map gives lane d the contiguous worker
+              range [d*W/D, (d+1)*W/D)). Indices are lane-LOCAL. HBM
+              cost ~= dataset/D per chip. Parallelism changes move the
+              lane boundaries, so `ensure` re-lays-out the slabs when
+              the plan's lane ranges change (one host->device transfer
+              per topology change — the cost the per-round path paid
+              every round).
+  replicated  the full `[n, ...]` split on every chip, indices GLOBAL.
+              Required when a lane's samples are not a contiguous range
+              of the stored array: per-epoch doc shuffling (the
+              permutation lives in the index plan), and the sync-DP
+              engine's `[S, W*B]` global-batch reflow. HBM cost =
+              dataset per chip.
+
+The cache stores the RAW stored arrays ({"x": data, "y": labels}).
+Eligibility therefore requires the dataset's host `transform_train` to
+be the identity — the values the round gathers are then bit-identical
+to what host staging would have shipped — OR a
+`transform_train_device` hook (models/base.KubeDataset), the device
+twin of a host transform (e.g. u8 -> f32 normalize, NHWC layout),
+applied to the gathered leaves inside the jitted round program.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from kubeml_tpu.data.registry import DatasetHandle
+from kubeml_tpu.data.sharding import EpochPlan
+
+PyTree = Any
+
+
+class DeviceDatasetCache:
+    """One job's device-resident train split + its layout metadata.
+
+    Lifecycle: construct with a layout decision (train/job.py makes it
+    from engine/shuffle/budget), then `ensure(plan, W)` before each
+    epoch — a no-op when the current device layout already serves the
+    plan. Engines receive the cache object itself and key their
+    compiled programs on `signature`.
+    """
+
+    def __init__(self, handle: Optional[DatasetHandle], mesh,
+                 layout: str = "sharded",
+                 device_transform: Optional[Callable] = None):
+        if layout not in ("sharded", "replicated"):
+            raise ValueError(
+                f"layout must be 'sharded' or 'replicated', got {layout!r}")
+        from kubeml_tpu.parallel.mesh import DATA_AXIS
+        self.handle = handle
+        self.mesh = mesh
+        self.layout = layout
+        self.device_transform = device_transform
+        self.n_lanes = mesh.shape[DATA_AXIS]
+        #: {"x": jax.Array, "y": jax.Array} — [D, L, ...] slabs
+        #: (sharded) or the full [n, ...] split (replicated)
+        self.arrays: Optional[Dict[str, Any]] = None
+        #: [D] global sample offset of each lane's slab (sharded only);
+        #: None means indices are global (replicated)
+        self.lane_starts: Optional[np.ndarray] = None
+        #: bytes resident per chip after the last upload
+        self.device_bytes = 0
+        self._plan_key = None
+
+    # ------------------------------------------------------------- estimates
+
+    @staticmethod
+    def dataset_bytes(handle: DatasetHandle) -> int:
+        """Total bytes of the train split (mmap metadata only — no read)."""
+        x_mm, y_mm = handle.train_arrays()
+        return int(x_mm.nbytes) + int(y_mm.nbytes)
+
+    @staticmethod
+    def per_sample_bytes(handle: DatasetHandle) -> int:
+        """Bytes one sample costs on the host-staged wire (data+label)."""
+        x_mm, y_mm = handle.train_arrays()
+        n = max(1, len(x_mm))
+        return int(x_mm.nbytes) // n + int(y_mm.nbytes) // n
+
+    @classmethod
+    def per_chip_bytes(cls, handle: DatasetHandle, layout: str,
+                       n_lanes: int) -> int:
+        """Static per-chip HBM estimate for the budget decision (slab
+        zero-padding adds at most one worker shard of slack)."""
+        total = cls.dataset_bytes(handle)
+        if layout == "replicated":
+            return total
+        return -(-total // max(1, n_lanes))
+
+    # --------------------------------------------------------------- uploads
+
+    @classmethod
+    def from_arrays(cls, mesh, arrays: Dict[str, np.ndarray],
+                    layout: str = "replicated",
+                    device_transform: Optional[Callable] = None
+                    ) -> "DeviceDatasetCache":
+        """Build a cache directly from host arrays (bench/experiments/
+        tests — no registry handle). `sharded` splits sample dim 0 into
+        contiguous near-equal lane slabs and records `lane_starts`."""
+        self = cls(handle=None, mesh=mesh, layout=layout,
+                   device_transform=device_transform)
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        from kubeml_tpu.parallel.mesh import DATA_AXIS
+        n = len(next(iter(arrays.values())))
+        if layout == "replicated":
+            rep = NamedSharding(mesh, P())
+            self.arrays = {k: jax.device_put(np.ascontiguousarray(v), rep)
+                           for k, v in arrays.items()}
+            self.device_bytes = sum(int(np.asarray(v).nbytes)
+                                    for v in arrays.values())
+            return self
+        D = self.n_lanes
+        bounds = [(i * n) // D for i in range(D + 1)]
+        L = max(1, max(bounds[d + 1] - bounds[d] for d in range(D)))
+
+        def slab(src: np.ndarray) -> np.ndarray:
+            out = np.zeros((D, L) + src.shape[1:], src.dtype)
+            for d in range(D):
+                lo, hi = bounds[d], bounds[d + 1]
+                out[d, : hi - lo] = src[lo:hi]
+            return out
+
+        sh = NamedSharding(mesh, P(DATA_AXIS))
+        self.arrays = {k: jax.device_put(slab(np.asarray(v)), sh)
+                       for k, v in arrays.items()}
+        self.lane_starts = np.asarray(bounds[:-1], np.int64)
+        self.device_bytes = sum(
+            int(a.nbytes) for a in self.arrays.values()) // D
+        return self
+
+    def _lane_ranges(self, plan: EpochPlan, W: int
+                     ) -> Tuple[List[int], List[int]]:
+        """Per-lane [lo, hi) GLOBAL sample ranges covering every chunk
+        the plan hands the lane's workers, derived from the plan itself
+        (robust to how plan_epoch splits docs). Lanes whose workers are
+        all inactive (N < D padding) get an empty range."""
+        ss = self.handle.subset_size
+        n = self.handle.train_samples
+        wpl = max(1, W // self.n_lanes)
+        doc_lo: Dict[int, int] = {}
+        doc_hi: Dict[int, int] = {}
+        for rp in plan.rounds:
+            for c in rp.chunks:
+                if not c.active:
+                    continue
+                doc_lo[c.worker] = min(doc_lo.get(c.worker, c.doc_start),
+                                       c.doc_start)
+                doc_hi[c.worker] = max(doc_hi.get(c.worker, c.doc_end),
+                                       c.doc_end)
+        lane_lo, lane_hi = [], []
+        for d in range(self.n_lanes):
+            workers = [w for w in range(d * wpl, min((d + 1) * wpl, W))
+                       if w in doc_lo]
+            if not workers:
+                lane_lo.append(0)
+                lane_hi.append(0)
+                continue
+            lane_lo.append(min(doc_lo[w] for w in workers) * ss)
+            lane_hi.append(min(max(doc_hi[w] for w in workers) * ss, n))
+        return lane_lo, lane_hi
+
+    def ensure(self, plan: Optional[EpochPlan] = None, W: int = 0) -> bool:
+        """Make the device arrays serve this epoch's plan; returns True
+        when an upload actually happened (first epoch, or — sharded
+        layout only — a parallelism change moved the lane boundaries).
+        Replicated layout uploads once and is plan-independent (the
+        permutation and reflow live in the index plan)."""
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        from kubeml_tpu.parallel.mesh import DATA_AXIS
+        x_mm, y_mm = self.handle.train_arrays()
+        if self.layout == "replicated":
+            if self.arrays is not None:
+                return False
+            rep = NamedSharding(self.mesh, P())
+            self.arrays = {
+                "x": jax.device_put(np.ascontiguousarray(x_mm), rep),
+                "y": jax.device_put(np.ascontiguousarray(y_mm), rep),
+            }
+            self.device_bytes = int(x_mm.nbytes) + int(y_mm.nbytes)
+            return True
+        if plan is None or W <= 0:
+            raise ValueError("sharded layout needs (plan, W) to lay out "
+                             "the lane slabs")
+        lane_lo, lane_hi = self._lane_ranges(plan, W)
+        key = (tuple(lane_lo), tuple(lane_hi))
+        if key == self._plan_key:
+            return False
+        L = max(1, max(h - l for l, h in zip(lane_lo, lane_hi)))
+
+        def slab(src: np.ndarray) -> np.ndarray:
+            out = np.zeros((self.n_lanes, L) + src.shape[1:], src.dtype)
+            for d, (lo, hi) in enumerate(zip(lane_lo, lane_hi)):
+                out[d, : hi - lo] = src[lo:hi]
+            return out
+
+        sh = NamedSharding(self.mesh, P(DATA_AXIS))
+        self.arrays = {"x": jax.device_put(slab(x_mm), sh),
+                       "y": jax.device_put(slab(y_mm), sh)}
+        self.lane_starts = np.asarray(lane_lo, np.int64)
+        self.device_bytes = sum(
+            int(a.nbytes) for a in self.arrays.values()) // self.n_lanes
+        self._plan_key = key
+        return True
+
+    # ------------------------------------------------------------------ keys
+
+    @property
+    def signature(self) -> tuple:
+        """Engine compile-cache key component: the compiled round bakes
+        in the cache layout and slab shapes/dtypes, so a slab re-layout
+        (parallelism change) or layout switch re-lowers."""
+        if self.arrays is None:
+            raise ValueError("cache not uploaded yet — call ensure() first")
+        return (self.layout,
+                tuple(sorted((k, tuple(v.shape), str(v.dtype))
+                             for k, v in self.arrays.items())),
+                self.device_transform is not None)

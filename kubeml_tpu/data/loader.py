@@ -259,13 +259,10 @@ class RoundLoader:
         return plan_epoch(self.handle.train_samples, n_workers, k, batch_size,
                           self.handle.subset_size)
 
-    def epoch_rounds(self, plan: EpochPlan, epoch: int
-                     ) -> Iterator[RoundBatch]:
-        """Yield one RoundBatch per sync round of the epoch.
-
-        All rounds share the same [W, S_max, B] shape so the engine compiles
-        once per (parallelism, K, batch) configuration.
-        """
+    def round_geometry(self, plan: EpochPlan) -> Tuple[int, int, int]:
+        """The epoch's shared round-tensor shape (W, S, B), with the
+        grow-only elastic floors updated as a side effect (idempotent:
+        a second call with the same plan returns the same shape)."""
         W = max(_pad_workers(plan.num_workers, self.n_lanes),
                 _pad_workers(self.w_floor, self.n_lanes))
         S = max(max((r.max_steps for r in plan.rounds), default=0),
@@ -282,21 +279,43 @@ class RoundLoader:
             # caller passes w_floor=0 and shapes simply track N).
             self.w_floor = W  # grow-only: a -N step never reshapes
             self.s_floor = S
-        B = plan.batch_size
-        x_mm, y_mm = self.handle.train_arrays()
-        perm = None
-        if self.shuffle:
-            # Permute only the FULL docs: the plan sizes chunks from the
-            # contiguous layout where only the globally-last doc is short, so
-            # that doc must stay in place or chunks sized for 52 samples
-            # would receive 64 and silently truncate.
-            ss = np.random.SeedSequence([self._root_rng.entropy, epoch])
-            n_docs = self.handle.num_train_docs
-            n_full = (self.handle.train_samples // self.handle.subset_size)
-            perm = np.arange(n_docs)
-            perm[:n_full] = np.random.default_rng(ss).permutation(n_full)
-        key_rng = np.random.default_rng(
+        return W, S, plan.batch_size
+
+    def _epoch_perm(self, epoch: int) -> Optional[np.ndarray]:
+        """Per-epoch doc permutation (None when shuffle is off).
+
+        Permutes only the FULL docs: the plan sizes chunks from the
+        contiguous layout where only the globally-last doc is short, so
+        that doc must stay in place or chunks sized for 52 samples
+        would receive 64 and silently truncate.
+        """
+        if not self.shuffle:
+            return None
+        ss = np.random.SeedSequence([self._root_rng.entropy, epoch])
+        n_docs = self.handle.num_train_docs
+        n_full = (self.handle.train_samples // self.handle.subset_size)
+        perm = np.arange(n_docs)
+        perm[:n_full] = np.random.default_rng(ss).permutation(n_full)
+        return perm
+
+    def _epoch_key_rng(self, epoch: int) -> np.random.Generator:
+        """The per-round rng-key stream: one (W, S, 2) uint32 draw per
+        round, in round order. Shared by every round source (host,
+        native, index-fed) so they are interchangeable bit-for-bit."""
+        return np.random.default_rng(
             np.random.SeedSequence([self._root_rng.entropy, epoch, 7]))
+
+    def epoch_rounds(self, plan: EpochPlan, epoch: int
+                     ) -> Iterator[RoundBatch]:
+        """Yield one RoundBatch per sync round of the epoch.
+
+        All rounds share the same [W, S_max, B] shape so the engine compiles
+        once per (parallelism, K, batch) configuration.
+        """
+        W, S, B = self.round_geometry(plan)
+        x_mm, y_mm = self.handle.train_arrays()
+        perm = self._epoch_perm(epoch)
+        key_rng = self._epoch_key_rng(epoch)
 
         for rp in plan.rounds:
             if self._native_train and perm is None:
@@ -327,6 +346,73 @@ class RoundLoader:
                                     dtype=np.uint32)
             yield RoundBatch(
                 batch=_fill_missing_workers(tbs, W),
+                sample_mask=sample_mask, step_mask=step_mask,
+                worker_mask=worker_mask, rngs=rngs,
+                round_index=rp.index, num_rounds=len(plan.rounds))
+
+    def epoch_index_rounds(self, plan: EpochPlan, epoch: int,
+                           lane_starts: Optional[np.ndarray] = None
+                           ) -> Iterator[RoundBatch]:
+        """Index-fed twin of `epoch_rounds` for the device-resident
+        dataset cache (data/device_cache.py): each round's batch is
+        `{"idx": [W, S, B] int32}` gather indices instead of the
+        materialized sample leaves. Everything else — geometry, masks,
+        rng stream, cycle-padding, round order — is the SAME code paths
+        or provably identical arithmetic, so an index-fed round gathers
+        bit-identical sample values to what `epoch_rounds` would have
+        shipped (padded slots differ in value but are fully masked).
+
+        `lane_starts` ([D] global sample offset per lane, from a
+        sharded-layout cache) rebases indices to be lane-LOCAL; None
+        means the cache is replicated and indices stay GLOBAL (required
+        for shuffle, where a chunk's samples are scattered).
+        """
+        W, S, B = self.round_geometry(plan)
+        perm = self._epoch_perm(epoch)
+        if perm is not None and lane_starts is not None:
+            raise DataError("shuffled epochs need a replicated cache: "
+                            "permuted docs are not lane-contiguous")
+        key_rng = self._epoch_key_rng(epoch)
+        n = self.handle.train_samples
+        ss = self.handle.subset_size
+        wpl = max(1, W // self.n_lanes)
+
+        for rp in plan.rounds:
+            idx = np.zeros((W, S, B), dtype=np.int32)
+            sample_mask = np.zeros((W, S, B), dtype=np.float32)
+            step_mask = np.zeros((W, S), dtype=np.float32)
+            worker_mask = np.zeros(W, dtype=np.float32)
+            for c in rp.chunks:
+                if not c.active:
+                    continue
+                if perm is None:
+                    lo = c.doc_start * ss
+                    hi = min(c.doc_end * ss, n)
+                    ids = np.arange(lo, hi, dtype=np.int64)
+                else:
+                    ids = np.concatenate([
+                        np.arange(perm[d] * ss,
+                                  min((perm[d] + 1) * ss, n), dtype=np.int64)
+                        for d in range(c.doc_start, c.doc_end)])
+                need = c.num_steps * B
+                # same cycle-pad as _fill_chunk's concatenate-and-slice:
+                # padded slots repeat the chunk's real samples in order
+                flat = ids[np.arange(need) % max(1, len(ids))]
+                if lane_starts is not None:
+                    flat = flat - lane_starts[c.worker // wpl]
+                idx[c.worker, :c.num_steps] = \
+                    flat.reshape(c.num_steps, B)
+                smask = np.zeros(need, dtype=np.float32)
+                smask[:len(ids)] = 1.0
+                sample_mask[c.worker, :c.num_steps] = \
+                    smask.reshape(c.num_steps, B)
+                step_mask[c.worker, :c.num_steps] = 1.0
+                worker_mask[c.worker] = 1.0
+
+            rngs = key_rng.integers(0, 2**32, size=(W, S, 2),
+                                    dtype=np.uint32)
+            yield RoundBatch(
+                batch={"idx": idx},
                 sample_mask=sample_mask, step_mask=step_mask,
                 worker_mask=worker_mask, rngs=rngs,
                 round_index=rp.index, num_rounds=len(plan.rounds))

@@ -299,6 +299,15 @@ class KubeDataset(abc.ABC):
     #: registry dataset name this model trains on
     dataset: str = ""
 
+    #: optional DEVICE twin of transform_train for the index-fed cached
+    #: path (data/device_cache.py): `f(x, y) -> {key: jnp.ndarray}`
+    #: applied to the RAW gathered leaves inside the jitted round (e.g.
+    #: u8 -> f32 normalize). A dataset whose host transform_train is not
+    #: the identity must provide this for the device cache to be
+    #: eligible — and the two must compute the same values, or cached
+    #: and host-staged rounds diverge.
+    transform_train_device = None
+
     def __init__(self, dataset_name: Optional[str] = None):
         if dataset_name:
             self.dataset = dataset_name

@@ -69,11 +69,11 @@ def _flash_safe_context() -> bool:
     inner axes of a partially-manual shard_map, even when they have size
     1 — lowering raises "Mosaic kernels cannot be automatically
     partitioned". Safe contexts are fully-manual shard_map bodies and
-    plain jit with no surrounding mesh.
+    plain jit with no surrounding mesh (compat.flash_safe_context holds
+    the per-JAX-version introspection).
     """
-    from jax.sharding import AxisType, get_abstract_mesh
-    am = get_abstract_mesh()
-    return am.empty or all(t == AxisType.Manual for t in am.axis_types)
+    from kubeml_tpu import compat
+    return compat.flash_safe_context()
 
 
 def _flash_tiles(T: int) -> bool:

@@ -39,6 +39,7 @@ from functools import partial
 from typing import Any, Callable, Dict, Optional, Tuple
 
 import jax
+from kubeml_tpu import compat
 import jax.numpy as jnp
 import numpy as np
 import optax
@@ -295,7 +296,7 @@ class KAvgEngine:
                 # for the carry types to match. Values stay seq-INVARIANT
                 # throughout — that is what vma's backward enforces.
                 params, model_state, opt_state = jax.tree_util.tree_map(
-                    lambda x: lax.pcast(x, DATA_AXIS, to="varying"),
+                    lambda x: compat.pcast(x, DATA_AXIS, to="varying"),
                     (params, model_state, opt_state))
 
             def step(carry, xs):
@@ -383,7 +384,7 @@ class KAvgEngine:
 
     def _build_train_round(self, w_per_lane: int, batch_template=None):
         """Compile the sync-round program: one sync round per dispatch."""
-        sharded = jax.shard_map(
+        sharded = compat.shard_map(
             self._make_lane_fn(w_per_lane), mesh=self.mesh,
             in_specs=(P(), self._batch_in_specs(batch_template),
                       P(DATA_AXIS), P(DATA_AXIS),
@@ -421,7 +422,7 @@ class KAvgEngine:
         batch_specs = (jax.tree_util.tree_map(lift, batch_specs)
                        if isinstance(batch_specs, dict)
                        else lift(batch_specs))
-        sharded = jax.shard_map(
+        sharded = compat.shard_map(
             multi_lane, mesh=self.mesh,
             in_specs=(P(), batch_specs,
                       lift(P(DATA_AXIS)), lift(P(DATA_AXIS)),
@@ -510,6 +511,162 @@ class KAvgEngine:
         )
         return avg, stats
 
+    # ------------------------------------------------------ index-fed train
+
+    def _indexed_lane_fn(self, w_per_lane: int, cache):
+        """Per-lane body for INDEX-FED rounds (data/device_cache.py):
+        gather the lane's samples from the device-resident dataset
+        slab, then run the exact same round body as the host-staged
+        path. The gather is the only addition — masks, local steps,
+        and the merge are byte-for-byte the same lane_fn, which is what
+        makes index-fed rounds bit-identical to host-staged ones (the
+        gathered values match what the host would have shipped; padded
+        slots gather sample 0 instead of zeros but are fully masked)."""
+        lane_fn = self._make_lane_fn(w_per_lane)
+        lane_sharded = cache.layout == "sharded"
+        device_transform = cache.device_transform
+
+        def indexed_lane(variables, cache_arrays, idx, sample_mask,
+                         step_mask, worker_mask, rngs, lr, epoch):
+            # sharded layout: the [D, L, ...] slab arrives per-lane as
+            # [1, L, ...]; indices are lane-local into that slab.
+            # replicated layout: the full [n, ...] split, global indices.
+            src = {k: (v[0] if lane_sharded else v)
+                   for k, v in cache_arrays.items()}
+            if device_transform is not None:
+                batch = device_transform(src["x"][idx], src["y"][idx])
+            else:
+                batch = {k: v[idx] for k, v in src.items()}
+            return lane_fn(variables, batch, sample_mask, step_mask,
+                           worker_mask, rngs, lr, epoch)
+
+        return indexed_lane
+
+    def _cache_in_specs(self, cache):
+        return {k: (P(DATA_AXIS) if cache.layout == "sharded" else P())
+                for k in cache.arrays}
+
+    def _build_train_round_indexed(self, w_per_lane: int, cache):
+        sharded = compat.shard_map(
+            self._indexed_lane_fn(w_per_lane, cache), mesh=self.mesh,
+            in_specs=(P(), self._cache_in_specs(cache),
+                      P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS),
+                      P(DATA_AXIS), P(DATA_AXIS), P(), P()),
+            out_specs=(P(), P(DATA_AXIS)),
+            **self._shmap_kwargs())
+        # donate only the variables — the cache (arg 1) must outlive
+        # every round of the job
+        donate = (0,) if self.donate else ()
+        return jax.jit(sharded, donate_argnums=donate)
+
+    def _build_train_rounds_indexed(self, w_per_lane: int, cache):
+        indexed = self._indexed_lane_fn(w_per_lane, cache)
+
+        def multi_lane(variables, cache_arrays, idx, sample_mask,
+                       step_mask, worker_mask, rngs, lr, epoch):
+            def one(vars_, xs):
+                ix, sm, stm, wm, rg = xs
+                return indexed(vars_, cache_arrays, ix, sm, stm, wm, rg,
+                               lr, epoch)
+
+            # the cache rides the scan as a closed-over constant: R
+            # rounds of indices scan over it without it ever moving
+            return lax.scan(one, variables,
+                            (idx, sample_mask, step_mask, worker_mask,
+                             rngs))
+
+        def lift(spec: P) -> P:
+            return P(None, *spec)
+
+        sharded = compat.shard_map(
+            multi_lane, mesh=self.mesh,
+            in_specs=(P(), self._cache_in_specs(cache),
+                      lift(P(DATA_AXIS)), lift(P(DATA_AXIS)),
+                      lift(P(DATA_AXIS)), lift(P(DATA_AXIS)),
+                      lift(P(DATA_AXIS)), P(), P()),
+            out_specs=(P(), lift(P(DATA_AXIS))),
+            **self._shmap_kwargs())
+        donate = (0,) if self.donate else ()
+        return jax.jit(sharded, donate_argnums=donate)
+
+    def train_round_indexed(self, variables: PyTree, cache,
+                            idx: np.ndarray, sample_mask: np.ndarray,
+                            step_mask: np.ndarray, worker_mask: np.ndarray,
+                            rngs: np.ndarray, lr: float, epoch: int
+                            ) -> Tuple[PyTree, RoundStats]:
+        """Execute one sync round against the device-resident dataset
+        cache: same contract and results as train_round, but the
+        dispatch carries only `idx` [W, S, B] int32 gather indices
+        (lane-local for sharded caches, global for replicated) instead
+        of materialized batch leaves."""
+        if self._seq_train:
+            raise ValueError("index-fed rounds do not support "
+                             "sequence-parallel batch sharding")
+        W = int(step_mask.shape[0])
+        if W % self.n_lanes:
+            raise ValueError(f"W={W} not a multiple of lanes={self.n_lanes}")
+        w_per_lane = W // self.n_lanes
+        key = ("idx", w_per_lane, tuple(np.shape(idx)[1:3]),
+               cache.signature)
+        compiled = key not in self._train_cache
+        if compiled:
+            self._train_cache[key] = self._build_train_round_indexed(
+                w_per_lane, cache)
+        avg, loss_sums = self._train_cache[key](
+            variables, cache.arrays,
+            jnp.asarray(idx, jnp.int32),
+            jnp.asarray(sample_mask, jnp.float32),
+            jnp.asarray(step_mask, jnp.float32),
+            jnp.asarray(worker_mask, jnp.float32),
+            jnp.asarray(rngs, jnp.uint32),
+            jnp.float32(lr), jnp.int32(epoch))
+        stats = RoundStats(
+            loss_sum_device=loss_sums,
+            step_count=np.asarray(step_mask).sum(axis=1),
+            sample_count=np.asarray(sample_mask).sum(axis=(1, 2)),
+            contributors=float(np.asarray(worker_mask).sum()),
+            compiled=compiled,
+        )
+        return avg, stats
+
+    def train_rounds_indexed(self, variables: PyTree, cache,
+                             idx: np.ndarray, sample_mask: np.ndarray,
+                             step_mask: np.ndarray, worker_mask: np.ndarray,
+                             rngs: np.ndarray, lr: float, epoch: int
+                             ) -> Tuple[PyTree, RoundStats]:
+        """R index-fed sync rounds in ONE dispatch (train_rounds with
+        `idx` [R, W, S, B] instead of batch leaves — the dispatch
+        payload a grouped round ships shrinks by the same factor)."""
+        if self._seq_train:
+            raise ValueError("index-fed rounds do not support "
+                             "sequence-parallel batch sharding")
+        R, W = int(step_mask.shape[0]), int(step_mask.shape[1])
+        if W % self.n_lanes:
+            raise ValueError(f"W={W} not a multiple of lanes={self.n_lanes}")
+        w_per_lane = W // self.n_lanes
+        key = ("idx-multi", R, w_per_lane, tuple(np.shape(idx)[2:4]),
+               cache.signature)
+        compiled = key not in self._train_cache
+        if compiled:
+            self._train_cache[key] = self._build_train_rounds_indexed(
+                w_per_lane, cache)
+        avg, loss_sums = self._train_cache[key](
+            variables, cache.arrays,
+            jnp.asarray(idx, jnp.int32),
+            jnp.asarray(sample_mask, jnp.float32),
+            jnp.asarray(step_mask, jnp.float32),
+            jnp.asarray(worker_mask, jnp.float32),
+            jnp.asarray(rngs, jnp.uint32),
+            jnp.float32(lr), jnp.int32(epoch))
+        stats = RoundStats(
+            loss_sum_device=loss_sums,
+            step_count=np.asarray(step_mask).sum(axis=2),
+            sample_count=np.asarray(sample_mask).sum(axis=(2, 3)),
+            contributors=float(np.asarray(worker_mask).sum()),
+            compiled=compiled,
+        )
+        return avg, stats
+
     # ----------------------------------------------------------------- eval
 
     def _build_eval_round(self, w_per_lane: int, metric_names: Tuple[str, ...],
@@ -537,7 +694,7 @@ class KAvgEngine:
             totals = {k: lax.psum(v, DATA_AXIS) for k, v in sums.items()}
             return totals, total_n
 
-        sharded = jax.shard_map(
+        sharded = compat.shard_map(
             lane_fn, mesh=mesh,
             in_specs=(P(), self._batch_in_specs(batch_template),
                       P(DATA_AXIS)),

@@ -224,3 +224,77 @@ class SyncDPEngine:
             state, batch, jnp.asarray(sample_mask, jnp.float32),
             jnp.asarray(rngs, jnp.uint32), jnp.float32(lr),
             jnp.int32(epoch))
+
+    # ------------------------------------------------------ index-fed train
+
+    def _build_indexed(self, opt_specs, param_specs, cache):
+        """Index-fed wrapper around the same scan body: gather the
+        [S, G] global-batch samples from the replicated device cache,
+        then run the exact _build program on the gathered leaves —
+        identical math, so results are bit-identical to a host-staged
+        dispatch of the same samples."""
+        run = self._build(opt_specs, param_specs)
+        device_transform = cache.device_transform
+
+        def run_indexed(state, cache_arrays, idx, sample_mask, rngs, lr,
+                        epoch):
+            if device_transform is not None:
+                batch = device_transform(cache_arrays["x"][idx],
+                                         cache_arrays["y"][idx])
+            else:
+                batch = {k: v[idx] for k, v in cache_arrays.items()}
+            return run(state, batch, sample_mask, rngs, lr, epoch)
+
+        return run_indexed
+
+    def train_steps_indexed(self, state: PyTree, cache, idx: np.ndarray,
+                            sample_mask: np.ndarray, rngs: np.ndarray,
+                            lr: float, epoch: int
+                            ) -> Tuple[PyTree, jax.Array]:
+        """train_steps against a device-resident dataset cache
+        (data/device_cache.py): the dispatch carries `idx` [S, G] int32
+        GLOBAL sample indices instead of the materialized [S, G, ...]
+        batch leaves. Requires a replicated cache — the sync-DP global
+        batch interleaves every worker's samples across the data axis,
+        so a lane's gather set is never a contiguous slab."""
+        if cache.layout != "replicated":
+            raise ValueError("sync-DP index-fed rounds need a replicated "
+                             f"cache, got layout={cache.layout!r}")
+        if self._opt_specs is None:
+            raise ValueError("call init_state() first")
+        S, G = int(np.shape(idx)[0]), int(np.shape(idx)[1])
+        if G % self.n_lanes:
+            raise ValueError(
+                f"global batch {G} not divisible by the "
+                f"data-axis size {self.n_lanes}")
+        key = ("idx", (S, G), cache.signature)
+        self.last_compiled = key not in self._cache
+        if self.last_compiled:
+            state_sh = {
+                "params": jax.tree_util.tree_map(
+                    lambda spec: NamedSharding(self.mesh, spec),
+                    self._param_specs),
+                "model_state": jax.tree_util.tree_map(
+                    lambda _: NamedSharding(self.mesh, P()),
+                    state["model_state"]),
+                "opt_state": jax.tree_util.tree_map(
+                    lambda spec: NamedSharding(self.mesh, spec),
+                    self._opt_specs),
+            }
+            rep = NamedSharding(self.mesh, P())
+            cache_sh = jax.tree_util.tree_map(lambda _: rep, cache.arrays)
+            idx_sh = NamedSharding(self.mesh, P(None, DATA_AXIS))
+            mask_sh = NamedSharding(self.mesh, P(None, DATA_AXIS))
+            self._cache[key] = jax.jit(
+                self._build_indexed(self._opt_specs, self._param_specs,
+                                    cache),
+                in_shardings=(state_sh, cache_sh, idx_sh, mask_sh, rep,
+                              rep, rep),
+                out_shardings=(state_sh, rep),
+                # donate only the state; the cache must outlive the job
+                donate_argnums=(0,) if self.donate else ())
+        return self._cache[key](
+            state, cache.arrays, jnp.asarray(idx, jnp.int32),
+            jnp.asarray(sample_mask, jnp.float32),
+            jnp.asarray(rngs, jnp.uint32), jnp.float32(lr),
+            jnp.int32(epoch))

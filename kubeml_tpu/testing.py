@@ -11,6 +11,8 @@ extension API) recipe.
 
 from __future__ import annotations
 
+import os
+
 
 def virtual_cpu_env(n: int) -> dict:
     """Env vars that make a CHILD python process CPU-targeted at
@@ -18,13 +20,34 @@ def virtual_cpu_env(n: int) -> dict:
     accelerator): the one copy of the recipe for every launcher that
     spawns CPU-emulated children (PS standalone spawns, the distributed
     launcher's --emulate-cpu, demo tools, test fixtures). JAX-free —
-    safe to import from processes that must not initialize a backend."""
+    safe to import from processes that must not initialize a backend.
+
+    Both device-count spellings are set: JAX_NUM_CPU_DEVICES for modern
+    JAX and the XLA_FLAGS host-platform flag for 0.4.x (which ignores
+    the former). Extending rather than clobbering an inherited XLA_FLAGS
+    keeps any operator-set flags live in the child."""
+    xla_flags = os.environ.get("XLA_FLAGS", "")
+    flag = f"--xla_force_host_platform_device_count={n}"
+    if flag not in xla_flags:
+        xla_flags = (xla_flags + " " + flag).strip()
     return {"PALLAS_AXON_POOL_IPS": "", "JAX_PLATFORMS": "cpu",
-            "JAX_NUM_CPU_DEVICES": str(n)}
+            "JAX_NUM_CPU_DEVICES": str(n), "XLA_FLAGS": xla_flags}
 
 
 def ensure_virtual_cpu_devices(n: int) -> None:
-    """Make `jax.devices()` return at least n CPU devices (idempotent)."""
+    """Make `jax.devices()` return at least n CPU devices (idempotent).
+
+    The XLA host-platform flag must be in the environment BEFORE the
+    first backend initialization: XLA parses XLA_FLAGS exactly once per
+    process, so on JAX versions without the jax_num_cpu_devices config
+    (<= 0.4.x) a post-init env change can never take effect — set it
+    before the `jax.devices()` idempotence probe below, which is itself
+    what triggers the first init in a fresh process."""
+    flag = f"--xla_force_host_platform_device_count={n}"
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (flags + " " + flag).strip()
+
     import jax
 
     if len(jax.devices()) >= n and jax.devices()[0].platform == "cpu":
@@ -32,6 +55,14 @@ def ensure_virtual_cpu_devices(n: int) -> None:
     import jax.extend.backend
     jax.extend.backend.clear_backends()
     jax.config.update("jax_platforms", "cpu")
-    jax.config.update("jax_num_cpu_devices", n)
+    try:
+        jax.config.update("jax_num_cpu_devices", n)
+    except AttributeError:
+        # JAX 0.4.x: no such config — the XLA_FLAGS fallback set above
+        # is honored when clear_backends() forces re-initialization
+        # (provided no backend had initialized before this call; an
+        # eagerly-initialized process already consumed XLA_FLAGS and
+        # only the modern config path can retarget it).
+        pass
     assert len(jax.devices()) >= n, \
         f"failed to create {n} virtual CPU devices"

@@ -108,6 +108,14 @@ def _resolve_dir(job_id: str, root: Optional[str]) -> str:
 
 def load_checkpoint(job_id: str, root: Optional[str] = None
                     ) -> Tuple[PyTree, dict]:
+    # fast-fail the common not-found case BEFORE the retry loop: a job
+    # that never checkpointed has neither directory, and no amount of
+    # publish-race retrying will conjure one — without this check every
+    # watchdog restart-eligibility probe and cold resume_from paid the
+    # 50 ms sleep-and-retry below just to learn "no such checkpoint"
+    base = os.path.join(root or _models_root(), job_id)
+    if not os.path.isdir(base) and not os.path.isdir(base + ".old"):
+        raise JobNotFoundError(job_id)
     # one retry on read failure: a cross-process reader that resolved
     # the .old fallback just before the writer's final rmtree(old) can
     # catch a half-deleted directory — after the publish completes, the

@@ -578,6 +578,7 @@ class TrainJob:
             self._batch_sharding = lambda key: _s
         self._sync_batch_sharding = NamedSharding(
             self.mesh, PartitionSpec(None, DATA_AXIS))
+        self._init_device_cache(handle, opts, engine_kind, n_seq)
         restored = None
         if self.req.resume_from:
             # warm-start from another job's checkpoint (net-new vs the
@@ -672,6 +673,88 @@ class TrainJob:
             self.variables = jax.tree_util.tree_map(np.asarray,
                                                     self.variables)
 
+    def _init_device_cache(self, handle, opts, engine_kind: str,
+                           n_seq: int) -> None:
+        """Decide the on-device round-assembly path (ISSUE: HBM-resident
+        dataset cache + index-fed rounds — data/device_cache.py).
+
+        Structural eligibility: single process (staging a committed
+        cross-process cache hits the same collective hazards as
+        _stage_batch), no sequence-parallel/pipeline/manual-TP round
+        (those stage per-key shardings the index path does not model),
+        and a dataset whose host transform_train is the identity — the
+        cached raw arrays then ARE what staging would ship — or one
+        providing a transform_train_device twin.
+
+        Layout: per-epoch shuffle and the sync-DP engine's [S, W*B]
+        global-batch reflow both need arbitrary global gathers, hence a
+        replicated cache; otherwise the plan's contiguous per-lane
+        sample ranges allow the D-times-cheaper sharded layout.
+
+        'auto' additionally requires the per-chip footprint to fit
+        device_cache_mb (fallback: host staging, logged); 'on' skips
+        the budget but rejects structurally ineligible jobs with a 400.
+        """
+        self._device_cache = None
+        self._cache_logged = False
+        mode = str(getattr(opts, "device_cache", "auto") or "auto")
+        if mode not in ("auto", "on", "off"):
+            raise KubeMLException(
+                f"device_cache must be 'auto', 'on', or 'off', "
+                f"got {mode!r}", 400)
+        if mode == "off":
+            return
+        from kubeml_tpu.data.device_cache import DeviceDatasetCache
+        from kubeml_tpu.models.base import KubeDataset
+        identity = (type(self.dataset).transform_train
+                    is KubeDataset.transform_train)
+        dev_hook = getattr(self.dataset, "transform_train_device", None)
+        structural_ok = (jax.process_count() == 1
+                         and n_seq == 1
+                         and not self._manual_tp and not self._pp
+                         and (identity or callable(dev_hook)))
+        if not structural_ok:
+            if mode == "on":
+                raise KubeMLException(
+                    "device_cache='on' requires a single-process job "
+                    "without sequence-parallel/pipeline/manual-TP "
+                    "rounds and an identity transform_train (or a "
+                    "transform_train_device hook)", 400)
+            return
+        layout = ("replicated"
+                  if (engine_kind == "syncdp" or opts.shuffle)
+                  else "sharded")
+        budget = max(0, int(getattr(opts, "device_cache_mb", 512))) << 20
+        per_chip = DeviceDatasetCache.per_chip_bytes(
+            handle, layout, data_axis_size(self.mesh))
+        if mode == "auto" and per_chip > budget:
+            self._log(
+                "job %s device cache disabled: ~%d MB/chip (%s) exceeds "
+                "the %d MB budget — host-staged rounds",
+                self.task.job_id, per_chip >> 20, layout, budget >> 20)
+            return
+        self._device_cache = DeviceDatasetCache(
+            handle, self.mesh, layout=layout,
+            device_transform=dev_hook if not identity else None)
+
+    def _log_cache_payload(self, W: int, S: int, B: int) -> None:
+        """One-time log of what the index path saves per round: the
+        [W, S, B] sample payload in host-staged bytes vs index bytes."""
+        if self._cache_logged or self._device_cache is None:
+            return
+        self._cache_logged = True
+        per_sample = self._device_cache.per_sample_bytes(
+            self._device_cache.handle)
+        slots = W * S * B
+        self._log(
+            "job %s device cache active (%s, ~%d MB/chip): per-round "
+            "dispatch payload %d B (indices) vs %d B (host-staged), "
+            "%.0fx smaller",
+            self.task.job_id, self._device_cache.layout,
+            self._device_cache.device_bytes >> 20,
+            slots * 4, slots * per_sample,
+            max(1.0, (slots * per_sample) / max(1, slots * 4)))
+
     def _stage_batch(self, rb):
         """Runs in the prefetch thread: push the (large) batch leaves to
         device with the mesh's data-axis sharding, overlapping round
@@ -755,15 +838,21 @@ class TrainJob:
             for k, v in rg.batch.items()}
         return dataclasses.replace(rg, batch=batch)
 
-    def _epoch_round_iter(self, plan, epoch, transform, group: int = 1):
+    def _epoch_round_iter(self, plan, epoch, transform, group: int = 1,
+                          source=None):
         """Shared round-iteration scaffold for both engines: prefetch
         with device staging, apply the fault-injection hook, abort on
         zero contributors (job.go:188-193). group > 1 stacks that many
         consecutive rounds into RoundGroups for one-dispatch execution
         (group_rounds enforces the zero-contributor abort per round;
         hooks and grouping are mutually exclusive —
-        _rounds_per_dispatch)."""
-        source = self._loader.epoch_rounds(plan, epoch)
+        _rounds_per_dispatch). `source` overrides the round source
+        (the index-fed cached path passes epoch_index_rounds); the
+        staging transforms apply unchanged — an {"idx"} batch stages
+        through the same shardings as sample leaves, just 3 orders of
+        magnitude smaller."""
+        if source is None:
+            source = self._loader.epoch_rounds(plan, epoch)
         if group > 1:
             source = group_rounds(source, group)
         rounds = iter(prefetch_rounds(source, depth=1, transform=transform))
@@ -783,21 +872,28 @@ class TrainJob:
             yield rb
 
     def _note_round_times(self, round_times) -> None:
-        """Derive this epoch's compile overhead from per-round dispatch
-        times + compiled flags (RoundStats.compiled). XLA compiles run
-        synchronously inside the dispatch call, so a compiling round's
-        dispatch time ~= compile time; steady dispatches are ms. The
-        overhead — compiling dispatches minus what a steady dispatch
-        would have cost — is subtracted from the epoch duration the
-        throughput policy sees (train() below). When every round of an
-        epoch compiled (1-round epochs are common on small datasets)
-        the steady estimate carries over from earlier epochs via an
-        EMA, which is sound because shape pinning makes every round of
-        an elastic job the SAME program with the same per-round cost."""
-        steady = [dt for dt, c in round_times if not c]
-        spikes = [dt for dt, c in round_times if c]
+        """Derive this epoch's compile overhead from per-dispatch times
+        (dispatch seconds, rounds in the dispatch, compiled flag). XLA
+        compiles run synchronously inside the dispatch call, so a
+        compiling dispatch's time ~= compile time; steady dispatches are
+        ms. Times are normalized to PER-ROUND before the steady EMA —
+        grouped dispatches (rounds_per_dispatch > 1) carry R rounds
+        each, and an epoch tail mixes R-round groups with single
+        rounds, so an unnormalized mean would blend two different
+        units and mis-estimate what a steady dispatch of the compiling
+        shape should have cost. The overhead — compiling dispatches
+        minus the steady per-round estimate times the rounds they
+        carried — is subtracted from the epoch duration the throughput
+        policy sees (train() below). When every dispatch of an epoch
+        compiled (1-round epochs are common on small datasets) the
+        steady estimate carries over from earlier epochs via an EMA,
+        which is sound because shape pinning makes every round of an
+        elastic job the SAME program with the same per-round cost."""
+        steady = [dt / r for dt, r, c in round_times if not c and r > 0]
+        spike_time = sum(dt for dt, r, c in round_times if c)
+        spike_rounds = sum(r for dt, r, c in round_times if c)
         est = float(np.mean(steady)) if steady else self._steady_round_ema
-        if spikes:
+        if spike_rounds:
             # with no steady sample anywhere yet (the job's very first
             # dispatch), treat a steady dispatch as ~0: async dispatch
             # is milliseconds, so a compiling round's dispatch time IS
@@ -807,7 +903,7 @@ class TrainJob:
             # left raw, a compile-inflated epoch 1 would hand every
             # later epoch a trivial <= 1.05x pass and a spurious +1.
             self._compile_overhead_s = max(
-                0.0, sum(spikes) - (est or 0.0) * len(spikes))
+                0.0, spike_time - (est or 0.0) * spike_rounds)
         else:
             self._compile_overhead_s = 0.0
         if steady:
@@ -830,20 +926,43 @@ class TrainJob:
         # which fully determines the device contributor count.
         dev_losses = []
         step_counts = np.zeros(0)
-        round_times = []  # (dispatch seconds, compiled?) per round
+        round_times = []  # (dispatch seconds, rounds, compiled?) per dispatch
         group = self._rounds_per_dispatch()
+        cache = self._device_cache
+        source = None
+        if cache is not None:
+            W, S, B = self._loader.round_geometry(plan)
+            with self.tracer.span("cache_upload"):
+                cache.ensure(plan, W)
+            self._log_cache_payload(W, S, B)
+            source = self._loader.epoch_index_rounds(
+                plan, epoch, lane_starts=cache.lane_starts)
         # depth=1: the staging transform makes queued rounds
-        # device-resident, so keep at most ~3 rounds of HBM in flight
+        # device-resident, so at most ~3 DISPATCHES of batch HBM are in
+        # flight (queued + consumer-held + feeder-in-flight) — which is
+        # ~3*R ROUNDS when rounds_per_dispatch groups R rounds per
+        # dispatch. The index-fed cached path shrinks each round's
+        # in-flight payload from sample leaves to [W, S, B] int32
+        # indices, so the multiplier stops mattering for HBM there.
         for rb in self._epoch_round_iter(plan, epoch, self._stage_group,
-                                         group=group):
+                                         group=group, source=source):
             if isinstance(rb, RoundGroup):
                 with self.tracer.span("dispatch"):
                     t_r = time.time()
-                    self.variables, stats = self._engine.train_rounds(
-                        self.variables, rb.batch, rb.sample_mask,
-                        rb.step_mask, rb.worker_mask, rb.rngs,
-                        lr=self.req.lr, epoch=epoch)
-                    round_times.append((time.time() - t_r, stats.compiled))
+                    if cache is not None:
+                        self.variables, stats = \
+                            self._engine.train_rounds_indexed(
+                                self.variables, cache, rb.batch["idx"],
+                                rb.sample_mask, rb.step_mask,
+                                rb.worker_mask, rb.rngs,
+                                lr=self.req.lr, epoch=epoch)
+                    else:
+                        self.variables, stats = self._engine.train_rounds(
+                            self.variables, rb.batch, rb.sample_mask,
+                            rb.step_mask, rb.worker_mask, rb.rngs,
+                            lr=self.req.lr, epoch=epoch)
+                    round_times.append((time.time() - t_r, rb.rounds,
+                                        stats.compiled))
                 if step_counts.size == 0:
                     step_counts = np.zeros(stats.step_count.shape[1])
                 step_counts += (stats.step_count * rb.worker_mask
@@ -854,10 +973,17 @@ class TrainJob:
                 continue
             with self.tracer.span("dispatch"):
                 t_r = time.time()
-                self.variables, stats = self._engine.train_round(
-                    self.variables, rb.batch, rb.sample_mask, rb.step_mask,
-                    rb.worker_mask, rb.rngs, lr=self.req.lr, epoch=epoch)
-                round_times.append((time.time() - t_r, stats.compiled))
+                if cache is not None:
+                    self.variables, stats = self._engine.train_round_indexed(
+                        self.variables, cache, rb.batch["idx"],
+                        rb.sample_mask, rb.step_mask, rb.worker_mask,
+                        rb.rngs, lr=self.req.lr, epoch=epoch)
+                else:
+                    self.variables, stats = self._engine.train_round(
+                        self.variables, rb.batch, rb.sample_mask,
+                        rb.step_mask, rb.worker_mask, rb.rngs,
+                        lr=self.req.lr, epoch=epoch)
+                round_times.append((time.time() - t_r, 1, stats.compiled))
             if step_counts.size == 0:
                 step_counts = np.zeros(len(stats.step_count))
             # count only merged workers' steps: a masked-out worker (lost
@@ -891,8 +1017,22 @@ class TrainJob:
         dev_losses = []
         real_steps = 0
         round_times = []
+        cache = self._device_cache
+        source = None
+        if cache is not None:
+            # replicated layout (plan-independent): the [S, W*B] global
+            # batch interleaves every worker's shard, so indices stay
+            # GLOBAL; _stage_batch_sync reflows the [W, S, B] idx leaf
+            # through the same _to_global as sample leaves would take,
+            # which is what keeps gathered values bit-identical
+            W, S, B = self._loader.round_geometry(plan)
+            with self.tracer.span("cache_upload"):
+                cache.ensure()
+            self._log_cache_payload(W, S, B)
+            source = self._loader.epoch_index_rounds(plan, epoch)
         for rb in self._epoch_round_iter(plan, epoch,
-                                         self._stage_batch_sync):
+                                         self._stage_batch_sync,
+                                         source=source):
             smask = (rb.sample_mask * rb.step_mask[:, :, None]
                      * rb.worker_mask[:, None, None])
             smask_global = self._to_global(smask)
@@ -901,10 +1041,17 @@ class TrainJob:
                     self.variables)
             with self.tracer.span("dispatch"):
                 t_r = time.time()
-                self._sync_state, losses = self._sync_engine.train_steps(
-                    self._sync_state, rb.batch, smask_global,
-                    rb.rngs[0], lr=self.req.lr, epoch=epoch)
-                round_times.append((time.time() - t_r,
+                if cache is not None:
+                    self._sync_state, losses = \
+                        self._sync_engine.train_steps_indexed(
+                            self._sync_state, cache, rb.batch["idx"],
+                            smask_global, rb.rngs[0],
+                            lr=self.req.lr, epoch=epoch)
+                else:
+                    self._sync_state, losses = self._sync_engine.train_steps(
+                        self._sync_state, rb.batch, smask_global,
+                        rb.rngs[0], lr=self.req.lr, epoch=epoch)
+                round_times.append((time.time() - t_r, 1,
                                     self._sync_engine.last_compiled))
             real_steps += int((smask_global.sum(axis=1) > 0).sum())
             dev_losses.append(losses)

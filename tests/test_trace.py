@@ -62,6 +62,9 @@ def test_job_logs_trace_summary(tmp_path, tmp_home, mesh8):
                    ToyDataset(), mesh8, registry=reg, log_file=str(log))
     job.train()
     text = log.read_text()
-    # every epoch line carries the phase breakdown
-    assert len(re.findall(r"\[data_wait=\S+ device_drain=\S+ dispatch=\S+\]",
-                          text)) == 2
+    # every epoch line carries the phase breakdown (the cache_upload
+    # span precedes it on epochs where the device dataset cache laid
+    # out or verified its slabs)
+    assert len(re.findall(
+        r"\[(?:cache_upload=\S+ )?data_wait=\S+ device_drain=\S+ "
+        r"dispatch=\S+\]", text)) == 2
