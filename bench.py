@@ -63,6 +63,13 @@ BASELINE_TIMED_EPOCHS = 2  # the arm exists for the ratio, not the curve
 # ~+2.7% over per-round dispatch; 8 regressed); the epoch tail that
 # does not fill a group dispatches singly, exactly as the job does.
 ROUNDS_PER_DISPATCH = 4
+# faulted arm: a FaultPlan poisons worker 0 with NaN on every
+# FAULT_EVERY-th round, exercising the on-device merge guard at
+# production shapes. Its counterpart is a CLEAN arm with the identical
+# single-round dispatch loop, so the overhead number isolates the
+# guard + drop recovery, not dispatch grouping.
+FAULT_TIMED_EPOCHS = 1
+FAULT_EVERY = 4
 
 
 def main():
@@ -217,10 +224,71 @@ def main():
         samples = timed_epochs * rounds_per_epoch * W * S * B
         return samples / elapsed / n_chips
 
+    # -- faulted arm: the SAME host-staged single-round loop, once clean
+    # and once under a FaultPlan NaN schedule, so the delta is the cost
+    # of the on-device guard dropping workers and the job carrying on
+    from kubeml_tpu.faults import FaultPlan
+
+    plan = FaultPlan.parse([{"kind": "nan", "round": r, "worker": 0}
+                            for r in range(0, rounds_per_epoch,
+                                           FAULT_EVERY)])
+
+    def faulted_epoch(variables, e, fault_plan):
+        from kubeml_tpu.data.loader import RoundBatch
+        dev_losses, dev_dropped = [], []
+        if fault_plan is not None:
+            fault_plan.epoch = e
+        for r in range(rounds_per_epoch):
+            rngs = rng.randint(0, 2**31, size=(W, S, 2)).astype(np.uint32)
+            rb = RoundBatch(batch={"x": x, "y": y},
+                            sample_mask=masks["sample_mask"],
+                            step_mask=masks["step_mask"],
+                            worker_mask=masks["worker_mask"], rngs=rngs,
+                            round_index=r, num_rounds=rounds_per_epoch)
+            if fault_plan is not None:
+                rb = fault_plan.inject_batch(rb)
+            staged = {k: jax.device_put(v, b_sh)
+                      for k, v in rb.batch.items()}
+            variables, stats = engine.train_round(
+                variables, staged, sample_mask=rb.sample_mask,
+                step_mask=rb.step_mask, worker_mask=rb.worker_mask,
+                rngs=rb.rngs, lr=0.1, epoch=e)
+            dev_losses.append(stats.loss_sum_device)
+            dev_dropped.append(stats.dropped_device)
+        np.asarray(reduce_losses(dev_losses))  # the epoch sync point
+        flags = np.asarray(jnp.stack(dev_dropped))  # [R, W], one read
+        return variables, flags
+
+    def measure_faulted(fault_plan):
+        variables = model.init_variables(
+            jax.random.PRNGKey(0), {"x": jnp.asarray(x[0, 0])})
+        variables, _ = faulted_epoch(variables, 0, fault_plan)  # warmup
+        anchor(variables)
+        if fault_plan is not None:
+            # warmup fired injections too — reset so the reported counter
+            # covers exactly the timed window the drop flags cover
+            fault_plan.injected = {k: 0 for k in fault_plan.injected}
+        t0 = time.perf_counter()
+        flags_total = np.zeros((rounds_per_epoch, W))
+        for e in range(FAULT_TIMED_EPOCHS):
+            variables, flags = faulted_epoch(variables, e + 1, fault_plan)
+            flags_total += flags
+        anchor(variables)
+        elapsed = time.perf_counter() - t0
+        samples = FAULT_TIMED_EPOCHS * rounds_per_epoch * W * S * B
+        return samples / elapsed / n_chips, flags_total
+
     per_chip = measure(cache_round, cache_rounds, 2, TIMED_EPOCHS)
     host_per_chip = measure(host_round, host_rounds, 1,
                             HOST_TIMED_EPOCHS)
     baseline_per_chip = _measure_baseline_arm(model, x, y)
+    clean_single_per_chip, _ = measure_faulted(None)
+    faulted_per_chip, fault_flags = measure_faulted(plan)
+    rounds_dropped = int((fault_flags.sum(axis=1) > 0).sum())
+    worker_drops = int(fault_flags.sum())
+    recovery_overhead_pct = max(
+        0.0, (clean_single_per_chip - faulted_per_chip)
+        / clean_single_per_chip * 100.0)
     # per-round dispatch payload of each arm (bytes): what one sync
     # round's samples cost on the host->device wire. Masks/rngs are
     # identical on both arms and excluded.
@@ -247,6 +315,18 @@ def main():
         "timed_epochs": TIMED_EPOCHS,
         "host_timed_epochs": HOST_TIMED_EPOCHS,
         "baseline_timed_epochs": BASELINE_TIMED_EPOCHS,
+        # faulted arm: NaN on worker 0 every FAULT_EVERY-th round vs the
+        # identical clean single-round loop. rounds_dropped comes from
+        # the engine's on-device dropped flags (read once per epoch) and
+        # must agree with the plan's own injection counter.
+        "faulted_samples_per_sec_per_chip": round(faulted_per_chip, 1),
+        "clean_single_round_samples_per_sec_per_chip":
+            round(clean_single_per_chip, 1),
+        "faulted_rounds_dropped": rounds_dropped,
+        "faulted_worker_drops": worker_drops,
+        "faulted_nan_injections": plan.injected["nan"],
+        "fault_recovery_overhead_pct": round(recovery_overhead_pct, 2),
+        "fault_timed_epochs": FAULT_TIMED_EPOCHS,
     }))
 
 

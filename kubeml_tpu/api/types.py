@@ -113,6 +113,23 @@ class TrainOptions:
     # per-chip HBM budget (MB) for the cached split under
     # device_cache='auto'; above it the job falls back to host staging
     device_cache_mb: int = 512
+    # net-new fault tolerance (the merge guard itself is always on —
+    # parallel/kavg.py drops non-finite workers from every merge):
+    # quarantine_after = N > 0 masks a worker out for the REST OF THE
+    # EPOCH once the guard drops it N consecutive rounds (host-side mask
+    # edit between dispatches, no retrace); 0 disables. Enabling it (or
+    # abort_after) costs a tiny per-round [W] readback, so both default
+    # off to preserve the fully-async dispatch pipeline.
+    quarantine_after: int = 0
+    # abort_after = N > 0 fails the job with a diagnostic when EVERY
+    # contributing worker is non-finite for N consecutive rounds —
+    # instead of silently "training" on frozen weights; 0 disables
+    abort_after: int = 0
+    # net-new: deterministic fault-injection plan (kubeml_tpu/faults.py)
+    # — a JSON spec of events at named (epoch, round, worker)
+    # coordinates: NaN bursts, worker dropouts, a process crash,
+    # checkpoint corruption, artificial slow rounds. Empty = no faults.
+    fault_plan: str = ""
 
     def to_dict(self) -> dict:
         return {
@@ -137,6 +154,9 @@ class TrainOptions:
             "max_restarts": self.max_restarts,
             "device_cache": self.device_cache,
             "device_cache_mb": self.device_cache_mb,
+            "quarantine_after": self.quarantine_after,
+            "abort_after": self.abort_after,
+            "fault_plan": self.fault_plan,
         }
 
     @classmethod
@@ -163,6 +183,9 @@ class TrainOptions:
             max_restarts=int(d.get("max_restarts", 1)),
             device_cache=d.get("device_cache", "auto"),
             device_cache_mb=int(d.get("device_cache_mb", 512)),
+            quarantine_after=int(d.get("quarantine_after", 0)),
+            abort_after=int(d.get("abort_after", 0)),
+            fault_plan=d.get("fault_plan", ""),
         )
 
 
@@ -246,6 +269,15 @@ class JobHistory:
     train_loss: List[float] = field(default_factory=list)
     parallelism: List[int] = field(default_factory=list)
     epoch_duration: List[float] = field(default_factory=list)
+    # net-new fault-tolerance observability (defaults keep old manifests
+    # and histories loadable): per-epoch worker-round drops by the
+    # non-finite merge guard (kavg; sync-DP counts skipped steps) and
+    # workers under quarantine at epoch end
+    dropped_workers: List[float] = field(default_factory=list)
+    quarantined_workers: List[int] = field(default_factory=list)
+    # checkpoint-based watchdog restarts consumed by the job (stamped by
+    # the PS at finish — control/ps.py)
+    restarts: int = 0
 
     def to_dict(self) -> dict:
         return _asdict(self)
@@ -258,6 +290,9 @@ class JobHistory:
             train_loss=list(d.get("train_loss", [])),
             parallelism=list(d.get("parallelism", [])),
             epoch_duration=list(d.get("epoch_duration", [])),
+            dropped_workers=list(d.get("dropped_workers", [])),
+            quarantined_workers=list(d.get("quarantined_workers", [])),
+            restarts=int(d.get("restarts", 0)),
         )
 
 
@@ -291,6 +326,10 @@ class MetricUpdate:
     train_loss: float
     parallelism: int
     epoch_duration: float
+    # fault-tolerance counters for the epoch (optional on the wire so
+    # updates from older jobs still parse)
+    dropped_workers: float = 0.0
+    quarantined_workers: int = 0
 
     def to_dict(self) -> dict:
         return _asdict(self)
@@ -299,7 +338,9 @@ class MetricUpdate:
     def from_dict(cls, d: dict) -> "MetricUpdate":
         return cls(**{k: d[k] for k in
                       ("job_id", "validation_loss", "accuracy", "train_loss",
-                       "parallelism", "epoch_duration")})
+                       "parallelism", "epoch_duration")},
+                   dropped_workers=float(d.get("dropped_workers", 0.0)),
+                   quarantined_workers=int(d.get("quarantined_workers", 0)))
 
 
 @dataclass

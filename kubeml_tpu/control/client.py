@@ -9,13 +9,46 @@ Get/Delete/List/Prune, List/Stop).
 from __future__ import annotations
 
 import os
+import random
+import time
 import uuid
 from typing import List, Optional
 
 from kubeml_tpu.api.const import CONTROLLER_URL
+from kubeml_tpu.api.errors import KubeMLException
 from kubeml_tpu.api.types import (DatasetSummary, History, InferRequest,
                                   TrainRequest, TrainTask)
 from kubeml_tpu.control.httpd import http_json
+
+# Bounded retry for TRANSIENT connection failures only. httpd.http_json
+# maps transport errors (refused/reset/DNS) to a 503 whose message leads
+# with "cannot reach" — that exact pairing is the retry predicate, so
+# SEMANTIC 503s (e.g. the PS's all-partitions-busy answer) pass straight
+# through: retrying those would just hammer a server that already gave a
+# considered answer. Capped small so CLI calls and tests never stall
+# more than ~1.5 s on a genuinely dead controller.
+RETRY_ATTEMPTS = 3
+RETRY_BASE_S = 0.1
+RETRY_CAP_S = 1.0
+
+
+def _retryable(e: KubeMLException) -> bool:
+    return e.status_code == 503 and "cannot reach" in str(e.message)
+
+
+def _request(method: str, url: str, body=None, **kw):
+    """http_json with exponential backoff + jitter on transient
+    connection errors (full jitter halves the thundering-herd sync of
+    many clients retrying a controller that just restarted)."""
+    delay = RETRY_BASE_S
+    for attempt in range(RETRY_ATTEMPTS):
+        try:
+            return http_json(method, url, body, **kw)
+        except KubeMLException as e:
+            if attempt == RETRY_ATTEMPTS - 1 or not _retryable(e):
+                raise
+            time.sleep(min(delay, RETRY_CAP_S) * (0.5 + random.random() / 2))
+            delay *= 2
 
 
 def _multipart_body(files: dict) -> tuple:
@@ -38,11 +71,11 @@ class NetworksClient:
         self.base = base
 
     def train(self, req: TrainRequest) -> str:
-        out = http_json("POST", f"{self.base}/train", req.to_dict())
+        out = _request("POST", f"{self.base}/train", req.to_dict())
         return out["id"]
 
     def infer(self, model_id: str, data) -> list:
-        out = http_json("POST", f"{self.base}/infer",
+        out = _request("POST", f"{self.base}/infer",
                         InferRequest(model_id=model_id, data=data).to_dict())
         return out["predictions"]
 
@@ -61,20 +94,20 @@ class DatasetsClient:
             with open(path, "rb") as f:
                 files[field] = (os.path.basename(path), f.read())
         body, ctype = _multipart_body(files)
-        out = http_json("POST", f"{self.base}/dataset/{name}", raw_body=body,
+        out = _request("POST", f"{self.base}/dataset/{name}", raw_body=body,
                         content_type=ctype, timeout=600)
         return DatasetSummary.from_dict(out)
 
     def delete(self, name: str) -> None:
-        http_json("DELETE", f"{self.base}/dataset/{name}")
+        _request("DELETE", f"{self.base}/dataset/{name}")
 
     def get(self, name: str) -> DatasetSummary:
         return DatasetSummary.from_dict(
-            http_json("GET", f"{self.base}/dataset/{name}"))
+            _request("GET", f"{self.base}/dataset/{name}"))
 
     def list(self) -> List[DatasetSummary]:
         return [DatasetSummary.from_dict(d)
-                for d in http_json("GET", f"{self.base}/dataset")]
+                for d in _request("GET", f"{self.base}/dataset")]
 
 
 class FunctionsClient:
@@ -83,17 +116,17 @@ class FunctionsClient:
 
     def create(self, name: str, code_path: str) -> None:
         with open(code_path, "rb") as f:
-            http_json("POST", f"{self.base}/functions/{name}",
+            _request("POST", f"{self.base}/functions/{name}",
                       raw_body=f.read(), content_type="text/x-python")
 
     def get(self, name: str) -> dict:
-        return http_json("GET", f"{self.base}/functions/{name}")
+        return _request("GET", f"{self.base}/functions/{name}")
 
     def delete(self, name: str) -> None:
-        http_json("DELETE", f"{self.base}/functions/{name}")
+        _request("DELETE", f"{self.base}/functions/{name}")
 
     def list(self) -> List[dict]:
-        return http_json("GET", f"{self.base}/functions")
+        return _request("GET", f"{self.base}/functions")
 
 
 class HistoriesClient:
@@ -102,17 +135,17 @@ class HistoriesClient:
 
     def get(self, task_id: str) -> History:
         return History.from_dict(
-            http_json("GET", f"{self.base}/history/{task_id}"))
+            _request("GET", f"{self.base}/history/{task_id}"))
 
     def delete(self, task_id: str) -> None:
-        http_json("DELETE", f"{self.base}/history/{task_id}")
+        _request("DELETE", f"{self.base}/history/{task_id}")
 
     def list(self) -> List[History]:
         return [History.from_dict(d)
-                for d in http_json("GET", f"{self.base}/history")]
+                for d in _request("GET", f"{self.base}/history")]
 
     def prune(self) -> int:
-        return http_json("DELETE", f"{self.base}/history")["deleted"]
+        return _request("DELETE", f"{self.base}/history")["deleted"]
 
 
 class TasksClient:
@@ -121,10 +154,10 @@ class TasksClient:
 
     def list(self) -> List[TrainTask]:
         return [TrainTask.from_dict(d)
-                for d in http_json("GET", f"{self.base}/tasks")]
+                for d in _request("GET", f"{self.base}/tasks")]
 
     def stop(self, job_id: str) -> None:
-        http_json("DELETE", f"{self.base}/tasks/{job_id}")
+        _request("DELETE", f"{self.base}/tasks/{job_id}")
 
 
 class V1:

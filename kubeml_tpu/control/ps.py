@@ -634,6 +634,9 @@ class ParameterServer(JsonService):
         logger.warning("job %s: restarting from its checkpoint "
                        "(restart %d/%d)", job_id, rec.restarts,
                        opts.max_restarts)
+        # surface the restart on /metrics: per-job gauge (cleared at
+        # finish like every job series) + the PS-lifetime total
+        self.metrics.note_restart(job_id)
         try:
             self._spawn_standalone(rec)  # re-arms the watchdog
         except Exception as e:
@@ -727,6 +730,17 @@ class ParameterServer(JsonService):
             rec = self.jobs.pop(job_id, None)
         if rec is None:
             return
+        if rec.restarts:
+            # stamp the watchdog restart count into the finished History
+            # record — the job process cannot know it (each incarnation
+            # sees only its own lifetime); a failed job that never saved
+            # a record simply has nothing to stamp
+            try:
+                h = self.history_store.get(job_id)
+                h.data.restarts = rec.restarts
+                self.history_store.save(h)
+            except JobNotFoundError:
+                pass
         if rec.proc is not None:
             # the job process exits after its finish notification; reap it
             # off-thread so this handler (called BY that process) returns

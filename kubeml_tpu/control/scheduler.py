@@ -18,9 +18,10 @@ from __future__ import annotations
 
 import collections
 import logging
+import random
 import threading
 import time
-from typing import Deque, Optional
+from typing import Deque, Dict, Optional
 
 from kubeml_tpu.api.errors import InvalidArgsError, KubeMLException
 from kubeml_tpu.api.types import TrainRequest, TrainTask
@@ -29,6 +30,13 @@ from kubeml_tpu.control.policy import SchedulerPolicy, ThroughputBasedPolicy
 from kubeml_tpu.utils.ids import make_job_id
 
 logger = logging.getLogger("kubeml_tpu.scheduler")
+
+# Per-task capacity-deferral backoff: exponential from BASE, CAPPED so a
+# task parked behind a long-running fleet still re-probes within ~5 s of
+# capacity freeing, with +/-25% jitter so tasks deferred in the same
+# sweep don't re-arrive as a synchronized burst that re-defers together.
+DEFER_BASE_S = 0.25
+DEFER_CAP_S = 5.0
 
 
 class SchedulerQueue:
@@ -66,6 +74,9 @@ class Scheduler(JsonService):
         # capacity-deferred tasks parked with a not-before stamp so the
         # backoff applies per task, not to the whole scheduling loop
         self._deferred: list = []  # [(not_before_monotonic, task)]
+        # consecutive deferrals per task id (loop thread owns it), reset
+        # on successful dispatch — drives the capped exponential backoff
+        self._defer_counts: Dict[str, int] = {}
         self._stop = threading.Event()
         self._loop_thread: Optional[threading.Thread] = None
 
@@ -117,6 +128,9 @@ class Scheduler(JsonService):
 
     def _h_finish(self, req: Request):
         self.policy.task_finished(req.params["taskId"])
+        # drop any backoff streak so the id doesn't linger forever
+        # (single-key dict pop — safe against the loop thread's reads)
+        self._defer_counts.pop(req.params["taskId"], None)
         return {"ok": True}
 
     # ----------------------------------------------------------------- loop
@@ -136,6 +150,7 @@ class Scheduler(JsonService):
                 continue
             try:
                 self._schedule(task)
+                self._defer_counts.pop(task.job_id, None)
             except KubeMLException as e:
                 if e.status_code == 503:
                     # no capacity (e.g. every device partition leased):
@@ -150,7 +165,11 @@ class Scheduler(JsonService):
                     # park THIS task with a not-before backoff; other
                     # queued tasks keep dispatching at full rate (an
                     # inline sleep here would stall the whole loop)
-                    self._deferred.append((time.monotonic() + 0.5, task))
+                    n = self._defer_counts.get(task.job_id, 0)
+                    self._defer_counts[task.job_id] = n + 1
+                    delay = min(DEFER_CAP_S, DEFER_BASE_S * (2 ** n)) \
+                        * (0.75 + 0.5 * random.random())
+                    self._deferred.append((time.monotonic() + delay, task))
                 else:
                     logger.exception("scheduling task %s failed",
                                      task.job_id)

@@ -1,0 +1,224 @@
+"""Deterministic fault-injection harness.
+
+The round-hook chaos tooling (utils/chaos.py) knocks out workers at
+RANDOM — good for soak runs, useless for asserting exact recovery
+behavior. A FaultPlan instead injects failures at NAMED (epoch, round,
+worker) coordinates, parsed from `TrainOptions.fault_plan`, so every
+injected failure is reproducible bit-for-bit in tier-1 CPU tests:
+
+    {"events": [
+        {"kind": "nan",     "epoch": 0, "round": 2, "worker": 1},
+        {"kind": "dropout", "epoch": 1, "round": 0, "worker": 3},
+        {"kind": "slow",    "round": 4, "duration_s": 0.2},
+        {"kind": "crash",   "epoch": 1, "round": 0},
+        {"kind": "corrupt_checkpoint", "epoch": 2, "round": 0}
+    ]}
+
+(the top-level {"events": [...]} wrapper is optional — a bare list
+parses too). Coordinate -1 (the default) is a wildcard: every epoch /
+every round / all workers. There is NO wall-clock randomness anywhere in
+this module — an injection either fires at its coordinates or it does
+not (tools/check_fault_tests.py lints the test suite for violations).
+
+Event kinds:
+
+  nan      poison the target worker's float batch leaves with NaN BEFORE
+           staging, so its K local steps go non-finite and the on-device
+           merge guard (parallel/kavg.py) must drop it. Under the syncdp
+           engine the poisoned samples make the GLOBAL gradient
+           non-finite, exercising the skip-step path instead.
+  dropout  zero the target worker's mask bit for the round — the classic
+           "function died mid-epoch" injection, but at exact coordinates.
+  crash    os._exit(CRASH_EXIT_CODE) at the round — exercises the PS
+           standalone watchdog end-to-end. Fires only in the job's FIRST
+           incarnation (a resumed process suppresses it, otherwise the
+           deterministic coordinates would crash every restart into a
+           loop); pending async checkpoint saves are drained first so
+           the restart point is deterministic, not a race against the
+           background writer.
+  corrupt_checkpoint
+           truncate the published checkpoint's weights.npz — drives the
+           reader fallback / next-save-repairs paths.
+  slow     time.sleep(duration_s) before dispatch — an artificial
+           straggler round (keep duration_s <= ~1 s in tier-1 tests).
+
+TrainJob wires the plan in automatically (train/job.py): it becomes the
+job's round hook (dropout/crash/slow/corrupt run post-staging) and wraps
+the staging transform (nan runs pre-staging — batch leaves are still
+host numpy there; post-staging they are immutable device arrays).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import logging
+import os
+import time
+from typing import Any, List, Optional
+
+import numpy as np
+
+logger = logging.getLogger("kubeml_tpu.faults")
+
+KINDS = ("nan", "dropout", "crash", "corrupt_checkpoint", "slow")
+
+# distinctive enough that a watchdog test can assert the death was the
+# injected crash, not an import error or OOM kill
+CRASH_EXIT_CODE = 23
+
+
+@dataclasses.dataclass
+class FaultEvent:
+    """One injection at (epoch, round, worker); -1 = wildcard."""
+
+    kind: str
+    epoch: int = -1
+    round: int = -1
+    worker: int = -1
+    duration_s: float = 0.0   # slow events only
+
+    def matches(self, epoch: int, rnd: int) -> bool:
+        return ((self.epoch < 0 or self.epoch == epoch)
+                and (self.round < 0 or self.round == rnd))
+
+
+class FaultPlan:
+    """A parsed, coordinate-driven fault schedule (callable round hook).
+
+    The owning TrainJob sets `epoch` at the top of each epoch and calls
+    `bind(job)` once at init (which also decides `is_restart` — crash
+    suppression for resumed incarnations). `injected` counts fired
+    events by kind, for tests and the bench's faulted arm.
+    """
+
+    def __init__(self, events: List[FaultEvent]):
+        self.events = events
+        self.epoch = 0
+        self.is_restart = False
+        self._job: Optional[Any] = None
+        self.injected = {k: 0 for k in KINDS}
+
+    @classmethod
+    def parse(cls, spec: Any) -> "FaultPlan":
+        """Parse a JSON string / dict / list of event dicts."""
+        if isinstance(spec, FaultPlan):
+            return spec
+        if isinstance(spec, str):
+            spec = json.loads(spec)
+        if isinstance(spec, dict):
+            spec = spec.get("events", [])
+        if not isinstance(spec, list):
+            raise ValueError("fault_plan must be a list of events or "
+                             "{'events': [...]}")
+        events = []
+        for e in spec:
+            kind = e.get("kind")
+            if kind not in KINDS:
+                raise ValueError(f"unknown fault kind {kind!r}; "
+                                 f"expected one of {KINDS}")
+            events.append(FaultEvent(
+                kind=kind,
+                epoch=int(e.get("epoch", -1)),
+                round=int(e.get("round", -1)),
+                worker=int(e.get("worker", -1)),
+                duration_s=float(e.get("duration_s", 0.0)),
+            ))
+        return cls(events)
+
+    def bind(self, job) -> None:
+        self._job = job
+        self.is_restart = bool(
+            job.req.resume_from and job.req.resume_from == job.task.job_id)
+
+    def has(self, kind: str) -> bool:
+        return any(ev.kind == kind for ev in self.events)
+
+    def _active(self, kind: str, rnd: int):
+        return [ev for ev in self.events
+                if ev.kind == kind and ev.matches(self.epoch, rnd)]
+
+    # ------------------------------------------------------- pre-staging
+
+    def inject_batch(self, rb):
+        """NaN bursts: poison the target worker's float batch leaves.
+
+        Runs in the prefetch feeder BEFORE staging, while the leaves are
+        still host numpy — the only point where batch contents are
+        mutable (post-staging they are device arrays)."""
+        events = self._active("nan", rb.round_index)
+        if not events:
+            return rb
+        batch = {k: np.array(v, copy=True)
+                 if np.issubdtype(np.asarray(v).dtype, np.floating) else v
+                 for k, v in rb.batch.items()}
+        for ev in events:
+            for k, v in batch.items():
+                if not np.issubdtype(v.dtype, np.floating):
+                    continue
+                if ev.worker < 0:
+                    v[...] = np.nan
+                else:
+                    v[ev.worker] = np.nan
+            self.injected["nan"] += 1
+            logger.info("fault nan: epoch %d round %d worker %s",
+                        self.epoch, rb.round_index,
+                        "ALL" if ev.worker < 0 else ev.worker)
+        return dataclasses.replace(rb, batch=batch)
+
+    # ------------------------------------------------------ post-staging
+
+    def __call__(self, rb):
+        """Round hook: dropout / slow / corrupt_checkpoint / crash."""
+        rnd = rb.round_index
+        mask = None
+        for ev in self._active("dropout", rnd):
+            mask = rb.worker_mask.copy() if mask is None else mask
+            if ev.worker < 0:
+                mask[:] = 0.0
+            else:
+                mask[ev.worker] = 0.0
+            self.injected["dropout"] += 1
+            logger.info("fault dropout: epoch %d round %d worker %s",
+                        self.epoch, rnd,
+                        "ALL" if ev.worker < 0 else ev.worker)
+        for ev in self._active("slow", rnd):
+            self.injected["slow"] += 1
+            logger.info("fault slow: epoch %d round %d sleeping %.3fs",
+                        self.epoch, rnd, ev.duration_s)
+            time.sleep(ev.duration_s)
+        if self._active("corrupt_checkpoint", rnd):
+            self._corrupt_checkpoint(rnd)
+        if self._active("crash", rnd) and not self.is_restart:
+            self._crash(rnd)
+        if mask is not None:
+            return dataclasses.replace(rb, worker_mask=mask)
+        return rb
+
+    def _corrupt_checkpoint(self, rnd: int) -> None:
+        from kubeml_tpu.api.const import kubeml_home
+        if self._job is None:
+            return
+        path = os.path.join(kubeml_home(), "models",
+                            self._job.task.job_id, "weights.npz")
+        if os.path.isfile(path):
+            with open(path, "wb") as f:
+                f.write(b"corrupted-by-fault-plan")
+            self.injected["corrupt_checkpoint"] += 1
+            logger.warning("fault corrupt_checkpoint: epoch %d round %d "
+                           "truncated %s", self.epoch, rnd, path)
+
+    def _crash(self, rnd: int) -> None:
+        job = self._job
+        if job is not None:
+            try:
+                # drain pending async saves so the restart resumes from a
+                # deterministic checkpoint, not a race with the writer
+                job._checkpointer.wait()
+            except Exception:
+                pass
+        self.injected["crash"] += 1
+        logger.warning("fault crash: epoch %d round %d — os._exit(%d)",
+                       self.epoch, rnd, CRASH_EXIT_CODE)
+        logging.shutdown()
+        os._exit(CRASH_EXIT_CODE)

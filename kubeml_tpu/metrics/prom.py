@@ -71,9 +71,28 @@ class MetricsRegistry:
         self.running_total = Gauge(
             "kubeml_job_running_total", "Number of running tasks by type",
             "type")
+        # fault-tolerance series (net-new vs metrics.go): per-job
+        # non-finite drops / quarantines from the guarded merge, and the
+        # watchdog restart counters — per-job (cleared at finish like
+        # every job series) plus a PS-lifetime total that persists
+        self.dropped_workers = Gauge(
+            "kubeml_job_dropped_workers",
+            "Worker updates dropped for non-finite values in the last "
+            "epoch of a job", "jobid")
+        self.quarantined_workers = Gauge(
+            "kubeml_job_quarantined_workers",
+            "Workers quarantined for repeated non-finite updates in the "
+            "last epoch of a job", "jobid")
+        self.restarts = Gauge(
+            "kubeml_job_restarts",
+            "Watchdog restarts of a job's standalone process", "jobid")
+        self.restarts_total = Gauge(
+            "kubeml_ps_restarts_total",
+            "Total watchdog restarts since the PS started", "type")
         self._job_gauges = [self.validation_loss, self.validation_accuracy,
                             self.train_loss, self.parallelism,
-                            self.epoch_duration]
+                            self.epoch_duration, self.dropped_workers,
+                            self.quarantined_workers, self.restarts]
 
     def update_job(self, m) -> None:
         """Apply a MetricUpdate (ml/pkg/ps/metrics.go:90-99)."""
@@ -82,11 +101,21 @@ class MetricsRegistry:
         self.train_loss.set(m.job_id, m.train_loss)
         self.parallelism.set(m.job_id, m.parallelism)
         self.epoch_duration.set(m.job_id, m.epoch_duration)
+        self.dropped_workers.set(m.job_id, m.dropped_workers)
+        self.quarantined_workers.set(m.job_id, m.quarantined_workers)
+
+    def note_restart(self, job_id: str) -> None:
+        """One watchdog restart: bump the per-job gauge and the
+        PS-lifetime total (the total survives clear_job, so a crashy
+        job's history stays visible after it finishes)."""
+        self.restarts.inc(job_id)
+        self.restarts_total.inc("standalone")
 
     def clear_job(self, job_id: str) -> None:
         for g in self._job_gauges:
             g.clear(job_id)
 
     def exposition(self) -> str:
-        gauges = self._job_gauges + [self.running_total]
+        gauges = self._job_gauges + [self.running_total,
+                                     self.restarts_total]
         return "\n".join(g.collect() for g in gauges) + "\n"

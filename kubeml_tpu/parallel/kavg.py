@@ -19,7 +19,12 @@ Semantics preserved exactly from the reference:
     (python/kubeml/kubeml/network.py:208-217 `_reset_optimizer_state`);
   - the average is taken over the workers that actually contributed
     ("merge with whoever reported", straggler/failure tolerance of
-    ml/pkg/train/util.go:144-166) — here a 0/1 worker mask;
+    ml/pkg/train/util.go:144-166) — here a 0/1 worker mask, ANDed
+    on-device with a per-worker all-leaves-finite flag: a worker whose
+    K local steps produced NaN/Inf weights or loss is dropped from the
+    merge exactly as if its mask bit had been 0 (the numerical analogue
+    of the survivor-merge, per-worker skip-step a la mixed-precision
+    training), and the drop is reported via RoundStats.dropped_device;
   - integer leaves (e.g. a BatchNorm step counter) are averaged in float
     and truncated back, matching ParallelSGD.Average's int64 handling
     (ml/pkg/model/parallelSGD.go:40-52);
@@ -62,21 +67,28 @@ TxFactory = Callable[[jax.Array, jax.Array], optax.GradientTransformation]
 class RoundStats:
     """Host-side view of one sync round's outcome.
 
-    `loss_sum` materializes LAZILY: reading it blocks on the round and
-    costs a device->host readback (tens of ms on tunneled backends), so
-    dispatch loops should accumulate `loss_sum_device` on device and read
-    back once per epoch. `step_count`, `sample_count`, and `contributors`
-    are host-derived from the masks (free — the merge's contributor count
-    is exactly `worker_mask.sum()`).
+    `loss_sum` and `dropped` materialize LAZILY: reading either blocks on
+    the round and costs a device->host readback (tens of ms on tunneled
+    backends), so dispatch loops should accumulate `loss_sum_device` /
+    `dropped_device` on device and read back once per epoch. `step_count`
+    and `sample_count` are host-derived from the masks (free).
+    `contributors` counts the workers that actually MERGED: the host
+    mask sum minus the on-device non-finite drops, so reading it also
+    synchronizes whenever a `dropped_device` is attached.
     """
 
     def __init__(self, loss_sum_device: jax.Array, step_count: np.ndarray,
                  sample_count: np.ndarray, contributors: float,
-                 compiled: bool = False):
+                 compiled: bool = False,
+                 dropped_device: Optional[jax.Array] = None):
         self.loss_sum_device = loss_sum_device    # [W] device array
         self.step_count = step_count              # [W] real local steps
         self.sample_count = sample_count          # [W] real samples
-        self.contributors = contributors          # workers merged
+        self.planned_contributors = contributors  # host mask sum
+        # [W] (or [R, W]) device array of 0/1 flags: 1 = the worker was
+        # masked in but produced a non-finite update and was dropped from
+        # the merge by the on-device guard
+        self.dropped_device = dropped_device
         # True when this dispatch built (traced + XLA-compiled) a new
         # round program — the job subtracts such rounds from the epoch
         # duration it reports to the throughput policy, so compile time
@@ -84,6 +96,7 @@ class RoundStats:
         # epoch time ~= steady state; on TPU only non-compile rounds are)
         self.compiled = compiled
         self._loss_sum: Optional[np.ndarray] = None
+        self._dropped: Optional[np.ndarray] = None
 
     @property
     def loss_sum(self) -> np.ndarray:
@@ -91,6 +104,24 @@ class RoundStats:
         if self._loss_sum is None:
             self._loss_sum = np.asarray(self.loss_sum_device)
         return self._loss_sum
+
+    @property
+    def dropped(self) -> np.ndarray:
+        """[W] (or [R, W]) non-finite drop flags (synchronizing)."""
+        if self._dropped is None:
+            if self.dropped_device is None:
+                self._dropped = np.zeros_like(
+                    np.asarray(self.step_count, dtype=np.float32))
+            else:
+                self._dropped = np.asarray(self.dropped_device)
+        return self._dropped
+
+    @property
+    def contributors(self) -> float:
+        """Workers merged = planned (mask sum) - non-finite drops."""
+        if self.dropped_device is None:
+            return self.planned_contributors
+        return float(self.planned_contributors - self.dropped.sum())
 
     def __repr__(self):
         return (f"RoundStats(steps={self.step_count.sum():.0f}, "
@@ -113,6 +144,20 @@ def _select_tree(mask: jax.Array, new: PyTree, old: PyTree) -> PyTree:
     """Elementwise tree select: mask==1 -> new, else old (masked step)."""
     return jax.tree_util.tree_map(
         lambda n, o: jnp.where(mask.astype(jnp.bool_), n, o), new, old)
+
+
+def tree_all_finite(tree: PyTree) -> jax.Array:
+    """Scalar bool: every floating leaf of `tree` is finite.
+
+    Integer leaves (e.g. BatchNorm step counters) cannot go non-finite
+    and are skipped. Shared by the kavg merge guard and the sync-DP
+    skip-step so "worker went non-finite" means the same thing in both
+    engines."""
+    ok = jnp.bool_(True)
+    for leaf in jax.tree_util.tree_leaves(tree):
+        if jnp.issubdtype(jnp.asarray(leaf).dtype, jnp.inexact):
+            ok = jnp.logical_and(ok, jnp.isfinite(leaf).all())
+    return ok
 
 
 def masked_scalar_loss(loss_fn: LossFn, model_state: PyTree, batch: PyTree,
@@ -331,6 +376,8 @@ class KAvgEngine:
             contrib = jax.tree_util.tree_map(
                 lambda x: jnp.zeros_like(x, dtype=jnp.float32), variables)
             loss_sums = []
+            dropped = []
+            eff_count = jnp.float32(0.0)
             for v in range(w_per_lane):  # static unroll, w_per_lane is tiny
                 chunk = {
                     "batch": jax.tree_util.tree_map(lambda x: x[v], batch),
@@ -340,12 +387,24 @@ class KAvgEngine:
                 }
                 new_vars, loss_sum = run_chunk(variables, chunk, lr, epoch)
                 wm = worker_mask[v]
+                # merge guard: a worker whose K local steps produced ANY
+                # non-finite weight (or a non-finite loss) is dropped from
+                # the merge exactly as if its mask bit had been 0 — the
+                # TPU-native "merge with whoever reported". The drop must
+                # be a jnp.where SELECT, not a multiply: NaN * 0 == NaN,
+                # so masking by multiplication would poison the psum for
+                # every worker (the exact failure this guard exists for).
+                ok = jnp.logical_and(tree_all_finite(new_vars),
+                                     jnp.isfinite(loss_sum))
+                okf = ok.astype(jnp.float32)
                 contrib = jax.tree_util.tree_map(
-                    lambda c, n: c + n.astype(jnp.float32) * wm,
-                    contrib, new_vars)
-                loss_sums.append(loss_sum * wm)
+                    lambda c, n: c + jnp.where(ok, n, 0).astype(jnp.float32)
+                    * wm, contrib, new_vars)
+                loss_sums.append(jnp.where(ok, loss_sum, 0.0) * wm)
+                dropped.append(wm * (1.0 - okf))
+                eff_count = eff_count + wm * okf
 
-            raw_count = lax.psum(worker_mask.sum(), DATA_AXIS)
+            raw_count = lax.psum(eff_count, DATA_AXIS)
             count = jnp.maximum(raw_count, 1.0)  # guard 0-contributor divide
             merge_dtype = self.merge_dtype
             use_ring = self._compressed_ring
@@ -374,11 +433,22 @@ class KAvgEngine:
                     else:
                         s = lax.psum(c.astype(merge_dtype), DATA_AXIS
                                      ).astype(jnp.float32)
-                    return (s / count).astype(ref.dtype)
-                return (lax.psum(c, DATA_AXIS) / count).astype(ref.dtype)
+                    merged = (s / count).astype(ref.dtype)
+                else:
+                    merged = (lax.psum(c, DATA_AXIS) / count
+                              ).astype(ref.dtype)
+                # every contributor dropped (all workers non-finite this
+                # round): contrib is all-zero and dividing by the clamped
+                # count would SILENTLY ZERO the weights. Carry the round-
+                # start variables forward instead — the round becomes a
+                # no-op and the job-level abort_after policy decides
+                # whether to keep going. For raw_count > 0 the select
+                # picks the identical merged value, so the normal path
+                # stays bit-identical.
+                return jnp.where(raw_count > 0, merged, ref)
 
             avg = jax.tree_util.tree_map(merge_leaf, contrib, variables)
-            return avg, jnp.stack(loss_sums)
+            return avg, (jnp.stack(loss_sums), jnp.stack(dropped))
 
         return lane_fn
 
@@ -389,7 +459,7 @@ class KAvgEngine:
             in_specs=(P(), self._batch_in_specs(batch_template),
                       P(DATA_AXIS), P(DATA_AXIS),
                       P(DATA_AXIS), P(DATA_AXIS), P(), P()),
-            out_specs=(P(), P(DATA_AXIS)),
+            out_specs=(P(), (P(DATA_AXIS), P(DATA_AXIS))),
             **self._shmap_kwargs())
         donate = (0,) if self.donate else ()
         return jax.jit(sharded, donate_argnums=donate)
@@ -427,7 +497,7 @@ class KAvgEngine:
             in_specs=(P(), batch_specs,
                       lift(P(DATA_AXIS)), lift(P(DATA_AXIS)),
                       lift(P(DATA_AXIS)), lift(P(DATA_AXIS)), P(), P()),
-            out_specs=(P(), lift(P(DATA_AXIS))),
+            out_specs=(P(), (lift(P(DATA_AXIS)), lift(P(DATA_AXIS)))),
             **self._shmap_kwargs())
         donate = (0,) if self.donate else ()
         return jax.jit(sharded, donate_argnums=donate)
@@ -455,7 +525,7 @@ class KAvgEngine:
         if compiled:
             self._train_cache[key] = self._build_train_rounds(
                 w_per_lane, batch_template=batch)
-        avg, loss_sums = self._train_cache[key](
+        avg, (loss_sums, dropped) = self._train_cache[key](
             variables, batch,
             jnp.asarray(sample_mask, jnp.float32),
             jnp.asarray(step_mask, jnp.float32),
@@ -468,6 +538,7 @@ class KAvgEngine:
             sample_count=np.asarray(sample_mask).sum(axis=(2, 3)),
             contributors=float(np.asarray(worker_mask).sum()),
             compiled=compiled,
+            dropped_device=dropped,
         )
         return avg, stats
 
@@ -495,7 +566,7 @@ class KAvgEngine:
 
         # shard_map slices dim 0 contiguously: lane d owns virtual workers
         # [d*W/D, (d+1)*W/D) — matching the reference's contiguous doc shards.
-        avg, loss_sums = self._train_cache[key](
+        avg, (loss_sums, dropped) = self._train_cache[key](
             variables, batch,
             jnp.asarray(sample_mask, jnp.float32),
             jnp.asarray(step_mask, jnp.float32),
@@ -508,6 +579,7 @@ class KAvgEngine:
             sample_count=np.asarray(sample_mask).sum(axis=(1, 2)),
             contributors=float(np.asarray(worker_mask).sum()),
             compiled=compiled,
+            dropped_device=dropped,
         )
         return avg, stats
 
@@ -552,7 +624,7 @@ class KAvgEngine:
             in_specs=(P(), self._cache_in_specs(cache),
                       P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS),
                       P(DATA_AXIS), P(DATA_AXIS), P(), P()),
-            out_specs=(P(), P(DATA_AXIS)),
+            out_specs=(P(), (P(DATA_AXIS), P(DATA_AXIS))),
             **self._shmap_kwargs())
         # donate only the variables — the cache (arg 1) must outlive
         # every round of the job
@@ -584,7 +656,7 @@ class KAvgEngine:
                       lift(P(DATA_AXIS)), lift(P(DATA_AXIS)),
                       lift(P(DATA_AXIS)), lift(P(DATA_AXIS)),
                       lift(P(DATA_AXIS)), P(), P()),
-            out_specs=(P(), lift(P(DATA_AXIS))),
+            out_specs=(P(), (lift(P(DATA_AXIS)), lift(P(DATA_AXIS)))),
             **self._shmap_kwargs())
         donate = (0,) if self.donate else ()
         return jax.jit(sharded, donate_argnums=donate)
@@ -612,7 +684,7 @@ class KAvgEngine:
         if compiled:
             self._train_cache[key] = self._build_train_round_indexed(
                 w_per_lane, cache)
-        avg, loss_sums = self._train_cache[key](
+        avg, (loss_sums, dropped) = self._train_cache[key](
             variables, cache.arrays,
             jnp.asarray(idx, jnp.int32),
             jnp.asarray(sample_mask, jnp.float32),
@@ -626,6 +698,7 @@ class KAvgEngine:
             sample_count=np.asarray(sample_mask).sum(axis=(1, 2)),
             contributors=float(np.asarray(worker_mask).sum()),
             compiled=compiled,
+            dropped_device=dropped,
         )
         return avg, stats
 
@@ -650,7 +723,7 @@ class KAvgEngine:
         if compiled:
             self._train_cache[key] = self._build_train_rounds_indexed(
                 w_per_lane, cache)
-        avg, loss_sums = self._train_cache[key](
+        avg, (loss_sums, dropped) = self._train_cache[key](
             variables, cache.arrays,
             jnp.asarray(idx, jnp.int32),
             jnp.asarray(sample_mask, jnp.float32),
@@ -664,6 +737,7 @@ class KAvgEngine:
             sample_count=np.asarray(sample_mask).sum(axis=(2, 3)),
             contributors=float(np.asarray(worker_mask).sum()),
             compiled=compiled,
+            dropped_device=dropped,
         )
         return avg, stats
 

@@ -52,7 +52,8 @@ import optax
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from kubeml_tpu.parallel.kavg import _select_tree, masked_scalar_loss
+from kubeml_tpu.parallel.kavg import (_select_tree, masked_scalar_loss,
+                                      tree_all_finite)
 from kubeml_tpu.parallel.mesh import DATA_AXIS
 
 PyTree = Any
@@ -89,6 +90,12 @@ class SyncDPEngine:
         # most recent train_steps built a new program — the job excludes
         # such rounds from the duration the throughput policy sees
         self.last_compiled = False
+        # [S] device array of 0/1 flags from the most recent train_steps:
+        # 1 = the global gradient went non-finite and the optimizer update
+        # was SKIPPED (params/opt state carried forward unchanged — the
+        # skip-step practice of mixed-precision training). Kept on device;
+        # accumulate and read back once per epoch like RoundStats.
+        self.last_skipped_device: Optional[jax.Array] = None
 
     # ----------------------------------------------------------------- state
 
@@ -150,9 +157,18 @@ class SyncDPEngine:
                                        smask), has_aux=True)(params)
                 updates, new_opt = tx.update(grads, opt_state, params)
                 new_params = optax.apply_updates(params, updates)
+                # skip-step guard: when the GLOBAL (all-reduced) gradient
+                # or the loss is non-finite, the whole step is a no-op —
+                # params and optimizer state carry forward unchanged, the
+                # sync-DP analogue of the kavg merge guard. The select
+                # already isolates the poisoned new_params, so no NaN
+                # escapes into the carry.
+                grads_ok = jnp.logical_and(tree_all_finite(grads),
+                                           jnp.isfinite(loss))
+                real = (smask.sum() > 0).astype(jnp.float32)
                 # an all-masked step (ragged epoch tail) must be a true
                 # no-op: zero grads alone would still move adam's momentum
-                stmask = (smask.sum() > 0).astype(jnp.float32)
+                stmask = real * grads_ok.astype(jnp.float32)
                 new_params = _select_tree(stmask, new_params, params)
                 new_state = _select_tree(stmask, new_state, model_state)
                 new_opt = _select_tree(stmask, new_opt, opt_state)
@@ -165,14 +181,19 @@ class SyncDPEngine:
                     lambda x, spec: lax.with_sharding_constraint(
                         x, NamedSharding(mesh, spec)),
                     new_params, param_specs)
-                return (new_params, new_state, new_opt), loss
+                # a skipped step reports loss 0 (a NaN entry would poison
+                # the epoch's on-device loss accumulation) and flags
+                # itself; only REAL steps can be "skipped"
+                loss_out = jnp.where(grads_ok, loss, 0.0) * real
+                skipped = real * (1.0 - grads_ok.astype(jnp.float32))
+                return (new_params, new_state, new_opt), (loss_out, skipped)
 
-            (params, model_state, opt_state), losses = lax.scan(
+            (params, model_state, opt_state), (losses, skipped) = lax.scan(
                 step, (state["params"], state["model_state"],
                        state["opt_state"]),
                 (batch, sample_mask, rngs))
             return {"params": params, "model_state": model_state,
-                    "opt_state": opt_state}, losses
+                    "opt_state": opt_state}, losses, skipped
 
         return run
 
@@ -184,7 +205,9 @@ class SyncDPEngine:
         batch leaves [S, B, ...] with B the GLOBAL batch (B % data-axis
         == 0); sample_mask [S, B] 1 = real example; rngs [S, 2] uint32 key
         data. Returns (new state, per-step mean losses [S], a device
-        array — read back lazily)."""
+        array — read back lazily). Steps whose global gradient went
+        non-finite are no-ops (loss reported 0); their flags land in
+        `last_skipped_device`."""
         if self._opt_specs is None:
             raise ValueError("call init_state() first")
         lead = jax.tree_util.tree_leaves(batch)[0]
@@ -218,12 +241,14 @@ class SyncDPEngine:
                 # pin outputs to the input layout: without this GSPMD may
                 # return params/opt leaves in whatever sharding propagation
                 # settled on, and the NEXT dispatch's in_shardings mismatch
-                out_shardings=(state_sh, rep),
+                out_shardings=(state_sh, rep, rep),
                 donate_argnums=(0,) if self.donate else ())
-        return self._cache[key](
+        state, losses, skipped = self._cache[key](
             state, batch, jnp.asarray(sample_mask, jnp.float32),
             jnp.asarray(rngs, jnp.uint32), jnp.float32(lr),
             jnp.int32(epoch))
+        self.last_skipped_device = skipped
+        return state, losses
 
     # ------------------------------------------------------ index-fed train
 
@@ -290,11 +315,13 @@ class SyncDPEngine:
                                     cache),
                 in_shardings=(state_sh, cache_sh, idx_sh, mask_sh, rep,
                               rep, rep),
-                out_shardings=(state_sh, rep),
+                out_shardings=(state_sh, rep, rep),
                 # donate only the state; the cache must outlive the job
                 donate_argnums=(0,) if self.donate else ())
-        return self._cache[key](
+        state, losses, skipped = self._cache[key](
             state, cache.arrays, jnp.asarray(idx, jnp.int32),
             jnp.asarray(sample_mask, jnp.float32),
             jnp.asarray(rngs, jnp.uint32), jnp.float32(lr),
             jnp.int32(epoch))
+        self.last_skipped_device = skipped
+        return state, losses

@@ -86,6 +86,79 @@ class JobCallbacks:
     on_finish: Callable[[str, Optional[str]], None] = lambda job_id, err: None
 
 
+class _NonFiniteGuard:
+    """Per-epoch host policy over the engine's on-device drop flags.
+
+    The merge guard (parallel/kavg.py) already protects every round; this
+    layer adds the JOB-level policy on top: a worker dropped for
+    `quarantine_after` consecutive rounds is masked out for the rest of
+    the epoch (a host-side mask-content edit between dispatches — shapes
+    are unchanged, so no retrace), and when EVERY contributing worker is
+    non-finite for `abort_after` consecutive rounds (a counter owned by
+    the job — frozen weights persist across epochs, so the streak does
+    too) the job fails with a diagnostic instead of silently "training"
+    on weights no round can move. Reading the per-round [W] drop flags
+    synchronizes on each round, which is why the whole layer is opt-in
+    (TrainOptions.quarantine_after / abort_after, default 0 = off).
+    """
+
+    def __init__(self, job, quarantine_after: int, abort_after: int):
+        self.job = job
+        self.quarantine_after = quarantine_after
+        self.abort_after = abort_after
+        self._consec: Optional[np.ndarray] = None   # [W] drop streaks
+        self.quarantined: Optional[np.ndarray] = None  # [W] 0/1
+        self.dropped_total = 0.0
+
+    def apply(self, rb):
+        """Mask quarantined workers out of the round before dispatch."""
+        if self.quarantined is None or not self.quarantined.any():
+            return rb
+        mask = rb.worker_mask * (1.0 - self.quarantined)
+        if mask.sum() < 1:
+            raise MergeError(
+                f"round {rb.round_index}: every worker is quarantined "
+                "for repeated non-finite updates")
+        return dataclasses.replace(rb, worker_mask=mask)
+
+    def observe(self, stats, rb) -> None:
+        """Fold one round's drop flags into the streak counters."""
+        dropped = stats.dropped  # [W] device readback (see class doc)
+        if self._consec is None:
+            self._consec = np.zeros(dropped.shape[0])
+            self.quarantined = np.zeros(dropped.shape[0], np.float32)
+        self.dropped_total += float(dropped.sum())
+        active = rb.worker_mask > 0
+        hit = (dropped > 0) & active
+        self._consec = np.where(hit, self._consec + 1, 0.0)
+        if self.quarantine_after > 0:
+            newq = ((self._consec >= self.quarantine_after)
+                    & (self.quarantined == 0))
+            if newq.any():
+                self.quarantined[newq] = 1.0
+                self.job._log(
+                    "job %s quarantined workers %s after %d consecutive "
+                    "non-finite rounds (rest of epoch)",
+                    self.job.task.job_id,
+                    np.flatnonzero(newq).tolist(), self.quarantine_after)
+        if active.any() and hit[active].all():
+            self.job._all_dropped_rounds += 1
+        else:
+            self.job._all_dropped_rounds = 0
+        if 0 < self.abort_after <= self.job._all_dropped_rounds:
+            raise KubeMLException(
+                f"aborting job {self.job.task.job_id}: every contributing "
+                f"worker produced non-finite updates for "
+                f"{self.job._all_dropped_rounds} consecutive rounds "
+                f"(abort_after={self.abort_after}) — the model has "
+                "diverged and no merge can move the weights", 500)
+
+    @property
+    def quarantined_count(self) -> int:
+        return (int(self.quarantined.sum())
+                if self.quarantined is not None else 0)
+
+
 class TrainJob:
     def __init__(self, task: TrainTask, model: KubeModel,
                  dataset: KubeDataset, mesh,
@@ -113,6 +186,17 @@ class TrainJob:
         # (SURVEY.md §5), its failure tolerance was only exercised by
         # real pod deaths
         self.round_hook = round_hook
+        # deterministic fault injection (kubeml_tpu/faults.py), parsed
+        # from TrainOptions.fault_plan in _init_model; composes with an
+        # explicitly passed round_hook (plan fires first)
+        self._fault_plan = None
+        # fault-tolerance counters: the all-workers-dropped streak spans
+        # epochs (frozen weights persist across the epoch boundary, so
+        # the abort_after streak must too); the per-epoch totals are
+        # consumed by train() into history + the metric push
+        self._all_dropped_rounds = 0
+        self._epoch_dropped = 0.0
+        self._epoch_quarantined = 0
         self._checkpointer = AsyncCheckpointer()
         self.tracer = Tracer()  # host-phase spans, summarized per epoch
         self.stop_event = threading.Event()
@@ -243,10 +327,15 @@ class TrainJob:
                 self.history.accuracy.append(accuracy)
                 self.history.parallelism.append(used_parallelism)
                 self.history.epoch_duration.append(elapsed)
+                self.history.dropped_workers.append(self._epoch_dropped)
+                self.history.quarantined_workers.append(
+                    self._epoch_quarantined)
                 self.callbacks.publish_metrics(MetricUpdate(
                     job_id=job_id, validation_loss=val_loss,
                     accuracy=accuracy, train_loss=train_loss,
-                    parallelism=used_parallelism, epoch_duration=elapsed))
+                    parallelism=used_parallelism, epoch_duration=elapsed,
+                    dropped_workers=self._epoch_dropped,
+                    quarantined_workers=self._epoch_quarantined))
                 self._log("job %s epoch %d/%d loss=%.4f val=%.4f acc=%.2f "
                             "N=%d %.2fs [%s]", job_id, epoch + 1, epochs,
                             train_loss, val_loss, accuracy, used_parallelism,
@@ -379,6 +468,25 @@ class TrainJob:
             raise KubeMLException(
                 f"unknown training engine {engine_kind!r}; "
                 f"expected 'kavg' or 'syncdp'", 400)
+        if opts.quarantine_after < 0 or opts.abort_after < 0:
+            raise KubeMLException(
+                "quarantine_after and abort_after must be >= 0 "
+                f"(got {opts.quarantine_after}, {opts.abort_after})", 400)
+        if opts.fault_plan:
+            from kubeml_tpu.faults import FaultPlan
+            try:
+                plan = FaultPlan.parse(opts.fault_plan)
+            except (ValueError, KeyError, TypeError) as e:
+                raise KubeMLException(f"invalid fault_plan: {e}", 400)
+            plan.bind(self)
+            self._fault_plan = plan
+            if self.round_hook is None:
+                self.round_hook = plan
+            else:
+                # plan fires first so an explicit hook observes the
+                # faulted round, mirroring what the engine will see
+                user_hook = self.round_hook
+                self.round_hook = lambda rb: user_hook(plan(rb))
 
         # ---- inner mesh axes (job-level TP / SP / PP / EP; net-new)
         n_model = max(1, int(opts.n_model))
@@ -704,6 +812,18 @@ class TrainJob:
                 f"got {mode!r}", 400)
         if mode == "off":
             return
+        if self._fault_plan is not None and self._fault_plan.has("nan"):
+            # index-fed rounds dispatch int32 indices — there is no host
+            # float batch for a NaN burst to poison, so the injection
+            # point the plan was written against would silently vanish
+            if mode == "on":
+                raise KubeMLException(
+                    "device_cache='on' is incompatible with fault_plan "
+                    "'nan' events: index-fed rounds carry no host float "
+                    "batch to poison", 400)
+            self._log("job %s device cache disabled: fault_plan injects "
+                      "NaN into host batches", self.task.job_id)
+            return
         from kubeml_tpu.data.device_cache import DeviceDatasetCache
         from kubeml_tpu.models.base import KubeDataset
         identity = (type(self.dataset).transform_train
@@ -820,7 +940,11 @@ class TrainJob:
                                1)))
         if R > 1 and (self.round_hook is not None
                       or jax.process_count() > 1
-                      or self._engine.batch_seq_dims):
+                      or self._engine.batch_seq_dims
+                      or self.req.options.quarantine_after > 0
+                      or self.req.options.abort_after > 0):
+            # quarantine/abort need per-round drop flags and per-round
+            # mask edits — per-round host control, like hooks
             return 1
         return R
 
@@ -925,9 +1049,25 @@ class TrainJob:
         # The zero-contributor check uses the host-side worker mask,
         # which fully determines the device contributor count.
         dev_losses = []
+        dev_dropped = []  # per-dispatch [W] drop counts, same discipline
         step_counts = np.zeros(0)
         round_times = []  # (dispatch seconds, rounds, compiled?) per dispatch
         group = self._rounds_per_dispatch()
+        opts = self.req.options
+        transform = self._stage_group
+        plan_f = self._fault_plan
+        if plan_f is not None:
+            plan_f.epoch = epoch
+            if plan_f.has("nan"):
+                # NaN bursts poison the HOST batch, so they wrap the
+                # staging transform (runs in the prefetch feeder, the
+                # only point where batch leaves are still mutable numpy)
+                transform = lambda rb: self._stage_group(
+                    plan_f.inject_batch(rb))
+        guard = None
+        if opts.quarantine_after > 0 or opts.abort_after > 0:
+            guard = _NonFiniteGuard(self, opts.quarantine_after,
+                                    opts.abort_after)
         cache = self._device_cache
         source = None
         if cache is not None:
@@ -944,7 +1084,7 @@ class TrainJob:
         # dispatch. The index-fed cached path shrinks each round's
         # in-flight payload from sample leaves to [W, S, B] int32
         # indices, so the multiplier stops mattering for HBM there.
-        for rb in self._epoch_round_iter(plan, epoch, self._stage_group,
+        for rb in self._epoch_round_iter(plan, epoch, transform,
                                          group=group, source=source):
             if isinstance(rb, RoundGroup):
                 with self.tracer.span("dispatch"):
@@ -970,7 +1110,13 @@ class TrainJob:
                 # one tiny eager sum per GROUP keeps the reducer's leaf
                 # shapes uniform with single rounds ([W])
                 dev_losses.append(stats.loss_sum_device.sum(axis=0))
+                dev_dropped.append(stats.dropped_device.sum(axis=0))
                 continue
+            if guard is not None:
+                # quarantined workers are masked out BEFORE dispatch (a
+                # mask-content edit, no retrace); raises when every
+                # worker is quarantined
+                rb = guard.apply(rb)
             with self.tracer.span("dispatch"):
                 t_r = time.time()
                 if cache is not None:
@@ -991,7 +1137,25 @@ class TrainJob:
             # reference's average-over-responders (util.go:82-98)
             step_counts += stats.step_count * rb.worker_mask
             dev_losses.append(stats.loss_sum_device)
+            if guard is not None:
+                # per-round [W] readback — the sync cost quarantine/abort
+                # opt into (class doc); may raise the abort diagnostic
+                guard.observe(stats, rb)
+            else:
+                dev_dropped.append(stats.dropped_device)
         self._note_round_times(round_times)
+        if guard is not None:
+            self._epoch_dropped = guard.dropped_total
+            self._epoch_quarantined = guard.quarantined_count
+        else:
+            # same once-per-epoch discipline as the loss: accumulate
+            # per-round device arrays, one stack+sum dispatch at the end
+            # (the reducer program is shared with the loss reduction —
+            # identical leaf count and [W] shapes)
+            self._epoch_dropped = float(np.asarray(
+                self._reduce_losses(dev_dropped)).sum()) \
+                if dev_dropped else 0.0
+            self._epoch_quarantined = 0
         with self.tracer.span("device_drain"):
             loss_sums = np.asarray(self._reduce_losses(dev_losses)) \
                 if dev_losses else np.zeros(0)
@@ -1015,8 +1179,17 @@ class TrainJob:
         plan = self._loader.plan(parallelism, self.req.options.k,
                                  self.req.batch_size)
         dev_losses = []
+        dev_skipped = []  # per-dispatch [S] skip flags (engine stash)
         real_steps = 0
         round_times = []
+        opts = self.req.options
+        transform = self._stage_batch_sync
+        plan_f = self._fault_plan
+        if plan_f is not None:
+            plan_f.epoch = epoch
+            if plan_f.has("nan"):
+                transform = lambda rb: self._stage_batch_sync(
+                    plan_f.inject_batch(rb))
         cache = self._device_cache
         source = None
         if cache is not None:
@@ -1030,8 +1203,7 @@ class TrainJob:
                 cache.ensure()
             self._log_cache_payload(W, S, B)
             source = self._loader.epoch_index_rounds(plan, epoch)
-        for rb in self._epoch_round_iter(plan, epoch,
-                                         self._stage_batch_sync,
+        for rb in self._epoch_round_iter(plan, epoch, transform,
                                          source=source):
             smask = (rb.sample_mask * rb.step_mask[:, :, None]
                      * rb.worker_mask[:, None, None])
@@ -1055,7 +1227,33 @@ class TrainJob:
                                     self._sync_engine.last_compiled))
             real_steps += int((smask_global.sum(axis=1) > 0).sum())
             dev_losses.append(losses)
+            dev_skipped.append(self._sync_engine.last_skipped_device)
+            if opts.abort_after > 0:
+                # opt-in per-dispatch readback (same sync cost the kavg
+                # guard pays): in syncdp "every worker non-finite" IS a
+                # skipped step — the global gradient went non-finite
+                sk = np.asarray(dev_skipped[-1])
+                realm = smask_global.sum(axis=1) > 0
+                for s in range(sk.shape[0]):
+                    if not realm[s]:
+                        continue
+                    if sk[s] > 0:
+                        self._all_dropped_rounds += 1
+                        if self._all_dropped_rounds >= opts.abort_after:
+                            raise KubeMLException(
+                                f"aborting job {self.task.job_id}: the "
+                                "global gradient was non-finite for "
+                                f"{self._all_dropped_rounds} consecutive "
+                                f"steps (abort_after={opts.abort_after}) "
+                                "— every step is a skip and the weights "
+                                "cannot move", 500)
+                    else:
+                        self._all_dropped_rounds = 0
         self._note_round_times(round_times)
+        skipped_total = float(np.asarray(
+            self._reduce_losses(dev_skipped)).sum()) if dev_skipped else 0.0
+        self._epoch_dropped = skipped_total
+        self._epoch_quarantined = 0
         with self.tracer.span("device_drain"):
             loss_sums = np.asarray(self._reduce_losses(dev_losses)) \
                 if dev_losses else np.zeros(0)
@@ -1064,9 +1262,11 @@ class TrainJob:
         # keep the variables view current for validate/checkpoint/infer
         # (refreshed every epoch: the next dispatch donates this state)
         self.variables = self._sync_engine.variables(self._sync_state)
-        # empty (all-masked) steps contributed 0 to the device sum, so
-        # dividing by the REAL step count gives the mean per-step loss
-        return float(loss_sums.sum()) / real_steps
+        # empty (all-masked) steps AND skipped (non-finite-gradient)
+        # steps contributed 0 to the device sum, so the divisor is the
+        # real steps that actually produced a finite loss
+        return float(loss_sums.sum()) / max(1, real_steps
+                                            - int(round(skipped_total)))
 
     def _validate(self, parallelism: int):
         if self._handle.test_samples == 0:

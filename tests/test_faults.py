@@ -1,0 +1,573 @@
+"""Fault-tolerant sync rounds: on-device non-finite guard, worker
+quarantine/abort policy, and the deterministic FaultPlan harness.
+
+Every test here is coordinate-driven (kubeml_tpu/faults.py): injections
+fire at named (epoch, round, worker) coordinates, never from wall-clock
+or unseeded randomness — tools/check_fault_tests.py lints this file for
+violations, and test_fault_test_lint below keeps the lint itself in the
+tier.
+"""
+
+import dataclasses
+import json
+import os
+import threading
+import time
+from types import SimpleNamespace
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from kubeml_tpu.api.errors import KubeMLException
+from kubeml_tpu.api.types import TrainOptions, TrainRequest
+from kubeml_tpu.data.loader import RoundBatch
+from kubeml_tpu.data.registry import DatasetRegistry
+from kubeml_tpu.faults import FaultPlan
+from kubeml_tpu.models import get_builtin
+from kubeml_tpu.parallel.kavg import KAvgEngine
+from kubeml_tpu.parallel.syncdp import SyncDPEngine
+from kubeml_tpu.train.checkpoint import load_checkpoint, save_checkpoint
+from kubeml_tpu.train.history import HistoryStore
+from kubeml_tpu.train.job import TrainJob
+
+from tests.test_job import ToyDataset, make_blobs, make_task
+from tests.test_kavg import (D, linear_loss, linear_metrics,
+                             numpy_reference, sgd_factory)
+from tests.test_syncdp import B as SYNC_B
+from tests.test_syncdp import S as SYNC_S
+from tests.test_syncdp import _problem
+
+pytestmark = pytest.mark.faults
+
+
+# ----------------------------------------------------------- plan parsing
+
+
+def test_fault_plan_parsing():
+    plan = FaultPlan.parse(
+        '{"events": [{"kind": "nan", "epoch": 1, "round": 2, "worker": 3}]}')
+    ev = plan.events[0]
+    assert (ev.kind, ev.epoch, ev.round, ev.worker) == ("nan", 1, 2, 3)
+    assert ev.matches(1, 2) and not ev.matches(1, 3) and not ev.matches(0, 2)
+
+    # bare list parses too; unset coordinates default to wildcards
+    plan = FaultPlan.parse([{"kind": "dropout"}])
+    ev = plan.events[0]
+    assert (ev.epoch, ev.round, ev.worker) == (-1, -1, -1)
+    assert ev.matches(0, 5) and ev.matches(7, 0)
+    assert plan.has("dropout") and not plan.has("crash")
+
+    # already-parsed plans pass through
+    assert FaultPlan.parse(plan) is plan
+
+    with pytest.raises(ValueError, match="unknown fault kind"):
+        FaultPlan.parse([{"kind": "explode"}])
+    with pytest.raises(ValueError, match="must be a list"):
+        FaultPlan.parse('{"events": 3}')
+
+
+def _round_batch(rnd, W=4, S=2, Bz=4):
+    rs = np.random.RandomState(3)
+    return RoundBatch(
+        batch={"x": rs.randn(W, S, Bz, D).astype(np.float32),
+               "y": rs.randn(W, S, Bz).astype(np.float32)},
+        sample_mask=np.ones((W, S, Bz), np.float32),
+        step_mask=np.ones((W, S), np.float32),
+        worker_mask=np.ones(W, np.float32),
+        rngs=np.zeros((W, S, 2), np.uint32),
+        round_index=rnd, num_rounds=4)
+
+
+def test_fault_plan_dropout_slow_and_coordinates():
+    plan = FaultPlan.parse([
+        {"kind": "dropout", "epoch": 0, "round": 1, "worker": 2},
+        {"kind": "slow", "epoch": 0, "round": 0, "duration_s": 0.01},
+    ])
+    out0 = plan(_round_batch(0))
+    assert out0.worker_mask.sum() == 4  # dropout targets round 1 only
+    assert plan.injected["slow"] == 1 and plan.injected["dropout"] == 0
+
+    rb1 = _round_batch(1)
+    out1 = plan(rb1)
+    assert out1.worker_mask[2] == 0.0 and out1.worker_mask.sum() == 3
+    assert rb1.worker_mask.sum() == 4  # the original mask is never edited
+    assert plan.injected["dropout"] == 1
+
+    plan.epoch = 1  # wrong epoch: nothing fires
+    assert plan(_round_batch(1)).worker_mask.sum() == 4
+    assert plan.injected["dropout"] == 1
+
+
+def test_fault_plan_nan_injection_targets_one_worker():
+    plan = FaultPlan.parse([{"kind": "nan", "round": 0, "worker": 1}])
+    rb = _round_batch(0)
+    out = plan.inject_batch(rb)
+    assert np.isnan(out.batch["x"][1]).all()
+    assert np.isnan(out.batch["y"][1]).all()
+    assert np.isfinite(out.batch["x"][0]).all()
+    assert np.isfinite(rb.batch["x"][1]).all()  # copy-on-poison
+    assert plan.injected["nan"] == 1
+    # non-matching round passes the batch through untouched
+    rb3 = _round_batch(3)
+    assert plan.inject_batch(rb3) is rb3
+
+
+# ------------------------------------------------- engine merge guard
+
+
+def test_engine_drops_nonfinite_worker_bit_identical(mesh8):
+    """A worker whose local steps go non-finite merges EXACTLY as if its
+    mask bit had been 0: same psum sequence, bit-identical weights."""
+    W, S, Bz, lr = 8, 3, 4, 0.05
+    rs = np.random.RandomState(11)
+    xs = rs.randn(W, S, Bz, D).astype(np.float32)
+    ys = rs.randn(W, S, Bz).astype(np.float32)
+    w0 = rs.randn(D).astype(np.float32)
+    poisoned = xs.copy()
+    poisoned[1] = np.nan
+
+    engine = KAvgEngine(mesh8, linear_loss, linear_metrics, sgd_factory,
+                        donate=False)
+    variables = {"params": {"w": jnp.asarray(w0)}}
+    kw = dict(sample_mask=np.ones((W, S, Bz)), step_mask=np.ones((W, S)),
+              rngs=np.zeros((W, S, 2), np.uint32), lr=lr, epoch=0)
+
+    avg, stats = engine.train_round(
+        variables, {"x": jnp.asarray(poisoned), "y": jnp.asarray(ys)},
+        worker_mask=np.ones(W), **kw)
+    dropped = np.asarray(stats.dropped)
+    assert dropped.sum() == 1 and dropped[1] == 1
+    assert stats.contributors == W - 1
+    assert float(stats.loss_sum[1]) == 0.0  # its loss never merges either
+
+    # the same round with worker 1 pre-masked out by the host
+    mask = np.ones(W)
+    mask[1] = 0.0
+    avg2, stats2 = engine.train_round(
+        variables, {"x": jnp.asarray(poisoned), "y": jnp.asarray(ys)},
+        worker_mask=mask, **kw)
+    assert stats2.contributors == W - 1
+    np.testing.assert_array_equal(np.asarray(avg["params"]["w"]),
+                                  np.asarray(avg2["params"]["w"]))
+    # and both match the numpy reference over the 7 finite workers
+    expect = numpy_reference(w0, xs, ys, lr, mask, [S] * W)
+    np.testing.assert_allclose(np.asarray(avg["params"]["w"]), expect,
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_all_nonfinite_round_carries_params_forward(mesh8):
+    """Every contributor dropped: the round is a no-op (round-start
+    weights carried forward bit-identically), never a silent zeroing."""
+    W, S, Bz = 8, 2, 4
+    rs = np.random.RandomState(12)
+    xs = np.full((W, S, Bz, D), np.nan, np.float32)
+    ys = rs.randn(W, S, Bz).astype(np.float32)
+    w0 = rs.randn(D).astype(np.float32)
+    engine = KAvgEngine(mesh8, linear_loss, linear_metrics, sgd_factory,
+                        donate=False)
+    avg, stats = engine.train_round(
+        {"params": {"w": jnp.asarray(w0)}},
+        {"x": jnp.asarray(xs), "y": jnp.asarray(ys)},
+        sample_mask=np.ones((W, S, Bz)), step_mask=np.ones((W, S)),
+        worker_mask=np.ones(W), rngs=np.zeros((W, S, 2), np.uint32),
+        lr=0.05, epoch=0)
+    assert np.asarray(stats.dropped).sum() == W
+    assert stats.contributors == 0
+    np.testing.assert_array_equal(np.asarray(avg["params"]["w"]), w0)
+
+
+def test_syncdp_skips_nonfinite_step(mesh8):
+    """A poisoned step under syncdp skips the optimizer update: params
+    end bit-identical to the same dispatch with that step masked out,
+    and the skip is flagged in last_skipped_device."""
+    model, x, y, variables = _problem()
+    rngs = np.random.RandomState(2).randint(
+        0, 2**31, size=(SYNC_S, 2)).astype(np.uint32)
+    x_bad = x[:SYNC_S].copy()
+    x_bad[2] = np.nan
+    smask = np.ones((SYNC_S, SYNC_B), np.float32)
+
+    def run(xarr, sm):
+        eng = SyncDPEngine(mesh8, model.loss,
+                           lambda lr, epoch: optax.sgd(0.05), donate=False)
+        state = eng.init_state(variables)
+        state, losses = eng.train_steps(
+            state, {"x": jnp.asarray(xarr), "y": jnp.asarray(y[:SYNC_S])},
+            sm, rngs, lr=0.05, epoch=0)
+        return eng, state, losses
+
+    eng_a, st_a, losses_a = run(x_bad, smask)
+    skipped = np.asarray(eng_a.last_skipped_device)
+    np.testing.assert_array_equal(skipped, [0.0, 0.0, 1.0, 0.0])
+    assert float(losses_a[2]) == 0.0
+
+    smask_b = smask.copy()
+    smask_b[2] = 0.0
+    _, st_b, _ = run(x[:SYNC_S], smask_b)
+    for a, b in zip(jax.tree_util.tree_leaves(st_a["params"]),
+                    jax.tree_util.tree_leaves(st_b["params"])):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+# ----------------------------------------------------- job-level policy
+
+
+@pytest.fixture()
+def jobenv(tmp_path, tmp_home, mesh8):
+    reg = DatasetRegistry()
+    make_blobs(reg)
+    return reg, HistoryStore(), mesh8
+
+
+def _run_faulted(jobenv, job_id, plan, *, epochs=2, parallelism=4,
+                 engine="kavg", expect_raise=None, **optkw):
+    reg, store, mesh = jobenv
+    task = make_task(job_id=job_id, epochs=epochs, parallelism=parallelism,
+                     engine=engine)
+    opts = task.parameters.options
+    if plan is not None:
+        opts.fault_plan = plan if isinstance(plan, str) else json.dumps(plan)
+    # pin both arms of every comparison to host staging: the nan events
+    # disable the device cache on their own arm, so the clean arm must
+    # not silently take the index-fed path instead
+    opts.device_cache = "off"
+    for k, v in optkw.items():
+        setattr(opts, k, v)
+    job = TrainJob(task, get_builtin("mlp")(hidden=16, num_classes=4),
+                   ToyDataset(), mesh, registry=reg, history_store=store)
+    if expect_raise is not None:
+        with pytest.raises(KubeMLException, match=expect_raise) as ei:
+            job.train()
+        return job, ei
+    return job, job.train()
+
+
+def test_job_nan_drop_matches_premasked_run(jobenv):
+    """End-to-end acceptance: one worker emits NaN mid-epoch; the job
+    completes, the drop lands in history, and the final weights are
+    bit-identical to the run whose mask excluded that worker from the
+    start (same coordinates, dropout instead of nan)."""
+    coords = {"epoch": 0, "round": 0, "worker": 1}
+    job_a, rec_a = _run_faulted(jobenv, "fnan1",
+                                [dict(coords, kind="nan")])
+    job_b, rec_b = _run_faulted(jobenv, "fdrop1",
+                                [dict(coords, kind="dropout")])
+    assert job_a._fault_plan.injected["nan"] == 1
+    assert job_b._fault_plan.injected["dropout"] == 1
+
+    # the on-device guard recorded the drop (dropout is a host mask
+    # edit — the device guard never fires on that arm)
+    assert rec_a.data.dropped_workers == [1.0, 0.0]
+    assert rec_b.data.dropped_workers == [0.0, 0.0]
+    assert len(rec_a.data.train_loss) == 2
+    assert np.isfinite(rec_a.data.train_loss).all()
+
+    va, _ = load_checkpoint("fnan1")
+    vb, _ = load_checkpoint("fdrop1")
+    for a, b in zip(jax.tree_util.tree_leaves(va),
+                    jax.tree_util.tree_leaves(vb)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_job_quarantines_repeat_offender(jobenv):
+    """quarantine_after=1: the worker that drops once is masked out for
+    the rest of the epoch and the count lands in history; the next
+    epoch starts with a clean slate."""
+    job, rec = _run_faulted(
+        jobenv, "fquar1", [{"kind": "nan", "epoch": 0, "worker": 2}],
+        quarantine_after=1)
+    assert rec.data.quarantined_workers == [1, 0]
+    # exactly ONE on-device drop: after the quarantine the worker is
+    # masked out host-side, so later poisoned rounds never reach it
+    assert rec.data.dropped_workers == [1.0, 0.0]
+    assert len(rec.data.train_loss) == 2
+    assert np.isfinite(rec.data.train_loss).all()
+
+
+def test_job_aborts_after_all_nonfinite_rounds(jobenv):
+    """abort_after=2 with every worker non-finite every round: the job
+    fails with the diagnostic instead of freezing forever."""
+    job, ei = _run_faulted(jobenv, "fabort1", [{"kind": "nan"}],
+                           abort_after=2, expect_raise="non-finite")
+    assert ei.value.status_code == 500
+    assert job.exit_err is not None
+
+
+def test_syncdp_job_nan_skips_and_completes(jobenv):
+    """Under syncdp a poisoned worker makes the GLOBAL gradient
+    non-finite: the affected steps skip, the skips land in
+    dropped_workers, and the job still completes with finite loss."""
+    job, rec = _run_faulted(
+        jobenv, "fsync1",
+        [{"kind": "nan", "epoch": 0, "round": 0, "worker": 1}],
+        engine="syncdp")
+    assert rec.data.dropped_workers[0] > 0
+    assert rec.data.dropped_workers[1] == 0.0
+    assert len(rec.data.train_loss) == 2
+    assert np.isfinite(rec.data.train_loss).all()
+
+
+def test_syncdp_job_aborts_after_all_skipped_steps(jobenv):
+    job, ei = _run_faulted(jobenv, "fsyncab1", [{"kind": "nan"}],
+                           engine="syncdp", abort_after=2,
+                           expect_raise="non-finite")
+    assert ei.value.status_code == 500
+
+
+def test_bad_fault_options_rejected(jobenv):
+    # unparseable plan
+    job, ei = _run_faulted(jobenv, "fbad1", "not json {",
+                           expect_raise="invalid fault_plan")
+    assert ei.value.status_code == 400
+    # unknown kind surfaces the parse error, not a traceback
+    _, ei = _run_faulted(jobenv, "fbad2", [{"kind": "explode"}],
+                         expect_raise="invalid fault_plan")
+    assert ei.value.status_code == 400
+    # negative policy knobs
+    _, ei = _run_faulted(jobenv, "fbad3", None, quarantine_after=-1,
+                         expect_raise="must be >= 0")
+    assert ei.value.status_code == 400
+    # nan events need a host float batch; device_cache='on' has none
+    reg, store, mesh = jobenv
+    task = make_task(job_id="fbad4", epochs=1)
+    task.parameters.options.fault_plan = json.dumps([{"kind": "nan"}])
+    task.parameters.options.device_cache = "on"
+    job = TrainJob(task, get_builtin("mlp")(hidden=16, num_classes=4),
+                   ToyDataset(), mesh, registry=reg, history_store=store)
+    with pytest.raises(KubeMLException, match="incompatible") as ei:
+        job.train()
+    assert ei.value.status_code == 400
+
+
+# ------------------------------------------------ checkpoint fault paths
+
+
+def _bound_plan(events, job_id):
+    plan = FaultPlan.parse(events)
+    plan.bind(SimpleNamespace(task=SimpleNamespace(job_id=job_id),
+                              req=SimpleNamespace(resume_from=None)))
+    return plan
+
+
+def test_corrupt_checkpoint_event_and_next_save_repairs(tmp_home):
+    variables = {"params": {"w": np.arange(4.0, dtype=np.float32)}}
+    save_checkpoint("fcorr1", variables, {"model": "mlp"})
+    plan = _bound_plan([{"kind": "corrupt_checkpoint"}], "fcorr1")
+    plan(_round_batch(0))
+    assert plan.injected["corrupt_checkpoint"] == 1
+    with pytest.raises(Exception):
+        load_checkpoint("fcorr1")
+    # the next successful save republishes a good checkpoint
+    save_checkpoint("fcorr1", variables, {"model": "mlp", "epoch": 1})
+    _, manifest = load_checkpoint("fcorr1")
+    assert manifest["epoch"] == 1
+
+
+def test_checkpoint_crash_window_old_fallback(tmp_home):
+    """A crash between save_checkpoint's two publish renames leaves only
+    `.old`: readers must fall back to it, and the next save must
+    republish the current dir and clean the stale `.old`/`.tmp`."""
+    from kubeml_tpu.api.const import kubeml_home
+
+    v1 = {"params": {"w": np.arange(4.0, dtype=np.float32)}}
+    save_checkpoint("fwin1", v1, {"model": "mlp", "epoch": 1})
+    d = os.path.join(kubeml_home(), "models", "fwin1")
+
+    # simulate the mid-publish crash window: current renamed away, plus
+    # a stale tmp dir from the dead writer
+    os.rename(d, d + ".old")
+    os.makedirs(d + ".tmp")
+    with open(os.path.join(d + ".tmp", "junk"), "w") as f:
+        f.write("x")
+
+    vars_back, manifest = load_checkpoint("fwin1")  # served from .old
+    assert manifest["epoch"] == 1
+    np.testing.assert_array_equal(
+        np.asarray(vars_back["params"]["w"]), v1["params"]["w"])
+
+    v2 = {"params": {"w": np.arange(4.0, dtype=np.float32) + 1}}
+    save_checkpoint("fwin1", v2, {"model": "mlp", "epoch": 2})
+    assert os.path.isdir(d)
+    assert not os.path.exists(d + ".old")
+    assert not os.path.exists(d + ".tmp")
+    vars2, manifest2 = load_checkpoint("fwin1")
+    assert manifest2["epoch"] == 2
+    np.testing.assert_array_equal(
+        np.asarray(vars2["params"]["w"]), v2["params"]["w"])
+
+
+# --------------------------------------------- control-plane satellites
+
+
+def test_client_retries_transient_connection_errors(monkeypatch):
+    from kubeml_tpu.control import client as client_mod
+
+    calls, sleeps = [], []
+
+    def fake_http(method, url, body=None, **kw):
+        calls.append(url)
+        if len(calls) < 3:
+            raise KubeMLException("cannot reach http://x:1/train: refused",
+                                  503)
+        return {"id": "ok1"}
+
+    monkeypatch.setattr(client_mod, "http_json", fake_http)
+    monkeypatch.setattr(client_mod, "time",
+                        SimpleNamespace(sleep=sleeps.append))
+    out = client_mod._request("POST", "http://x:1/train", {})
+    assert out == {"id": "ok1"}
+    assert len(calls) == 3
+    assert len(sleeps) == 2
+    assert all(0 < s <= client_mod.RETRY_CAP_S for s in sleeps)
+
+
+def test_client_does_not_retry_semantic_errors(monkeypatch):
+    from kubeml_tpu.control import client as client_mod
+
+    calls = []
+
+    def run(exc):
+        calls.clear()
+
+        def fake_http(method, url, body=None, **kw):
+            calls.append(url)
+            raise exc
+
+        monkeypatch.setattr(client_mod, "http_json", fake_http)
+        monkeypatch.setattr(client_mod, "time",
+                            SimpleNamespace(sleep=lambda s: None))
+        with pytest.raises(KubeMLException):
+            client_mod._request("GET", "http://x:1/tasks")
+        return len(calls)
+
+    # a considered 503 (capacity) is not a transport failure
+    assert run(KubeMLException("all device partitions leased", 503)) == 1
+    # nor is any non-503
+    assert run(KubeMLException("cannot reach http://x:1/tasks: x", 500)) == 1
+    # a genuinely dead endpoint exhausts the attempts, then raises
+    assert run(KubeMLException("cannot reach http://x:1/tasks: refused",
+                               503)) == client_mod.RETRY_ATTEMPTS
+
+
+def test_scheduler_defer_backoff_is_capped(monkeypatch):
+    from kubeml_tpu.control import scheduler as sched_mod
+
+    monkeypatch.setattr(sched_mod, "DEFER_BASE_S", 0.005)
+    monkeypatch.setattr(sched_mod, "DEFER_CAP_S", 0.02)
+    s = sched_mod.Scheduler(ps_url="http://127.0.0.1:1")
+
+    def always_busy(task):
+        raise KubeMLException("no capacity", 503)
+
+    monkeypatch.setattr(s, "_schedule", always_busy)
+    loop = threading.Thread(target=s._schedule_loop, daemon=True)
+    loop.start()
+    try:
+        req = TrainRequest(model_type="mlp", batch_size=8, epochs=1,
+                           dataset="blobs", lr=0.1, options=TrainOptions())
+        from kubeml_tpu.api.types import TrainTask
+        s.queue.push(TrainTask(job_id="busy1", parameters=req,
+                               parallelism=2))
+        deadline = time.monotonic() + 10
+        while (time.monotonic() < deadline
+               and s._defer_counts.get("busy1", 0) < 6):
+            time.sleep(0.005)
+        # the streak kept climbing well past where the uncapped delay
+        # (base * 2^n) would exceed the cap — i.e. re-probes stayed fast
+        assert s._defer_counts.get("busy1", 0) >= 6
+        for not_before, _task in list(s._deferred):
+            assert not_before - time.monotonic() \
+                <= sched_mod.DEFER_CAP_S * 1.3
+        # /finish clears the streak so the id doesn't linger forever
+        s._defer_counts["gone1"] = 4
+        s._h_finish(SimpleNamespace(params={"taskId": "gone1"}))
+        assert "gone1" not in s._defer_counts
+    finally:
+        s._stop.set()
+        with s.queue._cv:
+            s.queue._cv.notify_all()
+        loop.join(timeout=5)
+
+
+def test_fault_test_lint(tmp_path):
+    from tools.check_fault_tests import check_file, main
+
+    tests_dir = os.path.dirname(os.path.abspath(__file__))
+    assert main(["check_fault_tests", tests_dir]) == 0
+
+    bad = tmp_path / "test_bad_faults.py"
+    bad.write_text("from kubeml_tpu.faults import FaultPlan\n"
+                   "import time\n"
+                   "def test_x():\n"
+                   "    t = time.time()\n"
+                   "    return t\n")
+    violations = check_file(str(bad))
+    assert violations and violations[0][2] == "time.time("
+    assert main(["check_fault_tests", str(tmp_path)]) == 1
+
+    # the token inside a comment or docstring does not trip the lint
+    ok = tmp_path / "sub"
+    ok.mkdir()
+    clean = ok / "test_ok_faults.py"
+    clean.write_text('"""Mentions FaultPlan and time.time() only in '
+                     'prose."""\n'
+                     "# time.time() in a comment is fine too\n"
+                     "def test_y():\n"
+                     "    assert True\n")
+    assert check_file(str(clean)) == []
+    assert main(["check_fault_tests", str(ok)]) == 0
+
+
+# --------------------------------------- watchdog crash recovery (e2e)
+
+
+def test_fault_crash_recovered_by_watchdog(tmp_path, tmp_home, mesh8,
+                                           monkeypatch):
+    """A FaultPlan crash (os._exit at epoch 1, round 0) kills the
+    standalone job process at exact coordinates; the PS watchdog must
+    respawn it from the epoch-0 checkpoint, the restarted incarnation
+    suppresses the crash event and finishes, and the restart is visible
+    in the finished History and the PS restart counters."""
+    from kubeml_tpu.control.client import KubemlClient
+    from kubeml_tpu.control.deployment import start_deployment
+    from tests.test_control_plane import wait_history, write_blob_files
+
+    monkeypatch.setenv("STANDALONE_JOBS", "true")
+    monkeypatch.setenv("KUBEML_JOB_START_TIMEOUT", "600")
+    dep = start_deployment(mesh=mesh8)
+    try:
+        client = KubemlClient(dep.controller_url)
+        paths = write_blob_files(tmp_path)
+        client.v1().datasets().create(
+            "blobs", paths["xtr"], paths["ytr"], paths["xte"], paths["yte"])
+
+        plan = json.dumps([{"kind": "crash", "epoch": 1, "round": 0}])
+        req = TrainRequest(
+            model_type="mlp", batch_size=32, epochs=2, dataset="blobs",
+            lr=0.1,
+            options=TrainOptions(default_parallelism=2, k=2,
+                                 static_parallelism=True, max_restarts=1,
+                                 checkpoint_every=1, goal_accuracy=200.0,
+                                 fault_plan=plan))
+        job_id = client.v1().networks().train(req)
+
+        wait_history(client, job_id, timeout=420)
+        # wait for /finish so the PS has stamped the restart count into
+        # the stored history (and reaped the child)
+        assert dep.ps.wait_for_job(job_id, timeout=120)
+        history = client.v1().histories().get(job_id)
+        assert history.data.restarts == 1, \
+            "the injected crash was not recovered by a watchdog restart"
+        # one continuous run: epoch 0 from the first incarnation's
+        # checkpoint, epoch 1 from the restarted one
+        assert len(history.data.train_loss) == 2
+        assert np.isfinite(history.data.train_loss).all()
+        # per-job series cleared at finish; the PS-lifetime total stays
+        expo = dep.ps.metrics.exposition()
+        assert f'jobid="{job_id}"' not in expo
+        assert 'kubeml_ps_restarts_total{type="standalone"} 1' in expo
+    finally:
+        dep.stop()

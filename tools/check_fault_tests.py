@@ -1,0 +1,90 @@
+#!/usr/bin/env python3
+"""Lint: fault-injection tests must be deterministic.
+
+The whole point of a FaultPlan (kubeml_tpu/faults.py) is that every
+injected failure fires at named (epoch, round, worker) coordinates and
+reproduces bit-for-bit in tier-1 CPU runs. A test that mixes FaultPlan
+with wall-clock or unseeded randomness silently gives that up — so any
+test file that references FaultPlan is scanned for the tokens below and
+the build fails if one appears outside a comment.
+
+Run directly (exit 1 on violation) or via tests/test_faults.py, which
+keeps the lint itself in the tier-1 suite:
+
+    python tools/check_fault_tests.py [tests_dir]
+"""
+
+from __future__ import annotations
+
+import io
+import os
+import sys
+import tokenize
+
+FORBIDDEN = (
+    "time.time(",
+    "datetime.now(",
+    "datetime.utcnow(",
+    "random.random(",
+    "random.uniform(",
+    "random.randint(",
+    "random.choice(",
+    "np.random.rand",
+    "np.random.randn",
+    "numpy.random.rand",
+)
+
+
+def _code_lines(path: str):
+    """Yield (lineno, source) for non-comment, non-docstring code."""
+    with open(path, "rb") as f:
+        src = f.read()
+    lines = {}
+    try:
+        for tok in tokenize.tokenize(io.BytesIO(src).readline):
+            if tok.type in (tokenize.COMMENT, tokenize.STRING,
+                            tokenize.ENCODING):
+                continue
+            lines.setdefault(tok.start[0], []).append(tok.string)
+    except tokenize.TokenError:
+        # fall back to raw lines; better a false positive than a skip
+        for i, line in enumerate(src.decode("utf-8", "replace").split("\n")):
+            lines.setdefault(i + 1, []).append(line)
+    for no in sorted(lines):
+        yield no, "".join(lines[no])
+
+
+def check_file(path: str) -> list:
+    with open(path, encoding="utf-8", errors="replace") as f:
+        if "FaultPlan" not in f.read():
+            return []
+    violations = []
+    for no, code in _code_lines(path):
+        for tok in FORBIDDEN:
+            if tok in code:
+                violations.append((path, no, tok))
+    return violations
+
+
+def main(argv) -> int:
+    root = argv[1] if len(argv) > 1 else os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "tests")
+    violations = []
+    for dirpath, _dirs, files in os.walk(root):
+        for name in sorted(files):
+            if name.startswith("test_") and name.endswith(".py"):
+                violations.extend(check_file(os.path.join(dirpath, name)))
+    for path, no, tok in violations:
+        print(f"{path}:{no}: FaultPlan test uses wall-clock/unseeded "
+              f"randomness: {tok!r}", file=sys.stderr)
+    if violations:
+        print(f"\n{len(violations)} violation(s): fault-injection tests "
+              "must be coordinate-driven (see kubeml_tpu/faults.py)",
+              file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv))
