@@ -105,6 +105,7 @@ def main():
     from kubeml_tpu.models import get_builtin
     from kubeml_tpu.parallel.kavg import KAvgEngine
     from kubeml_tpu.parallel.mesh import make_mesh
+    from kubeml_tpu.utils.trace import Tracer
 
     from jax.sharding import NamedSharding, PartitionSpec as P
 
@@ -184,19 +185,25 @@ def main():
         return engine.train_rounds(variables, staged, rngs=rngs, lr=0.1,
                                    epoch=epoch, **gmasks)
 
-    def epoch(variables, e, round_fn, rounds_fn):
+    def epoch(variables, e, round_fn, rounds_fn, tracer):
         """One epoch, exactly as TrainJob dispatches it with
         --rounds-per-dispatch 4: full groups in one train_rounds
         dispatch each, the tail singly, losses on device, reduced in
-        one jitted stack+sum dispatch, ONE readback at the end."""
+        one jitted stack+sum dispatch, ONE readback at the end.
+        Dispatch/readback go through the job's tracer spans so the
+        JSON reports where each arm's wall-clock went, not just the
+        throughput it produced."""
         dev_losses = []
         for _ in range(groups):
-            variables, stats = rounds_fn(variables, e)
+            with tracer.span("dispatch"):
+                variables, stats = rounds_fn(variables, e)
             dev_losses.append(stats.loss_sum_device.sum(axis=0))
         for _ in range(tail):
-            variables, stats = round_fn(variables, e)
+            with tracer.span("dispatch"):
+                variables, stats = round_fn(variables, e)
             dev_losses.append(stats.loss_sum_device)
-        loss = np.asarray(reduce_losses(dev_losses))  # the epoch sync point
+        with tracer.span("device_drain"):
+            loss = np.asarray(reduce_losses(dev_losses))  # epoch sync point
         return variables, loss
 
     def anchor(variables):
@@ -212,17 +219,22 @@ def main():
         # the backend's per-process dispatch ramp. The anchor read is
         # warmed too — its one-off tiny-program compile and cold
         # transfer path cost over a second on tunneled backends and
-        # must not land in the timed window.
+        # must not land in the timed window. Warmup spans land in a
+        # throwaway tracer so the reported phase totals cover exactly
+        # the timed window.
         for w in range(warmup_epochs):
-            variables, _ = epoch(variables, w, round_fn, rounds_fn)
+            variables, _ = epoch(variables, w, round_fn, rounds_fn,
+                                 Tracer())
         anchor(variables)
+        tracer = Tracer()
         t0 = time.perf_counter()
         for e in range(timed_epochs):
-            variables, _ = epoch(variables, e + 1, round_fn, rounds_fn)
+            variables, _ = epoch(variables, e + 1, round_fn, rounds_fn,
+                                 tracer)
         anchor(variables)
         elapsed = time.perf_counter() - t0
         samples = timed_epochs * rounds_per_epoch * W * S * B
-        return samples / elapsed / n_chips
+        return samples / elapsed / n_chips, tracer.summary()
 
     # -- faulted arm: the SAME host-staged single-round loop, once clean
     # and once under a FaultPlan NaN schedule, so the delta is the cost
@@ -233,7 +245,7 @@ def main():
                             for r in range(0, rounds_per_epoch,
                                            FAULT_EVERY)])
 
-    def faulted_epoch(variables, e, fault_plan):
+    def faulted_epoch(variables, e, fault_plan, tracer):
         from kubeml_tpu.data.loader import RoundBatch
         dev_losses, dev_dropped = [], []
         if fault_plan is not None:
@@ -247,43 +259,49 @@ def main():
                             round_index=r, num_rounds=rounds_per_epoch)
             if fault_plan is not None:
                 rb = fault_plan.inject_batch(rb)
-            staged = {k: jax.device_put(v, b_sh)
-                      for k, v in rb.batch.items()}
-            variables, stats = engine.train_round(
-                variables, staged, sample_mask=rb.sample_mask,
-                step_mask=rb.step_mask, worker_mask=rb.worker_mask,
-                rngs=rb.rngs, lr=0.1, epoch=e)
+            with tracer.span("dispatch"):
+                staged = {k: jax.device_put(v, b_sh)
+                          for k, v in rb.batch.items()}
+                variables, stats = engine.train_round(
+                    variables, staged, sample_mask=rb.sample_mask,
+                    step_mask=rb.step_mask, worker_mask=rb.worker_mask,
+                    rngs=rb.rngs, lr=0.1, epoch=e)
             dev_losses.append(stats.loss_sum_device)
             dev_dropped.append(stats.dropped_device)
-        np.asarray(reduce_losses(dev_losses))  # the epoch sync point
-        flags = np.asarray(jnp.stack(dev_dropped))  # [R, W], one read
+        with tracer.span("device_drain"):
+            np.asarray(reduce_losses(dev_losses))  # the epoch sync point
+            flags = np.asarray(jnp.stack(dev_dropped))  # [R, W], one read
         return variables, flags
 
     def measure_faulted(fault_plan):
         variables = model.init_variables(
             jax.random.PRNGKey(0), {"x": jnp.asarray(x[0, 0])})
-        variables, _ = faulted_epoch(variables, 0, fault_plan)  # warmup
+        variables, _ = faulted_epoch(variables, 0, fault_plan,
+                                     Tracer())  # warmup
         anchor(variables)
         if fault_plan is not None:
             # warmup fired injections too — reset so the reported counter
             # covers exactly the timed window the drop flags cover
             fault_plan.injected = {k: 0 for k in fault_plan.injected}
+        tracer = Tracer()
         t0 = time.perf_counter()
         flags_total = np.zeros((rounds_per_epoch, W))
         for e in range(FAULT_TIMED_EPOCHS):
-            variables, flags = faulted_epoch(variables, e + 1, fault_plan)
+            variables, flags = faulted_epoch(variables, e + 1, fault_plan,
+                                             tracer)
             flags_total += flags
         anchor(variables)
         elapsed = time.perf_counter() - t0
         samples = FAULT_TIMED_EPOCHS * rounds_per_epoch * W * S * B
-        return samples / elapsed / n_chips, flags_total
+        return samples / elapsed / n_chips, flags_total, tracer.summary()
 
-    per_chip = measure(cache_round, cache_rounds, 2, TIMED_EPOCHS)
-    host_per_chip = measure(host_round, host_rounds, 1,
-                            HOST_TIMED_EPOCHS)
-    baseline_per_chip = _measure_baseline_arm(model, x, y)
-    clean_single_per_chip, _ = measure_faulted(None)
-    faulted_per_chip, fault_flags = measure_faulted(plan)
+    per_chip, cache_phases = measure(cache_round, cache_rounds, 2,
+                                     TIMED_EPOCHS)
+    host_per_chip, host_phases = measure(host_round, host_rounds, 1,
+                                         HOST_TIMED_EPOCHS)
+    baseline_per_chip, baseline_phases = _measure_baseline_arm(model, x, y)
+    clean_single_per_chip, _, clean_phases = measure_faulted(None)
+    faulted_per_chip, fault_flags, faulted_phases = measure_faulted(plan)
     rounds_dropped = int((fault_flags.sum(axis=1) > 0).sum())
     worker_drops = int(fault_flags.sum())
     recovery_overhead_pct = max(
@@ -327,6 +345,18 @@ def main():
         "faulted_nan_injections": plan.injected["nan"],
         "fault_recovery_overhead_pct": round(recovery_overhead_pct, 2),
         "fault_timed_epochs": FAULT_TIMED_EPOCHS,
+        # per-arm tracer phase totals over the TIMED window (warmup
+        # excluded): {span: {count, total_s, mean_s}}. A throughput
+        # regression in this file should be explainable from here —
+        # dispatch (device step calls) vs device_drain (the blocking
+        # epoch readback) — without re-running under a profiler.
+        "phase_summary": {
+            "device_cache": cache_phases,
+            "host_staged": host_phases,
+            "baseline": baseline_phases,
+            "clean_single": clean_phases,
+            "faulted": faulted_phases,
+        },
     }))
 
 
@@ -342,6 +372,8 @@ def _measure_baseline_arm(model, x, y) -> float:
     import jax.numpy as jnp
     import numpy as np
     import optax
+
+    from kubeml_tpu.utils.trace import Tracer
 
     W, S, B = x.shape[:3]
     flat_x = jnp.asarray(x.reshape(W * S, B, *x.shape[3:]))
@@ -375,23 +407,28 @@ def _measure_baseline_arm(model, x, y) -> float:
         params = optax.apply_updates(variables["params"], updates)
         return {**new_state, "params": params}, opt_state, loss
 
-    def run_epoch(variables, opt_state):
+    def run_epoch(variables, opt_state, tracer):
         losses = []
         for i in range(steps_per_epoch):
-            variables, opt_state, loss = step(
-                variables, opt_state, flat_x[i % (W * S)],
-                flat_y[i % (W * S)], keys_dev[i])
+            with tracer.span("dispatch"):
+                variables, opt_state, loss = step(
+                    variables, opt_state, flat_x[i % (W * S)],
+                    flat_y[i % (W * S)], keys_dev[i])
             losses.append(loss)
         # same per-epoch sync discipline as the engine arm
-        np.asarray(jnp.stack(losses).sum())
+        with tracer.span("device_drain"):
+            np.asarray(jnp.stack(losses).sum())
         return variables, opt_state
 
-    variables, opt_state = run_epoch(variables, opt_state)  # warmup
+    variables, opt_state = run_epoch(variables, opt_state,
+                                     Tracer())  # warmup
+    tracer = Tracer()
     t0 = time.perf_counter()
     for _ in range(BASELINE_TIMED_EPOCHS):
-        variables, opt_state = run_epoch(variables, opt_state)
+        variables, opt_state = run_epoch(variables, opt_state, tracer)
     elapsed = time.perf_counter() - t0
-    return BASELINE_TIMED_EPOCHS * steps_per_epoch * B / elapsed
+    return (BASELINE_TIMED_EPOCHS * steps_per_epoch * B / elapsed,
+            tracer.summary())
 
 
 if __name__ == "__main__":

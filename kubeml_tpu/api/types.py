@@ -239,6 +239,11 @@ class TrainTask:
     parallelism: int = 0
     elapsed_time_s: float = -1.0   # last epoch duration fed back to the policy
     state: str = "queued"          # queued | starting | running | finished | failed | stopped
+    # client-minted trace id; rides the task across the scheduler queue
+    # (thread-locals don't survive the hop) into the PS and from there
+    # to the standalone job process, so spans from every process in the
+    # chain correlate (utils/trace.py)
+    trace_id: str = ""
 
     def to_dict(self) -> dict:
         return {
@@ -247,6 +252,7 @@ class TrainTask:
             "parallelism": self.parallelism,
             "elapsed_time_s": self.elapsed_time_s,
             "state": self.state,
+            "trace_id": self.trace_id,
         }
 
     @classmethod
@@ -257,6 +263,7 @@ class TrainTask:
             parallelism=d.get("parallelism", 0),
             elapsed_time_s=d.get("elapsed_time_s", -1.0),
             state=d.get("state", "queued"),
+            trace_id=d.get("trace_id", ""),
         )
 
 
@@ -330,6 +337,9 @@ class MetricUpdate:
     # updates from older jobs still parse)
     dropped_workers: float = 0.0
     quarantined_workers: int = 0
+    # per-phase span durations for the epoch (tracer name -> seconds per
+    # round), feeding the PS latency histograms; optional on the wire
+    phase_times: Dict[str, List[float]] = field(default_factory=dict)
 
     def to_dict(self) -> dict:
         return _asdict(self)
@@ -340,7 +350,10 @@ class MetricUpdate:
                       ("job_id", "validation_loss", "accuracy", "train_loss",
                        "parallelism", "epoch_duration")},
                    dropped_workers=float(d.get("dropped_workers", 0.0)),
-                   quarantined_workers=int(d.get("quarantined_workers", 0)))
+                   quarantined_workers=int(d.get("quarantined_workers", 0)),
+                   phase_times={str(k): [float(x) for x in v]
+                                for k, v in (d.get("phase_times")
+                                             or {}).items()})
 
 
 @dataclass

@@ -243,6 +243,25 @@ def cmd_logs(args):
                 pass
 
 
+# --------------------------------------------------------------------- trace
+
+def cmd_trace(args):
+    """Fetch a job's merged Chrome trace (client + scheduler + PS + job
+    process spans on one trace id). Load the output in Perfetto
+    (ui.perfetto.dev) or chrome://tracing."""
+    doc = _client(args).v1().traces().get(args.id)
+    payload = json.dumps(doc)
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(payload)
+        meta = doc.get("metadata", {})
+        print(f"wrote {args.out}: {len(doc.get('traceEvents', []))} events "
+              f"from {len(meta.get('sources', []))} file(s), trace_id(s) "
+              f"{','.join(meta.get('trace_ids', [])) or '-'}")
+    else:
+        print(payload)
+
+
 # --------------------------------------------------------------------- serve
 
 def cmd_serve(args):
@@ -444,6 +463,14 @@ def build_parser() -> argparse.ArgumentParser:
     lg.add_argument("--id", required=True)
     lg.add_argument("-f", "--follow", action="store_true")
     lg.set_defaults(fn=cmd_logs)
+
+    tr = sub.add_parser("trace",
+                        help="fetch a job's merged Chrome trace "
+                             "(Perfetto-viewable)")
+    tr.add_argument("--id", required=True)
+    tr.add_argument("-o", "--out", default=None,
+                    help="write the trace JSON here instead of stdout")
+    tr.set_defaults(fn=cmd_trace)
 
     s = sub.add_parser("serve", help="start the control plane on this host")
     s.add_argument("--coordinator", default=None, metavar="HOST:PORT",

@@ -19,6 +19,8 @@ from kubeml_tpu.api.errors import KubeMLException
 from kubeml_tpu.api.types import (DatasetSummary, History, InferRequest,
                                   TrainRequest, TrainTask)
 from kubeml_tpu.control.httpd import http_json
+from kubeml_tpu.utils.trace import (TraceSink, Tracer, get_trace_context,
+                                    make_trace_id, trace_context)
 
 # Bounded retry for TRANSIENT connection failures only. httpd.http_json
 # maps transport errors (refused/reset/DNS) to a 503 whose message leads
@@ -70,9 +72,29 @@ class NetworksClient:
     def __init__(self, base: str):
         self.base = base
 
-    def train(self, req: TrainRequest) -> str:
-        out = _request("POST", f"{self.base}/train", req.to_dict())
-        return out["id"]
+    def train(self, req: TrainRequest,
+              trace_id: Optional[str] = None) -> str:
+        """Submit a training job. The SDK is where the trace begins: a
+        trace_id is minted here (unless the caller supplies one or the
+        thread already carries one) and rides the X-KubeML-Trace-Id
+        header through controller -> scheduler -> PS -> job process, so
+        `kubeml trace --id <job>` shows the whole chain. The client's
+        own submit span lands in the job's trace directory once the job
+        id is known (best-effort: the SDK may run on a host without
+        access to $KUBEML_HOME)."""
+        trace_id = trace_id or get_trace_context() or make_trace_id()
+        tracer = Tracer(trace_id=trace_id)
+        with trace_context(trace_id):
+            with tracer.span("client.train",
+                             function=(req.function_name
+                                       or req.model_type)):
+                out = _request("POST", f"{self.base}/train", req.to_dict())
+        job_id = out["id"]
+        try:
+            TraceSink(job_id, "client").write(tracer)
+        except OSError:
+            pass
+        return job_id
 
     def infer(self, model_id: str, data) -> list:
         out = _request("POST", f"{self.base}/infer",
@@ -160,6 +182,16 @@ class TasksClient:
         _request("DELETE", f"{self.base}/tasks/{job_id}")
 
 
+class TracesClient:
+    def __init__(self, base: str):
+        self.base = base
+
+    def get(self, job_id: str) -> dict:
+        """Merged Chrome trace-event document for a job (Perfetto/
+        chrome://tracing loadable)."""
+        return _request("GET", f"{self.base}/trace/{job_id}")
+
+
 class V1:
     def __init__(self, base: str):
         self._base = base
@@ -178,6 +210,9 @@ class V1:
 
     def tasks(self) -> TasksClient:
         return TasksClient(self._base)
+
+    def traces(self) -> TracesClient:
+        return TracesClient(self._base)
 
 
 class KubemlClient:

@@ -53,6 +53,7 @@ class Controller(JsonService):
         self.route("DELETE", "/dataset/{name}", self._h_dataset_delete)
         self.route("GET", "/tasks", self._h_tasks)
         self.route("DELETE", "/tasks/{jobId}", self._h_task_stop)
+        self.route("GET", "/trace/{jobId}", self._h_trace)
         self.route("GET", "/history", self._h_history_list)
         self.route("GET", "/history/{taskId}", self._h_history_get)
         self.route("DELETE", "/history/{taskId}", self._h_history_delete)
@@ -114,6 +115,14 @@ class Controller(JsonService):
         return http_json(
             "DELETE",
             f"{self._need(self.ps_url, 'PS')}/stop/{req.params['jobId']}")
+
+    def _h_trace(self, req: Request):
+        """Merged job timeline, proxied to the PS (which owns the trace
+        directory) so `kubeml trace --id` needs only the gateway URL."""
+        return http_json(
+            "GET",
+            f"{self._need(self.ps_url, 'PS')}/trace"
+            f"?id={req.params['jobId']}")
 
     # --------------------------------------------------------------- history
 

@@ -13,11 +13,15 @@ import json
 import logging
 import re
 import threading
+import time
 import urllib.request
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
 from kubeml_tpu.api.errors import KubeMLException, check_error
+from kubeml_tpu.metrics.prom import HttpMetrics
+from kubeml_tpu.utils.trace import (TRACE_HEADER, get_trace_context,
+                                    set_trace_context)
 
 logger = logging.getLogger("kubeml_tpu.http")
 
@@ -35,6 +39,7 @@ class Raw:
 class Route:
     def __init__(self, method: str, pattern: str, handler: Callable):
         self.method = method
+        self.pattern = pattern
         # '/train/{jobId}' -> ^/train/(?P<jobId>[^/]+)$
         regex = re.sub(r"\{(\w+)\}", r"(?P<\1>[^/]+)", pattern)
         self.regex = re.compile(f"^{regex}$")
@@ -42,25 +47,47 @@ class Route:
 
 
 class JsonService:
-    """Base class: subclasses call .route() then .start()."""
+    """Base class: subclasses call .route() then .start().
+
+    Every request goes through a small middleware layer: the
+    X-KubeML-Trace-Id header (if present) is bound to the handler thread
+    so any `http_json` call the handler makes propagates it downstream,
+    and request latency/status are recorded per endpoint *pattern* in
+    `self.http_metrics` (exposed on GET /metrics; subclasses with their
+    own /metrics route fold `http_metrics.exposition()` in themselves).
+    The clock is injectable for deterministic latency tests.
+    """
 
     name = "service"
 
-    def __init__(self, port: int = 0, host: str = "127.0.0.1"):
+    def __init__(self, port: int = 0, host: str = "127.0.0.1",
+                 clock: Optional[Callable[[], float]] = None):
         self._routes: List[Route] = []
         self._host = host
         self._port = port
         self._server: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
+        self._clock = clock or time.perf_counter
+        self.http_metrics = HttpMetrics(self.name)
         self.route("GET", "/health", lambda req: {"ok": True})
 
     def route(self, method: str, pattern: str, handler: Callable):
         self._routes.append(Route(method, pattern, handler))
 
+    def _h_default_metrics(self, req):
+        return Raw(self.http_metrics.exposition().encode(),
+                   "text/plain; version=0.0.4")
+
     # ------------------------------------------------------------ lifecycle
 
     def start(self) -> int:
         service = self
+        # default /metrics (HTTP middleware series only) unless the
+        # subclass registered its own — deferred to start() so a
+        # subclass route wins even though __init__ runs first
+        if not any(r.method == "GET" and r.pattern == "/metrics"
+                   for r in self._routes):
+            self.route("GET", "/metrics", self._h_default_metrics)
 
         class Handler(BaseHTTPRequestHandler):
             protocol_version = "HTTP/1.1"
@@ -69,6 +96,27 @@ class JsonService:
                 logger.debug("%s %s", service.name, fmt % args)
 
             def _dispatch(self, method):
+                t0 = service._clock()
+                self._status = 0
+                self._endpoint = "<unmatched>"
+                trace_id = self.headers.get(TRACE_HEADER)
+                prev_trace = get_trace_context()
+                if trace_id:
+                    set_trace_context(trace_id)
+                try:
+                    self._handle(method)
+                finally:
+                    if trace_id:
+                        set_trace_context(prev_trace)
+                    try:
+                        service.http_metrics.observe(
+                            method, self._endpoint, self._status,
+                            service._clock() - t0)
+                    except Exception:
+                        logger.exception("%s: http metrics observe failed",
+                                         service.name)
+
+            def _handle(self, method):
                 path = self.path.split("?")[0]
                 query = {}
                 if "?" in self.path:
@@ -88,6 +136,7 @@ class JsonService:
                     m = r.regex.match(path)
                     if not m:
                         continue
+                    self._endpoint = r.pattern
                     try:
                         req = Request(path=path, params=m.groupdict(),
                                       query=query, body=body, raw=raw,
@@ -114,6 +163,7 @@ class JsonService:
 
             def _reply(self, code, payload: bytes,
                        content_type: str = "application/json"):
+                self._status = code
                 self.send_response(code)
                 self.send_header("Content-Type", content_type)
                 self.send_header("Content-Length", str(len(payload)))
@@ -174,13 +224,22 @@ class Request:
 
 def http_json(method: str, url: str, body: Any = None,
               timeout: float = 300.0, raw_body: Optional[bytes] = None,
-              content_type: Optional[str] = None) -> Any:
+              content_type: Optional[str] = None,
+              trace_id: Optional[str] = None) -> Any:
     """JSON request helper with the shared error envelope.
 
     Pass raw_body/content_type instead of body for opaque payloads (e.g.
     multipart uploads); the response is still parsed as JSON.
+
+    The thread's trace context (or an explicit trace_id) is attached as
+    the X-KubeML-Trace-Id header, so a request handled inside a traced
+    server thread propagates the id downstream without every call site
+    knowing about tracing.
     """
     headers = {}
+    trace_id = trace_id or get_trace_context()
+    if trace_id:
+        headers[TRACE_HEADER] = trace_id
     if raw_body is not None:
         data = raw_body
         if content_type:

@@ -62,6 +62,8 @@ from kubeml_tpu.train.checkpoint import (checkpoint_saved_at,
 from kubeml_tpu.train.functionlib import FunctionRegistry
 from kubeml_tpu.train.history import HistoryStore
 from kubeml_tpu.train.job import JobCallbacks, TrainJob
+from kubeml_tpu.utils.trace import (TraceSink, Tracer, get_trace_context,
+                                    make_trace_id, merge_job_trace)
 
 logger = logging.getLogger("kubeml_tpu.ps")
 
@@ -283,6 +285,7 @@ class ParameterServer(JsonService):
         self.route("DELETE", "/stop/{jobId}", self._h_stop)
         self.route("GET", "/tasks", self._h_tasks)
         self.route("GET", "/metrics", self._h_prom)
+        self.route("GET", "/trace", self._h_trace)
         self.route("POST", "/infer", self._h_infer)
 
     @property
@@ -295,7 +298,21 @@ class ParameterServer(JsonService):
 
     def _h_start(self, req: Request):
         task = TrainTask.from_dict(req.body)
-        self.start_task(task)
+        # adopt the propagated trace id (header context when the task
+        # predates the trace_id field) and leave the PS's own mark on
+        # the job timeline — one span covering launch, flushed to the
+        # per-job trace dir for merge_job_trace
+        if not task.trace_id:
+            task.trace_id = get_trace_context() or make_trace_id()
+        tracer = Tracer(trace_id=task.trace_id)
+        with tracer.span("ps.start_task", job_id=task.job_id,
+                         mode="standalone" if self.standalone_jobs
+                         else "threaded"):
+            self.start_task(task)
+        try:
+            TraceSink(task.job_id, "ps").write(tracer)
+        except OSError:
+            logger.exception("ps: trace flush failed for %s", task.job_id)
         return {"job_id": task.job_id}
 
     def _h_update(self, req: Request):
@@ -331,8 +348,22 @@ class ParameterServer(JsonService):
             return [r.task.to_dict() for r in self.jobs.values()]
 
     def _h_prom(self, req: Request):
-        return Raw(self.metrics.exposition().encode(),
-                   "text/plain; version=0.0.4")
+        # job families plus this service's HTTP middleware series, one
+        # scrape target
+        text = self.metrics.exposition() + self.http_metrics.exposition()
+        return Raw(text.encode(), "text/plain; version=0.0.4")
+
+    def _h_trace(self, req: Request):
+        """Merged Chrome trace for a job (?id=<jobId>): every process's
+        TraceSink file plus any xla_profile capture, one Perfetto-
+        loadable document."""
+        job_id = req.query.get("id", "")
+        if not job_id:
+            raise InvalidArgsError("id query parameter required")
+        try:
+            return merge_job_trace(job_id)
+        except FileNotFoundError:
+            raise JobNotFoundError(f"{job_id} (no trace recorded)")
 
     def _h_infer(self, req: Request):
         model_id = req.body.get("model_id")
@@ -497,6 +528,11 @@ class ParameterServer(JsonService):
         cmd = [sys.executable, "-m", "kubeml_tpu.train.jobserver",
                "--job-id", task.job_id, "--ps-url", self.url,
                "--port-file", port_file]
+        if task.trace_id:
+            # argv (not just the /start task payload) so the child's
+            # spans correlate even for rounds logged before the task
+            # arrives, and across watchdog restarts
+            cmd += ["--trace-id", task.trace_id]
         mirror_cpu = 0
         if self._mesh is not None:
             # explicit mesh: size hint + (tests) mirror a virtual-CPU view

@@ -28,6 +28,8 @@ from kubeml_tpu.api.types import TrainRequest, TrainTask
 from kubeml_tpu.control.httpd import JsonService, Request, http_json
 from kubeml_tpu.control.policy import SchedulerPolicy, ThroughputBasedPolicy
 from kubeml_tpu.utils.ids import make_job_id
+from kubeml_tpu.utils.trace import (TraceSink, Tracer, get_trace_context,
+                                    make_trace_id)
 
 logger = logging.getLogger("kubeml_tpu.scheduler")
 
@@ -108,8 +110,18 @@ class Scheduler(JsonService):
             train_req = TrainRequest.from_dict(req.body)
         except (KeyError, TypeError, ValueError) as e:
             raise InvalidArgsError(f"bad train request: {e}")
-        task = TrainTask(job_id=make_job_id(), parameters=train_req)
-        self.queue.push(task)
+        # bind the client-minted trace id (header -> thread context, set
+        # by the middleware) to the task: the scheduling loop runs in
+        # another thread, so the id must ride the task, not the context
+        task = TrainTask(job_id=make_job_id(), parameters=train_req,
+                         trace_id=get_trace_context() or make_trace_id())
+        tracer = Tracer(trace_id=task.trace_id)
+        with tracer.span("scheduler.enqueue", job_id=task.job_id):
+            self.queue.push(task)
+        try:
+            TraceSink(task.job_id, "scheduler").write(tracer)
+        except OSError:
+            logger.exception("trace flush failed for %s", task.job_id)
         logger.info("queued train task %s (%s on %s)", task.job_id,
                     train_req.model_type, train_req.dataset)
         return {"id": task.job_id}
@@ -182,12 +194,15 @@ class Scheduler(JsonService):
         if self.ps_url is None:
             logger.warning("no PS configured; dropping task %s", task.job_id)
             return
+        # explicit trace_id: the loop thread has no ambient context
         if is_new:
             logger.info("starting task %s with parallelism %d", task.job_id,
                         parallelism)
-            http_json("POST", f"{self.ps_url}/start", task.to_dict())
+            http_json("POST", f"{self.ps_url}/start", task.to_dict(),
+                      trace_id=task.trace_id or None)
         else:
             logger.info("updating task %s to parallelism %d", task.job_id,
                         parallelism)
             http_json("POST", f"{self.ps_url}/update/{task.job_id}",
-                      {"parallelism": parallelism})
+                      {"parallelism": parallelism},
+                      trace_id=task.trace_id or None)

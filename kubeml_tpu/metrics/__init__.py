@@ -1,3 +1,5 @@
-from kubeml_tpu.metrics.prom import Gauge, MetricsRegistry
+from kubeml_tpu.metrics.prom import (Counter, Gauge, Histogram,
+                                     HttpMetrics, MetricsRegistry)
 
-__all__ = ["Gauge", "MetricsRegistry"]
+__all__ = ["Counter", "Gauge", "Histogram", "HttpMetrics",
+           "MetricsRegistry"]

@@ -12,13 +12,62 @@ unchanged against our /metrics endpoint:
     kubeml_job_running_total{type=...}
 
 Per-job series are cleared when a job finishes (metrics.go:90-106).
+
+Beyond the gauge parity set, this module now carries proper counter and
+histogram families (exposition format 0.0.4: cumulative monotone
+``_bucket`` series ending in ``le="+Inf"``, plus ``_sum``/``_count``):
+per-job round phase latencies (dispatch / data-wait / merge) fed from
+the job's tracer via MetricUpdate.phase_times, per-endpoint HTTP
+request duration + status counters recorded by the JsonService
+middleware (`HttpMetrics`), and the watchdog restart total — which was
+previously (wrongly) exposed as a gauge although it is monotone.
+tools/check_metrics.py lints the combined exposition.
 """
 
 from __future__ import annotations
 
 import math
 import threading
-from typing import Dict, Tuple
+from typing import Dict, Iterable, List, Sequence, Tuple, Union
+
+LabelValues = Union[str, Sequence[str]]
+
+# Latency buckets: 1ms..60s, roughly log-spaced.  Host-side round phases
+# on CPU tier-1 land mid-range; real TPU dispatches land in the low
+# buckets; stragglers and cold compiles still resolve above 1s instead
+# of all collapsing into +Inf.
+DEFAULT_TIME_BUCKETS = (0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1,
+                        0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0)
+
+
+def _escape(value: str) -> str:
+    return (str(value).replace("\\", "\\\\").replace('"', '\\"')
+            .replace("\n", "\\n"))
+
+
+def _fmt_labels(names: Sequence[str], values: Sequence[str],
+                extra: Tuple[str, str] = None) -> str:
+    pairs = [f'{n}="{_escape(v)}"' for n, v in zip(names, values)]
+    if extra is not None:
+        pairs.append(f'{extra[0]}="{extra[1]}"')
+    return "{" + ",".join(pairs) + "}" if pairs else ""
+
+
+def _fmt_value(v) -> str:
+    if isinstance(v, float) and math.isnan(v):
+        return "NaN"
+    return str(v)
+
+
+def _key(labels: Sequence[str], values: LabelValues) -> Tuple[str, ...]:
+    if isinstance(values, str):
+        values = (values,)
+    values = tuple(str(v) for v in values)
+    if len(values) != len(labels):
+        raise ValueError(
+            f"expected {len(labels)} label values {tuple(labels)}, "
+            f"got {values}")
+    return values
 
 
 class Gauge:
@@ -46,10 +95,159 @@ class Gauge:
                  f"# TYPE {self.name} gauge"]
         with self._lock:
             for lv, v in sorted(self._values.items()):
-                if isinstance(v, float) and math.isnan(v):
-                    v = "NaN"
-                lines.append(f'{self.name}{{{self.label}="{lv}"}} {v}')
+                lines.append(
+                    f'{self.name}{{{self.label}="{_escape(lv)}"}} '
+                    f'{_fmt_value(v)}')
         return "\n".join(lines)
+
+
+class Counter:
+    """Monotone counter family; name must end in ``_total`` by
+    convention (enforced by tools/check_metrics.py)."""
+
+    def __init__(self, name: str, help_: str, labels: LabelValues):
+        self.name = name
+        self.help = help_
+        self.labels = (labels,) if isinstance(labels, str) else tuple(labels)
+        self._values: Dict[Tuple[str, ...], float] = {}
+        self._lock = threading.Lock()
+
+    def inc(self, label_values: LabelValues, delta: float = 1.0):
+        if delta < 0:
+            raise ValueError("counters only go up")
+        key = _key(self.labels, label_values)
+        with self._lock:
+            self._values[key] = self._values.get(key, 0.0) + delta
+
+    def value(self, label_values: LabelValues) -> float:
+        key = _key(self.labels, label_values)
+        with self._lock:
+            return self._values.get(key, 0.0)
+
+    def collect(self) -> str:
+        lines = [f"# HELP {self.name} {self.help}",
+                 f"# TYPE {self.name} counter"]
+        with self._lock:
+            for key, v in sorted(self._values.items()):
+                lines.append(
+                    f"{self.name}{_fmt_labels(self.labels, key)} "
+                    f"{_fmt_value(v)}")
+        return "\n".join(lines)
+
+
+class Histogram:
+    """Cumulative histogram family (exposition format 0.0.4).
+
+    Per labelset: ``name_bucket{...,le="b"}`` for each upper bound plus
+    ``le="+Inf"``, then ``name_sum`` and ``name_count``.  Buckets are
+    cumulative and monotone by construction; bounds must be strictly
+    increasing.
+    """
+
+    def __init__(self, name: str, help_: str, labels: LabelValues,
+                 buckets: Sequence[float] = DEFAULT_TIME_BUCKETS):
+        self.name = name
+        self.help = help_
+        self.labels = (labels,) if isinstance(labels, str) else tuple(labels)
+        buckets = tuple(float(b) for b in buckets)
+        if not buckets:
+            raise ValueError("histogram needs at least one bucket bound")
+        if any(b2 <= b1 for b1, b2 in zip(buckets, buckets[1:])):
+            raise ValueError(f"bucket bounds must strictly increase: "
+                             f"{buckets}")
+        self.buckets = buckets
+        # per labelset: [per-bound counts..., +Inf count], sum
+        self._data: Dict[Tuple[str, ...], List] = {}
+        self._lock = threading.Lock()
+
+    def observe(self, label_values: LabelValues, value: float):
+        key = _key(self.labels, label_values)
+        value = float(value)
+        with self._lock:
+            entry = self._data.get(key)
+            if entry is None:
+                entry = [[0] * (len(self.buckets) + 1), 0.0]
+                self._data[key] = entry
+            counts, _ = entry
+            for i, bound in enumerate(self.buckets):
+                if value <= bound:
+                    counts[i] += 1
+                    break
+            else:
+                counts[len(self.buckets)] += 1
+            entry[1] += value
+
+    def clear(self, label_values: LabelValues):
+        with self._lock:
+            self._data.pop(_key(self.labels, label_values), None)
+
+    @staticmethod
+    def _fmt_bound(b: float) -> str:
+        s = repr(b)
+        return s[:-2] if s.endswith(".0") else s
+
+    def collect(self) -> str:
+        lines = [f"# HELP {self.name} {self.help}",
+                 f"# TYPE {self.name} histogram"]
+        with self._lock:
+            for key, (counts, total) in sorted(self._data.items()):
+                cum = 0
+                for bound, n in zip(self.buckets, counts):
+                    cum += n
+                    labels = _fmt_labels(self.labels, key,
+                                         ("le", self._fmt_bound(bound)))
+                    lines.append(f"{self.name}_bucket{labels} {cum}")
+                cum += counts[-1]
+                labels = _fmt_labels(self.labels, key, ("le", "+Inf"))
+                lines.append(f"{self.name}_bucket{labels} {cum}")
+                plain = _fmt_labels(self.labels, key)
+                lines.append(f"{self.name}_sum{plain} {_fmt_value(total)}")
+                lines.append(f"{self.name}_count{plain} {cum}")
+        return "\n".join(lines)
+
+
+class HttpMetrics:
+    """Per-endpoint HTTP request counters + duration histogram, recorded
+    by the JsonService middleware on every service (PS, scheduler,
+    controller, jobserver).  The endpoint label is the registered route
+    *pattern* (``/update/{jobId}``), never the raw path, so cardinality
+    stays bounded."""
+
+    # HTTP handlers are quick JSON hops; sub-ms matters more than the
+    # multi-second tail, so shift the default bucket grid down.
+    BUCKETS = (0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1,
+               0.25, 0.5, 1.0, 2.5, 10.0)
+
+    def __init__(self, service: str):
+        self.service = service
+        self.requests = Counter(
+            "kubeml_http_requests_total",
+            "HTTP requests handled, by service/method/endpoint/status",
+            ("service", "method", "endpoint", "status"))
+        self.duration = Histogram(
+            "kubeml_http_request_duration_seconds",
+            "HTTP request handling latency, by service/method/endpoint",
+            ("service", "method", "endpoint"), buckets=self.BUCKETS)
+
+    def observe(self, method: str, endpoint: str, status: int,
+                seconds: float):
+        self.requests.inc((self.service, method, endpoint, str(status)))
+        self.duration.observe((self.service, method, endpoint), seconds)
+
+    def exposition(self) -> str:
+        return (self.requests.collect() + "\n"
+                + self.duration.collect() + "\n")
+
+
+# Tracer span name -> (histogram attribute, family name) for the phase
+# latencies pushed per epoch via MetricUpdate.phase_times.  device_drain
+# is the blocking merged-loss readback — the host-visible "merge" cost
+# (the weight merge itself is fused on-device into the dispatch).
+PHASE_HISTOGRAMS = {
+    "dispatch": "dispatch_seconds",
+    "data_wait": "data_wait_seconds",
+    "device_drain": "merge_seconds",
+}
 
 
 class MetricsRegistry:
@@ -86,13 +284,28 @@ class MetricsRegistry:
         self.restarts = Gauge(
             "kubeml_job_restarts",
             "Watchdog restarts of a job's standalone process", "jobid")
-        self.restarts_total = Gauge(
+        self.restarts_total = Counter(
             "kubeml_ps_restarts_total",
             "Total watchdog restarts since the PS started", "type")
+        # round-phase latency distributions, fed from the job tracer's
+        # per-epoch durations (MetricUpdate.phase_times)
+        self.dispatch_seconds = Histogram(
+            "kubeml_job_dispatch_seconds",
+            "Round dispatch latency (device step calls) of a job", "jobid")
+        self.data_wait_seconds = Histogram(
+            "kubeml_job_data_wait_seconds",
+            "Time a job's round loop blocked waiting for input data",
+            "jobid")
+        self.merge_seconds = Histogram(
+            "kubeml_job_merge_seconds",
+            "Merged-result readback (device drain) latency of a job",
+            "jobid")
         self._job_gauges = [self.validation_loss, self.validation_accuracy,
                             self.train_loss, self.parallelism,
                             self.epoch_duration, self.dropped_workers,
                             self.quarantined_workers, self.restarts]
+        self._job_hists = [self.dispatch_seconds, self.data_wait_seconds,
+                           self.merge_seconds]
 
     def update_job(self, m) -> None:
         """Apply a MetricUpdate (ml/pkg/ps/metrics.go:90-99)."""
@@ -103,6 +316,10 @@ class MetricsRegistry:
         self.epoch_duration.set(m.job_id, m.epoch_duration)
         self.dropped_workers.set(m.job_id, m.dropped_workers)
         self.quarantined_workers.set(m.job_id, m.quarantined_workers)
+        for span, attr in PHASE_HISTOGRAMS.items():
+            hist = getattr(self, attr)
+            for seconds in getattr(m, "phase_times", {}).get(span, ()):
+                hist.observe(m.job_id, seconds)
 
     def note_restart(self, job_id: str) -> None:
         """One watchdog restart: bump the per-job gauge and the
@@ -114,8 +331,11 @@ class MetricsRegistry:
     def clear_job(self, job_id: str) -> None:
         for g in self._job_gauges:
             g.clear(job_id)
+        for h in self._job_hists:
+            h.clear(job_id)
 
     def exposition(self) -> str:
-        gauges = self._job_gauges + [self.running_total,
-                                     self.restarts_total]
-        return "\n".join(g.collect() for g in gauges) + "\n"
+        families = (self._job_gauges + [self.running_total,
+                                        self.restarts_total]
+                    + self._job_hists)
+        return "\n".join(f.collect() for f in families) + "\n"

@@ -50,8 +50,10 @@ from kubeml_tpu.train.checkpoint import (AsyncCheckpointer,
                                          mark_checkpoint_completed,
                                          save_checkpoint)
 from kubeml_tpu.train.history import HistoryStore
+from kubeml_tpu.metrics.prom import PHASE_HISTOGRAMS
 from kubeml_tpu.utils.env import limit_parallelism
-from kubeml_tpu.utils.trace import Tracer
+from kubeml_tpu.utils.trace import (TraceSink, Tracer, get_trace_context,
+                                    make_trace_id)
 
 logger = logging.getLogger("kubeml_tpu.train")
 
@@ -199,6 +201,7 @@ class TrainJob:
         self._epoch_quarantined = 0
         self._checkpointer = AsyncCheckpointer()
         self.tracer = Tracer()  # host-phase spans, summarized per epoch
+        self._trace_sink: Optional[TraceSink] = None
         self.stop_event = threading.Event()
         self.history = JobHistory()
         self.exit_err: Optional[str] = None
@@ -262,6 +265,16 @@ class TrainJob:
         """Run the job to completion. Returns the saved History record."""
         job_id = self.task.job_id
         self._open_log_file()
+        # correlate this process's spans with the client-minted trace id
+        # (task field for cross-process starts, ambient context for
+        # threaded ones); mint one if the job was started directly so
+        # the exported timeline is always well-formed
+        if not self.tracer.trace_id:
+            self.tracer.trace_id = (self.task.trace_id
+                                    or get_trace_context()
+                                    or make_trace_id())
+        self.task.trace_id = self.tracer.trace_id
+        self._trace_sink = TraceSink(job_id, "job")
         try:
             self._init_model()
             parallelism = self.task.parallelism or \
@@ -289,7 +302,9 @@ class TrainJob:
             for epoch in range(self._start_epoch, epochs):
                 t0 = time.time()
                 used_parallelism = parallelism
-                train_loss = self._train_epoch(parallelism, epoch)
+                with self.tracer.span("epoch", epoch=epoch,
+                                      parallelism=parallelism):
+                    train_loss = self._train_epoch(parallelism, epoch)
                 elapsed = time.time() - t0
                 # the policy sees STEADY-STATE duration: compile time
                 # (one-time per program, persistently cached) is not
@@ -330,17 +345,22 @@ class TrainJob:
                 self.history.dropped_workers.append(self._epoch_dropped)
                 self.history.quarantined_workers.append(
                     self._epoch_quarantined)
+                phase_times = {k: v for k, v
+                               in self.tracer.durations().items()
+                               if k in PHASE_HISTOGRAMS}
                 self.callbacks.publish_metrics(MetricUpdate(
                     job_id=job_id, validation_loss=val_loss,
                     accuracy=accuracy, train_loss=train_loss,
                     parallelism=used_parallelism, epoch_duration=elapsed,
                     dropped_workers=self._epoch_dropped,
-                    quarantined_workers=self._epoch_quarantined))
+                    quarantined_workers=self._epoch_quarantined,
+                    phase_times=phase_times))
                 self._log("job %s epoch %d/%d loss=%.4f val=%.4f acc=%.2f "
                             "N=%d %.2fs [%s]", job_id, epoch + 1, epochs,
                             train_loss, val_loss, accuracy, used_parallelism,
                             elapsed, self.tracer.format_summary())
                 self.tracer.reset()
+                self._flush_trace()  # crash-survivable partial timeline
 
                 # checkpoint cadence: explicit every-N, or (default
                 # auto) every validated epoch — so a running job is
@@ -433,9 +453,20 @@ class TrainJob:
             # kill at process exit) and a long-lived server doesn't
             # accumulate idle writer threads
             self._checkpointer.close()
+            self._flush_trace()
             self._close_log_file()
 
     # ------------------------------------------------------------ internals
+
+    def _flush_trace(self) -> None:
+        """Rewrite this process's trace file; never fails the job."""
+        if self._trace_sink is None:
+            return
+        try:
+            self._trace_sink.write(self.tracer)
+        except OSError:
+            self._log("job %s: trace flush failed", self.task.job_id,
+                      exc=True)
 
     def _manifest(self, epoch: Optional[int] = None,
                   parallelism: Optional[int] = None,
@@ -980,20 +1011,35 @@ class TrainJob:
         if group > 1:
             source = group_rounds(source, group)
         rounds = iter(prefetch_rounds(source, depth=1, transform=transform))
+        # Each iteration runs inside a "round" span that opens BEFORE the
+        # data wait and stays open across the yield: the consumer's
+        # dispatch executes while this generator is suspended inside the
+        # with-block, so data_wait AND dispatch spans nest under the
+        # round (epoch > round > phase in the exported timeline) without
+        # threading tracer state through the engine loops. The final
+        # probe of an exhausted iterator still records a round span
+        # carrying only its data_wait; it is tagged tail=True so
+        # timeline consumers can tell it from a trained round.
+        round_no = 0
         while True:
-            with self.tracer.span("data_wait"):
-                rb = next(rounds, None)
-            if rb is None:
-                return
-            if isinstance(rb, RoundGroup):
-                yield rb
-                continue
-            if self.round_hook is not None:
-                rb = self.round_hook(rb)
-            if rb.worker_mask.sum() < 1:
-                raise MergeError(
-                    f"round {rb.round_index}: no workers contributed")
-            yield rb
+            with self.tracer.span("round", round=round_no) as sp:
+                with self.tracer.span("data_wait"):
+                    rb = next(rounds, None)
+                if rb is None:
+                    sp["tail"] = True
+                    return
+                if isinstance(rb, RoundGroup):
+                    sp["rounds"] = rb.rounds
+                    yield rb
+                else:
+                    if self.round_hook is not None:
+                        rb = self.round_hook(rb)
+                    if rb.worker_mask.sum() < 1:
+                        raise MergeError(
+                            f"round {rb.round_index}: no workers contributed")
+                    sp["workers"] = int(rb.worker_mask.sum())
+                    yield rb
+            round_no += 1
 
     def _note_round_times(self, round_times) -> None:
         """Derive this epoch's compile overhead from per-dispatch times

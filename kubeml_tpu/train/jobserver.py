@@ -51,12 +51,16 @@ class JobServer(JsonService):
 
     def __init__(self, job_id: str, ps_url: Optional[str] = None,
                  scheduler_url: Optional[str] = None, port: int = 0,
-                 mesh=None):
+                 mesh=None, trace_id: Optional[str] = None):
         super().__init__(port=port)
         self.job_id = job_id
         self.ps_url = ps_url
         self.scheduler_url = scheduler_url
         self.mesh = mesh
+        # propagated over argv by the PS spawn (falls back to the task's
+        # wire field in _launch) so this process's spans join the
+        # client-minted trace
+        self.trace_id = trace_id
         self.finished = threading.Event()  # set after the job ends
         self.exit_error: Optional[str] = None
         self._job = None
@@ -102,6 +106,7 @@ class JobServer(JsonService):
         from kubeml_tpu.train.history import HistoryStore
         from kubeml_tpu.train.job import JobCallbacks, TrainJob
 
+        task.trace_id = task.trace_id or self.trace_id or ""
         fn_name = task.parameters.function_name or task.parameters.model_type
         model_cls, dataset_cls = FunctionRegistry().resolve(fn_name)
         model = model_cls()
@@ -180,6 +185,9 @@ def main(argv=None):
                    help="data-axis size (default: all devices)")
     p.add_argument("--virtual-cpu-devices", type=int, default=0,
                    help="retarget JAX at N virtual CPU devices (tests)")
+    p.add_argument("--trace-id", default=os.environ.get("KUBEML_TRACE_ID"),
+                   help="trace id minted by the client (cross-process "
+                        "span correlation)")
     args = p.parse_args(argv)
 
     logging.basicConfig(level=logging.INFO)
@@ -220,7 +228,7 @@ def main(argv=None):
     mesh = make_mesh(n_data=args.mesh_data or None)
     server = JobServer(args.job_id, ps_url=args.ps_url,
                        scheduler_url=args.scheduler_url, port=args.port,
-                       mesh=mesh)
+                       mesh=mesh, trace_id=args.trace_id)
     port = server.start()
     if args.port_file:
         tmp = args.port_file + ".tmp"

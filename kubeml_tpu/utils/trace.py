@@ -1,16 +1,35 @@
-"""Lightweight span tracing + optional XLA profiler capture.
+"""Cross-process span tracing + Chrome-trace export + XLA profiler capture.
 
 The reference has no tracing subsystem — only ad-hoc zap timings around
 the merge and epoch loops (ml/pkg/train/job.go:307,397,412) and an
 out-of-band psutil sampler in the experiment harness (SURVEY.md §5).
-Here tracing is structural:
+Here tracing is structural, Dapper-style:
 
-  - `Tracer.span(name)` wraps any host-side phase; per-epoch summaries
-    (count / total / mean) go to the job log, so `kubeml logs --id`
-    shows where wall-clock went (data wait vs device dispatch vs
-    readback) without external tooling;
+  - the SDK client mints a ``trace_id`` which rides the
+    ``X-KubeML-Trace-Id`` HTTP header (control/httpd.py middleware)
+    through controller, scheduler and PS, and reaches the spawned
+    standalone job process via argv — so spans from all four processes
+    correlate on one id;
+  - `Tracer.span(name, **args)` wraps any host-side phase.  Each
+    completed span is both (a) an entry in the per-epoch summary
+    (count / total / mean — goes to the job log, so `kubeml logs --id`
+    shows where wall-clock went without external tooling) and (b) a
+    Chrome trace-event (``ph: "X"``, microsecond ts/dur, args carrying
+    trace_id / parent / caller kwargs).  Nesting is tracked per thread,
+    so the exported timeline shows epoch > round > {data_wait, dispatch,
+    merge/readback};
+  - `TraceSink` writes each process's events to
+    ``$KUBEML_HOME/traces/<job_id>/<process>-<pid>.trace.json`` and
+    `merge_job_trace` combines all of them — plus any `xla_profile`
+    capture dropped in the same directory — into one Perfetto-viewable
+    file (served by the PS ``/trace?id=`` endpoint and
+    ``kubeml trace --id``);
   - `xla_profile(dir)` captures a real XLA profiler trace (viewable in
     TensorBoard / Perfetto) around any block, for kernel-level work.
+
+All timing goes through an injectable ``clock`` (default
+``time.time``, so cross-process timestamps align) which tests replace
+with a fake to assert exact span trees deterministically.
 
 Host-side spans are the right default on TPU: the device timeline
 belongs to XLA's profiler, while the host loop — input assembly, round
@@ -22,36 +41,134 @@ from __future__ import annotations
 
 import collections
 import contextlib
+import gzip
+import json
+import os
+import threading
 import time
-from typing import Dict, List, Tuple
+import uuid
+from typing import Callable, Dict, List, Optional
+
+TRACE_HEADER = "X-KubeML-Trace-Id"
+TRACE_ENV = "KUBEML_TRACE_ID"
+
+_context = threading.local()
+
+
+def make_trace_id() -> str:
+    """Mint a new 16-hex-char trace id (client side of propagation)."""
+    return uuid.uuid4().hex[:16]
+
+
+def get_trace_context() -> Optional[str]:
+    """Trace id bound to the current thread (set by the HTTP middleware
+    on the server side, or by `trace_context` on the client side)."""
+    return getattr(_context, "trace_id", None)
+
+
+def set_trace_context(trace_id: Optional[str]) -> None:
+    _context.trace_id = trace_id
+
+
+@contextlib.contextmanager
+def trace_context(trace_id: Optional[str]):
+    """Bind trace_id to this thread for the duration of the block; every
+    `http_json` call inside automatically carries it as a header."""
+    prev = get_trace_context()
+    set_trace_context(trace_id)
+    try:
+        yield
+    finally:
+        set_trace_context(prev)
 
 
 class Tracer:
-    """Accumulates named spans; cheap enough to stay on in production."""
+    """Accumulates named spans; cheap enough to stay on in production.
 
-    def __init__(self):
+    Thread-safe: spans are recorded from watchdog / dispatch threads
+    (train/job.py, control/ps.py), so all mutable state is behind a
+    lock.  Per-thread nesting stacks give each event a ``parent`` link
+    without cross-thread false nesting.
+    """
+
+    def __init__(self, clock: Optional[Callable[[], float]] = None,
+                 trace_id: Optional[str] = None, max_events: int = 200_000):
+        self._clock = clock or time.time
+        self.trace_id = trace_id
+        self.max_events = max_events
+        self.dropped_events = 0
+        self._lock = threading.Lock()
         self._spans: Dict[str, List[float]] = collections.defaultdict(list)
+        self._events: List[dict] = []
+        self._tls = threading.local()
+
+    def _stack(self) -> List[str]:
+        stack = getattr(self._tls, "stack", None)
+        if stack is None:
+            stack = []
+            self._tls.stack = stack
+        return stack
+
+    def _record(self, name: str, t0: float, dur: float,
+                parent: Optional[str], args: dict) -> None:
+        with self._lock:
+            self._spans[name].append(dur)
+            if len(self._events) >= self.max_events:
+                self.dropped_events += 1
+                return
+            ev_args = dict(args)
+            if self.trace_id:
+                ev_args["trace_id"] = self.trace_id
+            if parent:
+                ev_args["parent"] = parent
+            self._events.append({
+                "name": name,
+                "ph": "X",
+                "ts": round(t0 * 1e6),
+                "dur": round(dur * 1e6),
+                "pid": os.getpid(),
+                "tid": threading.get_ident() % (1 << 31),
+                "args": ev_args,
+            })
 
     @contextlib.contextmanager
-    def span(self, name: str):
-        t0 = time.perf_counter()
+    def span(self, name: str, **args):
+        """Time a block.  Yields the args dict, which is snapshotted at
+        span *end* — so the body can attach facts it only learns while
+        running (worker counts, tail markers)."""
+        stack = self._stack()
+        parent = stack[-1] if stack else None
+        stack.append(name)
+        t0 = self._clock()
         try:
-            yield
+            yield args
         finally:
-            self._spans[name].append(time.perf_counter() - t0)
+            dur = self._clock() - t0
+            stack.pop()
+            self._record(name, t0, dur, parent, args)
 
-    def add(self, name: str, seconds: float):
-        self._spans[name].append(seconds)
+    def add(self, name: str, seconds: float, **args):
+        """Record an externally-timed span ending now."""
+        end = self._clock()
+        stack = self._stack()
+        parent = stack[-1] if stack else None
+        self._record(name, end - seconds, seconds, parent, args)
 
     def summary(self) -> Dict[str, Dict[str, float]]:
-        return {
-            name: {
-                "count": len(xs),
-                "total_s": round(sum(xs), 4),
-                "mean_s": round(sum(xs) / len(xs), 6),
+        with self._lock:
+            return {
+                name: {
+                    "count": len(xs),
+                    "total_s": round(sum(xs), 4),
+                    "mean_s": round(sum(xs) / len(xs), 6),
+                }
+                for name, xs in self._spans.items()
             }
-            for name, xs in self._spans.items()
-        }
+
+    def durations(self) -> Dict[str, List[float]]:
+        """Raw per-span duration lists (feeds the PS phase histograms)."""
+        with self._lock:
+            return {name: list(xs) for name, xs in self._spans.items()}
 
     def format_summary(self) -> str:
         parts = []
@@ -60,9 +177,106 @@ class Tracer:
         return " ".join(parts)
 
     def reset(self) -> Dict[str, Dict[str, float]]:
+        """Clear the per-epoch duration summaries.  Timeline events are
+        kept — the epoch log line is periodic, the exported trace is the
+        whole job."""
         out = self.summary()
-        self._spans.clear()
+        with self._lock:
+            self._spans.clear()
         return out
+
+    def events(self) -> List[dict]:
+        with self._lock:
+            return list(self._events)
+
+
+def trace_dir(job_id: str, home: Optional[str] = None) -> str:
+    if home is None:
+        from kubeml_tpu.api.const import kubeml_home
+        home = kubeml_home()
+    return os.path.join(home, "traces", job_id)
+
+
+class TraceSink:
+    """Writes one process's trace events to the per-job trace directory.
+
+    Each writer owns ``<process>-<pid>.trace.json`` (pid-suffixed so a
+    restarted standalone incarnation gets its own file instead of
+    clobbering the crashed one's partial timeline).  Writes are atomic
+    (tmp + rename) so the merger never reads a torn file, and the whole
+    file is rewritten on each flush — callers flush per epoch, keeping a
+    crash-survivable partial trace on disk.
+    """
+
+    def __init__(self, job_id: str, process: str,
+                 home: Optional[str] = None):
+        self.job_id = job_id
+        self.process = process
+        self.dir = trace_dir(job_id, home)
+        self.path = os.path.join(
+            self.dir, f"{process}-{os.getpid()}.trace.json")
+
+    def write(self, tracer: Tracer) -> str:
+        pid = os.getpid()
+        events = [{
+            "name": "process_name", "ph": "M", "pid": pid, "tid": 0,
+            "args": {"name": f"{self.process}:{self.job_id}"},
+        }]
+        events.extend(tracer.events())
+        doc = {"traceEvents": events, "displayTimeUnit": "ms",
+               "metadata": {"process": self.process,
+                            "job_id": self.job_id,
+                            "trace_id": tracer.trace_id or ""}}
+        os.makedirs(self.dir, exist_ok=True)
+        tmp = f"{self.path}.tmp.{pid}"
+        with open(tmp, "w") as f:
+            json.dump(doc, f)
+        os.replace(tmp, self.path)
+        return self.path
+
+
+def _load_trace_events(path: str) -> List[dict]:
+    if path.endswith(".gz"):
+        with gzip.open(path, "rt") as f:
+            doc = json.load(f)
+    else:
+        with open(path) as f:
+            doc = json.load(f)
+    if isinstance(doc, list):  # bare Chrome trace array form
+        return doc
+    return list(doc.get("traceEvents", []))
+
+
+def merge_job_trace(job_id: str, home: Optional[str] = None) -> dict:
+    """Merge every per-process trace file under traces/<job_id>/ — our
+    own `TraceSink` output plus any `xla_profile` capture (the XLA
+    profiler drops ``*.trace.json.gz`` under plugins/profile/) — into
+    one Chrome trace-event document, sorted by timestamp.
+
+    Raises FileNotFoundError when the job has no trace directory.
+    """
+    root = trace_dir(job_id, home)
+    if not os.path.isdir(root):
+        raise FileNotFoundError(root)
+    sources, events = [], []
+    for dirpath, _dirs, files in os.walk(root):
+        for name in sorted(files):
+            if not (name.endswith(".trace.json")
+                    or name.endswith(".trace.json.gz")):
+                continue
+            path = os.path.join(dirpath, name)
+            try:
+                events.extend(_load_trace_events(path))
+                sources.append(os.path.relpath(path, root))
+            except (OSError, ValueError):  # torn/foreign file: skip, keep rest
+                continue
+    events.sort(key=lambda e: (e.get("ts", 0), e.get("pid", 0)))
+    trace_ids = sorted({e["args"]["trace_id"] for e in events
+                        if isinstance(e.get("args"), dict)
+                        and e["args"].get("trace_id")})
+    return {"traceEvents": events, "displayTimeUnit": "ms",
+            "metadata": {"job_id": job_id, "sources": sources,
+                         "trace_ids": trace_ids}}
 
 
 @contextlib.contextmanager

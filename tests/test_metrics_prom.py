@@ -1,0 +1,167 @@
+"""Prometheus metric types + exposition round-trip through the lint's
+text-format parser (tools/check_metrics.py), which also keeps the lint
+itself in the tier-1 suite."""
+
+import math
+
+import pytest
+
+from kubeml_tpu.api.types import MetricUpdate
+from kubeml_tpu.metrics.prom import (Counter, Gauge, Histogram, HttpMetrics,
+                                     MetricsRegistry)
+from tools.check_metrics import (main, parse_exposition, self_test,
+                                 validate_exposition)
+
+
+def test_counter_basics():
+    c = Counter("kubeml_demo_total", "help text", ("a", "b"))
+    c.inc(("x", "y"))
+    c.inc(("x", "y"), 2.0)
+    c.inc(("z", "w"))
+    assert c.value(("x", "y")) == 3.0
+    assert c.value(("missing", "pair")) == 0.0
+    with pytest.raises(ValueError):
+        c.inc(("x", "y"), -1.0)  # counters only go up
+    out = c.collect()
+    assert "# TYPE kubeml_demo_total counter" in out
+    assert 'kubeml_demo_total{a="x",b="y"} 3.0' in out
+    with pytest.raises(ValueError):
+        c.inc("onlyone")  # label arity enforced
+
+
+def test_histogram_cumulative_buckets():
+    h = Histogram("kubeml_demo_seconds", "help", ("op",),
+                  buckets=(0.1, 1.0, 10.0))
+    for v in (0.05, 0.5, 0.7, 5.0, 100.0):
+        h.observe("x", v)
+    out = h.collect()
+    assert "# TYPE kubeml_demo_seconds histogram" in out
+    # cumulative: ≤0.1 -> 1, ≤1 -> 3, ≤10 -> 4, +Inf -> 5
+    assert 'kubeml_demo_seconds_bucket{op="x",le="0.1"} 1' in out
+    assert 'kubeml_demo_seconds_bucket{op="x",le="1"} 3' in out
+    assert 'kubeml_demo_seconds_bucket{op="x",le="10"} 4' in out
+    assert 'kubeml_demo_seconds_bucket{op="x",le="+Inf"} 5' in out
+    assert 'kubeml_demo_seconds_count{op="x"} 5' in out
+    assert f'kubeml_demo_seconds_sum{{op="x"}} {0.05+0.5+0.7+5.0+100.0}' \
+        in out
+    h.clear("x")
+    assert "_bucket" not in h.collect()
+
+
+def test_histogram_rejects_bad_bounds():
+    with pytest.raises(ValueError):
+        Histogram("kubeml_h_seconds", "x", ("a",), buckets=())
+    with pytest.raises(ValueError):
+        Histogram("kubeml_h_seconds", "x", ("a",), buckets=(1.0, 1.0))
+    with pytest.raises(ValueError):
+        Histogram("kubeml_h_seconds", "x", ("a",), buckets=(2.0, 1.0))
+
+
+def test_label_escaping_round_trips():
+    g = Gauge("kubeml_esc", "x", "jobid")
+    g.set('we"ird\\job\n', 1.0)
+    fams = parse_exposition(g.collect())
+    (name, labels, value), = fams["kubeml_esc"]["samples"]
+    assert labels == {"jobid": 'we"ird\\job\n'}
+    assert value == 1.0
+
+
+def test_restarts_total_is_counter():
+    """Satellite fix: the watchdog restart total is monotone and must be
+    typed counter (it was exposed as a gauge), while the per-job gauge
+    families keep their types for dashboard parity."""
+    reg = MetricsRegistry()
+    reg.note_restart("jobx")
+    expo = reg.exposition()
+    assert "# TYPE kubeml_ps_restarts_total counter" in expo
+    assert 'kubeml_ps_restarts_total{type="standalone"} 1' in expo
+    assert "# TYPE kubeml_job_restarts gauge" in expo  # per-job stays gauge
+    assert "# TYPE kubeml_job_running_total gauge" in expo
+
+
+def test_registry_phase_histograms_and_clear():
+    reg = MetricsRegistry()
+    reg.update_job(MetricUpdate(
+        job_id="jobh", validation_loss=0.5, accuracy=0.9, train_loss=0.4,
+        parallelism=8, epoch_duration=1.5,
+        phase_times={"dispatch": [0.01, 0.2, 3.0], "data_wait": [0.002],
+                     "device_drain": [0.05, 0.06],
+                     "epoch": [1.5]}))  # not a phase family: ignored
+    expo = reg.exposition()
+    fams = parse_exposition(expo)
+    for fam, n in (("kubeml_job_dispatch_seconds", 3),
+                   ("kubeml_job_data_wait_seconds", 1),
+                   ("kubeml_job_merge_seconds", 2)):
+        assert fams[fam]["type"] == "histogram"
+        counts = [v for name, labels, v in fams[fam]["samples"]
+                  if name == fam + "_count"]
+        assert counts == [n], fam
+    assert validate_exposition(expo) == []
+    reg.clear_job("jobh")
+    assert 'jobid="jobh"' not in reg.exposition()
+
+
+def test_http_metrics_exposition():
+    m = HttpMetrics("testsvc")
+    m.observe("GET", "/metrics", 200, 0.002)
+    m.observe("GET", "/metrics", 200, 0.004)
+    m.observe("POST", "/update/{jobId}", 404, 0.1)
+    expo = m.exposition()
+    assert validate_exposition(expo) == []
+    fams = parse_exposition(expo)
+    reqs = {tuple(sorted(labels.items())): v for _, labels, v
+            in fams["kubeml_http_requests_total"]["samples"]}
+    assert reqs[(("endpoint", "/metrics"), ("method", "GET"),
+                 ("service", "testsvc"), ("status", "200"))] == 2.0
+    assert reqs[(("endpoint", "/update/{jobId}"), ("method", "POST"),
+                 ("service", "testsvc"), ("status", "404"))] == 1.0
+
+
+def test_full_exposition_round_trip():
+    """The combined PS-style exposition (job families + HTTP middleware
+    families) parses clean through the minimal text-format parser and
+    survives every lint rule."""
+    reg = MetricsRegistry()
+    reg.update_job(MetricUpdate(
+        job_id="rt1", validation_loss=0.1, accuracy=0.8, train_loss=0.2,
+        parallelism=4, epoch_duration=2.0,
+        phase_times={"dispatch": [0.01], "data_wait": [0.001],
+                     "device_drain": [0.02]}))
+    reg.running_total.set("train", 1)
+    reg.note_restart("rt1")
+    http = HttpMetrics("ps")
+    http.observe("GET", "/metrics", 200, 0.001)
+    text = reg.exposition() + http.exposition()
+    assert validate_exposition(text) == []
+    fams = parse_exposition(text)
+    # every family present exactly once, all kubeml_-prefixed, and the
+    # histogram set the PS serves is at least the three phase families
+    # plus HTTP latency
+    hist = {f for f, e in fams.items() if e["type"] == "histogram"}
+    assert {"kubeml_job_dispatch_seconds", "kubeml_job_data_wait_seconds",
+            "kubeml_job_merge_seconds",
+            "kubeml_http_request_duration_seconds"} <= hist
+    # parser recovers the exact observed value through escaping/formatting
+    sums = {labels["jobid"]: v
+            for name, labels, v
+            in fams["kubeml_job_dispatch_seconds"]["samples"]
+            if name.endswith("_sum")}
+    assert math.isclose(sums["rt1"], 0.01)
+
+
+def test_check_metrics_lint():
+    # the validator's own self-test: clean exposition accepted, every
+    # deliberately broken one flagged
+    assert self_test() == []
+    # live-registry mode exits clean
+    assert main(["check_metrics.py"]) == 0
+
+
+def test_check_metrics_flags_broken_file(tmp_path):
+    bad = tmp_path / "expo.txt"
+    bad.write_text("# HELP other_metric x\n# TYPE other_metric gauge\n"
+                   "other_metric 1\n")
+    assert main(["check_metrics.py", str(bad)]) == 1
+    good = tmp_path / "good.txt"
+    good.write_text(MetricsRegistry().exposition())
+    assert main(["check_metrics.py", str(good)]) == 0

@@ -105,7 +105,7 @@ def test_deferred_task_does_not_stall_dispatch(monkeypatch):
     got_normal = threading.Event()
     lock = threading.Lock()
 
-    def fake_http_json(method, url, body=None):
+    def fake_http_json(method, url, body=None, **kwargs):
         jid = body["job_id"]
         with lock:
             dispatched.setdefault(jid, []).append(_time.monotonic())

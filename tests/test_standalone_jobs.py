@@ -46,7 +46,8 @@ def test_standalone_train_updates_and_infer(standalone_stack):
     req = TrainRequest(model_type="mlp", batch_size=32, epochs=3,
                        dataset="blobs", lr=0.1,
                        options=TrainOptions(default_parallelism=2, k=2))
-    job_id = client.v1().networks().train(req)
+    trace_id = "feed0123beef4567"
+    job_id = client.v1().networks().train(req, trace_id=trace_id)
 
     # the job must be running as a child process, not a thread (records
     # are reserved before the spawn, so wait for the url to be set)
@@ -71,6 +72,23 @@ def test_standalone_train_updates_and_infer(standalone_stack):
     # child process reaped after finish; metrics series cleared
     assert dep.ps.wait_for_job(job_id, timeout=30)
     assert f'jobid="{job_id}"' not in dep.ps.metrics.exposition()
+
+    # cross-process trace correlation: the client-minted trace id
+    # appears in spans recorded by the standalone CHILD process (its
+    # trace file is pid-suffixed with the child's pid, not ours)
+    import os
+    from kubeml_tpu.utils.trace import merge_job_trace
+    doc = merge_job_trace(job_id)
+    assert doc["metadata"]["trace_ids"] == [trace_id]
+    child_pids = {int(s.split("-")[1].split(".")[0])
+                  for s in doc["metadata"]["sources"]
+                  if s.startswith("job-")}
+    assert child_pids and os.getpid() not in child_pids
+    epochs = [e for e in doc["traceEvents"]
+              if e.get("ph") == "X" and e["name"] == "epoch"]
+    assert len(epochs) == 3
+    assert all(e["args"]["trace_id"] == trace_id
+               and e["pid"] in child_pids for e in epochs)
 
     # inference from the checkpoint written by the CHILD process
     x = np.load(paths["xte"])[:5]

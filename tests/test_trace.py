@@ -1,8 +1,26 @@
-"""Tracing subsystem: span accounting + per-epoch summaries in job logs."""
+"""Tracing subsystem: span accounting, Chrome-trace timelines, per-epoch
+summaries in job logs, and the per-job trace directory + merger."""
 
+import json
 import re
+import threading
 
-from kubeml_tpu.utils.trace import Tracer, xla_profile
+import pytest
+
+from kubeml_tpu.utils.trace import (TraceSink, Tracer, get_trace_context,
+                                    make_trace_id, merge_job_trace,
+                                    trace_context, trace_dir, xla_profile)
+
+
+class FakeClock:
+    """Advances 1.0s on every read — span trees become exact."""
+
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        self.t += 1.0
+        return self.t
 
 
 def test_tracer_spans_and_summary():
@@ -19,6 +37,120 @@ def test_tracer_spans_and_summary():
     assert "a=" in txt and "b=0.500s/1" in txt
     assert tr.reset()["a"]["count"] == 2
     assert tr.summary() == {}
+
+
+def test_fake_clock_exact_span_tree():
+    """Injected clock -> deterministic timeline: exact ts/dur in µs,
+    parent links following the per-thread nesting, caller args (including
+    ones attached mid-span through the yielded dict) on the event."""
+    tid = make_trace_id()
+    tr = Tracer(clock=FakeClock(), trace_id=tid)
+    with tr.span("epoch", epoch=0):
+        with tr.span("round", round=0):
+            with tr.span("dispatch") as sp:
+                sp["workers"] = 4
+    ev = {e["name"]: e for e in tr.events()}
+    # clock reads: epoch@1, round@2, dispatch@3, then ends at 4, 5, 6
+    assert ev["dispatch"]["ts"] == 3_000_000
+    assert ev["dispatch"]["dur"] == 1_000_000
+    assert ev["round"]["ts"] == 2_000_000
+    assert ev["round"]["dur"] == 3_000_000
+    assert ev["epoch"]["ts"] == 1_000_000
+    assert ev["epoch"]["dur"] == 5_000_000
+    assert all(e["ph"] == "X" for e in ev.values())
+    assert ev["dispatch"]["args"] == {"trace_id": tid, "parent": "round",
+                                      "workers": 4}
+    assert ev["round"]["args"]["parent"] == "epoch"
+    assert "parent" not in ev["epoch"]["args"]
+    assert ev["epoch"]["args"]["epoch"] == 0
+    assert tr.summary()["epoch"] == {"count": 1, "total_s": 5.0,
+                                     "mean_s": 5.0}
+
+
+def test_reset_keeps_timeline_events():
+    tr = Tracer(clock=FakeClock())
+    with tr.span("a"):
+        pass
+    tr.reset()
+    with tr.span("b"):
+        pass
+    assert tr.summary() == {"b": {"count": 1, "total_s": 1.0,
+                                  "mean_s": 1.0}}
+    assert [e["name"] for e in tr.events()] == ["a", "b"]
+
+
+def test_event_cap_drops_but_keeps_summary():
+    tr = Tracer(clock=FakeClock(), max_events=2)
+    for _ in range(3):
+        with tr.span("a"):
+            pass
+    assert len(tr.events()) == 2
+    assert tr.dropped_events == 1
+    assert tr.summary()["a"]["count"] == 3  # the log summary never drops
+
+
+def test_tracer_thread_safety():
+    """Concurrent spans from many threads: no lost updates, and parent
+    links never cross threads (each thread has its own nesting stack)."""
+    tr = Tracer()
+    n_threads, n_spans = 8, 200
+
+    def work():
+        for _ in range(n_spans):
+            with tr.span("outer"):
+                with tr.span("inner"):
+                    pass
+
+    threads = [threading.Thread(target=work) for _ in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    s = tr.summary()
+    assert s["outer"]["count"] == n_threads * n_spans
+    assert s["inner"]["count"] == n_threads * n_spans
+    inner = [e for e in tr.events() if e["name"] == "inner"]
+    assert len(inner) == n_threads * n_spans
+    assert all(e["args"]["parent"] == "outer" for e in inner)
+
+
+def test_trace_context_binds_and_restores():
+    assert get_trace_context() is None
+    with trace_context("aaaa000011112222"):
+        assert get_trace_context() == "aaaa000011112222"
+        with trace_context("bbbb000011112222"):
+            assert get_trace_context() == "bbbb000011112222"
+        assert get_trace_context() == "aaaa000011112222"
+    assert get_trace_context() is None
+
+
+def test_trace_sink_and_merge(tmp_home):
+    tid = make_trace_id()
+    t1 = Tracer(clock=FakeClock(), trace_id=tid)
+    with t1.span("ps.start_task"):
+        pass
+    t2 = Tracer(clock=FakeClock(), trace_id=tid)
+    with t2.span("epoch"):
+        pass
+    TraceSink("mergejob1", "ps").write(t1)
+    path = TraceSink("mergejob1", "job").write(t2)
+    assert json.load(open(path))["metadata"]["trace_id"] == tid
+    # a torn/foreign file in the directory is skipped, not fatal
+    with open(f"{trace_dir('mergejob1')}/bad.trace.json", "w") as f:
+        f.write("{not json")
+    doc = merge_job_trace("mergejob1")
+    assert sorted(doc["metadata"]["sources"]) == [
+        f"job-{__import__('os').getpid()}.trace.json",
+        f"ps-{__import__('os').getpid()}.trace.json"]
+    assert doc["metadata"]["trace_ids"] == [tid]
+    spans = [e for e in doc["traceEvents"] if e["ph"] == "X"]
+    assert {e["name"] for e in spans} == {"ps.start_task", "epoch"}
+    assert all(e["args"]["trace_id"] == tid for e in spans)
+    procs = {e["args"]["name"] for e in doc["traceEvents"]
+             if e["ph"] == "M"}
+    assert procs == {"ps:mergejob1", "job:mergejob1"}
+    with pytest.raises(FileNotFoundError):
+        merge_job_trace("nosuchjob1")
 
 
 def test_xla_profile_noop_safe(tmp_path):
@@ -67,4 +199,24 @@ def test_job_logs_trace_summary(tmp_path, tmp_home, mesh8):
     # out or verified its slabs)
     assert len(re.findall(
         r"\[(?:cache_upload=\S+ )?data_wait=\S+ device_drain=\S+ "
-        r"dispatch=\S+\]", text)) == 2
+        r"dispatch=\S+ epoch=\S+ round=\S+\]", text)) == 2
+
+    # the same run left a whole-job Chrome timeline in the trace dir:
+    # one trace id, round spans nested under epoch spans, dispatch
+    # spans nested under rounds
+    doc = merge_job_trace("tracejob1")
+    spans = [e for e in doc["traceEvents"] if e.get("ph") == "X"]
+    tids = doc["metadata"]["trace_ids"]
+    assert len(tids) == 1 and job.task.trace_id == tids[0]
+    assert all(e["args"]["trace_id"] == tids[0] for e in spans)
+    epochs = [e for e in spans if e["name"] == "epoch"]
+    assert [e["args"]["epoch"] for e in epochs] == [0, 1]
+    rounds = [e for e in spans if e["name"] == "round"]
+    assert rounds and all(e["args"]["parent"] == "epoch" for e in rounds)
+    # the exhaustion probe round carries the tail marker, real rounds
+    # carry their worker count
+    assert [e for e in rounds if e["args"].get("tail")]
+    assert [e for e in rounds if e["args"].get("workers")]
+    dispatches = [e for e in spans if e["name"] == "dispatch"]
+    assert dispatches
+    assert all(e["args"]["parent"] == "round" for e in dispatches)

@@ -1,0 +1,349 @@
+#!/usr/bin/env python3
+"""Lint: the /metrics exposition must be well-formed Prometheus 0.0.4.
+
+Dashboards and scrapers fail silently on malformed expositions — a
+histogram with non-cumulative buckets renders as an empty heatmap, a
+family without a TYPE line is scraped as untyped and breaks rate()
+queries.  This tool parses an exposition with a minimal text-format
+parser and enforces the house rules:
+
+  * every family name carries the ``kubeml_`` prefix
+  * every family declares ``# HELP`` and ``# TYPE`` before its samples
+  * no family is declared twice (duplicate registration)
+  * counter families end in ``_total``
+  * histogram ``le`` bounds are strictly increasing and finish with
+    ``+Inf``; bucket counts are monotone cumulative; ``_count`` equals
+    the ``+Inf`` bucket and ``_sum`` is present
+
+Run directly (exit 1 on violation) or via tests/test_metrics_prom.py,
+which keeps the lint itself in the tier-1 suite.  With no argument it
+validates a live exposition built from MetricsRegistry + HttpMetrics
+(so a bad default registration fails the build, not the dashboard):
+
+    python tools/check_metrics.py [exposition.txt]
+"""
+
+from __future__ import annotations
+
+import math
+import sys
+
+_HIST_SUFFIXES = ("_bucket", "_sum", "_count")
+
+
+def _parse_label_block(s: str, lineno: int) -> dict:
+    """Parse the inside of ``{...}``: ``name="value",...`` with the
+    0.0.4 escapes (backslash, quote, newline) honoured."""
+    labels = {}
+    i = 0
+    while i < len(s):
+        eq = s.find("=", i)
+        if eq < 0:
+            raise ValueError(f"line {lineno}: malformed label block {s!r}")
+        name = s[i:eq].strip().lstrip(",").strip()
+        if not name or eq + 1 >= len(s) or s[eq + 1] != '"':
+            raise ValueError(f"line {lineno}: malformed label block {s!r}")
+        buf = []
+        k = eq + 2
+        while k < len(s):
+            c = s[k]
+            if c == "\\":
+                if k + 1 >= len(s):
+                    raise ValueError(
+                        f"line {lineno}: dangling escape in {s!r}")
+                buf.append({"n": "\n"}.get(s[k + 1], s[k + 1]))
+                k += 2
+            elif c == '"':
+                break
+            else:
+                buf.append(c)
+                k += 1
+        else:
+            raise ValueError(f"line {lineno}: unterminated label value "
+                             f"in {s!r}")
+        labels[name] = "".join(buf)
+        i = k + 1
+    return labels
+
+
+def _split_sample(line: str, lineno: int):
+    """``name{labels} value`` or ``name value`` ->
+    (name, labels dict, float value)."""
+    brace = line.find("{")
+    if brace >= 0:
+        name = line[:brace]
+        # find the closing brace OUTSIDE quoted label values
+        k, in_quotes = brace + 1, False
+        while k < len(line):
+            c = line[k]
+            if in_quotes:
+                if c == "\\":
+                    k += 1
+                elif c == '"':
+                    in_quotes = False
+            elif c == '"':
+                in_quotes = True
+            elif c == "}":
+                break
+            k += 1
+        if k >= len(line):
+            raise ValueError(f"line {lineno}: unterminated labels: {line!r}")
+        labels = _parse_label_block(line[brace + 1:k], lineno)
+        rest = line[k + 1:]
+    else:
+        parts = line.split(None, 1)
+        if len(parts) != 2:
+            raise ValueError(f"line {lineno}: malformed sample: {line!r}")
+        name, labels, rest = parts[0], {}, parts[1]
+    fields = rest.split()
+    if not fields:
+        raise ValueError(f"line {lineno}: sample without value: {line!r}")
+    try:
+        value = float(fields[0])
+    except ValueError:
+        raise ValueError(f"line {lineno}: non-numeric value "
+                         f"{fields[0]!r}: {line!r}")
+    return name, labels, value
+
+
+def parse_exposition(text: str) -> dict:
+    """Parse text-format 0.0.4 into
+    ``{family: {"help", "type", "samples": [(name, labels, value)]}}``.
+
+    Raises ValueError on syntactically malformed lines.  Samples whose
+    name matches no declared family land under the special key ``""``
+    (the validator reports them); histogram child samples
+    (``_bucket``/``_sum``/``_count``) attach to their base family.
+    """
+    families: dict = {}
+    orphans = []
+    for lineno, raw in enumerate(text.split("\n"), start=1):
+        line = raw.strip()
+        if not line:
+            continue
+        if line.startswith("#"):
+            parts = line.split(None, 3)
+            if len(parts) >= 3 and parts[1] in ("HELP", "TYPE"):
+                fam = parts[2]
+                entry = families.setdefault(
+                    fam, {"help": None, "type": None, "samples": []})
+                field = parts[1].lower()
+                payload = parts[3] if len(parts) > 3 else ""
+                if entry[field] is not None:
+                    raise ValueError(
+                        f"line {lineno}: duplicate # {parts[1]} for {fam}")
+                entry[field] = payload
+            continue
+        name, labels, value = _split_sample(line, lineno)
+        fam = name
+        if fam not in families:
+            for suffix in _HIST_SUFFIXES:
+                if name.endswith(suffix) and name[:-len(suffix)] in families:
+                    fam = name[:-len(suffix)]
+                    break
+        if fam in families:
+            if families[fam]["type"] is None:
+                raise ValueError(
+                    f"line {lineno}: sample {name!r} before its # TYPE")
+            families[fam]["samples"].append((name, labels, value))
+        else:
+            orphans.append((name, labels, value))
+    if orphans:
+        families[""] = {"help": None, "type": None, "samples": orphans}
+    return families
+
+
+def _validate_histogram(fam: str, entry: dict, errors: list) -> None:
+    # group by labelset minus `le`
+    groups: dict = {}
+    for name, labels, value in entry["samples"]:
+        base = tuple(sorted((k, v) for k, v in labels.items() if k != "le"))
+        g = groups.setdefault(base, {"buckets": [], "sum": None,
+                                     "count": None})
+        if name == fam + "_bucket":
+            if "le" not in labels:
+                errors.append(f"{fam}: bucket sample without le label")
+                continue
+            le = labels["le"]
+            bound = math.inf if le == "+Inf" else float(le)
+            g["buckets"].append((bound, value))
+        elif name == fam + "_sum":
+            g["sum"] = value
+        elif name == fam + "_count":
+            g["count"] = value
+        else:
+            errors.append(f"{fam}: unexpected histogram sample {name}")
+    if not groups:
+        return
+    for base, g in sorted(groups.items()):
+        where = f"{fam}{dict(base) if base else ''}"
+        bounds = [b for b, _ in g["buckets"]]
+        counts = [c for _, c in g["buckets"]]
+        if not bounds:
+            errors.append(f"{where}: no _bucket samples")
+            continue
+        if bounds != sorted(bounds) or len(set(bounds)) != len(bounds):
+            errors.append(f"{where}: le bounds not strictly increasing: "
+                          f"{bounds}")
+        if bounds[-1] != math.inf:
+            errors.append(f"{where}: missing le=\"+Inf\" bucket")
+        if any(c2 < c1 for c1, c2 in zip(counts, counts[1:])):
+            errors.append(f"{where}: bucket counts not cumulative "
+                          f"monotone: {counts}")
+        if g["sum"] is None:
+            errors.append(f"{where}: missing _sum sample")
+        if g["count"] is None:
+            errors.append(f"{where}: missing _count sample")
+        elif bounds and bounds[-1] == math.inf \
+                and g["count"] != counts[-1]:
+            errors.append(f"{where}: _count {g['count']} != +Inf bucket "
+                          f"{counts[-1]}")
+
+
+def validate_exposition(text: str) -> list:
+    """Return a list of violation strings (empty == clean)."""
+    try:
+        families = parse_exposition(text)
+    except ValueError as e:
+        return [str(e)]
+    errors = []
+    for fam, entry in sorted(families.items()):
+        if fam == "":
+            for name, _labels, _v in entry["samples"]:
+                errors.append(f"{name}: sample without a declared family "
+                              "(missing # TYPE, or name outside every "
+                              "family)")
+            continue
+        if not fam.startswith("kubeml_"):
+            errors.append(f"{fam}: family name lacks the kubeml_ prefix")
+        if entry["help"] is None:
+            errors.append(f"{fam}: missing # HELP line")
+        if entry["type"] is None:
+            errors.append(f"{fam}: missing # TYPE line")
+            continue
+        ftype = entry["type"]
+        if ftype not in ("gauge", "counter", "histogram"):
+            errors.append(f"{fam}: unknown type {ftype!r}")
+            continue
+        if ftype == "counter" and not fam.endswith("_total"):
+            errors.append(f"{fam}: counter families must end in _total")
+        if ftype == "histogram":
+            _validate_histogram(fam, entry, errors)
+        else:
+            for name, _labels, _v in entry["samples"]:
+                if name != fam:
+                    errors.append(f"{fam}: unexpected sample name {name}")
+    return errors
+
+
+# --------------------------------------------------------------- self-test
+
+_GOOD = """\
+# HELP kubeml_demo_seconds demo latency
+# TYPE kubeml_demo_seconds histogram
+kubeml_demo_seconds_bucket{op="x",le="0.1"} 1
+kubeml_demo_seconds_bucket{op="x",le="1"} 2
+kubeml_demo_seconds_bucket{op="x",le="+Inf"} 3
+kubeml_demo_seconds_sum{op="x"} 2.5
+kubeml_demo_seconds_count{op="x"} 3
+# HELP kubeml_demo_total demo counter
+# TYPE kubeml_demo_total counter
+kubeml_demo_total{op="x"} 4
+"""
+
+_BROKEN = {
+    "prefix": "# HELP other_metric x\n# TYPE other_metric gauge\n"
+              "other_metric 1\n",
+    "no-type": "kubeml_orphan 1\n",
+    "dup-family": "# HELP kubeml_a x\n# TYPE kubeml_a gauge\n"
+                  "# HELP kubeml_a x\n# TYPE kubeml_a gauge\n",
+    "counter-suffix": "# HELP kubeml_hits x\n# TYPE kubeml_hits counter\n"
+                      "kubeml_hits 1\n",
+    "non-monotone-bounds": (
+        "# HELP kubeml_h_seconds x\n# TYPE kubeml_h_seconds histogram\n"
+        'kubeml_h_seconds_bucket{le="1"} 1\n'
+        'kubeml_h_seconds_bucket{le="0.5"} 2\n'
+        'kubeml_h_seconds_bucket{le="+Inf"} 2\n'
+        "kubeml_h_seconds_sum 1\nkubeml_h_seconds_count 2\n"),
+    "missing-inf": (
+        "# HELP kubeml_h_seconds x\n# TYPE kubeml_h_seconds histogram\n"
+        'kubeml_h_seconds_bucket{le="1"} 1\n'
+        "kubeml_h_seconds_sum 1\nkubeml_h_seconds_count 1\n"),
+    "non-cumulative": (
+        "# HELP kubeml_h_seconds x\n# TYPE kubeml_h_seconds histogram\n"
+        'kubeml_h_seconds_bucket{le="1"} 5\n'
+        'kubeml_h_seconds_bucket{le="+Inf"} 3\n'
+        "kubeml_h_seconds_sum 1\nkubeml_h_seconds_count 3\n"),
+    "count-mismatch": (
+        "# HELP kubeml_h_seconds x\n# TYPE kubeml_h_seconds histogram\n"
+        'kubeml_h_seconds_bucket{le="+Inf"} 3\n'
+        "kubeml_h_seconds_sum 1\nkubeml_h_seconds_count 7\n"),
+}
+
+
+def self_test() -> list:
+    """The validator must accept the good exposition and flag every
+    deliberately broken one.  Returns failure strings (empty == ok)."""
+    failures = []
+    good_errors = validate_exposition(_GOOD)
+    if good_errors:
+        failures.append(f"clean exposition flagged: {good_errors}")
+    for tag, text in sorted(_BROKEN.items()):
+        if not validate_exposition(text):
+            failures.append(f"broken exposition {tag!r} passed validation")
+    return failures
+
+
+def _live_exposition() -> str:
+    """Build an exposition from the real registries with sample data, so
+    the lint exercises the families the PS actually serves."""
+    import os
+    try:
+        from kubeml_tpu.api.types import MetricUpdate
+        from kubeml_tpu.metrics.prom import HttpMetrics, MetricsRegistry
+    except ImportError:
+        # direct `python tools/check_metrics.py` puts tools/ on sys.path,
+        # not the repo root
+        sys.path.insert(0, os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__))))
+        from kubeml_tpu.api.types import MetricUpdate
+        from kubeml_tpu.metrics.prom import HttpMetrics, MetricsRegistry
+
+    reg = MetricsRegistry()
+    reg.update_job(MetricUpdate(
+        job_id="lintjob", validation_loss=0.5, accuracy=0.9,
+        train_loss=0.4, parallelism=8, epoch_duration=1.5,
+        phase_times={"dispatch": [0.01, 0.2], "data_wait": [0.001],
+                     "device_drain": [0.05]}))
+    reg.running_total.set("train", 1)
+    reg.note_restart("lintjob")
+    http = HttpMetrics("lint")
+    http.observe("GET", "/metrics", 200, 0.002)
+    http.observe("POST", "/update/{jobId}", 404, 0.1)
+    return reg.exposition() + http.exposition()
+
+
+def main(argv) -> int:
+    failures = self_test()
+    for f in failures:
+        print(f"self-test: {f}", file=sys.stderr)
+    if len(argv) > 1:
+        with open(argv[1], encoding="utf-8") as f:
+            text = f.read()
+        source = argv[1]
+    else:
+        text = _live_exposition()
+        source = "live MetricsRegistry+HttpMetrics exposition"
+    errors = validate_exposition(text)
+    for e in errors:
+        print(f"{source}: {e}", file=sys.stderr)
+    if errors or failures:
+        print(f"\n{len(errors) + len(failures)} violation(s): the "
+              "/metrics exposition must stay scraper-clean (see "
+              "kubeml_tpu/metrics/prom.py)", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv))
