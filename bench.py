@@ -295,6 +295,74 @@ def main():
         samples = FAULT_TIMED_EPOCHS * rounds_per_epoch * W * S * B
         return samples / elapsed / n_chips, flags_total, tracer.summary()
 
+    # -- preempted arm: elastic degraded-mode costs at production
+    # shapes. Three numbers: the SIGTERM drain's synchronous
+    # round-granular checkpoint (the grace budget a platform must
+    # grant), the restart's time-to-training-again from that checkpoint
+    # (load + first round dispatched + merged), and the overhead of
+    # re-dealing a mid-epoch-quarantined worker's unconsumed rounds to
+    # the survivors versus a clean epoch at the SAME sample coverage.
+    import shutil
+    import tempfile
+
+    from kubeml_tpu.parallel.kavg import drain_round
+    from kubeml_tpu.train.checkpoint import (load_checkpoint,
+                                             save_checkpoint)
+
+    def measure_preempted():
+        variables = model.init_variables(
+            jax.random.PRNGKey(0), {"x": jnp.asarray(x[0, 0])})
+        variables, _ = faulted_epoch(variables, 0, None, Tracer())  # warm
+        anchor(variables)
+        half = rounds_per_epoch // 2
+        manifest = {
+            "model": "resnet18", "function": "resnet18",
+            "parallelism": W, "epoch": 0,
+            "train_state": {
+                "epoch": 1, "round": half,
+                "step_counts": [float(half * S)] * W,
+                "loss_sums": [0.0] * W, "dropped": 0.0,
+                "all_dropped_rounds": 0, "reassigned": 0}}
+        tmp = tempfile.mkdtemp(prefix="kubeml-bench-preempt-")
+        try:
+            t0 = time.perf_counter()
+            drain_round(variables)  # the job's preempt-path barrier
+            save_checkpoint("benchpreempt", variables, manifest, root=tmp)
+            ckpt_s = time.perf_counter() - t0
+            t0 = time.perf_counter()
+            restored, _mf = load_checkpoint("benchpreempt", root=tmp)
+            rngs = rng.randint(0, 2**31, size=(W, S, 2)).astype(np.uint32)
+            staged = {"x": jax.device_put(x, b_sh),
+                      "y": jax.device_put(y, b_sh)}
+            restored, _st = engine.train_round(
+                restored, staged, rngs=rngs, lr=0.1, epoch=1, **masks)
+            anchor(restored)
+            resume_s = time.perf_counter() - t0
+        finally:
+            shutil.rmtree(tmp, ignore_errors=True)
+
+        # degraded epoch: worker 0 masked from round `half` onward, its
+        # orphaned tail re-dealt to the W-1 survivors as makeup rounds
+        # at epoch end (the job's makeup_rounds geometry: same S*B per
+        # surviving worker per makeup round)
+        num_makeup = math.ceil((rounds_per_epoch - half) / (W - 1))
+        qmask = masks["worker_mask"].copy()
+        qmask[0] = 0.0
+        t0 = time.perf_counter()
+        for r in range(rounds_per_epoch + num_makeup):
+            wm = masks["worker_mask"] if r < half else qmask
+            rngs = rng.randint(0, 2**31, size=(W, S, 2)).astype(np.uint32)
+            staged = {"x": jax.device_put(x, b_sh),
+                      "y": jax.device_put(y, b_sh)}
+            variables, _st = engine.train_round(
+                variables, staged, sample_mask=masks["sample_mask"],
+                step_mask=masks["step_mask"], worker_mask=wm,
+                rngs=rngs, lr=0.1, epoch=1)
+        anchor(variables)
+        degraded_s = time.perf_counter() - t0
+        reassigned = num_makeup * (W - 1) * S
+        return ckpt_s, resume_s, degraded_s, reassigned
+
     per_chip, cache_phases = measure(cache_round, cache_rounds, 2,
                                      TIMED_EPOCHS)
     host_per_chip, host_phases = measure(host_round, host_rounds, 1,
@@ -302,6 +370,14 @@ def main():
     baseline_per_chip, baseline_phases = _measure_baseline_arm(model, x, y)
     clean_single_per_chip, _, clean_phases = measure_faulted(None)
     faulted_per_chip, fault_flags, faulted_phases = measure_faulted(plan)
+    (preempt_ckpt_s, preempt_resume_s,
+     degraded_epoch_s, reassigned_batches) = measure_preempted()
+    # clean-epoch wall time at the same coverage, derived from the
+    # identical single-round clean arm's throughput
+    clean_epoch_s = (rounds_per_epoch * W * S * B
+                     / (clean_single_per_chip * n_chips))
+    reassignment_overhead_pct = max(
+        0.0, (degraded_epoch_s - clean_epoch_s) / clean_epoch_s * 100.0)
     rounds_dropped = int((fault_flags.sum(axis=1) > 0).sum())
     worker_drops = int(fault_flags.sum())
     recovery_overhead_pct = max(
@@ -345,6 +421,17 @@ def main():
         "faulted_nan_injections": plan.injected["nan"],
         "fault_recovery_overhead_pct": round(recovery_overhead_pct, 2),
         "fault_timed_epochs": FAULT_TIMED_EPOCHS,
+        # preempted arm (elastic degraded mode): the SIGTERM drain's
+        # synchronous round-granular checkpoint (= the grace budget a
+        # platform must grant), the restart's time back to training
+        # (checkpoint load + first round dispatched + merged), and the
+        # cost of re-dealing a mid-epoch-lost worker's unconsumed
+        # rounds to the survivors vs a clean epoch at identical sample
+        # coverage.
+        "preempt_checkpoint_s": round(preempt_ckpt_s, 3),
+        "preempt_resume_latency_s": round(preempt_resume_s, 3),
+        "reassigned_batches": reassigned_batches,
+        "reassignment_overhead_pct": round(reassignment_overhead_pct, 2),
         # per-arm tracer phase totals over the TIMED window (warmup
         # excluded): {span: {count, total_s, mean_s}}. A throughput
         # regression in this file should be explainable from here —

@@ -69,6 +69,21 @@ class FunctionNotFoundError(KubeMLException):
         super().__init__(f"Function not found{': ' + name if name else ''}", 404)
 
 
+class JobPreemptedError(KubeMLException):
+    """Control-flow signal: the job drained and checkpointed mid-epoch in
+    response to a preemption notice (SIGTERM or a `preempt` fault event)
+    and expects the PS to reschedule it from the round-granular
+    checkpoint. Not a failure — train() re-raises it without reporting
+    on_finish so the PS job record stays alive for the watchdog."""
+
+    def __init__(self, job_id: str = "", epoch: int = 0, round_: int = 0):
+        super().__init__(
+            f"job {job_id} preempted at epoch {epoch} round {round_}", 503)
+        self.job_id = job_id
+        self.epoch = epoch
+        self.round = round_
+
+
 def check_error(status_code: int, body: bytes) -> None:
     """Raise a KubeMLException from an error-envelope HTTP response.
 

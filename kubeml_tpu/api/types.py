@@ -130,6 +130,21 @@ class TrainOptions:
     # coordinates: NaN bursts, worker dropouts, a process crash,
     # checkpoint corruption, artificial slow rounds. Empty = no faults.
     fault_plan: str = ""
+    # net-new elastic degraded mode (round-granular resume): N > 0
+    # checkpoints every N sync rounds WITH a train_state cursor (epoch,
+    # round, guard masks, partial accumulators) so a crash/preemption
+    # restart resumes at the failed round instead of the epoch start.
+    # kavg only (it re-derives optimizer state each round, so the
+    # weights + cursor fully determine the resumed trajectory); forces
+    # rounds_per_dispatch=1. 0 disables (epoch-granular checkpoints).
+    checkpoint_every_rounds: int = 0
+    # net-new elastic degraded mode (mid-epoch work reassignment): when
+    # the non-finite guard quarantines a worker mid-epoch, re-deal its
+    # undispatched sample indices to the surviving workers as extra
+    # makeup rounds at the end of the epoch, so every index still trains
+    # exactly once per epoch. Requires quarantine_after > 0; counts land
+    # in History.reassigned_batches and kubeml_job_reassigned_batches.
+    reassign_on_quarantine: bool = False
 
     def to_dict(self) -> dict:
         return {
@@ -157,6 +172,8 @@ class TrainOptions:
             "quarantine_after": self.quarantine_after,
             "abort_after": self.abort_after,
             "fault_plan": self.fault_plan,
+            "checkpoint_every_rounds": self.checkpoint_every_rounds,
+            "reassign_on_quarantine": self.reassign_on_quarantine,
         }
 
     @classmethod
@@ -186,6 +203,9 @@ class TrainOptions:
             quarantine_after=int(d.get("quarantine_after", 0)),
             abort_after=int(d.get("abort_after", 0)),
             fault_plan=d.get("fault_plan", ""),
+            checkpoint_every_rounds=int(d.get("checkpoint_every_rounds", 0)),
+            reassign_on_quarantine=bool(d.get("reassign_on_quarantine",
+                                              False)),
         )
 
 
@@ -244,6 +264,11 @@ class TrainTask:
     # to the standalone job process, so spans from every process in the
     # chain correlate (utils/trace.py)
     trace_id: str = ""
+    # degraded-mode visibility (stamped by the PS on /tasks listings so
+    # `kubeml task list` shows them without scraping /metrics): watchdog
+    # restarts consumed and graceful preemption handoffs survived
+    restarts: int = 0
+    preemptions: int = 0
 
     def to_dict(self) -> dict:
         return {
@@ -253,6 +278,8 @@ class TrainTask:
             "elapsed_time_s": self.elapsed_time_s,
             "state": self.state,
             "trace_id": self.trace_id,
+            "restarts": self.restarts,
+            "preemptions": self.preemptions,
         }
 
     @classmethod
@@ -264,6 +291,8 @@ class TrainTask:
             elapsed_time_s=d.get("elapsed_time_s", -1.0),
             state=d.get("state", "queued"),
             trace_id=d.get("trace_id", ""),
+            restarts=int(d.get("restarts", 0)),
+            preemptions=int(d.get("preemptions", 0)),
         )
 
 
@@ -282,9 +311,15 @@ class JobHistory:
     # workers under quarantine at epoch end
     dropped_workers: List[float] = field(default_factory=list)
     quarantined_workers: List[int] = field(default_factory=list)
+    # net-new elastic degraded mode: per-epoch minibatch steps re-dealt
+    # from quarantined workers to survivors (makeup rounds)
+    reassigned_batches: List[int] = field(default_factory=list)
     # checkpoint-based watchdog restarts consumed by the job (stamped by
     # the PS at finish — control/ps.py)
     restarts: int = 0
+    # SIGTERM/preempt-fault graceful handoffs survived (restart from a
+    # round-granular checkpoint; does not consume the restart budget)
+    preemptions: int = 0
 
     def to_dict(self) -> dict:
         return _asdict(self)
@@ -299,7 +334,9 @@ class JobHistory:
             epoch_duration=list(d.get("epoch_duration", [])),
             dropped_workers=list(d.get("dropped_workers", [])),
             quarantined_workers=list(d.get("quarantined_workers", [])),
+            reassigned_batches=list(d.get("reassigned_batches", [])),
             restarts=int(d.get("restarts", 0)),
+            preemptions=int(d.get("preemptions", 0)),
         )
 
 
@@ -337,6 +374,12 @@ class MetricUpdate:
     # updates from older jobs still parse)
     dropped_workers: float = 0.0
     quarantined_workers: int = 0
+    # minibatch steps re-dealt from quarantined workers this epoch
+    # (elastic degraded mode; optional on the wire)
+    reassigned_batches: int = 0
+    # async checkpoint saves coalesced because the writer fell behind
+    # (cumulative over the job's life; optional on the wire)
+    checkpoint_drops: int = 0
     # per-phase span durations for the epoch (tracer name -> seconds per
     # round), feeding the PS latency histograms; optional on the wire
     phase_times: Dict[str, List[float]] = field(default_factory=dict)
@@ -351,6 +394,8 @@ class MetricUpdate:
                        "parallelism", "epoch_duration")},
                    dropped_workers=float(d.get("dropped_workers", 0.0)),
                    quarantined_workers=int(d.get("quarantined_workers", 0)),
+                   reassigned_batches=int(d.get("reassigned_batches", 0)),
+                   checkpoint_drops=int(d.get("checkpoint_drops", 0)),
                    phase_times={str(k): [float(x) for x in v]
                                 for k, v in (d.get("phase_times")
                                              or {}).items()})

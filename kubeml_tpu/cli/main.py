@@ -67,6 +67,12 @@ def cmd_train(args):
         _fail("--max-parallelism must be >= 0")
     if args.max_restarts < 0:
         _fail("--max-restarts must be >= 0")
+    if args.checkpoint_every_rounds < 0:
+        _fail("--checkpoint-every-rounds must be >= 0")
+    if args.quarantine_after < 0:
+        _fail("--quarantine-after must be >= 0")
+    if args.reassign_on_quarantine and args.quarantine_after <= 0:
+        _fail("--reassign-on-quarantine requires --quarantine-after")
     if args.tensor_parallel > 1 and args.seq_parallel > 1 \
             and args.seq_impl == "ulysses":
         _fail("tensor parallelism composes with --seq-impl ring only "
@@ -104,7 +110,10 @@ def cmd_train(args):
             seq_impl=args.seq_impl,
             tp_impl=args.tp_impl,
             max_parallelism=args.max_parallelism,
-            max_restarts=args.max_restarts))
+            max_restarts=args.max_restarts,
+            checkpoint_every_rounds=args.checkpoint_every_rounds,
+            quarantine_after=args.quarantine_after,
+            reassign_on_quarantine=args.reassign_on_quarantine))
     job_id = client.v1().networks().train(req)
     print(job_id)
 
@@ -164,10 +173,13 @@ def cmd_fn_list(args):
 
 def cmd_task_list(args):
     tasks = _client(args).v1().tasks().list()
-    print(f"{'ID':<12}{'FUNCTION':<18}{'DATASET':<14}{'STATE':<12}{'N':>4}")
+    print(f"{'ID':<12}{'FUNCTION':<18}{'DATASET':<14}{'STATE':<12}{'N':>4}"
+          f"{'RESTARTS':>10}{'PREEMPT':>9}")
     for t in tasks:
         print(f"{t.job_id:<12}{t.parameters.function_name:<18}"
-              f"{t.parameters.dataset:<14}{t.state:<12}{t.parallelism:>4}")
+              f"{t.parameters.dataset:<14}{t.state:<12}{t.parallelism:>4}"
+              f"{getattr(t, 'restarts', 0):>10}"
+              f"{getattr(t, 'preemptions', 0):>9}")
 
 
 def cmd_task_stop(args):
@@ -210,12 +222,16 @@ def cmd_history_delete(args):
 def cmd_history_list(args):
     rows = _client(args).v1().histories().list()
     print(f"{'ID':<12}{'FUNCTION':<18}{'DATASET':<14}{'EPOCHS':>7}"
-          f"{'BEST_ACC':>10}")
+          f"{'BEST_ACC':>10}{'RST/PRE':>9}{'REASSIGN':>10}")
     for h in rows:
         accs = [a for a in h.data.accuracy if a == a]
         best = f"{max(accs):.2f}" if accs else "-"
+        lifecycle = (f"{getattr(h.data, 'restarts', 0)}"
+                     f"/{getattr(h.data, 'preemptions', 0)}")
+        reassigned = sum(getattr(h.data, 'reassigned_batches', []) or [])
         print(f"{h.id:<12}{h.task.function_name or h.task.model_type:<18}"
-              f"{h.task.dataset:<14}{len(h.data.train_loss):>7}{best:>10}")
+              f"{h.task.dataset:<14}{len(h.data.train_loss):>7}{best:>10}"
+              f"{lifecycle:>9}{reassigned:>10}")
 
 
 def cmd_history_prune(args):
@@ -411,6 +427,24 @@ def build_parser() -> argparse.ArgumentParser:
                         "its own checkpoint up to N times, resuming its "
                         "epoch/history/topology (0 = a dead process "
                         "fails the job)")
+    t.add_argument("--checkpoint-every-rounds", type=int, default=0,
+                   metavar="R",
+                   help="round-granular checkpoint cadence: every R "
+                        "sync rounds, save weights plus the epoch's "
+                        "round cursor, so a crash or preemption resumes "
+                        "mid-epoch at the failed round instead of "
+                        "replaying the epoch (kavg engine only; 0 = "
+                        "epoch-granular checkpoints)")
+    t.add_argument("--quarantine-after", type=int, default=0, metavar="Q",
+                   help="mask a worker out for the rest of the epoch "
+                        "after Q consecutive non-finite rounds (0 = "
+                        "off; per-round device readback cost)")
+    t.add_argument("--reassign-on-quarantine", action="store_true",
+                   help="elastic degraded mode: when a worker is "
+                        "quarantined mid-epoch, re-deal its unconsumed "
+                        "rounds to the surviving workers at epoch end "
+                        "so every sample still trains exactly once "
+                        "(kavg engine; requires --quarantine-after)")
     t.set_defaults(fn=cmd_train)
 
     i = sub.add_parser("infer", help="run inference on a trained model")

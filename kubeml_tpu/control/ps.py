@@ -207,6 +207,13 @@ class _JobRecord:
         self.update_event = threading.Event()
         self.restarts = 0  # checkpoint-based crash restarts consumed
         self.restarting = False  # watchdog respawn claimed, in progress
+        self.preempted = False  # child announced a graceful preemption
+        self.preemptions = 0  # reschedules consumed (do NOT count as
+        #                       restarts: preemption is the platform's
+        #                       doing, not the job's, so it must not eat
+        #                       the max_restarts crash budget)
+        self.last_heartbeat: Optional[float] = None  # monotonic stamp
+        self.heartbeat_progress = (0, 0)  # (epoch, round) last reported
 
     def push_update(self, parallelism: int):
         # standalone-ness is `job is None`, NOT `proc is not None`: a
@@ -278,10 +285,21 @@ class ParameterServer(JsonService):
         self.ds_registry = DatasetRegistry()
         self.history_store = HistoryStore()
 
+        # liveness reaper config: a standalone child that stops posting
+        # heartbeats for interval * miss_budget seconds is declared
+        # wedged and killed into the checkpoint-restart path. 0 disables.
+        self.heartbeat_timeout = (
+            float(os.environ.get("KUBEML_HEARTBEAT_INTERVAL", "10"))
+            * float(os.environ.get("KUBEML_HEARTBEAT_MISS_BUDGET", "6")))
+        self._reaper_stop = threading.Event()
+        self._reaper_thread: Optional[threading.Thread] = None
+
         self.route("POST", "/start", self._h_start)
         self.route("POST", "/update/{jobId}", self._h_update)
         self.route("POST", "/metrics/{jobId}", self._h_metrics)
         self.route("POST", "/finish/{jobId}", self._h_finish)
+        self.route("POST", "/preempted/{jobId}", self._h_preempted)
+        self.route("POST", "/heartbeat/{jobId}", self._h_heartbeat)
         self.route("DELETE", "/stop/{jobId}", self._h_stop)
         self.route("GET", "/tasks", self._h_tasks)
         self.route("GET", "/metrics", self._h_prom)
@@ -293,6 +311,65 @@ class ParameterServer(JsonService):
         if self._mesh is None:
             self._mesh = make_mesh()
         return self._mesh
+
+    def start(self):
+        port = super().start()
+        if self.standalone_jobs and self.heartbeat_timeout > 0:
+            self._reaper_thread = threading.Thread(
+                target=self._reaper_loop, name="heartbeat-reaper",
+                daemon=True)
+            self._reaper_thread.start()
+        return port
+
+    # -------------------------------------------------- liveness reaper
+
+    def _reaper_loop(self):
+        period = max(1.0, self.heartbeat_timeout / 4)
+        while not self._reaper_stop.wait(timeout=period):
+            try:
+                self._scan_heartbeats(time.monotonic())
+            except Exception:
+                logger.exception("heartbeat sweep failed")
+
+    def _scan_heartbeats(self, now: float) -> List[str]:
+        """One liveness sweep (pure given `now` — unit-testable without
+        wall-clock waits): kill standalone children whose last progress
+        heartbeat is older than the miss budget. The crash watchdog is
+        the exit-code path for a DEAD child; this covers the
+        alive-but-wedged one (deadlocked collective, hung IO) whose
+        process never exits. Killing it routes recovery through that
+        same watchdog: proc.wait() returns and the job restarts from
+        its round-granular checkpoint. A child that never heartbeated
+        is never reaped — liveness starts at its first beat, which
+        covers slow starts and heartbeat-disabled children."""
+        if self.heartbeat_timeout <= 0:
+            return []
+        reaped: List[str] = []
+        with self._jobs_lock:
+            stale = []
+            for job_id, rec in self.jobs.items():
+                if (rec.proc is None or rec.last_heartbeat is None
+                        or rec.task.state == "stopping"):
+                    continue
+                age = now - rec.last_heartbeat
+                if age >= self.heartbeat_timeout:
+                    rec.last_heartbeat = None  # one kill per silence
+                    stale.append((job_id, rec, age))
+        for job_id, rec, age in stale:
+            logger.error(
+                "job %s: no heartbeat for %.0fs (budget %.0fs) at "
+                "epoch %d round %d — declaring wedged; killing pid %s "
+                "for checkpoint restart", job_id, age,
+                self.heartbeat_timeout, rec.heartbeat_progress[0],
+                rec.heartbeat_progress[1],
+                rec.proc.pid if rec.proc else "?")
+            self.metrics.note_wedged(job_id)
+            try:
+                rec.proc.kill()
+            except OSError:
+                pass
+            reaped.append(job_id)
+        return reaped
 
     # ------------------------------------------------------------- handlers
 
@@ -343,9 +420,51 @@ class ParameterServer(JsonService):
         rec.task.state = "stopping"
         return {"ok": True}
 
+    def _h_preempted(self, req: Request):
+        """A standalone child drained, checkpointed at the round cursor
+        and is about to exit: mark its record so the watchdog reschedules
+        it (without consuming the crash-restart budget)."""
+        job_id = req.params["jobId"]
+        body = req.body if isinstance(req.body, dict) else {}
+        with self._jobs_lock:
+            rec = self.jobs.get(job_id)
+            if rec is None:
+                raise JobNotFoundError(job_id)
+            rec.preempted = True
+            rec.preemptions += 1
+        logger.warning("job %s preempted at epoch %s round %s; will "
+                       "reschedule from its round checkpoint", job_id,
+                       body.get("epoch"), body.get("round"))
+        self.metrics.note_preemption(job_id)
+        return {"ok": True}
+
+    def _h_heartbeat(self, req: Request):
+        """Progress heartbeat from a standalone child (epoch + round
+        cursor). Feeds the liveness reaper: silence past the miss budget
+        means alive-but-wedged, and the child is killed into the
+        ordinary checkpoint-restart path."""
+        job_id = req.params["jobId"]
+        body = req.body if isinstance(req.body, dict) else {}
+        progress = (int(body.get("epoch", 0)), int(body.get("round", 0)))
+        with self._jobs_lock:
+            rec = self.jobs.get(job_id)
+            if rec is None:
+                raise JobNotFoundError(job_id)
+            rec.last_heartbeat = time.monotonic()
+            rec.heartbeat_progress = progress
+        self.metrics.note_heartbeat(job_id, *progress)
+        return {"ok": True}
+
     def _h_tasks(self, req: Request):
         with self._jobs_lock:
-            return [r.task.to_dict() for r in self.jobs.values()]
+            out = []
+            for r in self.jobs.values():
+                # stamp the PS-side lifecycle counters onto the listing:
+                # each child incarnation only knows its own lifetime
+                r.task.restarts = r.restarts
+                r.task.preemptions = r.preemptions
+                out.append(r.task.to_dict())
+            return out
 
     def _h_prom(self, req: Request):
         # job families plus this service's HTTP middleware series, one
@@ -651,28 +770,40 @@ class ParameterServer(JsonService):
         with self._jobs_lock:
             if self.jobs.get(job_id) is not rec:
                 return  # already deregistered via /finish
+            # a preempted exit is the PLATFORM's doing: always eligible
+            # for reschedule (given a checkpoint) and exempt from the
+            # max_restarts crash budget
+            preempted, rec.preempted = rec.preempted, False
             eligible = (not self._stopping
                         and rec.task.state != "stopping"
-                        and rec.restarts < opts.max_restarts
+                        and (preempted or rec.restarts < opts.max_restarts)
                         and has_checkpoint)
             if eligible:
-                rec.restarts += 1
+                if not preempted:
+                    rec.restarts += 1
                 rec.proc = None
                 rec.url = None
                 rec.restarting = True
+                rec.last_heartbeat = None  # fresh liveness window
                 rec.task.parameters.resume_from = job_id
-        logger.warning("job %s process exited without finishing (rc=%s)",
-                       job_id, rc)
+        if not preempted:
+            logger.warning("job %s process exited without finishing "
+                           "(rc=%s)", job_id, rc)
         if not eligible:
             self._finish(job_id,
                          error=f"job process exited unexpectedly (rc={rc})")
             return
-        logger.warning("job %s: restarting from its checkpoint "
-                       "(restart %d/%d)", job_id, rec.restarts,
-                       opts.max_restarts)
-        # surface the restart on /metrics: per-job gauge (cleared at
-        # finish like every job series) + the PS-lifetime total
-        self.metrics.note_restart(job_id)
+        if preempted:
+            logger.warning("job %s: rescheduling after preemption "
+                           "(%d so far) from its round checkpoint",
+                           job_id, rec.preemptions)
+        else:
+            logger.warning("job %s: restarting from its checkpoint "
+                           "(restart %d/%d)", job_id, rec.restarts,
+                           opts.max_restarts)
+            # surface the restart on /metrics: per-job gauge (cleared at
+            # finish like every job series) + the PS-lifetime total
+            self.metrics.note_restart(job_id)
         try:
             self._spawn_standalone(rec)  # re-arms the watchdog
         except Exception as e:
@@ -766,14 +897,15 @@ class ParameterServer(JsonService):
             rec = self.jobs.pop(job_id, None)
         if rec is None:
             return
-        if rec.restarts:
-            # stamp the watchdog restart count into the finished History
-            # record — the job process cannot know it (each incarnation
-            # sees only its own lifetime); a failed job that never saved
-            # a record simply has nothing to stamp
+        if rec.restarts or rec.preemptions:
+            # stamp the watchdog restart/preemption counts into the
+            # finished History record — the job process cannot know them
+            # (each incarnation sees only its own lifetime); a failed job
+            # that never saved a record simply has nothing to stamp
             try:
                 h = self.history_store.get(job_id)
                 h.data.restarts = rec.restarts
+                h.data.preemptions = rec.preemptions
                 self.history_store.save(h)
             except JobNotFoundError:
                 pass
@@ -825,6 +957,7 @@ class ParameterServer(JsonService):
         blocks any parent waiting on those streams). The reference's
         analogue is pod garbage collection on PS teardown."""
         super().stop()
+        self._reaper_stop.set()
         with self._jobs_lock:
             self._stopping = True  # no further spawns or crash-restarts
             recs = list(self.jobs.values())

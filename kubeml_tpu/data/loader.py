@@ -305,12 +305,29 @@ class RoundLoader:
         return np.random.default_rng(
             np.random.SeedSequence([self._root_rng.entropy, epoch, 7]))
 
-    def epoch_rounds(self, plan: EpochPlan, epoch: int
-                     ) -> Iterator[RoundBatch]:
+    def _makeup_key_rng(self, epoch: int) -> np.random.Generator:
+        """Separate rng-key stream for makeup (reassignment) rounds.
+
+        Makeup rounds are appended AFTER the epoch's planned rounds, so
+        drawing them from the main `_epoch_key_rng` stream would work —
+        but a separate stream keeps the planned rounds' keys identical
+        between a degraded run and a clean one, which makes the
+        round-granular resume contract (`start_round` skips consume the
+        exact same draws) independent of whether reassignment fired."""
+        return np.random.default_rng(
+            np.random.SeedSequence([self._root_rng.entropy, epoch, 11]))
+
+    def epoch_rounds(self, plan: EpochPlan, epoch: int,
+                     start_round: int = 0) -> Iterator[RoundBatch]:
         """Yield one RoundBatch per sync round of the epoch.
 
         All rounds share the same [W, S_max, B] shape so the engine compiles
         once per (parallelism, K, batch) configuration.
+
+        `start_round` > 0 resumes mid-epoch (round-granular restart):
+        rounds before the cursor are skipped WITHOUT assembly, but their
+        rng-key draws are still consumed so rounds >= start_round carry
+        bit-identical keys to an uninterrupted epoch.
         """
         W, S, B = self.round_geometry(plan)
         x_mm, y_mm = self.handle.train_arrays()
@@ -318,6 +335,9 @@ class RoundLoader:
         key_rng = self._epoch_key_rng(epoch)
 
         for rp in plan.rounds:
+            if rp.index < start_round:
+                key_rng.integers(0, 2**32, size=(W, S, 2), dtype=np.uint32)
+                continue
             if self._native_train and perm is None:
                 rngs = key_rng.integers(0, 2**32, size=(W, S, 2),
                                         dtype=np.uint32)
@@ -351,8 +371,8 @@ class RoundLoader:
                 round_index=rp.index, num_rounds=len(plan.rounds))
 
     def epoch_index_rounds(self, plan: EpochPlan, epoch: int,
-                           lane_starts: Optional[np.ndarray] = None
-                           ) -> Iterator[RoundBatch]:
+                           lane_starts: Optional[np.ndarray] = None,
+                           start_round: int = 0) -> Iterator[RoundBatch]:
         """Index-fed twin of `epoch_rounds` for the device-resident
         dataset cache (data/device_cache.py): each round's batch is
         `{"idx": [W, S, B] int32}` gather indices instead of the
@@ -366,6 +386,9 @@ class RoundLoader:
         sharded-layout cache) rebases indices to be lane-LOCAL; None
         means the cache is replicated and indices stay GLOBAL (required
         for shuffle, where a chunk's samples are scattered).
+
+        `start_round` resumes mid-epoch exactly like `epoch_rounds`:
+        skipped rounds still consume their rng-key draws.
         """
         W, S, B = self.round_geometry(plan)
         perm = self._epoch_perm(epoch)
@@ -373,11 +396,12 @@ class RoundLoader:
             raise DataError("shuffled epochs need a replicated cache: "
                             "permuted docs are not lane-contiguous")
         key_rng = self._epoch_key_rng(epoch)
-        n = self.handle.train_samples
-        ss = self.handle.subset_size
         wpl = max(1, W // self.n_lanes)
 
         for rp in plan.rounds:
+            if rp.index < start_round:
+                key_rng.integers(0, 2**32, size=(W, S, 2), dtype=np.uint32)
+                continue
             idx = np.zeros((W, S, B), dtype=np.int32)
             sample_mask = np.zeros((W, S, B), dtype=np.float32)
             step_mask = np.zeros((W, S), dtype=np.float32)
@@ -385,15 +409,7 @@ class RoundLoader:
             for c in rp.chunks:
                 if not c.active:
                     continue
-                if perm is None:
-                    lo = c.doc_start * ss
-                    hi = min(c.doc_end * ss, n)
-                    ids = np.arange(lo, hi, dtype=np.int64)
-                else:
-                    ids = np.concatenate([
-                        np.arange(perm[d] * ss,
-                                  min((perm[d] + 1) * ss, n), dtype=np.int64)
-                        for d in range(c.doc_start, c.doc_end)])
+                ids = self._chunk_global_ids(c, perm)
                 need = c.num_steps * B
                 # same cycle-pad as _fill_chunk's concatenate-and-slice:
                 # padded slots repeat the chunk's real samples in order
@@ -416,6 +432,104 @@ class RoundLoader:
                 sample_mask=sample_mask, step_mask=step_mask,
                 worker_mask=worker_mask, rngs=rngs,
                 round_index=rp.index, num_rounds=len(plan.rounds))
+
+    def _chunk_global_ids(self, c, perm) -> np.ndarray:
+        """GLOBAL sample ids of one plan chunk, in chunk order — the
+        single source of truth shared by the index-fed round path and
+        the makeup-round (reassignment) path, so both address exactly
+        the samples `epoch_rounds` would have materialized."""
+        n = self.handle.train_samples
+        ss = self.handle.subset_size
+        if perm is None:
+            lo = c.doc_start * ss
+            hi = min(c.doc_end * ss, n)
+            return np.arange(lo, hi, dtype=np.int64)
+        return np.concatenate([
+            np.arange(perm[d] * ss,
+                      min((perm[d] + 1) * ss, n), dtype=np.int64)
+            for d in range(c.doc_start, c.doc_end)])
+
+    def makeup_rounds(self, plan: EpochPlan, epoch: int,
+                      quarantined_since: Dict[int, int],
+                      index_mode: bool) -> Iterator[RoundBatch]:
+        """Re-deal quarantined workers' undispatched samples to survivors.
+
+        `quarantined_since` maps a worker slot to the first round index
+        at which the guard masked it out pre-dispatch; every sample of
+        that worker's chunks in plan rounds >= that index was never
+        trained. Those orphan ids are packed — in (worker, round) order,
+        deterministically — into extra "makeup" rounds dealt across the
+        surviving workers, appended after the epoch's planned rounds
+        (round_index continues past the plan), so every dataset index
+        still trains exactly once in the epoch.
+
+        `index_mode=True` yields `{"idx": [W, S, B]}` GLOBAL gather
+        indices for the device cache (the job forces a replicated cache
+        layout under reassignment — orphans cross lanes by design);
+        False materializes batches through `transform_train` like
+        `epoch_rounds`. Rng keys come from the dedicated makeup stream
+        (`_makeup_key_rng`) so planned rounds keep clean-run keys.
+        """
+        W, S, B = self.round_geometry(plan)
+        perm = self._epoch_perm(epoch)
+        quarantined = set(quarantined_since)
+        orphans = []
+        for rp in plan.rounds:
+            for c in rp.chunks:
+                if (c.active and c.worker in quarantined
+                        and rp.index >= quarantined_since[c.worker]):
+                    orphans.append(self._chunk_global_ids(c, perm))
+        if not orphans:
+            return
+        survivors = sorted({c.worker for rp in plan.rounds
+                            for c in rp.chunks if c.active} - quarantined)
+        if not survivors:
+            raise DataError(
+                "reassignment has no surviving workers to re-deal to")
+        flat = np.concatenate(orphans)
+        key_rng = self._makeup_key_rng(epoch)
+        cap = len(survivors) * S * B  # samples one makeup round can hold
+        num_makeup = -(-len(flat) // cap)
+        x_mm = y_mm = None
+        if not index_mode:
+            x_mm, y_mm = self.handle.train_arrays()
+        base = len(plan.rounds)
+        for m in range(num_makeup):
+            part = flat[m * cap:(m + 1) * cap]
+            idx = np.zeros((W, S, B), dtype=np.int32)
+            tbs: list = [None] * W
+            sample_mask = np.zeros((W, S, B), dtype=np.float32)
+            step_mask = np.zeros((W, S), dtype=np.float32)
+            worker_mask = np.zeros(W, dtype=np.float32)
+            for j, w in enumerate(survivors):
+                ids = part[j * S * B:(j + 1) * S * B]
+                if len(ids) == 0:
+                    continue
+                steps = -(-len(ids) // B)  # ceil
+                if index_mode:
+                    need = steps * B
+                    padded = ids[np.arange(need) % len(ids)]  # cycle-pad
+                    idx[w, :steps] = padded.reshape(steps, B)
+                    smask = np.zeros(need, dtype=np.float32)
+                    smask[:len(ids)] = 1.0
+                    sample_mask[w, :steps] = smask.reshape(steps, B)
+                else:
+                    tb = self.dataset.transform_train(
+                        np.asarray(x_mm[ids]), np.asarray(y_mm[ids]))
+                    tb, smask = _fill_chunk(tb, steps, B)
+                    tb, smask = _pad_steps(tb, smask, S)
+                    tbs[w] = tb
+                    sample_mask[w] = smask
+                step_mask[w, :steps] = 1.0
+                worker_mask[w] = 1.0
+            rngs = key_rng.integers(0, 2**32, size=(W, S, 2),
+                                    dtype=np.uint32)
+            yield RoundBatch(
+                batch={"idx": idx} if index_mode
+                else _fill_missing_workers(tbs, W),
+                sample_mask=sample_mask, step_mask=step_mask,
+                worker_mask=worker_mask, rngs=rngs,
+                round_index=base + m, num_rounds=base + num_makeup)
 
     def _native_round(self, rp: RoundPlan, W, S, B, x_mm, y_mm, rngs,
                       num_rounds) -> RoundBatch:

@@ -41,6 +41,18 @@ Event kinds:
            reader fallback / next-save-repairs paths.
   slow     time.sleep(duration_s) before dispatch — an artificial
            straggler round (keep duration_s <= ~1 s in tier-1 tests).
+  preempt  graceful preemption notice at the round — the job finishes
+           the round, drains pending saves, writes a round-granular
+           checkpoint (train_state cursor) and raises JobPreemptedError
+           for the PS to reschedule; the in-process twin of the
+           jobserver's SIGTERM handler, deterministic on CPU. Like
+           crash, fires only in the job's first incarnation.
+  quarantine
+           force the non-finite guard to quarantine the target worker
+           from the round onward (requires quarantine_after > 0 and an
+           explicit worker) — drives the mid-epoch reassignment path
+           without NaN poisoning, so it composes with the device cache
+           (which NaN plans disable).
 
 TrainJob wires the plan in automatically (train/job.py): it becomes the
 job's round hook (dropout/crash/slow/corrupt run post-staging) and wraps
@@ -61,7 +73,8 @@ import numpy as np
 
 logger = logging.getLogger("kubeml_tpu.faults")
 
-KINDS = ("nan", "dropout", "crash", "corrupt_checkpoint", "slow")
+KINDS = ("nan", "dropout", "crash", "corrupt_checkpoint", "slow",
+         "preempt", "quarantine")
 
 # distinctive enough that a watchdog test can assert the death was the
 # injected crash, not an import error or OOM kill
@@ -117,6 +130,10 @@ class FaultPlan:
             if kind not in KINDS:
                 raise ValueError(f"unknown fault kind {kind!r}; "
                                  f"expected one of {KINDS}")
+            if kind == "quarantine" and int(e.get("worker", -1)) < 0:
+                raise ValueError(
+                    "quarantine events need an explicit worker "
+                    "(quarantining every worker would abort the merge)")
             events.append(FaultEvent(
                 kind=kind,
                 epoch=int(e.get("epoch", -1)),
@@ -169,8 +186,20 @@ class FaultPlan:
     # ------------------------------------------------------ post-staging
 
     def __call__(self, rb):
-        """Round hook: dropout / slow / corrupt_checkpoint / crash."""
+        """Round hook: dropout / slow / corrupt_checkpoint / crash /
+        preempt / quarantine. May run in the prefetch feeder thread, a
+        couple of rounds AHEAD of the consumer — preempt and quarantine
+        therefore only RECORD their round coordinate on the job (both
+        job hooks are simple flag/dict writes, thread-safe under the
+        GIL); the training loop applies them at exactly that round."""
         rnd = rb.round_index
+        for ev in self._active("quarantine", rnd):
+            if self._job is not None:
+                self._job.force_quarantine(ev.worker, rnd)
+                self.injected["quarantine"] += 1
+                logger.warning(
+                    "fault quarantine: epoch %d round %d worker %d",
+                    self.epoch, rnd, ev.worker)
         mask = None
         for ev in self._active("dropout", rnd):
             mask = rb.worker_mask.copy() if mask is None else mask
@@ -189,6 +218,12 @@ class FaultPlan:
             time.sleep(ev.duration_s)
         if self._active("corrupt_checkpoint", rnd):
             self._corrupt_checkpoint(rnd)
+        if (self._active("preempt", rnd) and not self.is_restart
+                and self._job is not None):
+            self.injected["preempt"] += 1
+            logger.warning("fault preempt: epoch %d round %d — requesting "
+                           "graceful drain", self.epoch, rnd)
+            self._job.preempt(at_round=rnd)
         if self._active("crash", rnd) and not self.is_restart:
             self._crash(rnd)
         if mask is not None:

@@ -287,6 +287,35 @@ class MetricsRegistry:
         self.restarts_total = Counter(
             "kubeml_ps_restarts_total",
             "Total watchdog restarts since the PS started", "type")
+        # elastic degraded mode: mid-epoch reassignment volume, graceful
+        # preemptions, coalesced checkpoint saves, and the heartbeat
+        # cursor the liveness reaper watches
+        self.reassigned_batches = Gauge(
+            "kubeml_job_reassigned_batches",
+            "Minibatch steps re-dealt from quarantined workers to "
+            "survivors in the last epoch of a job", "jobid")
+        self.preemptions = Gauge(
+            "kubeml_job_preemptions",
+            "Graceful preemption reschedules of a job's standalone "
+            "process", "jobid")
+        self.checkpoint_drops = Gauge(
+            "kubeml_job_checkpoint_drops",
+            "Async checkpoint saves coalesced into a newer snapshot "
+            "because the writer fell behind", "jobid")
+        self.heartbeat_epoch = Gauge(
+            "kubeml_job_heartbeat_epoch",
+            "Epoch cursor of a job's last progress heartbeat", "jobid")
+        self.heartbeat_round = Gauge(
+            "kubeml_job_heartbeat_round",
+            "Round cursor of a job's last progress heartbeat", "jobid")
+        self.preemptions_total = Counter(
+            "kubeml_ps_preemptions_total",
+            "Total graceful preemption reschedules since the PS started",
+            "type")
+        self.wedged_total = Counter(
+            "kubeml_ps_wedged_kills_total",
+            "Standalone children killed by the heartbeat reaper for "
+            "missing the liveness budget", "type")
         # round-phase latency distributions, fed from the job tracer's
         # per-epoch durations (MetricUpdate.phase_times)
         self.dispatch_seconds = Histogram(
@@ -303,7 +332,10 @@ class MetricsRegistry:
         self._job_gauges = [self.validation_loss, self.validation_accuracy,
                             self.train_loss, self.parallelism,
                             self.epoch_duration, self.dropped_workers,
-                            self.quarantined_workers, self.restarts]
+                            self.quarantined_workers, self.restarts,
+                            self.reassigned_batches, self.preemptions,
+                            self.checkpoint_drops, self.heartbeat_epoch,
+                            self.heartbeat_round]
         self._job_hists = [self.dispatch_seconds, self.data_wait_seconds,
                            self.merge_seconds]
 
@@ -316,6 +348,10 @@ class MetricsRegistry:
         self.epoch_duration.set(m.job_id, m.epoch_duration)
         self.dropped_workers.set(m.job_id, m.dropped_workers)
         self.quarantined_workers.set(m.job_id, m.quarantined_workers)
+        self.reassigned_batches.set(
+            m.job_id, getattr(m, "reassigned_batches", 0))
+        self.checkpoint_drops.set(
+            m.job_id, getattr(m, "checkpoint_drops", 0))
         for span, attr in PHASE_HISTOGRAMS.items():
             hist = getattr(self, attr)
             for seconds in getattr(m, "phase_times", {}).get(span, ()):
@@ -328,6 +364,21 @@ class MetricsRegistry:
         self.restarts.inc(job_id)
         self.restarts_total.inc("standalone")
 
+    def note_preemption(self, job_id: str) -> None:
+        """One graceful preemption reschedule (same per-job gauge +
+        lifetime total split as restarts)."""
+        self.preemptions.inc(job_id)
+        self.preemptions_total.inc("standalone")
+
+    def note_heartbeat(self, job_id: str, epoch: int, rnd: int) -> None:
+        self.heartbeat_epoch.set(job_id, epoch)
+        self.heartbeat_round.set(job_id, rnd)
+
+    def note_wedged(self, job_id: str) -> None:
+        """Heartbeat reaper kill; the restart itself is counted by the
+        watchdog path the kill routes into."""
+        self.wedged_total.inc("standalone")
+
     def clear_job(self, job_id: str) -> None:
         for g in self._job_gauges:
             g.clear(job_id)
@@ -336,6 +387,8 @@ class MetricsRegistry:
 
     def exposition(self) -> str:
         families = (self._job_gauges + [self.running_total,
-                                        self.restarts_total]
+                                        self.restarts_total,
+                                        self.preemptions_total,
+                                        self.wedged_total]
                     + self._job_hists)
         return "\n".join(f.collect() for f in families) + "\n"

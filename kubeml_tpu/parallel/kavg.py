@@ -160,6 +160,22 @@ def tree_all_finite(tree: PyTree) -> jax.Array:
     return ok
 
 
+def drain_round(variables: PyTree) -> PyTree:
+    """Block until every leaf of `variables` is materialized on device.
+
+    JAX dispatch is asynchronous: when the training loop acts on a
+    preemption notice, the just-"completed" round's merged weights may
+    still be queued behind the dispatch. The preemption grace path calls
+    this before the synchronous round-granular checkpoint so "drain the
+    in-flight round" is a real barrier — and so resume-latency numbers
+    (bench.py preempted arm) measure checkpoint IO, not queued device
+    work. Returns the same tree for call-site chaining."""
+    for leaf in jax.tree_util.tree_leaves(variables):
+        if hasattr(leaf, "block_until_ready"):
+            leaf.block_until_ready()
+    return variables
+
+
 def masked_scalar_loss(loss_fn: LossFn, model_state: PyTree, batch: PyTree,
                        rng: jax.Array, smask: jax.Array):
     """params -> (masked-mean loss, new model state) — THE per-step loss

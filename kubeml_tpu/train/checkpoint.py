@@ -171,6 +171,14 @@ class AsyncCheckpointer:
     Lifecycle: one checkpointer per TrainJob (wait()/close() clear ALL
     latched errors, so sharing one instance across concurrent jobs would
     let one job's wait() swallow another's failure).
+
+    Backlog bound: the latest-wins dict caps the queue at ONE pending
+    snapshot per job — a round-granular cadence (checkpoint_every_rounds)
+    outpacing a slow disk coalesces into the newest state instead of
+    building an unbounded HBM backlog of device snapshots. Every
+    coalesced (dropped) save is counted in `dropped_saves` and logged,
+    so a persistently-starved writer is observable, and the counter is
+    surfaced as the job's kubeml_job_checkpoint_drops gauge.
     """
 
     def __init__(self, root: Optional[str] = None):
@@ -181,12 +189,19 @@ class AsyncCheckpointer:
         self._errors: Dict[str, BaseException] = {}
         self._thread: Optional[threading.Thread] = None
         self._closed = False
+        self.dropped_saves = 0
 
     def save(self, job_id: str, variables: PyTree, manifest: dict) -> None:
         snap = jax.tree_util.tree_map(jnp.copy, variables)
         with self._cond:
             if self._closed:
                 raise RuntimeError("AsyncCheckpointer is closed")
+            if job_id in self._pending:
+                self.dropped_saves += 1
+                logger.info(
+                    "checkpoint save for %s coalesced into a newer "
+                    "snapshot (writer behind; %d dropped so far)",
+                    job_id, self.dropped_saves)
             self._pending[job_id] = (snap, manifest)
             if self._thread is None:
                 self._thread = threading.Thread(

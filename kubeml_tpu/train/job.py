@@ -37,14 +37,15 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from kubeml_tpu.api.errors import KubeMLException, MergeError
+from kubeml_tpu.api.errors import (JobPreemptedError, KubeMLException,
+                                   MergeError)
 from kubeml_tpu.api.types import (History, JobHistory, MetricUpdate,
                                   TrainTask)
 from kubeml_tpu.data.loader import (RoundGroup, RoundLoader, group_rounds,
                                     prefetch_rounds)
 from kubeml_tpu.data.registry import DatasetRegistry
 from kubeml_tpu.models.base import KubeDataset, KubeModel
-from kubeml_tpu.parallel.kavg import KAvgEngine
+from kubeml_tpu.parallel.kavg import KAvgEngine, drain_round
 from kubeml_tpu.parallel.mesh import data_axis_size
 from kubeml_tpu.train.checkpoint import (AsyncCheckpointer,
                                          mark_checkpoint_completed,
@@ -111,9 +112,46 @@ class _NonFiniteGuard:
         self._consec: Optional[np.ndarray] = None   # [W] drop streaks
         self.quarantined: Optional[np.ndarray] = None  # [W] 0/1
         self.dropped_total = 0.0
+        # worker -> first round index its dispatches were masked out:
+        # every sample of the worker's chunks in plan rounds >= that
+        # index was never trained — exactly what the reassignment path
+        # (RoundLoader.makeup_rounds) re-deals to survivors
+        self.quarantined_since: dict = {}
+        self._forced: dict = {}  # worker -> round, pending fault marks
+
+    def force(self, worker: int, rnd: int) -> None:
+        """Schedule a fault-driven quarantine of `worker` from round
+        `rnd` onward (applied by `apply` at that round — the fault hook
+        may run in the prefetch feeder, ahead of the consumer)."""
+        if worker not in self._forced or rnd < self._forced[worker]:
+            self._forced[worker] = rnd
+
+    def seed(self, consec, quarantined, quarantined_since,
+             dropped_total: float) -> None:
+        """Restore mid-epoch guard state from a round-granular resume."""
+        self._consec = np.asarray(consec, dtype=np.float64)
+        self.quarantined = np.asarray(quarantined, dtype=np.float32)
+        self.quarantined_since = {int(w): int(r)
+                                  for w, r in quarantined_since.items()}
+        self.dropped_total = float(dropped_total)
 
     def apply(self, rb):
         """Mask quarantined workers out of the round before dispatch."""
+        due = [w for w, r in self._forced.items() if r <= rb.round_index]
+        if due:
+            W = rb.worker_mask.shape[0]
+            if self.quarantined is None:
+                self._consec = np.zeros(W)
+                self.quarantined = np.zeros(W, np.float32)
+            for w in due:
+                del self._forced[w]
+                if 0 <= w < W and not self.quarantined[w]:
+                    self.quarantined[w] = 1.0
+                    self.quarantined_since.setdefault(w, rb.round_index)
+                    self.job._log(
+                        "job %s force-quarantined worker %d from round "
+                        "%d (fault plan)", self.job.task.job_id, w,
+                        rb.round_index)
         if self.quarantined is None or not self.quarantined.any():
             return rb
         mask = rb.worker_mask * (1.0 - self.quarantined)
@@ -138,6 +176,12 @@ class _NonFiniteGuard:
                     & (self.quarantined == 0))
             if newq.any():
                 self.quarantined[newq] = 1.0
+                for w in np.flatnonzero(newq):
+                    # first MASKED round is the next one — this worker's
+                    # round-rb.round_index contribution was dropped by
+                    # the merge guard, not withheld
+                    self.quarantined_since.setdefault(
+                        int(w), rb.round_index + 1)
                 self.job._log(
                     "job %s quarantined workers %s after %d consecutive "
                     "non-finite rounds (rest of epoch)",
@@ -199,6 +243,19 @@ class TrainJob:
         self._all_dropped_rounds = 0
         self._epoch_dropped = 0.0
         self._epoch_quarantined = 0
+        self._epoch_reassigned = 0
+        # elastic degraded mode: preemption grace (SIGTERM / `preempt`
+        # fault → finish the round, drain, round-granular checkpoint,
+        # JobPreemptedError for the PS to reschedule), the per-epoch
+        # guard handle (routes forced quarantines from the fault hook),
+        # the mid-epoch train_state consumed by a round-granular resume,
+        # and the (epoch, round) progress cursor the jobserver's
+        # heartbeats report to the PS liveness reaper
+        self._preempt_event = threading.Event()
+        self._preempt_at_round: Optional[int] = None
+        self._guard = None
+        self._resume_state: Optional[dict] = None
+        self._progress = (0, 0)
         self._checkpointer = AsyncCheckpointer()
         self.tracer = Tracer()  # host-phase spans, summarized per epoch
         self._trace_sink: Optional[TraceSink] = None
@@ -224,6 +281,29 @@ class TrainJob:
     def stop(self):
         """`kubeml task stop` path (train/api.go:129-134 -> stopChan)."""
         self.stop_event.set()
+
+    def preempt(self, at_round: Optional[int] = None):
+        """Graceful-preemption request (jobserver SIGTERM handler or a
+        `preempt` fault event). The training loop finishes the in-flight
+        round, drains pending saves, writes a checkpoint with a
+        round-granular train_state cursor and raises JobPreemptedError.
+        `at_round` pins the drain to an exact round coordinate (the
+        fault hook runs in the prefetch feeder, AHEAD of the consumer —
+        without the pin the drain round would be a race); None means
+        "after whatever round completes next"."""
+        if at_round is not None:
+            cur = self._preempt_at_round
+            self._preempt_at_round = (at_round if cur is None
+                                      else min(cur, at_round))
+        self._preempt_event.set()
+
+    def force_quarantine(self, worker: int, rnd: int):
+        """`quarantine` fault hook: mark a worker for quarantine from
+        round `rnd` onward. Recorded on the epoch's guard and applied by
+        guard.apply at exactly that round (the hook may fire early, from
+        the prefetch feeder)."""
+        if self._guard is not None:
+            self._guard.force(int(worker), int(rnd))
 
     def _log(self, msg, *args, exc=False):
         """Log to the module logger (honors app logging config) AND the
@@ -345,6 +425,8 @@ class TrainJob:
                 self.history.dropped_workers.append(self._epoch_dropped)
                 self.history.quarantined_workers.append(
                     self._epoch_quarantined)
+                self.history.reassigned_batches.append(
+                    self._epoch_reassigned)
                 phase_times = {k: v for k, v
                                in self.tracer.durations().items()
                                if k in PHASE_HISTOGRAMS}
@@ -354,6 +436,8 @@ class TrainJob:
                     parallelism=used_parallelism, epoch_duration=elapsed,
                     dropped_workers=self._epoch_dropped,
                     quarantined_workers=self._epoch_quarantined,
+                    reassigned_batches=self._epoch_reassigned,
+                    checkpoint_drops=self._checkpointer.dropped_saves,
                     phase_times=phase_times))
                 self._log("job %s epoch %d/%d loss=%.4f val=%.4f acc=%.2f "
                             "N=%d %.2fs [%s]", job_id, epoch + 1, epochs,
@@ -385,6 +469,19 @@ class TrainJob:
                                        parallelism=parallelism))
                     last_ckpt_epoch = epoch + 1
 
+                if self._preempt_event.is_set():
+                    # epoch-boundary preemption grace — the fallback for
+                    # configurations whose epoch loop has no per-round
+                    # host control (grouped dispatch, syncdp); the kavg
+                    # single-round path drains mid-epoch instead
+                    # (_train_epoch) and never reaches here
+                    drain_round(self.variables)
+                    self._checkpointer.wait()
+                    save_checkpoint(
+                        job_id, self.variables,
+                        self._manifest(epoch=epoch + 1,
+                                       parallelism=parallelism))
+                    raise JobPreemptedError(job_id, epoch + 1, 0)
                 if self.stop_event.is_set():
                     self._log("job %s stopped by request", job_id)
                     break
@@ -441,6 +538,18 @@ class TrainJob:
             self.task.state = "finished"
             self.callbacks.on_finish(job_id, None)
             return record
+        except JobPreemptedError as e:
+            # NOT a failure and NOT finished: the round-granular
+            # checkpoint is on disk and the PS must reschedule this job
+            # (the jobserver posts /preempted, the watchdog respawns
+            # with resume_from=job_id). on_finish is deliberately NOT
+            # called — it would tear down the PS job record the restart
+            # needs.
+            self.task.state = "preempted"
+            self._log("job %s preempted at epoch %d round %d — "
+                      "checkpointed for reschedule", job_id, e.epoch,
+                      e.round)
+            raise
         except Exception as e:  # job abort reports exitErr to the PS
             self.exit_err = str(e)
             self.task.state = "failed"
@@ -470,7 +579,8 @@ class TrainJob:
 
     def _manifest(self, epoch: Optional[int] = None,
                   parallelism: Optional[int] = None,
-                  completed: bool = False) -> dict:
+                  completed: bool = False,
+                  train_state: Optional[dict] = None) -> dict:
         m = {
             "model": self.req.model_type,
             "function": self.req.function_name or self.req.model_type,
@@ -478,6 +588,12 @@ class TrainJob:
         }
         if completed:
             m["completed"] = True
+        if train_state is not None:
+            # round-granular resume cursor (elastic degraded mode):
+            # `epoch` below is the COMPLETED-epoch count, train_state
+            # pins the exact round inside the in-progress epoch plus
+            # the host accumulators a bit-identical resume needs
+            m["train_state"] = train_state
         if epoch is not None:
             # mid-job snapshot: record everything crash recovery needs to
             # resume THIS job where it stopped — completed-epoch count,
@@ -503,6 +619,25 @@ class TrainJob:
             raise KubeMLException(
                 "quarantine_after and abort_after must be >= 0 "
                 f"(got {opts.quarantine_after}, {opts.abort_after})", 400)
+        ckpt_rounds = int(getattr(opts, "checkpoint_every_rounds", 0))
+        if ckpt_rounds < 0:
+            raise KubeMLException(
+                f"checkpoint_every_rounds must be >= 0, got "
+                f"{ckpt_rounds}", 400)
+        if ckpt_rounds > 0 and engine_kind != "kavg":
+            raise KubeMLException(
+                "checkpoint_every_rounds requires the kavg engine: kavg "
+                "re-derives optimizer state from the weights every "
+                "round, so weights + round cursor fully determine the "
+                "resumed trajectory; syncdp's persistent device "
+                "optimizer state has no durable representation in the "
+                "checkpoint manifest", 400)
+        if getattr(opts, "reassign_on_quarantine", False) and (
+                engine_kind != "kavg" or opts.quarantine_after <= 0):
+            raise KubeMLException(
+                "reassign_on_quarantine requires the kavg engine with "
+                "quarantine_after > 0 — reassignment re-deals exactly "
+                "what the quarantine guard masked out", 400)
         if opts.fault_plan:
             from kubeml_tpu.faults import FaultPlan
             try:
@@ -510,6 +645,12 @@ class TrainJob:
             except (ValueError, KeyError, TypeError) as e:
                 raise KubeMLException(f"invalid fault_plan: {e}", 400)
             plan.bind(self)
+            if plan.has("quarantine") and (engine_kind != "kavg"
+                                           or opts.quarantine_after <= 0):
+                raise KubeMLException(
+                    "fault_plan 'quarantine' events require the kavg "
+                    "engine with quarantine_after > 0 (they drive the "
+                    "quarantine guard directly)", 400)
             self._fault_plan = plan
             if self.round_hook is None:
                 self.round_hook = plan
@@ -733,7 +874,11 @@ class TrainJob:
                     f"checkpoint {self.req.resume_from} holds function "
                     f"{ckpt_fn!r}, not {this_fn!r}", 400)
             if self.req.resume_from == self.task.job_id and \
-                    (manifest.get("epoch") or manifest.get("completed")):
+                    (manifest.get("epoch") or manifest.get("completed")
+                     or manifest.get("train_state")):
+                # epoch may legitimately be 0 when a round-granular save
+                # fired inside the FIRST epoch — train_state still makes
+                # this a crash recovery, not a warm start
                 # crash recovery (the PS watchdog restarts a dead job
                 # process with resume_from = its own id): this is the
                 # SAME job continuing, not a warm start of a new one —
@@ -744,6 +889,16 @@ class TrainJob:
                 # reference tolerates pod death WITHIN a merge
                 # (util.go:144-166); process-level recovery is net-new.
                 self._start_epoch = int(manifest.get("epoch") or 0)
+                ts = manifest.get("train_state")
+                if ts and not manifest.get("completed"):
+                    # round-granular resume: the save was mid-epoch, so
+                    # restart inside that epoch at the stored round
+                    # cursor (consumed by _train_epoch). `epoch` in a
+                    # train_state manifest is the completed-epoch count
+                    # (the cursor's epoch is in progress).
+                    self._resume_state = dict(ts)
+                    self._start_epoch = int(ts.get("epoch",
+                                                   self._start_epoch))
                 if manifest.get("completed"):
                     # the crash hit between the final save and the
                     # /finish notification: every epoch (incl. an
@@ -790,7 +945,17 @@ class TrainJob:
                 raise KubeMLException(
                     f"checkpoint {self.req.resume_from} is shaped for a "
                     "different model configuration", 400)
-            self.variables = restored
+            # own the restored leaves on device before the first
+            # dispatch: load_checkpoint hands back HOST numpy buffers,
+            # and the engines donate the variables argument every round
+            # — donating a zero-copy-aliased numpy buffer lets XLA
+            # reuse memory the host still owns, so the resumed run's
+            # first rounds silently train on corrupted weights (or
+            # segfault once the loader's dict is collected). jnp.array
+            # forces a device-owned copy the donation may consume;
+            # dtype pinned so x64-downcasting can't reshape the tree.
+            self.variables = jax.tree_util.tree_map(
+                lambda l: jnp.array(l, dtype=l.dtype), restored)
             self._log("job %s warm-started from checkpoint %s",
                       self.task.job_id, self.req.resume_from)
         if self._tp_rules is not None:
@@ -873,8 +1038,13 @@ class TrainJob:
                     "transform_train_device hook)", 400)
             return
         layout = ("replicated"
-                  if (engine_kind == "syncdp" or opts.shuffle)
+                  if (engine_kind == "syncdp" or opts.shuffle
+                      or getattr(opts, "reassign_on_quarantine", False))
                   else "sharded")
+        # reassignment forces the replicated layout: makeup rounds deal
+        # a quarantined worker's samples to ARBITRARY surviving lanes,
+        # which the sharded layout's lane-local index rebasing cannot
+        # address by construction
         budget = max(0, int(getattr(opts, "device_cache_mb", 512))) << 20
         per_chip = DeviceDatasetCache.per_chip_bytes(
             handle, layout, data_axis_size(self.mesh))
@@ -973,9 +1143,12 @@ class TrainJob:
                       or jax.process_count() > 1
                       or self._engine.batch_seq_dims
                       or self.req.options.quarantine_after > 0
-                      or self.req.options.abort_after > 0):
+                      or self.req.options.abort_after > 0
+                      or getattr(self.req.options,
+                                 "checkpoint_every_rounds", 0) > 0):
             # quarantine/abort need per-round drop flags and per-round
-            # mask edits — per-round host control, like hooks
+            # mask edits, round-granular checkpoints need a per-round
+            # cursor — per-round host control, like hooks
             return 1
         return R
 
@@ -1082,6 +1255,7 @@ class TrainJob:
                 else 0.5 * self._steady_round_ema + 0.5 * m
 
     def _train_epoch(self, parallelism: int, epoch: int) -> float:
+        self._progress = (epoch, 0)  # heartbeat cursor (jobserver reads it)
         if self._sync_engine is not None:
             return self._train_epoch_syncdp(parallelism, epoch)
         plan = self._loader.plan(parallelism, self.req.options.k,
@@ -1114,15 +1288,72 @@ class TrainJob:
         if opts.quarantine_after > 0 or opts.abort_after > 0:
             guard = _NonFiniteGuard(self, opts.quarantine_after,
                                     opts.abort_after)
+        self._guard = guard  # routes force_quarantine from the fault hook
+        self._epoch_reassigned = 0
+        ckpt_rounds = int(getattr(opts, "checkpoint_every_rounds", 0))
+
+        # ---- round-granular resume (elastic degraded mode): continue a
+        # crashed/preempted epoch at the stored round cursor. The loader
+        # still consumes the skipped rounds' rng-key draws, and the host
+        # accumulators (step counts, partial loss sums, guard state) are
+        # seeded from the snapshot, so under unchanged membership the
+        # resumed trajectory is bit-identical in the WEIGHTS to an
+        # uninterrupted run (the reported loss may differ in the last
+        # ulp — float sums associate differently across the split).
+        W, S, B = self._loader.round_geometry(plan)
+        num_rounds = len(plan.rounds)
+        start_round = 0
+        loss_base = None
+        dropped_base = 0.0
+        resume = None
+        if self._resume_state is not None and \
+                int(self._resume_state.get("epoch", -1)) == epoch:
+            resume = self._resume_state
+            self._resume_state = None  # consumed; later epochs run clean
+            stored = list(resume.get("step_counts", []))
+            if (len(stored) != W
+                    or not 0 <= int(resume.get("round", -1)) <= num_rounds):
+                # membership (or the plan) changed across the restart —
+                # the cursor's accumulators no longer line up with this
+                # epoch's rounds, so replay the epoch from round 0 (the
+                # weights are the cursor state; replayed rounds re-train
+                # a partial epoch rather than lose its coverage)
+                self._log(
+                    "job %s: discarding round cursor (stored W=%d "
+                    "round=%s vs W=%d rounds=%d) — replaying epoch %d "
+                    "from round 0", self.task.job_id, len(stored),
+                    resume.get("round"), W, num_rounds, epoch)
+                resume = None
+        if resume is not None:
+            start_round = int(resume["round"])
+            step_counts = np.asarray(resume["step_counts"], dtype=float)
+            loss_base = np.asarray(resume.get("loss_sums",
+                                              np.zeros(W)), dtype=float)
+            dropped_base = float(resume.get("dropped", 0.0))
+            self._all_dropped_rounds = int(
+                resume.get("all_dropped_rounds", 0))
+            self._epoch_reassigned = int(resume.get("reassigned", 0))
+            if guard is not None and resume.get("quarantined") is not None:
+                guard.seed(resume.get("consec", np.zeros(W)),
+                           resume["quarantined"],
+                           resume.get("quarantined_since", {}),
+                           dropped_base)
+            group = 1  # the resumed epoch needs per-round accounting
+            self._log("job %s resuming epoch %d at round %d/%d",
+                      self.task.job_id, epoch, start_round, num_rounds)
+
         cache = self._device_cache
         source = None
         if cache is not None:
-            W, S, B = self._loader.round_geometry(plan)
             with self.tracer.span("cache_upload"):
                 cache.ensure(plan, W)
             self._log_cache_payload(W, S, B)
             source = self._loader.epoch_index_rounds(
-                plan, epoch, lane_starts=cache.lane_starts)
+                plan, epoch, lane_starts=cache.lane_starts,
+                start_round=start_round)
+        elif start_round:
+            source = self._loader.epoch_rounds(plan, epoch,
+                                               start_round=start_round)
         # depth=1: the staging transform makes queued rounds
         # device-resident, so at most ~3 DISPATCHES of batch HBM are in
         # flight (queued + consumer-held + feeder-in-flight) — which is
@@ -1130,34 +1361,10 @@ class TrainJob:
         # dispatch. The index-fed cached path shrinks each round's
         # in-flight payload from sample leaves to [W, S, B] int32
         # indices, so the multiplier stops mattering for HBM there.
-        for rb in self._epoch_round_iter(plan, epoch, transform,
-                                         group=group, source=source):
-            if isinstance(rb, RoundGroup):
-                with self.tracer.span("dispatch"):
-                    t_r = time.time()
-                    if cache is not None:
-                        self.variables, stats = \
-                            self._engine.train_rounds_indexed(
-                                self.variables, cache, rb.batch["idx"],
-                                rb.sample_mask, rb.step_mask,
-                                rb.worker_mask, rb.rngs,
-                                lr=self.req.lr, epoch=epoch)
-                    else:
-                        self.variables, stats = self._engine.train_rounds(
-                            self.variables, rb.batch, rb.sample_mask,
-                            rb.step_mask, rb.worker_mask, rb.rngs,
-                            lr=self.req.lr, epoch=epoch)
-                    round_times.append((time.time() - t_r, rb.rounds,
-                                        stats.compiled))
-                if step_counts.size == 0:
-                    step_counts = np.zeros(stats.step_count.shape[1])
-                step_counts += (stats.step_count * rb.worker_mask
-                                ).sum(axis=0)
-                # one tiny eager sum per GROUP keeps the reducer's leaf
-                # shapes uniform with single rounds ([W])
-                dev_losses.append(stats.loss_sum_device.sum(axis=0))
-                dev_dropped.append(stats.dropped_device.sum(axis=0))
-                continue
+        def dispatch_round(rb):
+            # single-round dispatch + accounting, shared by the planned
+            # loop below and the makeup-round pass (reassignment)
+            nonlocal step_counts
             if guard is not None:
                 # quarantined workers are masked out BEFORE dispatch (a
                 # mask-content edit, no retrace); raises when every
@@ -1189,6 +1396,97 @@ class TrainJob:
                 guard.observe(stats, rb)
             else:
                 dev_dropped.append(stats.dropped_device)
+
+        def round_state(cursor: int) -> dict:
+            return self._round_train_state(
+                epoch, cursor, guard, step_counts, dev_losses,
+                dev_dropped, loss_base, dropped_base)
+
+        for rb in self._epoch_round_iter(plan, epoch, transform,
+                                         group=group, source=source):
+            if isinstance(rb, RoundGroup):
+                with self.tracer.span("dispatch"):
+                    t_r = time.time()
+                    if cache is not None:
+                        self.variables, stats = \
+                            self._engine.train_rounds_indexed(
+                                self.variables, cache, rb.batch["idx"],
+                                rb.sample_mask, rb.step_mask,
+                                rb.worker_mask, rb.rngs,
+                                lr=self.req.lr, epoch=epoch)
+                    else:
+                        self.variables, stats = self._engine.train_rounds(
+                            self.variables, rb.batch, rb.sample_mask,
+                            rb.step_mask, rb.worker_mask, rb.rngs,
+                            lr=self.req.lr, epoch=epoch)
+                    round_times.append((time.time() - t_r, rb.rounds,
+                                        stats.compiled))
+                if step_counts.size == 0:
+                    step_counts = np.zeros(stats.step_count.shape[1])
+                step_counts += (stats.step_count * rb.worker_mask
+                                ).sum(axis=0)
+                # one tiny eager sum per GROUP keeps the reducer's leaf
+                # shapes uniform with single rounds ([W])
+                dev_losses.append(stats.loss_sum_device.sum(axis=0))
+                dev_dropped.append(stats.dropped_device.sum(axis=0))
+                continue
+            dispatch_round(rb)
+            rounds_done = rb.round_index + 1
+            self._progress = (epoch, rounds_done)
+            if (ckpt_rounds and self.checkpoint
+                    and rounds_done % ckpt_rounds == 0):
+                # round-cadence cursor snapshot: async like the epoch
+                # saves, but the train_state readback syncs on the
+                # partial loss sums — the cost the cadence opts into
+                self._checkpointer.save(
+                    self.task.job_id, self.variables,
+                    self._manifest(epoch=epoch, parallelism=parallelism,
+                                   train_state=round_state(rounds_done)))
+            if self._preempt_event.is_set() and (
+                    self._preempt_at_round is None
+                    or rb.round_index >= self._preempt_at_round):
+                # preemption grace: the in-flight round just completed —
+                # barrier the async dispatch (the merged weights may
+                # still be queued), drain pending async saves so the
+                # cursor snapshot is the newest publish, write it
+                # synchronously, then hand the job back to the PS
+                drain_round(self.variables)
+                self._checkpointer.wait()
+                save_checkpoint(
+                    self.task.job_id, self.variables,
+                    self._manifest(epoch=epoch, parallelism=parallelism,
+                                   train_state=round_state(rounds_done)))
+                raise JobPreemptedError(self.task.job_id, epoch,
+                                        rounds_done)
+
+        # ---- mid-epoch work reassignment (elastic degraded mode):
+        # re-deal quarantined workers' unconsumed rounds to the
+        # survivors so every sample index still trains exactly once this
+        # epoch. Runs as a SECOND iteration pass — not chained into the
+        # prefetch source — because the feeder thread runs ahead of the
+        # consumer and the quarantine set is only final once the planned
+        # rounds have all been observed. Makeup rounds draw rng keys
+        # from an independent stream, so the planned rounds' keys stay
+        # identical to a clean run's.
+        if (guard is not None
+                and getattr(opts, "reassign_on_quarantine", False)
+                and guard.quarantined_since):
+            makeup = self._loader.makeup_rounds(
+                plan, epoch, guard.quarantined_since,
+                index_mode=cache is not None)
+            for rb in self._epoch_round_iter(plan, epoch, transform,
+                                             source=makeup):
+                redealt = int(round(float(np.asarray(rb.step_mask).sum())))
+                dispatch_round(rb)
+                self._epoch_reassigned += redealt
+                self._progress = (epoch, rb.round_index + 1)
+            if self._epoch_reassigned:
+                self._log(
+                    "job %s epoch %d re-dealt %d minibatch steps from "
+                    "quarantined workers %s to the survivors",
+                    self.task.job_id, epoch, self._epoch_reassigned,
+                    sorted(guard.quarantined_since))
+        self._guard = None
         self._note_round_times(round_times)
         if guard is not None:
             self._epoch_dropped = guard.dropped_total
@@ -1198,13 +1496,19 @@ class TrainJob:
             # per-round device arrays, one stack+sum dispatch at the end
             # (the reducer program is shared with the loss reduction —
             # identical leaf count and [W] shapes)
-            self._epoch_dropped = float(np.asarray(
-                self._reduce_losses(dev_dropped)).sum()) \
-                if dev_dropped else 0.0
+            self._epoch_dropped = dropped_base + (float(np.asarray(
+                self._reduce_losses(dev_dropped)).sum())
+                if dev_dropped else 0.0)
             self._epoch_quarantined = 0
         with self.tracer.span("device_drain"):
             loss_sums = np.asarray(self._reduce_losses(dev_losses)) \
                 if dev_losses else np.zeros(0)
+        if loss_base is not None:
+            # fold the pre-restart partial sums back in (a resume with
+            # cursor == num_rounds trains zero live rounds and the epoch
+            # closes entirely from the restored accumulators)
+            loss_sums = loss_base if loss_sums.size == 0 \
+                else loss_sums + loss_base
         # per-worker epoch loss, then unweighted mean over workers that ran
         # (reference aggregation ml/pkg/train/util.go:82-98)
         ran = step_counts > 0
@@ -1212,6 +1516,44 @@ class TrainJob:
             raise MergeError("epoch produced no training steps")
         per_worker = loss_sums[ran] / step_counts[ran]
         return float(per_worker.mean())
+
+    def _round_train_state(self, epoch: int, cursor: int, guard,
+                           step_counts, dev_losses, dev_dropped,
+                           loss_base, dropped_base) -> dict:
+        """Host snapshot of an in-progress epoch at `cursor` (the next
+        planned round to run) — everything a restart needs to continue
+        the epoch bit-identically in the weights under unchanged
+        membership. Reads the partial loss sums back from device (one
+        sync per snapshot — the price of a round-granular cursor).
+        kavg-only: the engine re-derives optimizer state every round
+        from the merged weights, so weights + cursor fully determine
+        the resumed trajectory (_init_model rejects the cadence for
+        syncdp, whose carried optimizer state is not JSON-friendly)."""
+        sums = np.asarray(self._reduce_losses(dev_losses)) \
+            if dev_losses else np.zeros(len(step_counts))
+        if loss_base is not None:
+            sums = sums + loss_base
+        if guard is not None:
+            dropped = float(guard.dropped_total)
+        else:
+            dropped = dropped_base + (float(np.asarray(
+                self._reduce_losses(dev_dropped)).sum())
+                if dev_dropped else 0.0)
+        state = {
+            "epoch": int(epoch),
+            "round": int(cursor),
+            "step_counts": [float(x) for x in step_counts],
+            "loss_sums": [float(x) for x in sums],
+            "dropped": dropped,
+            "all_dropped_rounds": int(self._all_dropped_rounds),
+            "reassigned": int(self._epoch_reassigned),
+        }
+        if guard is not None and guard.quarantined is not None:
+            state["consec"] = [float(x) for x in guard._consec]
+            state["quarantined"] = [float(x) for x in guard.quarantined]
+            state["quarantined_since"] = {
+                str(w): int(r) for w, r in guard.quarantined_since.items()}
+        return state
 
     def _train_epoch_syncdp(self, parallelism: int, epoch: int) -> float:
         """Per-step gradient-averaging epoch (options.engine='syncdp').
@@ -1229,6 +1571,7 @@ class TrainJob:
         real_steps = 0
         round_times = []
         opts = self.req.options
+        self._epoch_reassigned = 0  # syncdp never re-deals (kavg-only)
         transform = self._stage_batch_sync
         plan_f = self._fault_plan
         if plan_f is not None:

@@ -39,7 +39,8 @@ import os
 import threading
 from typing import Optional
 
-from kubeml_tpu.api.errors import InvalidArgsError, KubeMLException
+from kubeml_tpu.api.errors import (InvalidArgsError, JobPreemptedError,
+                                   KubeMLException)
 from kubeml_tpu.api.types import MetricUpdate, TrainTask
 from kubeml_tpu.control.httpd import JsonService, Request, http_json
 
@@ -65,6 +66,10 @@ class JobServer(JsonService):
         self.exit_error: Optional[str] = None
         self._job = None
         self._job_thread: Optional[threading.Thread] = None
+        self._hb_thread: Optional[threading.Thread] = None
+        # progress heartbeats to the PS liveness reaper; 0 disables
+        self.heartbeat_interval = float(
+            os.environ.get("KUBEML_HEARTBEAT_INTERVAL", "10"))
         self._next_parallelism: Optional[int] = None
         self._update_event = threading.Event()
 
@@ -126,13 +131,62 @@ class JobServer(JsonService):
         self._job_thread = threading.Thread(
             target=self._run, name=f"job-{self.job_id}", daemon=True)
         self._job_thread.start()
+        if self.ps_url is not None and self.heartbeat_interval > 0:
+            self._hb_thread = threading.Thread(
+                target=self._heartbeat_loop,
+                name=f"heartbeat-{self.job_id}", daemon=True)
+            self._hb_thread.start()
 
     def _run(self):
         try:
             self._job.train()
+        except JobPreemptedError as e:
+            # graceful preemption: the round-granular checkpoint is on
+            # disk; tell the PS so its watchdog reschedules this job
+            # (deliberately NOT /finish — that would tear down the job
+            # record the restart needs)
+            logger.warning("job %s preempted at epoch %d round %d; "
+                           "notifying PS", self.job_id, e.epoch, e.round)
+            if self.ps_url is not None:
+                try:
+                    http_json("POST",
+                              f"{self.ps_url}/preempted/{self.job_id}",
+                              {"epoch": e.epoch, "round": e.round})
+                except KubeMLException as err:
+                    logger.warning("preemption notification failed: %s",
+                                   err.message)
+            self.finished.set()
         except Exception:
             logger.exception("job %s failed", self.job_id)
             self.finished.set()  # train() reports on_finish itself; backstop
+
+    def preempt(self):
+        """SIGTERM entry: ask the job to drain the in-flight round,
+        checkpoint at the round cursor, and exit for rescheduling."""
+        job = self._job
+        if job is not None:
+            logger.warning("job server %s: preemption notice (SIGTERM); "
+                           "draining in-flight round", self.job_id)
+            job.preempt()
+        else:
+            # no task yet — nothing to drain, just exit cleanly
+            self.finished.set()
+
+    def _heartbeat_loop(self):
+        """Progress heartbeats (epoch, round cursor) to the PS liveness
+        reaper — a job that stops posting for the miss budget is
+        declared wedged and restarted from its round checkpoint. Paced
+        on the finished event, never time.sleep, so shutdown is prompt."""
+        while not self.finished.wait(timeout=self.heartbeat_interval):
+            job = self._job
+            if job is None:
+                continue
+            epoch, rnd = getattr(job, "_progress", (0, 0))
+            try:
+                http_json("POST", f"{self.ps_url}/heartbeat/{self.job_id}",
+                          {"epoch": int(epoch), "round": int(rnd)})
+            except KubeMLException as e:
+                logger.debug("heartbeat failed: %s", e.message)
 
     # ------------------------------------------------------------ callbacks
 
@@ -230,6 +284,12 @@ def main(argv=None):
                        scheduler_url=args.scheduler_url, port=args.port,
                        mesh=mesh, trace_id=args.trace_id)
     port = server.start()
+    # preemption grace: SIGTERM (the platform's eviction notice) drains
+    # the in-flight round, publishes a round-granular checkpoint and
+    # posts /preempted to the PS instead of dying mid-round. The handler
+    # only sets events — all real work happens on the training thread.
+    import signal
+    signal.signal(signal.SIGTERM, lambda *_: server.preempt())
     if args.port_file:
         tmp = args.port_file + ".tmp"
         with open(tmp, "w") as f:

@@ -249,12 +249,13 @@ def _read_manifest(tmp_home, job_id) -> dict:
 
 
 def _kill_in_window(dep, tmp_home, job_id, epochs, expect_restarts=0,
-                    timeout=240.0, min_epoch=1):
+                    timeout=240.0, min_epoch=1, sig=None):
     """Wait for the job's incarnation `expect_restarts` to be fully
     RUNNING (task state 'running' — a kill between readiness and the
     /start push would hit a child that never received its task) with a
     durable MID-JOB checkpoint (min_epoch <= manifest epoch < epochs),
-    then SIGKILL it. min_epoch > 1 lets chained-crash tests require the
+    then SIGKILL it (or send `sig`, e.g. SIGTERM for the preemption
+    grace path). min_epoch > 1 lets chained-crash tests require the
     CURRENT incarnation to have checkpointed (not just the previous
     one's leftover manifest). Returns the record."""
     deadline = time.time() + timeout
@@ -277,7 +278,10 @@ def _kill_in_window(dep, tmp_home, job_id, epochs, expect_restarts=0,
                 and rec.task.state == "running" and \
                 min_epoch <= _read_manifest(tmp_home, job_id
                                             ).get("epoch", 0) < epochs:
-            rec.proc.kill()
+            if sig is None:
+                rec.proc.kill()
+            else:
+                rec.proc.send_signal(sig)
             return rec
         time.sleep(0.05)
     raise AssertionError("kill window never opened")
@@ -420,3 +424,52 @@ def test_restart_budget_exhausted_fails_job(standalone_stack, tmp_home):
         assert len(h.data.train_loss) < epochs
     except KubeMLException:
         pass  # no history at all is the expected common case
+
+
+def test_sigterm_preemption_reschedules_without_budget(standalone_stack,
+                                                       tmp_home):
+    """Preemption grace end-to-end: SIGTERM the standalone child mid-job
+    (the platform's eviction notice). The jobserver's handler drains the
+    in-flight round, writes a round-granular checkpoint, posts
+    /preempted to the PS and exits; the watchdog reschedules WITHOUT
+    consuming the crash-restart budget — proven by max_restarts=0, where
+    a crash-path exit would fail the job instead. The rescheduled
+    incarnation resumes at the round cursor and finishes with one
+    continuous history carrying preemptions=1."""
+    import signal
+
+    dep, client, tmp_path = standalone_stack
+    paths = write_blob_files(tmp_path, n_train=4000)
+    client.v1().datasets().create(
+        "blobs", paths["xtr"], paths["ytr"], paths["xte"], paths["yte"])
+
+    epochs = 30
+    req = TrainRequest(model_type="mlp", batch_size=16, epochs=epochs,
+                       dataset="blobs", lr=0.05,
+                       options=TrainOptions(default_parallelism=2, k=1,
+                                            static_parallelism=True,
+                                            max_restarts=0,
+                                            checkpoint_every_rounds=8,
+                                            goal_accuracy=200.0))
+    job_id = client.v1().networks().train(req)
+
+    rec = _kill_in_window(dep, tmp_home, job_id, epochs,
+                          sig=signal.SIGTERM)
+
+    # the record must be rescheduled, not failed: preemption counted,
+    # restart budget untouched
+    deadline = time.time() + 120
+    while time.time() < deadline:
+        with dep.ps._jobs_lock:
+            alive = dep.ps.jobs.get(job_id)
+        if alive is None or rec.preemptions >= 1:
+            break
+        time.sleep(0.1)
+    assert rec.preemptions == 1, "PS never saw the /preempted grace post"
+    assert rec.restarts == 0, "preemption must not consume max_restarts"
+
+    history = wait_history(client, job_id, timeout=300)
+    assert len(history.data.train_loss) == epochs
+    assert history.data.preemptions == 1
+    assert history.data.restarts == 0
+    assert dep.ps.wait_for_job(job_id, timeout=60)

@@ -56,11 +56,22 @@ def _code_lines(path: str):
 
 def check_file(path: str) -> list:
     with open(path, encoding="utf-8", errors="replace") as f:
-        if "FaultPlan" not in f.read():
-            return []
+        text = f.read()
+    if "FaultPlan" not in text:
+        return []
+    # preemption tests must be coordinate-driven too: a FaultPlan test
+    # exercising `preempt` (or graceful SIGTERM drains) that paces
+    # itself with wall-clock sleeps is exactly the nondeterminism the
+    # plan exists to eliminate — the preempt event names the round, so
+    # the test can always assert on coordinates instead of waiting.
+    # Scoped per-file (FaultPlan AND preempt together), never globally:
+    # scheduler/backoff tests legitimately sleep.
+    forbidden = FORBIDDEN
+    if "preempt" in text:
+        forbidden = FORBIDDEN + ("time.sleep(",)
     violations = []
     for no, code in _code_lines(path):
-        for tok in FORBIDDEN:
+        for tok in forbidden:
             if tok in code:
                 violations.append((path, no, tok))
     return violations
