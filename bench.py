@@ -102,6 +102,7 @@ def main():
     import jax.numpy as jnp
     import numpy as np
 
+    from kubeml_tpu.metrics.runtime import HbmWatermark, JitCompileTracker
     from kubeml_tpu.models import get_builtin
     from kubeml_tpu.parallel.kavg import KAvgEngine
     from kubeml_tpu.parallel.mesh import make_mesh
@@ -185,22 +186,28 @@ def main():
         return engine.train_rounds(variables, staged, rngs=rngs, lr=0.1,
                                    epoch=epoch, **gmasks)
 
-    def epoch(variables, e, round_fn, rounds_fn, tracer):
+    def epoch(variables, e, round_fn, rounds_fn, tracer, jt=None):
         """One epoch, exactly as TrainJob dispatches it with
         --rounds-per-dispatch 4: full groups in one train_rounds
         dispatch each, the tail singly, losses on device, reduced in
         one jitted stack+sum dispatch, ONE readback at the end.
         Dispatch/readback go through the job's tracer spans so the
         JSON reports where each arm's wall-clock went, not just the
-        throughput it produced."""
+        throughput it produced. ``jt`` (a JitCompileTracker) counts
+        dispatches that built a new XLA program, same as the job's
+        _note_round_times feed."""
         dev_losses = []
         for _ in range(groups):
             with tracer.span("dispatch"):
                 variables, stats = rounds_fn(variables, e)
+            if jt is not None:
+                jt.note(stats.compiled)
             dev_losses.append(stats.loss_sum_device.sum(axis=0))
         for _ in range(tail):
             with tracer.span("dispatch"):
                 variables, stats = round_fn(variables, e)
+            if jt is not None:
+                jt.note(stats.compiled)
             dev_losses.append(stats.loss_sum_device)
         with tracer.span("device_drain"):
             loss = np.asarray(reduce_losses(dev_losses))  # epoch sync point
@@ -221,20 +228,27 @@ def main():
         # transfer path cost over a second on tunneled backends and
         # must not land in the timed window. Warmup spans land in a
         # throwaway tracer so the reported phase totals cover exactly
-        # the timed window.
+        # the timed window. The jit tracker and HBM watermark DO span
+        # warmup: compiles happen there by design, and the arm's peak
+        # footprint is set by its first full epoch — excluding warmup
+        # would report a peak the arm never runs at.
+        jt, hbm = JitCompileTracker(), HbmWatermark()
         for w in range(warmup_epochs):
             variables, _ = epoch(variables, w, round_fn, rounds_fn,
-                                 Tracer())
+                                 Tracer(), jt)
+            hbm.sample()
         anchor(variables)
         tracer = Tracer()
         t0 = time.perf_counter()
         for e in range(timed_epochs):
             variables, _ = epoch(variables, e + 1, round_fn, rounds_fn,
-                                 tracer)
+                                 tracer, jt)
         anchor(variables)
         elapsed = time.perf_counter() - t0
+        hbm.sample()  # after the anchor sync, outside the timed window
         samples = timed_epochs * rounds_per_epoch * W * S * B
-        return samples / elapsed / n_chips, tracer.summary()
+        runtime = {**jt.snapshot(), **hbm.snapshot()}
+        return samples / elapsed / n_chips, tracer.summary(), runtime
 
     # -- faulted arm: the SAME host-staged single-round loop, once clean
     # and once under a FaultPlan NaN schedule, so the delta is the cost
@@ -245,7 +259,7 @@ def main():
                             for r in range(0, rounds_per_epoch,
                                            FAULT_EVERY)])
 
-    def faulted_epoch(variables, e, fault_plan, tracer):
+    def faulted_epoch(variables, e, fault_plan, tracer, jt=None):
         from kubeml_tpu.data.loader import RoundBatch
         dev_losses, dev_dropped = [], []
         if fault_plan is not None:
@@ -266,6 +280,8 @@ def main():
                     variables, staged, sample_mask=rb.sample_mask,
                     step_mask=rb.step_mask, worker_mask=rb.worker_mask,
                     rngs=rb.rngs, lr=0.1, epoch=e)
+            if jt is not None:
+                jt.note(stats.compiled)
             dev_losses.append(stats.loss_sum_device)
             dev_dropped.append(stats.dropped_device)
         with tracer.span("device_drain"):
@@ -276,9 +292,11 @@ def main():
     def measure_faulted(fault_plan):
         variables = model.init_variables(
             jax.random.PRNGKey(0), {"x": jnp.asarray(x[0, 0])})
+        jt, hbm = JitCompileTracker(), HbmWatermark()
         variables, _ = faulted_epoch(variables, 0, fault_plan,
-                                     Tracer())  # warmup
+                                     Tracer(), jt)  # warmup
         anchor(variables)
+        hbm.sample()
         if fault_plan is not None:
             # warmup fired injections too — reset so the reported counter
             # covers exactly the timed window the drop flags cover
@@ -288,12 +306,15 @@ def main():
         flags_total = np.zeros((rounds_per_epoch, W))
         for e in range(FAULT_TIMED_EPOCHS):
             variables, flags = faulted_epoch(variables, e + 1, fault_plan,
-                                             tracer)
+                                             tracer, jt)
             flags_total += flags
         anchor(variables)
         elapsed = time.perf_counter() - t0
+        hbm.sample()
         samples = FAULT_TIMED_EPOCHS * rounds_per_epoch * W * S * B
-        return samples / elapsed / n_chips, flags_total, tracer.summary()
+        runtime = {**jt.snapshot(), **hbm.snapshot()}
+        return (samples / elapsed / n_chips, flags_total,
+                tracer.summary(), runtime)
 
     # -- preempted arm: elastic degraded-mode costs at production
     # shapes. Three numbers: the SIGTERM drain's synchronous
@@ -363,13 +384,16 @@ def main():
         reassigned = num_makeup * (W - 1) * S
         return ckpt_s, resume_s, degraded_s, reassigned
 
-    per_chip, cache_phases = measure(cache_round, cache_rounds, 2,
-                                     TIMED_EPOCHS)
-    host_per_chip, host_phases = measure(host_round, host_rounds, 1,
-                                         HOST_TIMED_EPOCHS)
-    baseline_per_chip, baseline_phases = _measure_baseline_arm(model, x, y)
-    clean_single_per_chip, _, clean_phases = measure_faulted(None)
-    faulted_per_chip, fault_flags, faulted_phases = measure_faulted(plan)
+    per_chip, cache_phases, cache_runtime = measure(
+        cache_round, cache_rounds, 2, TIMED_EPOCHS)
+    host_per_chip, host_phases, host_runtime = measure(
+        host_round, host_rounds, 1, HOST_TIMED_EPOCHS)
+    (baseline_per_chip, baseline_phases,
+     baseline_runtime) = _measure_baseline_arm(model, x, y)
+    clean_single_per_chip, _, clean_phases, clean_runtime = \
+        measure_faulted(None)
+    (faulted_per_chip, fault_flags,
+     faulted_phases, faulted_runtime) = measure_faulted(plan)
     (preempt_ckpt_s, preempt_resume_s,
      degraded_epoch_s, reassigned_batches) = measure_preempted()
     # clean-epoch wall time at the same coverage, derived from the
@@ -444,10 +468,25 @@ def main():
             "clean_single": clean_phases,
             "faulted": faulted_phases,
         },
+        # per-arm runtime introspection (metrics/runtime.py): compile
+        # counts from the engines' own RoundStats.compiled flags (so a
+        # recompile storm shows up here as compiles >> program shapes)
+        # and the arm's HBM watermark — on real accelerators the
+        # allocator's peak_bytes_in_use, on CPU the live-array-bytes
+        # approximation. Arms run serially in one process, so a later
+        # arm's allocator peak includes whatever earlier arms left
+        # resident; compare arms by their in_use deltas, not peaks.
+        "runtime": {
+            "device_cache": cache_runtime,
+            "host_staged": host_runtime,
+            "baseline": baseline_runtime,
+            "clean_single": clean_runtime,
+            "faulted": faulted_runtime,
+        },
     }))
 
 
-def _measure_baseline_arm(model, x, y) -> float:
+def _measure_baseline_arm(model, x, y) -> tuple:
     """Single-node baseline arm, measured in-process: plain jitted
     one-step-per-dispatch training (persistent optimizer state, no
     K-avg/masks — experiments/baseline_train.py semantics) over the
@@ -460,6 +499,7 @@ def _measure_baseline_arm(model, x, y) -> float:
     import numpy as np
     import optax
 
+    from kubeml_tpu.metrics.runtime import HbmWatermark, JitCompileTracker
     from kubeml_tpu.utils.trace import Tracer
 
     W, S, B = x.shape[:3]
@@ -494,28 +534,35 @@ def _measure_baseline_arm(model, x, y) -> float:
         params = optax.apply_updates(variables["params"], updates)
         return {**new_state, "params": params}, opt_state, loss
 
-    def run_epoch(variables, opt_state, tracer):
+    def run_epoch(variables, opt_state, tracer, jt):
         losses = []
         for i in range(steps_per_epoch):
+            # plain jax.jit has no RoundStats.compiled flag — its own
+            # cache size before/after the call is the same signal
+            before = step._cache_size()
             with tracer.span("dispatch"):
                 variables, opt_state, loss = step(
                     variables, opt_state, flat_x[i % (W * S)],
                     flat_y[i % (W * S)], keys_dev[i])
+            jt.note(step._cache_size() > before)
             losses.append(loss)
         # same per-epoch sync discipline as the engine arm
         with tracer.span("device_drain"):
             np.asarray(jnp.stack(losses).sum())
         return variables, opt_state
 
+    jt, hbm = JitCompileTracker(), HbmWatermark()
     variables, opt_state = run_epoch(variables, opt_state,
-                                     Tracer())  # warmup
+                                     Tracer(), jt)  # warmup
+    hbm.sample()
     tracer = Tracer()
     t0 = time.perf_counter()
     for _ in range(BASELINE_TIMED_EPOCHS):
-        variables, opt_state = run_epoch(variables, opt_state, tracer)
+        variables, opt_state = run_epoch(variables, opt_state, tracer, jt)
     elapsed = time.perf_counter() - t0
+    hbm.sample()
     return (BASELINE_TIMED_EPOCHS * steps_per_epoch * B / elapsed,
-            tracer.summary())
+            tracer.summary(), {**jt.snapshot(), **hbm.snapshot()})
 
 
 if __name__ == "__main__":

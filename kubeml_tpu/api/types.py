@@ -145,6 +145,14 @@ class TrainOptions:
     # exactly once per epoch. Requires quarantine_after > 0; counts land
     # in History.reassigned_batches and kubeml_job_reassigned_batches.
     reassign_on_quarantine: bool = False
+    # net-new training-health telemetry: compute per-worker grad-norm /
+    # update-ratio / loss-spread stat lanes inside the jitted round
+    # programs (parallel/kavg.py, parallel/syncdp.py). The lanes are
+    # pure extra outputs accumulated lazily on device — weights are
+    # bit-identical with the flag on or off and no mid-epoch host syncs
+    # are added — so they default ON; turn off to shave the (small)
+    # extra FLOPs and HBM of the stat outputs.
+    train_stats: bool = True
 
     def to_dict(self) -> dict:
         return {
@@ -174,6 +182,7 @@ class TrainOptions:
             "fault_plan": self.fault_plan,
             "checkpoint_every_rounds": self.checkpoint_every_rounds,
             "reassign_on_quarantine": self.reassign_on_quarantine,
+            "train_stats": self.train_stats,
         }
 
     @classmethod
@@ -206,6 +215,7 @@ class TrainOptions:
             checkpoint_every_rounds=int(d.get("checkpoint_every_rounds", 0)),
             reassign_on_quarantine=bool(d.get("reassign_on_quarantine",
                                               False)),
+            train_stats=bool(d.get("train_stats", True)),
         )
 
 
@@ -314,6 +324,13 @@ class JobHistory:
     # net-new elastic degraded mode: per-epoch minibatch steps re-dealt
     # from quarantined workers to survivors (makeup rounds)
     reassigned_batches: List[int] = field(default_factory=list)
+    # net-new training-health telemetry (on-device stat lanes,
+    # parallel/kavg.py): per-epoch [min, mean, max] across workers of
+    # the RMS global grad norm and of the update/param norm ratio, plus
+    # the mean cross-worker loss spread. Empty when train_stats was off.
+    grad_norm_summary: List[List[float]] = field(default_factory=list)
+    update_ratio_summary: List[List[float]] = field(default_factory=list)
+    loss_spread: List[float] = field(default_factory=list)
     # checkpoint-based watchdog restarts consumed by the job (stamped by
     # the PS at finish — control/ps.py)
     restarts: int = 0
@@ -335,6 +352,11 @@ class JobHistory:
             dropped_workers=list(d.get("dropped_workers", [])),
             quarantined_workers=list(d.get("quarantined_workers", [])),
             reassigned_batches=list(d.get("reassigned_batches", [])),
+            grad_norm_summary=[list(x) for x in
+                               d.get("grad_norm_summary", [])],
+            update_ratio_summary=[list(x) for x in
+                                  d.get("update_ratio_summary", [])],
+            loss_spread=list(d.get("loss_spread", [])),
             restarts=int(d.get("restarts", 0)),
             preemptions=int(d.get("preemptions", 0)),
         )
@@ -383,6 +405,23 @@ class MetricUpdate:
     # per-phase span durations for the epoch (tracer name -> seconds per
     # round), feeding the PS latency histograms; optional on the wire
     phase_times: Dict[str, List[float]] = field(default_factory=dict)
+    # training-health stat lanes for the epoch (optional on the wire —
+    # empty when the job ran with train_stats off): per-worker RMS
+    # global grad norm, update/param norm ratio, and mean per-step loss,
+    # plus the mean cross-worker loss spread (on-device population std
+    # of the merged workers' per-round mean losses)
+    grad_norms: List[float] = field(default_factory=list)
+    update_ratios: List[float] = field(default_factory=list)
+    worker_losses: List[float] = field(default_factory=list)
+    loss_spread: float = 0.0
+    # runtime introspection (metrics/runtime.py; cumulative over the
+    # job's life): engine-program jit compiles and the device-memory
+    # watermark at epoch end
+    jit_compiles: int = 0
+    hbm_peak_bytes: int = 0
+    hbm_in_use_bytes: int = 0
+    # tracer events dropped at the ring cap so far (utils/trace.py)
+    trace_events_dropped: int = 0
 
     def to_dict(self) -> dict:
         return _asdict(self)
@@ -398,7 +437,18 @@ class MetricUpdate:
                    checkpoint_drops=int(d.get("checkpoint_drops", 0)),
                    phase_times={str(k): [float(x) for x in v]
                                 for k, v in (d.get("phase_times")
-                                             or {}).items()})
+                                             or {}).items()},
+                   grad_norms=[float(x) for x in d.get("grad_norms", [])],
+                   update_ratios=[float(x) for x in
+                                  d.get("update_ratios", [])],
+                   worker_losses=[float(x) for x in
+                                  d.get("worker_losses", [])],
+                   loss_spread=float(d.get("loss_spread", 0.0)),
+                   jit_compiles=int(d.get("jit_compiles", 0)),
+                   hbm_peak_bytes=int(d.get("hbm_peak_bytes", 0)),
+                   hbm_in_use_bytes=int(d.get("hbm_in_use_bytes", 0)),
+                   trace_events_dropped=int(d.get("trace_events_dropped",
+                                                  0)))
 
 
 @dataclass

@@ -172,14 +172,25 @@ def cmd_fn_list(args):
 # ---------------------------------------------------------------------- task
 
 def cmd_task_list(args):
-    tasks = _client(args).v1().tasks().list()
+    client = _client(args)
+    tasks = client.v1().tasks().list()
+    health = client.v1().health()
     print(f"{'ID':<12}{'FUNCTION':<18}{'DATASET':<14}{'STATE':<12}{'N':>4}"
-          f"{'RESTARTS':>10}{'PREEMPT':>9}")
+          f"{'RESTARTS':>10}{'PREEMPT':>9}{'HEALTH':>10}{'GRAD':>9}")
     for t in tasks:
+        hstate, grad = "-", "-"
+        try:
+            v = health.get(t.job_id)
+            hstate = v.get("state", "-")
+            gn = (v.get("latest") or {}).get("grad_norms") or []
+            if gn:
+                grad = f"{max(float(x) for x in gn):.3g}"
+        except KubeMLException:
+            pass  # health endpoint down: the rest of the row still prints
         print(f"{t.job_id:<12}{t.parameters.function_name:<18}"
               f"{t.parameters.dataset:<14}{t.state:<12}{t.parallelism:>4}"
               f"{getattr(t, 'restarts', 0):>10}"
-              f"{getattr(t, 'preemptions', 0):>9}")
+              f"{getattr(t, 'preemptions', 0):>9}{hstate:>10}{grad:>9}")
 
 
 def cmd_task_stop(args):
@@ -222,16 +233,26 @@ def cmd_history_delete(args):
 def cmd_history_list(args):
     rows = _client(args).v1().histories().list()
     print(f"{'ID':<12}{'FUNCTION':<18}{'DATASET':<14}{'EPOCHS':>7}"
-          f"{'BEST_ACC':>10}{'RST/PRE':>9}{'REASSIGN':>10}")
+          f"{'BEST_ACC':>10}{'RST/PRE':>9}{'REASSIGN':>10}"
+          f"{'GRAD(MAX)':>11}{'UPD(MEAN)':>11}")
     for h in rows:
         accs = [a for a in h.data.accuracy if a == a]
         best = f"{max(accs):.2f}" if accs else "-"
         lifecycle = (f"{getattr(h.data, 'restarts', 0)}"
                      f"/{getattr(h.data, 'preemptions', 0)}")
         reassigned = sum(getattr(h.data, 'reassigned_batches', []) or [])
+        # per-epoch [min, mean, max] summaries of the on-device stat
+        # lanes (JobHistory.grad_norm_summary / update_ratio_summary):
+        # worst grad norm and mean update/param ratio over the run
+        gns = [s[2] for s in getattr(h.data, 'grad_norm_summary', [])
+               if len(s) == 3 and s[2] > 0]
+        urs = [s[1] for s in getattr(h.data, 'update_ratio_summary', [])
+               if len(s) == 3 and s[1] > 0]
+        grad = f"{max(gns):.3g}" if gns else "-"
+        upd = f"{sum(urs) / len(urs):.3g}" if urs else "-"
         print(f"{h.id:<12}{h.task.function_name or h.task.model_type:<18}"
               f"{h.task.dataset:<14}{len(h.data.train_loss):>7}{best:>10}"
-              f"{lifecycle:>9}{reassigned:>10}")
+              f"{lifecycle:>9}{reassigned:>10}{grad:>11}{upd:>11}")
 
 
 def cmd_history_prune(args):
@@ -276,6 +297,88 @@ def cmd_trace(args):
               f"{','.join(meta.get('trace_ids', [])) or '-'}")
     else:
         print(payload)
+
+
+# -------------------------------------------------------------------- health
+
+def cmd_health(args):
+    """One-shot machine-readable training-health verdict for a job
+    (the same document `kubeml top` renders, GET /health/{jobId})."""
+    print(json.dumps(_client(args).v1().health().get(args.id), indent=2))
+
+
+def _fmt_bytes(n) -> str:
+    n = float(n or 0)
+    for unit in ("B", "KiB", "MiB", "GiB"):
+        if n < 1024 or unit == "GiB":
+            return f"{n:.0f}{unit}" if unit == "B" else f"{n:.1f}{unit}"
+        n /= 1024
+    return f"{n:.1f}GiB"
+
+
+def _render_top(doc: dict) -> str:
+    """Render one health verdict as the `kubeml top` screen: job state
+    + reasons, the per-worker stat table, and the runtime gauges."""
+    latest = doc.get("latest") or {}
+    lines = [f"job {doc.get('id', '?')}  state={doc.get('state', '?')}  "
+             f"N={latest.get('parallelism', '-')}  "
+             f"loss={latest.get('train_loss', float('nan')):.4f}  "
+             f"epoch_s={latest.get('epoch_duration', 0.0):.2f}"]
+    for r in doc.get("reasons", []):
+        lines.append(f"  [{r.get('severity', '?'):>8}] "
+                     f"{r.get('rule', '?')}: {r.get('detail', '')}")
+    worker_losses = latest.get("worker_losses") or []
+    grad_norms = latest.get("grad_norms") or []
+    update_ratios = latest.get("update_ratios") or []
+    phases = latest.get("phase_times") or {}
+    dispatch = [float(t) for t in phases.get("dispatch", [])]
+    if worker_losses or grad_norms:
+        lines.append(f"{'WORKER':<8}{'LOSS':>12}{'GRAD_NORM':>12}"
+                     f"{'UPD_RATIO':>12}")
+        n = max(len(worker_losses), len(grad_norms), len(update_ratios))
+        for w in range(n):
+            def cell(xs, fmt):
+                return fmt.format(xs[w]) if w < len(xs) else "-"
+            lines.append(f"{w:<8}"
+                         f"{cell(worker_losses, '{:.4f}'):>12}"
+                         f"{cell(grad_norms, '{:.3g}'):>12}"
+                         f"{cell(update_ratios, '{:.3g}'):>12}")
+    if latest.get("loss_spread"):
+        lines.append(f"loss spread: {float(latest['loss_spread']):.4g}")
+    if dispatch:
+        lines.append(
+            f"dispatch: n={len(dispatch)} "
+            f"mean={sum(dispatch) / len(dispatch):.3f}s "
+            f"max={max(dispatch):.3f}s")
+    lines.append(
+        f"hbm: peak={_fmt_bytes(latest.get('hbm_peak_bytes'))} "
+        f"in_use={_fmt_bytes(latest.get('hbm_in_use_bytes'))}   "
+        f"jit compiles: {latest.get('jit_compiles', 0)}   "
+        f"dropped/quarantined: "
+        f"{latest.get('dropped_workers', 0):g}"
+        f"/{latest.get('quarantined_workers', 0)}")
+    return "\n".join(lines)
+
+
+def cmd_top(args):
+    """Live per-worker training view: polls the job's health verdict
+    every --interval seconds and redraws (the htop of `kubeml`);
+    --iterations bounds the loop (0 = until interrupted, 1 = one shot —
+    what tests and scripts use)."""
+    health = _client(args).v1().health()
+    shown = 0
+    try:
+        while True:
+            doc = health.get(args.id)
+            if shown and sys.stdout.isatty():
+                print("\x1b[2J\x1b[H", end="")  # clear + home
+            print(_render_top(doc), flush=True)
+            shown += 1
+            if args.iterations and shown >= args.iterations:
+                break
+            time.sleep(max(0.2, args.interval))
+    except KeyboardInterrupt:
+        pass
 
 
 # --------------------------------------------------------------------- serve
@@ -505,6 +608,23 @@ def build_parser() -> argparse.ArgumentParser:
     tr.add_argument("-o", "--out", default=None,
                     help="write the trace JSON here instead of stdout")
     tr.set_defaults(fn=cmd_trace)
+
+    he = sub.add_parser("health",
+                        help="one-shot training-health verdict for a job "
+                             "(machine-readable JSON)")
+    he.add_argument("--id", required=True)
+    he.set_defaults(fn=cmd_health)
+
+    tp = sub.add_parser("top",
+                        help="live per-worker training view (loss, grad "
+                             "norm, phase times, HBM, health state)")
+    tp.add_argument("--id", required=True)
+    tp.add_argument("--interval", type=float, default=2.0, metavar="S",
+                    help="poll/redraw period in seconds")
+    tp.add_argument("--iterations", type=int, default=0, metavar="N",
+                    help="stop after N redraws (0 = run until ^C; 1 = "
+                         "one-shot, for scripts)")
+    tp.set_defaults(fn=cmd_top)
 
     s = sub.add_parser("serve", help="start the control plane on this host")
     s.add_argument("--coordinator", default=None, metavar="HOST:PORT",

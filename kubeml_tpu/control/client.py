@@ -192,6 +192,17 @@ class TracesClient:
         return _request("GET", f"{self.base}/trace/{job_id}")
 
 
+class HealthClient:
+    def __init__(self, base: str):
+        self.base = base
+
+    def get(self, job_id: str) -> dict:
+        """Training-health verdict for a job: {"id", "state",
+        "reasons": [{"rule", "severity", "detail"}], "latest": {...}}
+        (control/health.py)."""
+        return _request("GET", f"{self.base}/health/{job_id}")
+
+
 class V1:
     def __init__(self, base: str):
         self._base = base
@@ -213,6 +224,9 @@ class V1:
 
     def traces(self) -> TracesClient:
         return TracesClient(self._base)
+
+    def health(self) -> HealthClient:
+        return HealthClient(self._base)
 
 
 class KubemlClient:

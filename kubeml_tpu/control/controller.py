@@ -15,6 +15,7 @@ Parity with ml/pkg/controller/api.go:16-42:
     DELETE /history/{taskId}   -> delete one
     DELETE /history            -> prune all
     GET    /health
+    GET    /health/{jobId}     -> PS training-health verdict
 """
 
 from __future__ import annotations
@@ -54,6 +55,9 @@ class Controller(JsonService):
         self.route("GET", "/tasks", self._h_tasks)
         self.route("DELETE", "/tasks/{jobId}", self._h_task_stop)
         self.route("GET", "/trace/{jobId}", self._h_trace)
+        # /health stays the gateway's own liveness probe; the job-health
+        # verdict gets its own path segment
+        self.route("GET", "/health/{jobId}", self._h_job_health)
         self.route("GET", "/history", self._h_history_list)
         self.route("GET", "/history/{taskId}", self._h_history_get)
         self.route("DELETE", "/history/{taskId}", self._h_history_delete)
@@ -122,6 +126,15 @@ class Controller(JsonService):
         return http_json(
             "GET",
             f"{self._need(self.ps_url, 'PS')}/trace"
+            f"?id={req.params['jobId']}")
+
+    def _h_job_health(self, req: Request):
+        """Training-health verdict, proxied to the PS (which owns the
+        rolling metric windows) so `kubeml health/top --id` need only
+        the gateway URL."""
+        return http_json(
+            "GET",
+            f"{self._need(self.ps_url, 'PS')}/health"
             f"?id={req.params['jobId']}")
 
     # --------------------------------------------------------------- history

@@ -1,0 +1,252 @@
+"""Declarative training-health rules over rolling metric windows.
+
+The PS receives one MetricUpdate per job epoch (control/ps.py
+`_h_metrics`); this module keeps a bounded rolling window of those
+updates per job and evaluates a small, declarative rule set into a
+verdict the `GET /health?id=` endpoint serves and `kubeml top` renders:
+
+    {"id": ..., "state": "healthy|warning|critical|unknown",
+     "reasons": [{"rule": ..., "severity": ..., "detail": ...}, ...],
+     "latest": {...last epoch's stats...}}
+
+Rules look only at the window — no wall clock reads inside checks — so
+tests drive them with a fake clock (`HealthEvaluator(clock=...)`), the
+same determinism discipline as ps._scan_heartbeats(now).
+
+Rule set (thresholds chosen for the repo's CPU-scale models; all
+overridable per-evaluator):
+
+  worker_divergence  critical  the non-finite guard dropped or
+                               quarantined workers this epoch — the
+                               alert-layer annotation over the existing
+                               quarantine counters (fired by faults.py
+                               nan plans in tier-1 tests)
+  grad_explosion     critical  a worker's RMS grad norm exceeds the
+                               absolute ceiling, or blew up relative to
+                               the window median (shape of divergence
+                               even at small scale)
+  loss_divergence    warning   cross-worker loss spread is large
+                               relative to the train loss — workers are
+                               no longer fitting the same function
+  update_stall       warning   every worker's update/param ratio has
+                               been ~0 for several epochs — the
+                               optimizer stopped moving (lr underflow,
+                               frozen params, dead schedule)
+  straggler          warning   the slowest round dispatch is many times
+                               the epoch median (faults.py slow plans)
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import statistics
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+# verdict states, ordered by severity (prom.HEALTH_STATES mirrors this)
+STATES = ("healthy", "warning", "critical", "unknown")
+
+
+@dataclasses.dataclass
+class HealthRule:
+    """One declarative check over a job's metric window.
+
+    `check(window)` sees the job's sample list (oldest first; each a
+    dict of the MetricUpdate's health fields) and returns a
+    human-readable detail string when firing, else None."""
+
+    name: str
+    severity: str              # 'warning' | 'critical'
+    description: str
+    check: Callable[[List[dict]], Optional[str]]
+
+
+def _latest(window: List[dict]) -> dict:
+    return window[-1] if window else {}
+
+
+def _rule_worker_divergence(window: List[dict]) -> Optional[str]:
+    m = _latest(window)
+    dropped = float(m.get("dropped_workers", 0.0))
+    quarantined = int(m.get("quarantined_workers", 0))
+    if dropped > 0 or quarantined > 0:
+        return (f"non-finite guard dropped {dropped:g} worker update(s), "
+                f"{quarantined} worker(s) quarantined in the last epoch")
+    return None
+
+
+def _make_grad_explosion(abs_limit: float, rel_limit: float):
+    def check(window: List[dict]) -> Optional[str]:
+        m = _latest(window)
+        norms = [float(x) for x in m.get("grad_norms", []) if x > 0]
+        if not norms:
+            return None
+        worst = max(norms)
+        if worst > abs_limit:
+            return (f"grad norm {worst:.3g} exceeds the absolute limit "
+                    f"{abs_limit:g}")
+        history = [max((float(x) for x in s.get("grad_norms", [])
+                        if x > 0), default=0.0) for s in window[:-1]]
+        history = [h for h in history if h > 0]
+        if len(history) >= 2:
+            base = statistics.median(history)
+            if base > 0 and worst > rel_limit * base:
+                return (f"grad norm {worst:.3g} is {worst / base:.0f}x "
+                        f"the window median {base:.3g}")
+        return None
+    return check
+
+
+def _make_loss_divergence(rel_limit: float):
+    def check(window: List[dict]) -> Optional[str]:
+        m = _latest(window)
+        spread = float(m.get("loss_spread", 0.0))
+        loss = abs(float(m.get("train_loss", 0.0)))
+        if spread > rel_limit * max(loss, 1e-6):
+            return (f"cross-worker loss spread {spread:.3g} vs train "
+                    f"loss {loss:.3g} — workers are diverging")
+        return None
+    return check
+
+
+def _make_update_stall(ratio_floor: float, min_epochs: int):
+    def check(window: List[dict]) -> Optional[str]:
+        if len(window) < min_epochs:
+            return None
+        recent = window[-min_epochs:]
+        for s in recent:
+            ratios = [float(x) for x in s.get("update_ratios", [])]
+            if not ratios or max(ratios) >= ratio_floor:
+                return None
+        return (f"update/param ratio below {ratio_floor:g} on every "
+                f"worker for {min_epochs} epochs — optimizer stalled")
+    return check
+
+
+def _make_straggler(rel_limit: float, min_rounds: int):
+    def check(window: List[dict]) -> Optional[str]:
+        m = _latest(window)
+        times = [float(t) for t in
+                 (m.get("phase_times") or {}).get("dispatch", [])]
+        if len(times) < min_rounds:
+            return None
+        med = statistics.median(times)
+        worst = max(times)
+        if med > 0 and worst > rel_limit * med:
+            return (f"slowest round dispatch {worst:.3g}s is "
+                    f"{worst / med:.0f}x the epoch median {med:.3g}s")
+        return None
+    return check
+
+
+def default_rules(grad_abs: float = 1e4, grad_rel: float = 50.0,
+                  spread_rel: float = 0.75, stall_floor: float = 1e-7,
+                  stall_epochs: int = 3, straggler_rel: float = 5.0,
+                  straggler_min_rounds: int = 4) -> List[HealthRule]:
+    return [
+        HealthRule("worker_divergence", "critical",
+                   "non-finite guard dropped or quarantined workers",
+                   _rule_worker_divergence),
+        HealthRule("grad_explosion", "critical",
+                   "gradient norm exceeded absolute or relative limits",
+                   _make_grad_explosion(grad_abs, grad_rel)),
+        HealthRule("loss_divergence", "warning",
+                   "cross-worker loss spread large vs train loss",
+                   _make_loss_divergence(spread_rel)),
+        HealthRule("update_stall", "warning",
+                   "update/param ratio ~0 across workers for epochs",
+                   _make_update_stall(stall_floor, stall_epochs)),
+        HealthRule("straggler", "warning",
+                   "one round dispatch far slower than the epoch median",
+                   _make_straggler(straggler_rel, straggler_min_rounds)),
+    ]
+
+
+# the MetricUpdate fields a window sample keeps (copied out so the
+# evaluator never holds live wire objects)
+_SAMPLE_FIELDS = ("train_loss", "validation_loss", "accuracy",
+                  "parallelism", "epoch_duration", "dropped_workers",
+                  "quarantined_workers", "grad_norms", "update_ratios",
+                  "worker_losses", "loss_spread", "jit_compiles",
+                  "hbm_peak_bytes", "hbm_in_use_bytes", "phase_times")
+
+
+class HealthEvaluator:
+    """Per-job rolling windows + rule evaluation.
+
+    `observe(m)` ingests a MetricUpdate (or any object with its health
+    fields), re-evaluates the rules, and returns the list of NEWLY
+    firing rules (deduped against the job's already-active set) so the
+    PS can bump `kubeml_health_alerts_total` once per onset instead of
+    once per epoch. `verdict(job_id)` returns the machine-readable
+    verdict served by `GET /health?id=`.
+    """
+
+    def __init__(self, clock: Callable[[], float] = time.time,
+                 window_s: float = 600.0, max_samples: int = 32,
+                 rules: Optional[List[HealthRule]] = None):
+        self.clock = clock
+        self.window_s = window_s
+        self.max_samples = max_samples
+        self.rules = default_rules() if rules is None else rules
+        self._windows: Dict[str, List] = {}     # job -> [(t, sample)]
+        self._active: Dict[str, Dict[str, dict]] = {}  # job -> rule -> reason
+
+    def _sample(self, m: Any) -> dict:
+        s = {}
+        for f in _SAMPLE_FIELDS:
+            v = getattr(m, f, None) if not isinstance(m, dict) \
+                else m.get(f)
+            if v is not None:
+                s[f] = v
+        return s
+
+    def _prune(self, entries: List, now: float) -> List:
+        entries = [e for e in entries if now - e[0] <= self.window_s]
+        return entries[-self.max_samples:]
+
+    def observe(self, m: Any) -> List[dict]:
+        """Ingest one epoch update; returns newly-fired reasons."""
+        job_id = m["job_id"] if isinstance(m, dict) else m.job_id
+        now = self.clock()
+        entries = self._prune(self._windows.get(job_id, []), now)
+        entries.append((now, self._sample(m)))
+        self._windows[job_id] = entries
+        window = [s for _, s in entries]
+        firing: Dict[str, dict] = {}
+        for rule in self.rules:
+            detail = rule.check(window)
+            if detail:
+                firing[rule.name] = {"rule": rule.name,
+                                     "severity": rule.severity,
+                                     "detail": detail}
+        previous = self._active.get(job_id, {})
+        new = [r for name, r in firing.items() if name not in previous]
+        self._active[job_id] = firing
+        return new
+
+    def verdict(self, job_id: str) -> dict:
+        """The served health document. `state` is the worst severity of
+        the currently-firing rules; a job with no samples (never
+        reported, or window expired) is `unknown`."""
+        now = self.clock()
+        entries = self._prune(self._windows.get(job_id, []), now)
+        self._windows[job_id] = entries
+        if not entries:
+            return {"id": job_id, "state": "unknown", "reasons": [],
+                    "latest": {}}
+        reasons = sorted(self._active.get(job_id, {}).values(),
+                         key=lambda r: (r["severity"] != "critical",
+                                        r["rule"]))
+        if any(r["severity"] == "critical" for r in reasons):
+            state = "critical"
+        elif reasons:
+            state = "warning"
+        else:
+            state = "healthy"
+        return {"id": job_id, "state": state, "reasons": reasons,
+                "latest": dict(entries[-1][1])}
+
+    def clear(self, job_id: str) -> None:
+        self._windows.pop(job_id, None)
+        self._active.pop(job_id, None)

@@ -72,6 +72,13 @@ class JsonService:
         self.route("GET", "/health", lambda req: {"ok": True})
 
     def route(self, method: str, pattern: str, handler: Callable):
+        # re-registering a (method, pattern) replaces the earlier route
+        # (matching is first-wins), so a subclass can extend a base
+        # route — e.g. the PS folds a job-health verdict into /health
+        # while keeping the bare-liveness behavior
+        self._routes = [r for r in self._routes
+                        if not (r.method == method
+                                and r.pattern == pattern)]
         self._routes.append(Route(method, pattern, handler))
 
     def _h_default_metrics(self, req):

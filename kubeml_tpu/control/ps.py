@@ -51,6 +51,7 @@ import numpy as np
 from kubeml_tpu.api.errors import (InvalidArgsError, JobNotFoundError,
                                    KubeMLException)
 from kubeml_tpu.api.types import MetricUpdate, TrainTask
+from kubeml_tpu.control.health import HealthEvaluator
 from kubeml_tpu.control.httpd import JsonService, Raw, Request, http_json
 from kubeml_tpu.data.registry import DatasetRegistry
 from kubeml_tpu.metrics.prom import MetricsRegistry
@@ -281,6 +282,9 @@ class ParameterServer(JsonService):
         self._infer_batcher = InferBatcher() if InferBatcher.enabled() \
             else None
         self.metrics = MetricsRegistry()
+        # training-health verdicts over rolling MetricUpdate windows
+        # (control/health.py); served on GET /health?id=
+        self.health = HealthEvaluator()
         self.fn_registry = FunctionRegistry()
         self.ds_registry = DatasetRegistry()
         self.history_store = HistoryStore()
@@ -304,6 +308,10 @@ class ParameterServer(JsonService):
         self.route("GET", "/tasks", self._h_tasks)
         self.route("GET", "/metrics", self._h_prom)
         self.route("GET", "/trace", self._h_trace)
+        # replaces the base liveness route: without ?id= it still
+        # answers {"ok": true}, with ?id=<jobId> it serves the job's
+        # health verdict
+        self.route("GET", "/health", self._h_health)
         self.route("POST", "/infer", self._h_infer)
 
     @property
@@ -402,8 +410,31 @@ class ParameterServer(JsonService):
         return {"ok": True}
 
     def _h_metrics(self, req: Request):
-        self.metrics.update_job(MetricUpdate.from_dict(req.body))
+        m = MetricUpdate.from_dict(req.body)
+        self.metrics.update_job(m)
+        self._observe_health(m)
         return {"ok": True}
+
+    def _observe_health(self, m: MetricUpdate) -> None:
+        """Feed one epoch update through the health rules: bump the
+        alert counter once per rule ONSET (the evaluator dedupes
+        against already-active rules) and publish the verdict gauge."""
+        for reason in self.health.observe(m):
+            self.metrics.note_health_alert(m.job_id, reason["rule"])
+            logger.warning("job %s health alert [%s/%s]: %s", m.job_id,
+                           reason["severity"], reason["rule"],
+                           reason["detail"])
+        self.metrics.set_health(
+            m.job_id, self.health.verdict(m.job_id)["state"])
+
+    def _h_health(self, req: Request):
+        """Bare GET /health keeps the liveness contract every service
+        answers; ?id=<jobId> serves that job's training-health verdict
+        (state + machine-readable reasons + the latest epoch's stats)."""
+        job_id = req.query.get("id", "")
+        if not job_id:
+            return {"ok": True}
+        return self.health.verdict(job_id)
 
     def _h_finish(self, req: Request):
         self._finish(req.params["jobId"], req.body.get("error")
@@ -880,6 +911,7 @@ class ParameterServer(JsonService):
 
     def _publish_metrics(self, m: MetricUpdate):
         self.metrics.update_job(m)
+        self._observe_health(m)
 
     def _finish(self, job_id: str, error: Optional[str] = None):
         """Clear per-job series + notify the scheduler
@@ -917,6 +949,7 @@ class ParameterServer(JsonService):
         else:
             self._release_partition(rec)
         self.metrics.clear_job(job_id)
+        self.health.clear(job_id)
         self.metrics.running_total.inc("train", -1.0)
         if error:
             logger.warning("job %s exited with error: %s", job_id, error)

@@ -101,6 +101,51 @@ class Gauge:
         return "\n".join(lines)
 
 
+class MultiGauge:
+    """Gauge family with an arbitrary label tuple (the single-label
+    Gauge above predates it and stays for the reference-parity
+    families). Used where one job fans out into several series —
+    per-worker health stats (`worker` label) and the HBM watermark
+    (`kind=peak|in_use`) — so per-worker data rides LABELS, never
+    family-name suffixes (the cardinality rule tools/check_metrics.py
+    enforces)."""
+
+    def __init__(self, name: str, help_: str, labels: LabelValues):
+        self.name = name
+        self.help = help_
+        self.labels = (labels,) if isinstance(labels, str) else tuple(labels)
+        self._values: Dict[Tuple[str, ...], float] = {}
+        self._lock = threading.Lock()
+
+    def set(self, label_values: LabelValues, value: float):
+        key = _key(self.labels, label_values)
+        with self._lock:
+            self._values[key] = value
+
+    def value(self, label_values: LabelValues) -> float:
+        key = _key(self.labels, label_values)
+        with self._lock:
+            return self._values.get(key, 0.0)
+
+    def clear_prefix(self, first_label_value: str):
+        """Drop every series whose FIRST label equals the value — the
+        job-finish cleanup for jobid-leading families."""
+        with self._lock:
+            for key in [k for k in self._values
+                        if k[0] == str(first_label_value)]:
+                del self._values[key]
+
+    def collect(self) -> str:
+        lines = [f"# HELP {self.name} {self.help}",
+                 f"# TYPE {self.name} gauge"]
+        with self._lock:
+            for key, v in sorted(self._values.items()):
+                lines.append(
+                    f"{self.name}{_fmt_labels(self.labels, key)} "
+                    f"{_fmt_value(v)}")
+        return "\n".join(lines)
+
+
 class Counter:
     """Monotone counter family; name must end in ``_total`` by
     convention (enforced by tools/check_metrics.py)."""
@@ -123,6 +168,17 @@ class Counter:
         key = _key(self.labels, label_values)
         with self._lock:
             return self._values.get(key, 0.0)
+
+    def clear_prefix(self, first_label_value: str):
+        """Drop series whose FIRST label equals the value. Only for
+        jobid-leading counters whose cardinality must not grow without
+        bound across the PS's life — dropping a finished job's series
+        is the documented reset (scrapers see a fresh start, as after
+        any process restart)."""
+        with self._lock:
+            for key in [k for k in self._values
+                        if k[0] == str(first_label_value)]:
+                del self._values[key]
 
     def collect(self) -> str:
         lines = [f"# HELP {self.name} {self.help}",
@@ -250,6 +306,12 @@ PHASE_HISTOGRAMS = {
 }
 
 
+# the complete job-health state space (control/health.py verdicts);
+# kubeml_job_health exposes one 0/1 series per state so dashboards can
+# alert on `kubeml_job_health{state="critical"} == 1` without regexes
+HEALTH_STATES = ("healthy", "warning", "critical", "unknown")
+
+
 class MetricsRegistry:
     """The PS metric set (ml/pkg/ps/metrics.go)."""
 
@@ -329,15 +391,60 @@ class MetricsRegistry:
             "kubeml_job_merge_seconds",
             "Merged-result readback (device drain) latency of a job",
             "jobid")
+        # training-health telemetry (on-device stat lanes riding
+        # MetricUpdate + control/health.py rule verdicts): per-worker
+        # stats carry the worker as a LABEL (cardinality rule), the
+        # verdict fans out one 0/1 series per state
+        self.job_health = MultiGauge(
+            "kubeml_job_health",
+            "Health verdict of a job: 1 on the active state's series",
+            ("jobid", "state"))
+        self.worker_grad_norm = MultiGauge(
+            "kubeml_job_worker_grad_norm",
+            "Per-worker RMS global gradient norm in the last epoch of a "
+            "job", ("jobid", "worker"))
+        self.worker_update_ratio = MultiGauge(
+            "kubeml_job_worker_update_ratio",
+            "Per-worker update-norm/param-norm ratio in the last epoch "
+            "of a job", ("jobid", "worker"))
+        self.loss_spread = Gauge(
+            "kubeml_job_loss_spread",
+            "Cross-worker std of per-round mean losses in the last epoch "
+            "of a job", "jobid")
+        self.hbm_bytes = MultiGauge(
+            "kubeml_device_hbm_bytes",
+            "Device memory watermark of a job's process, by kind "
+            "(peak|in_use)", ("jobid", "kind"))
+        self.health_alerts_total = Counter(
+            "kubeml_health_alerts_total",
+            "Health-rule alerts fired for a job, by rule",
+            ("jobid", "rule"))
+        self.jit_compiles_total = Counter(
+            "kubeml_jit_compiles_total",
+            "Engine round-program jit compiles of a job", "jobid")
+        self.trace_dropped_total = Counter(
+            "kubeml_trace_events_dropped_total",
+            "Tracer events dropped at the per-process ring cap for a job",
+            "jobid")
+        # MetricUpdate carries these as cumulative-over-the-job values;
+        # the counters advance by delta so they stay monotone even when
+        # an update is replayed after a job restart
+        self._jit_seen: Dict[str, float] = {}
+        self._trace_seen: Dict[str, float] = {}
         self._job_gauges = [self.validation_loss, self.validation_accuracy,
                             self.train_loss, self.parallelism,
                             self.epoch_duration, self.dropped_workers,
                             self.quarantined_workers, self.restarts,
                             self.reassigned_batches, self.preemptions,
                             self.checkpoint_drops, self.heartbeat_epoch,
-                            self.heartbeat_round]
+                            self.heartbeat_round, self.loss_spread]
         self._job_hists = [self.dispatch_seconds, self.data_wait_seconds,
                            self.merge_seconds]
+        self._job_multi = [self.job_health, self.worker_grad_norm,
+                           self.worker_update_ratio, self.hbm_bytes]
+        self._job_counters = [self.health_alerts_total,
+                              self.jit_compiles_total,
+                              self.trace_dropped_total]
 
     def update_job(self, m) -> None:
         """Apply a MetricUpdate (ml/pkg/ps/metrics.go:90-99)."""
@@ -356,6 +463,31 @@ class MetricsRegistry:
             hist = getattr(self, attr)
             for seconds in getattr(m, "phase_times", {}).get(span, ()):
                 hist.observe(m.job_id, seconds)
+        # training-health stat lanes: re-key the per-worker series each
+        # epoch so a parallelism shrink doesn't leave stale workers
+        grad_norms = getattr(m, "grad_norms", None) or []
+        update_ratios = getattr(m, "update_ratios", None) or []
+        if grad_norms or update_ratios:
+            self.worker_grad_norm.clear_prefix(m.job_id)
+            self.worker_update_ratio.clear_prefix(m.job_id)
+            for i, gn in enumerate(grad_norms):
+                self.worker_grad_norm.set((m.job_id, str(i)), gn)
+            for i, ur in enumerate(update_ratios):
+                self.worker_update_ratio.set((m.job_id, str(i)), ur)
+            self.loss_spread.set(m.job_id, getattr(m, "loss_spread", 0.0))
+        peak = getattr(m, "hbm_peak_bytes", 0)
+        if peak:
+            self.hbm_bytes.set((m.job_id, "peak"), peak)
+            self.hbm_bytes.set((m.job_id, "in_use"),
+                               getattr(m, "hbm_in_use_bytes", 0))
+        for cum, seen, counter in (
+                (getattr(m, "jit_compiles", 0), self._jit_seen,
+                 self.jit_compiles_total),
+                (getattr(m, "trace_events_dropped", 0), self._trace_seen,
+                 self.trace_dropped_total)):
+            if cum > seen.get(m.job_id, 0):
+                counter.inc(m.job_id, cum - seen.get(m.job_id, 0))
+                seen[m.job_id] = cum
 
     def note_restart(self, job_id: str) -> None:
         """One watchdog restart: bump the per-job gauge and the
@@ -379,16 +511,35 @@ class MetricsRegistry:
         watchdog path the kill routes into."""
         self.wedged_total.inc("standalone")
 
+    def set_health(self, job_id: str, state: str) -> None:
+        """Publish a job's health verdict: 1 on the active state's
+        series, 0 on the rest (so a state change flips atomically for
+        scrapers instead of briefly showing two active states)."""
+        for s in HEALTH_STATES:
+            self.job_health.set((job_id, s), 1.0 if s == state else 0.0)
+
+    def note_health_alert(self, job_id: str, rule: str) -> None:
+        self.health_alerts_total.inc((job_id, rule))
+
     def clear_job(self, job_id: str) -> None:
         for g in self._job_gauges:
             g.clear(job_id)
         for h in self._job_hists:
             h.clear(job_id)
+        for mg in self._job_multi:
+            mg.clear_prefix(job_id)
+        for c in self._job_counters:
+            c.clear_prefix(job_id)
+        self._jit_seen.pop(job_id, None)
+        self._trace_seen.pop(job_id, None)
 
     def exposition(self) -> str:
         families = (self._job_gauges + [self.running_total,
                                         self.restarts_total,
                                         self.preemptions_total,
-                                        self.wedged_total]
-                    + self._job_hists)
+                                        self.wedged_total,
+                                        self.health_alerts_total,
+                                        self.jit_compiles_total,
+                                        self.trace_dropped_total]
+                    + self._job_multi + self._job_hists)
         return "\n".join(f.collect() for f in families) + "\n"

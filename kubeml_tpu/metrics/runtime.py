@@ -1,0 +1,145 @@
+"""Device-runtime introspection: jit-compile tracking and HBM watermarks.
+
+Two perf regressions dominate TPU training postmortems and neither is
+visible in loss curves: RECOMPILE STORMS (a drifting batch/round shape
+makes every dispatch re-trace, so the job spends its epoch in XLA, not
+on device) and HBM creep (a leaked reference or an unexpectedly
+replicated layout walks peak memory up until allocation fails). Both
+engines already know when they compiled (`RoundStats.compiled`,
+`SyncDPEngine.last_compiled`) — this module turns those signals plus
+`device.memory_stats()` into counters/gauges the job publishes per
+epoch (`kubeml_jit_compiles_total`, `kubeml_device_hbm_bytes`).
+
+Everything here is host-side bookkeeping — nothing touches the dispatch
+path, and sampling memory_stats() is a cheap C++ call (no device sync).
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Dict, List, Optional, Tuple
+
+logger = logging.getLogger("kubeml_tpu.metrics.runtime")
+
+# a storm is many compiles CLOSE TOGETHER: this many compiles within the
+# trailing window of notes flags it (tunable per tracker)
+STORM_COMPILES = 3
+STORM_WINDOW = 8
+
+
+class JitCompileTracker:
+    """Counts engine-program compiles and flags recompile storms.
+
+    The job calls `note(compiled, duration_s)` once per dispatch with the
+    engine's compile flag and the wall time of that dispatch (which, on a
+    compile, is dominated by tracing+XLA). A healthy job compiles a
+    handful of programs up front (one per distinct round shape) and never
+    again; `storm` goes True when >= `storm_compiles` of the trailing
+    `storm_window` dispatches compiled — the signature of shape drift
+    (e.g. a ragged tail round shape changing every epoch, or batch-size
+    churn defeating the program cache).
+    """
+
+    def __init__(self, storm_compiles: int = STORM_COMPILES,
+                 storm_window: int = STORM_WINDOW):
+        self.storm_compiles = storm_compiles
+        self.storm_window = storm_window
+        self.compiles = 0
+        self.dispatches = 0
+        self.compile_seconds = 0.0
+        self.storms = 0
+        self.storm = False
+        self._recent: List[bool] = []
+
+    def note(self, compiled: bool, duration_s: float = 0.0) -> None:
+        """Record one dispatch; duration only accumulates on compiles."""
+        self.dispatches += 1
+        self._recent.append(bool(compiled))
+        if len(self._recent) > self.storm_window:
+            self._recent.pop(0)
+        if compiled:
+            self.compiles += 1
+            self.compile_seconds += float(duration_s)
+        in_storm = sum(self._recent) >= self.storm_compiles
+        if in_storm and not self.storm:
+            self.storms += 1
+            logger.warning(
+                "recompile storm: %d of the last %d dispatches compiled "
+                "(%d compiles total) — check for round-shape drift",
+                sum(self._recent), len(self._recent), self.compiles)
+        self.storm = in_storm
+
+    def snapshot(self) -> Dict[str, float]:
+        return {
+            "jit_compiles": self.compiles,
+            "jit_dispatches": self.dispatches,
+            "jit_compile_seconds": round(self.compile_seconds, 6),
+            "jit_storms": self.storms,
+        }
+
+
+def device_memory_stats(device=None) -> Optional[Tuple[int, int]]:
+    """(peak_bytes, in_use_bytes) from the backend allocator, or None.
+
+    TPU/GPU backends expose `device.memory_stats()` with
+    `peak_bytes_in_use` / `bytes_in_use`; the CPU backend returns None
+    (or lacks the method entirely), in which case callers fall back to
+    `live_arrays_bytes` via HbmWatermark."""
+    try:
+        import jax
+        if device is None:
+            device = jax.devices()[0]
+        stats = device.memory_stats()
+    except Exception:
+        return None
+    if not stats:
+        return None
+    in_use = int(stats.get("bytes_in_use", 0))
+    peak = int(stats.get("peak_bytes_in_use", in_use))
+    return peak, in_use
+
+
+def live_arrays_bytes() -> int:
+    """Sum of nbytes over all live jax.Arrays — the CPU-backend stand-in
+    for bytes_in_use (no allocator watermark exists there, so
+    HbmWatermark tracks the running peak across samples instead)."""
+    try:
+        import jax
+        return int(sum(getattr(a, "nbytes", 0) for a in jax.live_arrays()))
+    except Exception:
+        return 0
+
+
+class HbmWatermark:
+    """Peak / in-use device-memory sampler.
+
+    `sample()` is called at natural sync points (epoch end, bench arm
+    end); on real accelerators it reads the allocator's own watermark,
+    on CPU it approximates with live-array bytes and keeps the max seen
+    across samples as the peak. Either way the result feeds
+    `kubeml_device_hbm_bytes{kind=peak|in_use}`.
+    """
+
+    def __init__(self, device=None):
+        self.device = device
+        self.peak_bytes = 0
+        self.in_use_bytes = 0
+        self.samples = 0
+
+    def sample(self) -> Tuple[int, int]:
+        stats = device_memory_stats(self.device)
+        if stats is not None:
+            peak, in_use = stats
+            self.peak_bytes = max(self.peak_bytes, peak)
+        else:
+            in_use = live_arrays_bytes()
+            self.peak_bytes = max(self.peak_bytes, in_use)
+        self.in_use_bytes = in_use
+        self.samples += 1
+        return self.peak_bytes, self.in_use_bytes
+
+    def snapshot(self) -> Dict[str, int]:
+        return {
+            "hbm_peak_bytes": self.peak_bytes,
+            "hbm_in_use_bytes": self.in_use_bytes,
+        }

@@ -70,17 +70,28 @@ class RoundStats:
     `loss_sum` and `dropped` materialize LAZILY: reading either blocks on
     the round and costs a device->host readback (tens of ms on tunneled
     backends), so dispatch loops should accumulate `loss_sum_device` /
-    `dropped_device` on device and read back once per epoch. `step_count`
-    and `sample_count` are host-derived from the masks (free).
-    `contributors` counts the workers that actually MERGED: the host
-    mask sum minus the on-device non-finite drops, so reading it also
-    synchronizes whenever a `dropped_device` is attached.
+    `dropped_device` on device and read back once per epoch; a loop that
+    only wants an opportunistic progress number must use the
+    non-blocking `peek()` instead. `step_count` and `sample_count` are
+    host-derived from the masks (free). `contributors` counts the
+    workers that actually MERGED: the host mask sum minus the on-device
+    non-finite drops, so reading it also synchronizes whenever a
+    `dropped_device` is attached.
+
+    When the engine runs with `collect_stats=True`, `stat_device` holds
+    the [W, 3] (or [R, W, 3]) per-worker health-stat accumulators —
+    columns are the step-masked sums of squared global grad norm,
+    squared update norm, and squared param norm — and `spread_device`
+    the per-round cross-worker loss-spread scalar. Both follow the same
+    lazy discipline as `loss_sum_device`.
     """
 
     def __init__(self, loss_sum_device: jax.Array, step_count: np.ndarray,
                  sample_count: np.ndarray, contributors: float,
                  compiled: bool = False,
-                 dropped_device: Optional[jax.Array] = None):
+                 dropped_device: Optional[jax.Array] = None,
+                 stat_device: Optional[jax.Array] = None,
+                 spread_device: Optional[jax.Array] = None):
         self.loss_sum_device = loss_sum_device    # [W] device array
         self.step_count = step_count              # [W] real local steps
         self.sample_count = sample_count          # [W] real samples
@@ -95,8 +106,30 @@ class RoundStats:
         # is never read as throughput signal (policy.go:50-94 assumes
         # epoch time ~= steady state; on TPU only non-compile rounds are)
         self.compiled = compiled
+        # on-device health-stat lanes (engine collect_stats=True only)
+        self.stat_device = stat_device
+        self.spread_device = spread_device
         self._loss_sum: Optional[np.ndarray] = None
         self._dropped: Optional[np.ndarray] = None
+
+    def peek(self) -> Optional[np.ndarray]:
+        """Non-blocking view of the [W] loss sums: the array if the
+        round has already drained on device, else None.
+
+        WARNING: the `loss_sum`/`dropped`/`contributors` properties
+        SYNCHRONIZE — reading any of them mid-dispatch blocks the host
+        on the in-flight round and serializes the dispatch pipeline.
+        Anything that wants a merely opportunistic number (heartbeats,
+        a live `kubeml top` sampler) must go through peek(); the
+        dispatch loop in train/job.py accumulates `loss_sum_device` and
+        reads back once per epoch for exactly this reason."""
+        if self._loss_sum is not None:
+            return self._loss_sum
+        ready = getattr(self.loss_sum_device, "is_ready", None)
+        if callable(ready) and not ready():
+            return None
+        self._loss_sum = np.asarray(self.loss_sum_device)
+        return self._loss_sum
 
     @property
     def loss_sum(self) -> np.ndarray:
@@ -160,6 +193,20 @@ def tree_all_finite(tree: PyTree) -> jax.Array:
     return ok
 
 
+def tree_sq_norm(tree: PyTree) -> jax.Array:
+    """Scalar f32: sum of squares over every floating leaf of `tree`
+    (the square of the global L2 norm). Integer leaves are skipped,
+    mirroring tree_all_finite — a BatchNorm counter is not a gradient.
+    Shared by both engines' stat lanes so "grad norm" means the same
+    thing under kavg and syncdp."""
+    total = jnp.float32(0.0)
+    for leaf in jax.tree_util.tree_leaves(tree):
+        if jnp.issubdtype(jnp.asarray(leaf).dtype, jnp.inexact):
+            total = total + jnp.sum(
+                jnp.square(leaf.astype(jnp.float32)))
+    return total
+
+
 def drain_round(variables: PyTree) -> PyTree:
     """Block until every leaf of `variables` is materialized on device.
 
@@ -205,7 +252,8 @@ class KAvgEngine:
                  tx_factory: TxFactory, donate: bool = True,
                  merge_dtype: Any = None, unroll: int = 8,
                  batch_seq_dims: Optional[Dict[str, int]] = None,
-                 manual_inner: bool = False):
+                 manual_inner: bool = False,
+                 collect_stats: bool = False):
         """donate=True donates the input variables buffer to each
         train_round (frees a full model copy of HBM) — the caller must then
         always continue from the *returned* variables, never reuse the
@@ -249,7 +297,18 @@ class KAvgEngine:
         axis, vma inserting the gradient psums at the invariant
         boundaries). Composes with batch_seq_dims (TP+SP in one round)
         and with merge_dtype (a fully-manual sub-f32 psum is safe; only
-        the partial-manual one miscompiles)."""
+        the partial-manual one miscompiles).
+
+        collect_stats: compile the round with the on-device HEALTH STAT
+        LANES: per worker per round, the step-masked sums of squared
+        global grad norm, squared update norm, and squared param norm,
+        plus the cross-worker loss-spread scalar. The stats are pure
+        EXTRA OUTPUTS computed from values the update dataflow already
+        produces (grads, updates, round-start params) — nothing feeds
+        back into the optimizer chain, so the merged weights are
+        bit-identical with stats on or off (tests/test_health.py proves
+        it), and like the loss they accumulate lazily on device (zero
+        extra host syncs mid-epoch)."""
         self.mesh = mesh
         self.loss_fn = loss_fn
         self.metrics_fn = metrics_fn
@@ -258,6 +317,7 @@ class KAvgEngine:
         self.merge_dtype = merge_dtype
         self.unroll = max(1, int(unroll))
         self.n_lanes = mesh.shape[DATA_AXIS]
+        self.collect_stats = bool(collect_stats)
         self.batch_seq_dims = dict(batch_seq_dims or {})
         self._seq_train = (mesh.shape[SEQ_AXIS] > 1
                            and bool(self.batch_seq_dims))
@@ -339,6 +399,7 @@ class KAvgEngine:
         loss_fn = self.loss_fn
         tx_factory = self.tx_factory
         full_manual = self._full_manual
+        collect = self.collect_stats
 
         def run_chunk(variables, chunk, lr, epoch):
             """K masked local steps for one virtual worker.
@@ -368,6 +429,16 @@ class KAvgEngine:
                                        smask), has_aux=True)(params)
                 updates, new_opt = tx.update(grads, opt_state, params)
                 new_params = optax.apply_updates(params, updates)
+                out = loss * stmask
+                if collect:
+                    # health-stat lane: squared global grad/update/param
+                    # norms from the values the update chain already
+                    # computed. Masked steps contribute zero (stmask
+                    # multiply is safe here: a non-finite worker's stats
+                    # are SELECTed out lane-side, not multiplied).
+                    out = (out, stmask * jnp.stack([
+                        tree_sq_norm(grads), tree_sq_norm(updates),
+                        tree_sq_norm(params)]))
                 # note: compiling an unmasked variant for all-real rounds
                 # was tried in round 3 and measured WITHIN NOISE on the
                 # v5e headline config — XLA fuses these selects into the
@@ -376,14 +447,18 @@ class KAvgEngine:
                 params = _select_tree(stmask, new_params, params)
                 model_state = _select_tree(stmask, new_state, model_state)
                 opt_state = _select_tree(stmask, new_opt, opt_state)
-                return (params, model_state, opt_state), loss * stmask
+                return (params, model_state, opt_state), out
 
-            (params, model_state, _), losses = lax.scan(
+            (params, model_state, _), out = lax.scan(
                 step, (params, model_state, opt_state),
                 (chunk["batch"], chunk["sample_mask"], chunk["step_mask"],
                  chunk["rngs"]),
                 unroll=min(self.unroll, chunk["step_mask"].shape[0]))
-            return {"params": params, **model_state}, losses.sum()
+            new_vars = {"params": params, **model_state}
+            if collect:
+                losses, stat_steps = out
+                return new_vars, losses.sum(), stat_steps.sum(axis=0)
+            return new_vars, out.sum(), None
 
         def lane_fn(variables, batch, sample_mask, step_mask, worker_mask,
                     rngs, lr, epoch):
@@ -393,6 +468,9 @@ class KAvgEngine:
                 lambda x: jnp.zeros_like(x, dtype=jnp.float32), variables)
             loss_sums = []
             dropped = []
+            stat_rows = []
+            spread_m1 = jnp.float32(0.0)  # masked sums of per-worker mean
+            spread_m2 = jnp.float32(0.0)  # loss and its square (for var)
             eff_count = jnp.float32(0.0)
             for v in range(w_per_lane):  # static unroll, w_per_lane is tiny
                 chunk = {
@@ -401,7 +479,8 @@ class KAvgEngine:
                     "step_mask": step_mask[v],
                     "rngs": rngs[v],
                 }
-                new_vars, loss_sum = run_chunk(variables, chunk, lr, epoch)
+                new_vars, loss_sum, stat_sum = run_chunk(
+                    variables, chunk, lr, epoch)
                 wm = worker_mask[v]
                 # merge guard: a worker whose K local steps produced ANY
                 # non-finite weight (or a non-finite loss) is dropped from
@@ -419,6 +498,19 @@ class KAvgEngine:
                 loss_sums.append(jnp.where(ok, loss_sum, 0.0) * wm)
                 dropped.append(wm * (1.0 - okf))
                 eff_count = eff_count + wm * okf
+                if collect:
+                    # stat rows ride the same SELECT-not-multiply guard
+                    # as the loss: a dropped worker's NaN grads must not
+                    # poison the epoch accumulators
+                    stat_rows.append(
+                        jnp.where(ok, stat_sum, jnp.zeros_like(stat_sum))
+                        * wm)
+                    mean_v = loss_sum / jnp.maximum(
+                        chunk["step_mask"].sum(), 1.0)
+                    w_ok = wm * okf
+                    safe = jnp.where(ok, mean_v, 0.0)
+                    spread_m1 = spread_m1 + w_ok * safe
+                    spread_m2 = spread_m2 + w_ok * safe * safe
 
             raw_count = lax.psum(eff_count, DATA_AXIS)
             count = jnp.maximum(raw_count, 1.0)  # guard 0-contributor divide
@@ -464,9 +556,28 @@ class KAvgEngine:
                 return jnp.where(raw_count > 0, merged, ref)
 
             avg = jax.tree_util.tree_map(merge_leaf, contrib, variables)
+            if collect:
+                # cross-worker loss spread: population std of the merged
+                # workers' per-step mean losses, computed with two psums
+                # over moments already on device (no extra readback)
+                m1 = lax.psum(spread_m1, DATA_AXIS) / count
+                m2 = lax.psum(spread_m2, DATA_AXIS) / count
+                spread = jnp.sqrt(jnp.maximum(m2 - m1 * m1, 0.0))
+                return avg, (jnp.stack(loss_sums), jnp.stack(dropped),
+                             jnp.stack(stat_rows), spread)
             return avg, (jnp.stack(loss_sums), jnp.stack(dropped))
 
         return lane_fn
+
+    def _stat_out_specs(self, lift=None):
+        """out_specs tail for the collect_stats extras: the [W, 3] stat
+        matrix shards over data like the loss sums; the spread scalar is
+        replicated (it is a cross-lane psum result)."""
+        if not self.collect_stats:
+            return ()
+        if lift is None:
+            return (P(DATA_AXIS), P())
+        return (lift(P(DATA_AXIS)), P(None))
 
     def _build_train_round(self, w_per_lane: int, batch_template=None):
         """Compile the sync-round program: one sync round per dispatch."""
@@ -475,7 +586,8 @@ class KAvgEngine:
             in_specs=(P(), self._batch_in_specs(batch_template),
                       P(DATA_AXIS), P(DATA_AXIS),
                       P(DATA_AXIS), P(DATA_AXIS), P(), P()),
-            out_specs=(P(), (P(DATA_AXIS), P(DATA_AXIS))),
+            out_specs=(P(), (P(DATA_AXIS), P(DATA_AXIS))
+                       + self._stat_out_specs()),
             **self._shmap_kwargs())
         donate = (0,) if self.donate else ()
         return jax.jit(sharded, donate_argnums=donate)
@@ -513,7 +625,8 @@ class KAvgEngine:
             in_specs=(P(), batch_specs,
                       lift(P(DATA_AXIS)), lift(P(DATA_AXIS)),
                       lift(P(DATA_AXIS)), lift(P(DATA_AXIS)), P(), P()),
-            out_specs=(P(), (lift(P(DATA_AXIS)), lift(P(DATA_AXIS)))),
+            out_specs=(P(), (lift(P(DATA_AXIS)), lift(P(DATA_AXIS)))
+                       + self._stat_out_specs(lift)),
             **self._shmap_kwargs())
         donate = (0,) if self.donate else ()
         return jax.jit(sharded, donate_argnums=donate)
@@ -536,12 +649,12 @@ class KAvgEngine:
         w_per_lane = W // self.n_lanes
         lead = jax.tree_util.tree_leaves(batch)[0]
         key = ("multi", R, w_per_lane, tuple(lead.shape[2:4]),
-               jax.tree_util.tree_structure(batch))
+               jax.tree_util.tree_structure(batch), self.collect_stats)
         compiled = key not in self._train_cache
         if compiled:
             self._train_cache[key] = self._build_train_rounds(
                 w_per_lane, batch_template=batch)
-        avg, (loss_sums, dropped) = self._train_cache[key](
+        avg, (loss_sums, dropped, *extra) = self._train_cache[key](
             variables, batch,
             jnp.asarray(sample_mask, jnp.float32),
             jnp.asarray(step_mask, jnp.float32),
@@ -555,6 +668,8 @@ class KAvgEngine:
             contributors=float(np.asarray(worker_mask).sum()),
             compiled=compiled,
             dropped_device=dropped,
+            stat_device=extra[0] if extra else None,
+            spread_device=extra[1] if extra else None,
         )
         return avg, stats
 
@@ -574,7 +689,7 @@ class KAvgEngine:
         w_per_lane = W // self.n_lanes
         lead = jax.tree_util.tree_leaves(batch)[0]
         key = (w_per_lane, tuple(lead.shape[1:3]),
-               jax.tree_util.tree_structure(batch))
+               jax.tree_util.tree_structure(batch), self.collect_stats)
         compiled = key not in self._train_cache
         if compiled:
             self._train_cache[key] = self._build_train_round(
@@ -582,7 +697,7 @@ class KAvgEngine:
 
         # shard_map slices dim 0 contiguously: lane d owns virtual workers
         # [d*W/D, (d+1)*W/D) — matching the reference's contiguous doc shards.
-        avg, (loss_sums, dropped) = self._train_cache[key](
+        avg, (loss_sums, dropped, *extra) = self._train_cache[key](
             variables, batch,
             jnp.asarray(sample_mask, jnp.float32),
             jnp.asarray(step_mask, jnp.float32),
@@ -596,6 +711,8 @@ class KAvgEngine:
             contributors=float(np.asarray(worker_mask).sum()),
             compiled=compiled,
             dropped_device=dropped,
+            stat_device=extra[0] if extra else None,
+            spread_device=extra[1] if extra else None,
         )
         return avg, stats
 
@@ -640,7 +757,8 @@ class KAvgEngine:
             in_specs=(P(), self._cache_in_specs(cache),
                       P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS),
                       P(DATA_AXIS), P(DATA_AXIS), P(), P()),
-            out_specs=(P(), (P(DATA_AXIS), P(DATA_AXIS))),
+            out_specs=(P(), (P(DATA_AXIS), P(DATA_AXIS))
+                       + self._stat_out_specs()),
             **self._shmap_kwargs())
         # donate only the variables — the cache (arg 1) must outlive
         # every round of the job
@@ -672,7 +790,8 @@ class KAvgEngine:
                       lift(P(DATA_AXIS)), lift(P(DATA_AXIS)),
                       lift(P(DATA_AXIS)), lift(P(DATA_AXIS)),
                       lift(P(DATA_AXIS)), P(), P()),
-            out_specs=(P(), (lift(P(DATA_AXIS)), lift(P(DATA_AXIS)))),
+            out_specs=(P(), (lift(P(DATA_AXIS)), lift(P(DATA_AXIS)))
+                       + self._stat_out_specs(lift)),
             **self._shmap_kwargs())
         donate = (0,) if self.donate else ()
         return jax.jit(sharded, donate_argnums=donate)
@@ -695,12 +814,12 @@ class KAvgEngine:
             raise ValueError(f"W={W} not a multiple of lanes={self.n_lanes}")
         w_per_lane = W // self.n_lanes
         key = ("idx", w_per_lane, tuple(np.shape(idx)[1:3]),
-               cache.signature)
+               cache.signature, self.collect_stats)
         compiled = key not in self._train_cache
         if compiled:
             self._train_cache[key] = self._build_train_round_indexed(
                 w_per_lane, cache)
-        avg, (loss_sums, dropped) = self._train_cache[key](
+        avg, (loss_sums, dropped, *extra) = self._train_cache[key](
             variables, cache.arrays,
             jnp.asarray(idx, jnp.int32),
             jnp.asarray(sample_mask, jnp.float32),
@@ -715,6 +834,8 @@ class KAvgEngine:
             contributors=float(np.asarray(worker_mask).sum()),
             compiled=compiled,
             dropped_device=dropped,
+            stat_device=extra[0] if extra else None,
+            spread_device=extra[1] if extra else None,
         )
         return avg, stats
 
@@ -734,12 +855,12 @@ class KAvgEngine:
             raise ValueError(f"W={W} not a multiple of lanes={self.n_lanes}")
         w_per_lane = W // self.n_lanes
         key = ("idx-multi", R, w_per_lane, tuple(np.shape(idx)[2:4]),
-               cache.signature)
+               cache.signature, self.collect_stats)
         compiled = key not in self._train_cache
         if compiled:
             self._train_cache[key] = self._build_train_rounds_indexed(
                 w_per_lane, cache)
-        avg, (loss_sums, dropped) = self._train_cache[key](
+        avg, (loss_sums, dropped, *extra) = self._train_cache[key](
             variables, cache.arrays,
             jnp.asarray(idx, jnp.int32),
             jnp.asarray(sample_mask, jnp.float32),
@@ -754,6 +875,8 @@ class KAvgEngine:
             contributors=float(np.asarray(worker_mask).sum()),
             compiled=compiled,
             dropped_device=dropped,
+            stat_device=extra[0] if extra else None,
+            spread_device=extra[1] if extra else None,
         )
         return avg, stats
 

@@ -53,7 +53,7 @@ from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from kubeml_tpu.parallel.kavg import (_select_tree, masked_scalar_loss,
-                                      tree_all_finite)
+                                      tree_all_finite, tree_sq_norm)
 from kubeml_tpu.parallel.mesh import DATA_AXIS
 
 PyTree = Any
@@ -68,20 +68,27 @@ class SyncDPEngine:
 
     def __init__(self, mesh: Mesh, loss_fn: Callable, tx_factory: Callable,
                  zero1: bool = True, fsdp: bool = False,
-                 donate: bool = True):
+                 donate: bool = True, collect_stats: bool = False):
         """zero1=True shards optimizer state over the data axis (ZeRO-1);
         fsdp=True additionally shards the PARAMETERS over the data axis
         (ZeRO-3 / FSDP: each chip stores 1/D of the model and GSPMD
         all-gathers each layer at use, reduce-scattering the grads), for
         models too large to replicate per chip. fsdp implies zero1.
         donate=True donates the carried state to each train_steps call —
-        thread the returned state, never reuse the argument."""
+        thread the returned state, never reuse the argument.
+        collect_stats=True adds per-step health-stat outputs (squared
+        global grad / update / param norms, see `last_stats_device`) to
+        the scan — pure EXTRA outputs computed from values the step
+        already produces, so trained weights are bit-identical with the
+        flag on or off, and they stay on device until the job's
+        epoch-end drain (no mid-epoch host syncs)."""
         self.mesh = mesh
         self.loss_fn = loss_fn
         self.tx_factory = tx_factory
         self.zero1 = zero1 or fsdp
         self.fsdp = fsdp
         self.donate = donate
+        self.collect_stats = bool(collect_stats)
         self.n_lanes = mesh.shape[DATA_AXIS]
         self._cache: Dict[Any, Callable] = {}
         self._opt_specs: Optional[PyTree] = None
@@ -96,6 +103,12 @@ class SyncDPEngine:
         # skip-step practice of mixed-precision training). Kept on device;
         # accumulate and read back once per epoch like RoundStats.
         self.last_skipped_device: Optional[jax.Array] = None
+        # [S, 3] device array from the most recent train_steps when
+        # collect_stats: per-step (sq global grad norm, sq update norm,
+        # sq param norm), zeroed for masked/skipped steps. Same lazy
+        # discipline as last_skipped_device — keep on device, reduce at
+        # epoch end. None when collect_stats is off.
+        self.last_stats_device: Optional[jax.Array] = None
 
     # ----------------------------------------------------------------- state
 
@@ -145,6 +158,7 @@ class SyncDPEngine:
         mesh = self.mesh
         loss_fn = self.loss_fn
         tx_factory = self.tx_factory
+        collect = self.collect_stats
 
         def run(state, batch, sample_mask, rngs, lr, epoch):
             tx = tx_factory(lr, epoch)
@@ -186,14 +200,32 @@ class SyncDPEngine:
                 # itself; only REAL steps can be "skipped"
                 loss_out = jnp.where(grads_ok, loss, 0.0) * real
                 skipped = real * (1.0 - grads_ok.astype(jnp.float32))
-                return (new_params, new_state, new_opt), (loss_out, skipped)
+                outs = (loss_out, skipped)
+                if collect:
+                    # health-stat lane: pure extra outputs from values the
+                    # step already computed — nothing feeds back into the
+                    # carry, so weights are bit-identical stats on/off.
+                    # where-select, not multiply: NaN * 0 == NaN would
+                    # leak a poisoned step's grads into the epoch sums.
+                    stat = jnp.where(
+                        stmask > 0,
+                        jnp.stack([tree_sq_norm(grads),
+                                   tree_sq_norm(updates),
+                                   tree_sq_norm(new_params)]),
+                        jnp.zeros((3,), jnp.float32))
+                    outs = outs + (stat,)
+                return (new_params, new_state, new_opt), outs
 
-            (params, model_state, opt_state), (losses, skipped) = lax.scan(
+            (params, model_state, opt_state), outs = lax.scan(
                 step, (state["params"], state["model_state"],
                        state["opt_state"]),
                 (batch, sample_mask, rngs))
-            return {"params": params, "model_state": model_state,
-                    "opt_state": opt_state}, losses, skipped
+            losses, skipped = outs[0], outs[1]
+            new_state = {"params": params, "model_state": model_state,
+                         "opt_state": opt_state}
+            if collect:
+                return new_state, losses, skipped, outs[2]
+            return new_state, losses, skipped
 
         return run
 
@@ -216,7 +248,7 @@ class SyncDPEngine:
                 f"global batch {lead.shape[1]} not divisible by the "
                 f"data-axis size {self.n_lanes}")
         key = (tuple(lead.shape[:2]),
-               jax.tree_util.tree_structure(batch))
+               jax.tree_util.tree_structure(batch), self.collect_stats)
         self.last_compiled = key not in self._cache
         if self.last_compiled:
             batch_sh = jax.tree_util.tree_map(
@@ -241,13 +273,15 @@ class SyncDPEngine:
                 # pin outputs to the input layout: without this GSPMD may
                 # return params/opt leaves in whatever sharding propagation
                 # settled on, and the NEXT dispatch's in_shardings mismatch
-                out_shardings=(state_sh, rep, rep),
+                out_shardings=(state_sh, rep, rep)
+                + ((rep,) if self.collect_stats else ()),
                 donate_argnums=(0,) if self.donate else ())
-        state, losses, skipped = self._cache[key](
+        state, losses, skipped, *extra = self._cache[key](
             state, batch, jnp.asarray(sample_mask, jnp.float32),
             jnp.asarray(rngs, jnp.uint32), jnp.float32(lr),
             jnp.int32(epoch))
         self.last_skipped_device = skipped
+        self.last_stats_device = extra[0] if extra else None
         return state, losses
 
     # ------------------------------------------------------ index-fed train
@@ -292,7 +326,7 @@ class SyncDPEngine:
             raise ValueError(
                 f"global batch {G} not divisible by the "
                 f"data-axis size {self.n_lanes}")
-        key = ("idx", (S, G), cache.signature)
+        key = ("idx", (S, G), cache.signature, self.collect_stats)
         self.last_compiled = key not in self._cache
         if self.last_compiled:
             state_sh = {
@@ -315,13 +349,15 @@ class SyncDPEngine:
                                     cache),
                 in_shardings=(state_sh, cache_sh, idx_sh, mask_sh, rep,
                               rep, rep),
-                out_shardings=(state_sh, rep, rep),
+                out_shardings=(state_sh, rep, rep)
+                + ((rep,) if self.collect_stats else ()),
                 # donate only the state; the cache must outlive the job
                 donate_argnums=(0,) if self.donate else ())
-        state, losses, skipped = self._cache[key](
+        state, losses, skipped, *extra = self._cache[key](
             state, cache.arrays, jnp.asarray(idx, jnp.int32),
             jnp.asarray(sample_mask, jnp.float32),
             jnp.asarray(rngs, jnp.uint32), jnp.float32(lr),
             jnp.int32(epoch))
         self.last_skipped_device = skipped
+        self.last_stats_device = extra[0] if extra else None
         return state, losses

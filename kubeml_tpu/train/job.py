@@ -52,6 +52,7 @@ from kubeml_tpu.train.checkpoint import (AsyncCheckpointer,
                                          save_checkpoint)
 from kubeml_tpu.train.history import HistoryStore
 from kubeml_tpu.metrics.prom import PHASE_HISTOGRAMS
+from kubeml_tpu.metrics.runtime import HbmWatermark, JitCompileTracker
 from kubeml_tpu.utils.env import limit_parallelism
 from kubeml_tpu.utils.trace import (TraceSink, Tracer, get_trace_context,
                                     make_trace_id)
@@ -72,6 +73,16 @@ def _make_loss_reducer(mesh):
     from jax.sharding import NamedSharding, PartitionSpec
     return jax.jit(lambda losses: jnp.stack(losses).sum(axis=0),
                    out_shardings=NamedSharding(mesh, PartitionSpec()))
+
+
+def _minmeanmax(xs) -> list:
+    """[min, mean, max] over the reporting workers' per-epoch stat (the
+    JobHistory summary shape shown by `kubeml task list`); [0,0,0] when
+    the epoch carried no stats (train_stats off, or a stat-free path)."""
+    vals = [float(x) for x in xs if x == x]  # drop NaN defensively
+    if not vals:
+        return [0.0, 0.0, 0.0]
+    return [min(vals), sum(vals) / len(vals), max(vals)]
 
 
 @dataclasses.dataclass
@@ -275,6 +286,15 @@ class TrainJob:
         self._steady_round_ema: Optional[float] = None
         self._compile_overhead_s = 0.0
         self._elastic = False
+        # training-health telemetry (ISSUE: observability): per-epoch
+        # host view of the on-device stat lanes (grad norms, update
+        # ratios, per-worker losses, cross-worker loss spread), the
+        # jit-compile tracker fed from the same round_times the policy
+        # timing uses, and the HBM watermark sampled at epoch end —
+        # all folded into the MetricUpdate push (metrics/runtime.py)
+        self._epoch_stats: dict = {}
+        self._jit_tracker = JitCompileTracker()
+        self._hbm = HbmWatermark()
 
     # ------------------------------------------------------------------ api
 
@@ -427,6 +447,18 @@ class TrainJob:
                     self._epoch_quarantined)
                 self.history.reassigned_batches.append(
                     self._epoch_reassigned)
+                stats = self._epoch_stats or {}
+                grad_norms = list(stats.get("grad_norms", []))
+                update_ratios = list(stats.get("update_ratios", []))
+                self.history.grad_norm_summary.append(
+                    _minmeanmax(grad_norms))
+                self.history.update_ratio_summary.append(
+                    _minmeanmax(update_ratios))
+                self.history.loss_spread.append(
+                    float(stats.get("loss_spread", 0.0)))
+                # epoch end is a natural sync point (the loss drain just
+                # synchronized), so the HBM watermark sample is free
+                self._hbm.sample()
                 phase_times = {k: v for k, v
                                in self.tracer.durations().items()
                                if k in PHASE_HISTOGRAMS}
@@ -438,7 +470,17 @@ class TrainJob:
                     quarantined_workers=self._epoch_quarantined,
                     reassigned_batches=self._epoch_reassigned,
                     checkpoint_drops=self._checkpointer.dropped_saves,
-                    phase_times=phase_times))
+                    phase_times=phase_times,
+                    grad_norms=grad_norms,
+                    update_ratios=update_ratios,
+                    worker_losses=list(stats.get("worker_losses", [])),
+                    loss_spread=float(stats.get("loss_spread", 0.0)),
+                    # cumulative counters: the PS registry advances its
+                    # monotone prom counters by the delta (prom.py)
+                    jit_compiles=self._jit_tracker.compiles,
+                    hbm_peak_bytes=self._hbm.peak_bytes,
+                    hbm_in_use_bytes=self._hbm.in_use_bytes,
+                    trace_events_dropped=self.tracer.dropped_events))
                 self._log("job %s epoch %d/%d loss=%.4f val=%.4f acc=%.2f "
                             "N=%d %.2fs [%s]", job_id, epoch + 1, epochs,
                             train_loss, val_loss, accuracy, used_parallelism,
@@ -823,13 +865,19 @@ class TrainJob:
                                    shuffle=opts.shuffle,
                                    w_floor=w_floor)
         # the K-avg engine always exists: it runs kavg training AND the
-        # eval rounds for both engines (weighted-metrics fan-out)
+        # eval rounds for both engines (weighted-metrics fan-out).
+        # collect_stats compiles the on-device health-stat lanes in —
+        # pure extra round outputs, weights bit-identical on/off
+        # (tests/test_health.py), so it defaults ON and exists only as
+        # an escape hatch
+        collect_stats = bool(getattr(opts, "train_stats", True))
         self._engine = KAvgEngine(
             self.mesh, self.model.loss, self.model.metrics,
             self.model.configure_optimizers,
             batch_seq_dims=(self.model.seq_batch_dims
                             if n_seq > 1 else None),
-            manual_inner=self._manual_tp or self._pp)
+            manual_inner=self._manual_tp or self._pp,
+            collect_stats=collect_stats)
         self._sync_engine = None
         self._sync_state = None
         if getattr(opts, "fsdp", False) and engine_kind != "syncdp":
@@ -842,7 +890,8 @@ class TrainJob:
             from kubeml_tpu.parallel.syncdp import SyncDPEngine
             self._sync_engine = SyncDPEngine(
                 self.mesh, self.model.loss, self.model.configure_optimizers,
-                fsdp=bool(getattr(opts, "fsdp", False)))
+                fsdp=bool(getattr(opts, "fsdp", False)),
+                collect_stats=collect_stats)
         from jax.sharding import NamedSharding, PartitionSpec
         from kubeml_tpu.parallel.kavg import seq_batch_spec
         from kubeml_tpu.parallel.mesh import DATA_AXIS
@@ -1232,6 +1281,11 @@ class TrainJob:
         steady estimate carries over from earlier epochs via an EMA,
         which is sound because shape pinning makes every round of an
         elastic job the SAME program with the same per-round cost."""
+        for dt, _r, c in round_times:
+            # the runtime introspection tracker sees every dispatch: it
+            # counts compiles and flags recompile storms (shape drift),
+            # feeding kubeml_jit_compiles_total (metrics/runtime.py)
+            self._jit_tracker.note(bool(c), dt if c else 0.0)
         steady = [dt / r for dt, r, c in round_times if not c and r > 0]
         spike_time = sum(dt for dt, r, c in round_times if c)
         spike_rounds = sum(r for dt, r, c in round_times if c)
@@ -1256,6 +1310,7 @@ class TrainJob:
 
     def _train_epoch(self, parallelism: int, epoch: int) -> float:
         self._progress = (epoch, 0)  # heartbeat cursor (jobserver reads it)
+        self._epoch_stats = {}
         if self._sync_engine is not None:
             return self._train_epoch_syncdp(parallelism, epoch)
         plan = self._loader.plan(parallelism, self.req.options.k,
@@ -1268,8 +1323,15 @@ class TrainJob:
         # which is noticeably slow during a backend's dispatch ramp.
         # The zero-contributor check uses the host-side worker mask,
         # which fully determines the device contributor count.
+        # (RoundStats.peek() exists for callers that must LOOK without
+        # paying that sync — this loop deliberately never reads loss,
+        # dropped or the stat lanes mid-epoch; see the peek docstring in
+        # parallel/kavg.py for why the blocking properties are a trap.)
         dev_losses = []
         dev_dropped = []  # per-dispatch [W] drop counts, same discipline
+        dev_stats = []    # per-dispatch [W, 3] health-stat sums (lazy too)
+        dev_spread = []   # per-round cross-worker loss-spread scalars
+        stat_rounds = 0   # rounds contributing to dev_spread
         step_counts = np.zeros(0)
         round_times = []  # (dispatch seconds, rounds, compiled?) per dispatch
         group = self._rounds_per_dispatch()
@@ -1364,7 +1426,7 @@ class TrainJob:
         def dispatch_round(rb):
             # single-round dispatch + accounting, shared by the planned
             # loop below and the makeup-round pass (reassignment)
-            nonlocal step_counts
+            nonlocal step_counts, stat_rounds
             if guard is not None:
                 # quarantined workers are masked out BEFORE dispatch (a
                 # mask-content edit, no retrace); raises when every
@@ -1390,6 +1452,10 @@ class TrainJob:
             # reference's average-over-responders (util.go:82-98)
             step_counts += stats.step_count * rb.worker_mask
             dev_losses.append(stats.loss_sum_device)
+            if stats.stat_device is not None:
+                dev_stats.append(stats.stat_device)
+                dev_spread.append(stats.spread_device)
+                stat_rounds += 1
             if guard is not None:
                 # per-round [W] readback — the sync cost quarantine/abort
                 # opt into (class doc); may raise the abort diagnostic
@@ -1429,6 +1495,12 @@ class TrainJob:
                 # shapes uniform with single rounds ([W])
                 dev_losses.append(stats.loss_sum_device.sum(axis=0))
                 dev_dropped.append(stats.dropped_device.sum(axis=0))
+                if stats.stat_device is not None:
+                    # [R, W, 3] -> [W, 3] and [R] -> scalar, same
+                    # uniform-leaf-shape discipline as the loss
+                    dev_stats.append(stats.stat_device.sum(axis=0))
+                    dev_spread.append(stats.spread_device.sum())
+                    stat_rounds += rb.rounds
                 continue
             dispatch_round(rb)
             rounds_done = rb.round_index + 1
@@ -1515,6 +1587,35 @@ class TrainJob:
         if not ran.any():
             raise MergeError("epoch produced no training steps")
         per_worker = loss_sums[ran] / step_counts[ran]
+        if dev_stats:
+            # drain the stat lanes with the SAME one-dispatch reducer as
+            # the loss ([W, 3] leaves stack+sum exactly like [W] ones),
+            # then finish on the host: per-worker RMS grad norm over the
+            # steps it ran, update/param ratio, mean per-round spread.
+            # (A resumed epoch's stats cover only the post-resume rounds
+            # — the cursor snapshot carries no stat accumulators.)
+            stat_tot = np.asarray(self._reduce_losses(dev_stats))
+            spread_tot = float(np.asarray(
+                self._reduce_losses(dev_spread)))
+            steps = np.maximum(step_counts, 1.0)
+            gsq, usq, psq = stat_tot[:, 0], stat_tot[:, 1], stat_tot[:, 2]
+            grad_norms = np.where(ran, np.sqrt(gsq / steps), 0.0)
+            update_ratios = np.where(
+                ran & (psq > 0),
+                np.sqrt(usq / np.maximum(psq, 1e-30)), 0.0)
+            worker_losses = np.where(ran, loss_sums / steps, 0.0)
+            # publish the VIRTUAL workers only: the engine arrays are
+            # lane-padded to the pinned shape cap, and the padding tail
+            # (always masked out) would read as N-parallelism stalled
+            # workers on `kubeml top`. A mid-list zero stays meaningful:
+            # that worker was quarantined this epoch.
+            n = min(parallelism, len(grad_norms))
+            self._epoch_stats = {
+                "grad_norms": [float(x) for x in grad_norms[:n]],
+                "update_ratios": [float(x) for x in update_ratios[:n]],
+                "worker_losses": [float(x) for x in worker_losses[:n]],
+                "loss_spread": spread_tot / max(1, stat_rounds),
+            }
         return float(per_worker.mean())
 
     def _round_train_state(self, epoch: int, cursor: int, guard,
@@ -1568,6 +1669,7 @@ class TrainJob:
                                  self.req.batch_size)
         dev_losses = []
         dev_skipped = []  # per-dispatch [S] skip flags (engine stash)
+        dev_stats = []    # per-dispatch [S, 3] stat lanes (engine stash)
         real_steps = 0
         round_times = []
         opts = self.req.options
@@ -1617,6 +1719,8 @@ class TrainJob:
             real_steps += int((smask_global.sum(axis=1) > 0).sum())
             dev_losses.append(losses)
             dev_skipped.append(self._sync_engine.last_skipped_device)
+            if self._sync_engine.last_stats_device is not None:
+                dev_stats.append(self._sync_engine.last_stats_device)
             if opts.abort_after > 0:
                 # opt-in per-dispatch readback (same sync cost the kavg
                 # guard pays): in syncdp "every worker non-finite" IS a
@@ -1654,8 +1758,23 @@ class TrainJob:
         # empty (all-masked) steps AND skipped (non-finite-gradient)
         # steps contributed 0 to the device sum, so the divisor is the
         # real steps that actually produced a finite loss
-        return float(loss_sums.sum()) / max(1, real_steps
-                                            - int(round(skipped_total)))
+        counted = max(1, real_steps - int(round(skipped_total)))
+        epoch_loss = float(loss_sums.sum()) / counted
+        if dev_stats:
+            # single-model semantics: every step trains ONE global batch,
+            # so the health stats are one series (worker index 0), the
+            # per-step RMS over the steps that actually updated; there
+            # is no cross-worker loss spread to report
+            tot = np.asarray(self._reduce_losses(dev_stats)).sum(axis=0)
+            gsq, usq, psq = float(tot[0]), float(tot[1]), float(tot[2])
+            self._epoch_stats = {
+                "grad_norms": [float(np.sqrt(gsq / counted))],
+                "update_ratios": [float(np.sqrt(usq / max(psq, 1e-30)))
+                                  if psq > 0 else 0.0],
+                "worker_losses": [epoch_loss],
+                "loss_spread": 0.0,
+            }
+        return epoch_loss
 
     def _validate(self, parallelism: int):
         if self._handle.test_samples == 0:

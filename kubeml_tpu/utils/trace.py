@@ -47,7 +47,7 @@ import os
 import threading
 import time
 import uuid
-from typing import Callable, Dict, List, Optional
+from typing import Callable, Dict, List, Optional, Tuple
 
 TRACE_HEADER = "X-KubeML-Trace-Id"
 TRACE_ENV = "KUBEML_TRACE_ID"
@@ -226,7 +226,14 @@ class TraceSink:
         doc = {"traceEvents": events, "displayTimeUnit": "ms",
                "metadata": {"process": self.process,
                             "job_id": self.job_id,
-                            "trace_id": tracer.trace_id or ""}}
+                            "trace_id": tracer.trace_id or "",
+                            # events silently refused by the max_events
+                            # cap — surfaced (not resurrected) so a
+                            # merged timeline says it is PARTIAL instead
+                            # of reading as a complete record
+                            # (kubeml_trace_events_dropped_total carries
+                            # the same count to Prometheus)
+                            "dropped_events": tracer.dropped_events}}
         os.makedirs(self.dir, exist_ok=True)
         tmp = f"{self.path}.tmp.{pid}"
         with open(tmp, "w") as f:
@@ -235,7 +242,9 @@ class TraceSink:
         return self.path
 
 
-def _load_trace_events(path: str) -> List[dict]:
+def _load_trace_doc(path: str) -> Tuple[List[dict], int]:
+    """(events, dropped_events) from one trace file; bare Chrome trace
+    arrays (no metadata envelope) report 0 drops."""
     if path.endswith(".gz"):
         with gzip.open(path, "rt") as f:
             doc = json.load(f)
@@ -243,8 +252,13 @@ def _load_trace_events(path: str) -> List[dict]:
         with open(path) as f:
             doc = json.load(f)
     if isinstance(doc, list):  # bare Chrome trace array form
-        return doc
-    return list(doc.get("traceEvents", []))
+        return doc, 0
+    meta = doc.get("metadata") or {}
+    try:
+        dropped = int(meta.get("dropped_events", 0))
+    except (TypeError, ValueError):
+        dropped = 0
+    return list(doc.get("traceEvents", [])), dropped
 
 
 def merge_job_trace(job_id: str, home: Optional[str] = None) -> dict:
@@ -259,6 +273,7 @@ def merge_job_trace(job_id: str, home: Optional[str] = None) -> dict:
     if not os.path.isdir(root):
         raise FileNotFoundError(root)
     sources, events = [], []
+    dropped_events = 0
     for dirpath, _dirs, files in os.walk(root):
         for name in sorted(files):
             if not (name.endswith(".trace.json")
@@ -266,17 +281,23 @@ def merge_job_trace(job_id: str, home: Optional[str] = None) -> dict:
                 continue
             path = os.path.join(dirpath, name)
             try:
-                events.extend(_load_trace_events(path))
-                sources.append(os.path.relpath(path, root))
+                evs, dropped = _load_trace_doc(path)
             except (OSError, ValueError):  # torn/foreign file: skip, keep rest
                 continue
+            events.extend(evs)
+            dropped_events += dropped
+            sources.append(os.path.relpath(path, root))
     events.sort(key=lambda e: (e.get("ts", 0), e.get("pid", 0)))
     trace_ids = sorted({e["args"]["trace_id"] for e in events
                         if isinstance(e.get("args"), dict)
                         and e["args"].get("trace_id")})
     return {"traceEvents": events, "displayTimeUnit": "ms",
             "metadata": {"job_id": job_id, "sources": sources,
-                         "trace_ids": trace_ids}}
+                         "trace_ids": trace_ids,
+                         # nonzero = the merged timeline is PARTIAL:
+                         # this many spans hit the writers' max_events
+                         # caps and never made it to disk
+                         "dropped_events": dropped_events}}
 
 
 @contextlib.contextmanager

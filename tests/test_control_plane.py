@@ -440,7 +440,8 @@ def test_service_metrics_exposition(stack):
     """Every control-plane service serves a lint-clean /metrics with
     per-endpoint HTTP request counters; the PS additionally serves the
     three job phase histogram families with valid cumulative buckets
-    (fed here over the real wire path, POST /metrics/{jobId})."""
+    (fed here over the real wire path, POST /metrics/{jobId}) plus the
+    health-stat and runtime-introspection families."""
     from kubeml_tpu.api.types import MetricUpdate
     from kubeml_tpu.control.httpd import http_json
     from tools.check_metrics import parse_exposition, validate_exposition
@@ -450,7 +451,11 @@ def test_service_metrics_exposition(stack):
         job_id="metricprobe", validation_loss=0.5, accuracy=0.9,
         train_loss=0.4, parallelism=2, epoch_duration=1.0,
         phase_times={"dispatch": [0.01, 0.2], "data_wait": [0.001],
-                     "device_drain": [0.05]}).to_dict())
+                     "device_drain": [0.05]},
+        grad_norms=[0.5, 0.7], update_ratios=[1e-3, 2e-3],
+        worker_losses=[0.41, 0.39], loss_spread=0.01,
+        jit_compiles=3, hbm_peak_bytes=1 << 20,
+        hbm_in_use_bytes=1 << 19, trace_events_dropped=0).to_dict())
 
     ps_text = urllib.request.urlopen(dep.ps.url + "/metrics").read().decode()
     assert validate_exposition(ps_text) == []
@@ -463,14 +468,47 @@ def test_service_metrics_exposition(stack):
                   if name == fam + "_count"
                   and labels["jobid"] == "metricprobe"]
         assert counts == [n], fam
+
+    # per-worker stats are LABELLED series (the lint's cardinality guard
+    # rejects indexed family names), runtime counters come from the
+    # update's cumulative values
+    grads = {labels["worker"]: v for name, labels, v
+             in fams["kubeml_job_worker_grad_norm"]["samples"]
+             if labels["jobid"] == "metricprobe"}
+    assert grads == {"0": 0.5, "1": 0.7}
+    assert fams["kubeml_jit_compiles_total"]["type"] == "counter"
+    compiles = [v for name, labels, v
+                in fams["kubeml_jit_compiles_total"]["samples"]
+                if labels["jobid"] == "metricprobe"]
+    assert compiles == [3]
+    hbm = {labels["kind"]: v for name, labels, v
+           in fams["kubeml_device_hbm_bytes"]["samples"]
+           if labels["jobid"] == "metricprobe"}
+    assert hbm == {"peak": float(1 << 20), "in_use": float(1 << 19)}
+    states = {labels["state"]: v for name, labels, v
+              in fams["kubeml_job_health"]["samples"]
+              if labels["jobid"] == "metricprobe"}
+    assert sum(states.values()) == 1.0  # one-hot state vector
     dep.ps.metrics.clear_job("metricprobe")
 
-    # middleware counters: the scrape itself and the metric POST above
-    # are already on the books, labeled by route pattern
-    reqs = {(labels["method"], labels["endpoint"]): v
-            for name, labels, v
-            in fams["kubeml_http_requests_total"]["samples"]
-            if labels["service"] == "ps" and labels["status"] == "200"}
+    # middleware counters, labeled by route pattern. The middleware
+    # records a request *after* replying (so latency covers the full
+    # handler), which means a scrape issued right after the POST can
+    # race its increment — poll briefly instead of asserting one-shot.
+    deadline = time.monotonic() + 5.0
+    while True:
+        reqs = {(labels["method"], labels["endpoint"]): v
+                for name, labels, v
+                in fams["kubeml_http_requests_total"]["samples"]
+                if labels["service"] == "ps" and labels["status"] == "200"}
+        if ("POST", "/metrics/{jobId}") in reqs:
+            break
+        if time.monotonic() > deadline:
+            raise AssertionError(
+                f"POST /metrics/{{jobId}} never hit the counter: {reqs}")
+        time.sleep(0.05)
+        fams = parse_exposition(
+            urllib.request.urlopen(dep.ps.url + "/metrics").read().decode())
     assert reqs[("POST", "/metrics/{jobId}")] >= 1
     assert "kubeml_http_request_duration_seconds" in fams
 
@@ -486,6 +524,22 @@ def test_service_metrics_exposition(stack):
         assert {labels["service"] for _, labels, _ in samples} \
             == {svc.name}
 
+    # the jobserver (standalone-mode child) is a JsonService too and
+    # must stay scraper-clean — it is the one service the deployment
+    # fixture does not start, so probe a bare instance directly
+    from kubeml_tpu.train.jobserver import JobServer
+    js = JobServer("metricprobe", ps_url=dep.ps.url, port=0)
+    js.start()
+    try:
+        urllib.request.urlopen(js.url + "/health").read()
+        text = urllib.request.urlopen(js.url + "/metrics").read().decode()
+        assert validate_exposition(text) == []
+        samples = parse_exposition(text)[
+            "kubeml_http_requests_total"]["samples"]
+        assert {labels["service"] for _, labels, _ in samples} == {"job"}
+    finally:
+        js.stop()
+
 
 def test_train_options_wire_roundtrip_round5_fields():
     """The round-5 TrainOptions fields survive the REST wire format
@@ -499,3 +553,27 @@ def test_train_options_wire_roundtrip_round5_fields():
                         max_parallelism=8, max_restarts=2)
     rt = TrainOptions.from_dict(opts.to_dict())
     assert rt == opts
+
+
+def test_health_telemetry_wire_roundtrip():
+    """TrainOptions.train_stats and the health/runtime MetricUpdate
+    fields survive to_dict/from_dict — a field that serializes but
+    doesn't parse would silently publish defaults (and the PS would
+    evaluate health on nothing)."""
+    from kubeml_tpu.api.types import MetricUpdate, TrainOptions
+
+    opts = TrainOptions(default_parallelism=2, train_stats=False)
+    assert TrainOptions.from_dict(opts.to_dict()) == opts
+    assert TrainOptions.from_dict({}).train_stats is True  # default on
+
+    m = MetricUpdate(
+        job_id="wire", validation_loss=0.5, accuracy=0.9, train_loss=0.4,
+        parallelism=2, epoch_duration=1.0,
+        grad_norms=[0.5, 0.7], update_ratios=[1e-3, 2e-3],
+        worker_losses=[0.41, 0.39], loss_spread=0.01,
+        jit_compiles=3, hbm_peak_bytes=1 << 20,
+        hbm_in_use_bytes=1 << 19, trace_events_dropped=2)
+    rt = MetricUpdate.from_dict(m.to_dict())
+    assert rt == m
+    assert rt.grad_norms == [0.5, 0.7]
+    assert rt.trace_events_dropped == 2

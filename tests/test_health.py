@@ -1,0 +1,443 @@
+"""Training-health telemetry (docs/observability.md).
+
+Three layers under test, all on the 8-virtual-CPU-device mesh:
+
+  - the ON-DEVICE STAT LANES: non-perturbation (weights bit-identical
+    with stats on or off, both engines), value identities (plain SGD
+    makes ``usq == lr^2 * gsq`` exactly), the NaN-select guard (a
+    dropped worker's stat rows are zeroed, never NaN-poisoned), and the
+    lazy-read discipline (RoundStats.peek() never synchronizes);
+  - the HEALTH RULES: fake-clock HealthEvaluator — every rule's onset,
+    alert dedup (newly-fired only), window expiry -> unknown;
+  - the WIRE + CLI: a deterministic nan fault plan drives a real job
+    through the control plane, GET /health?id= goes critical with a
+    worker_divergence reason while it runs, and `kubeml top` /
+    `kubeml health` render it.
+"""
+
+import json
+import time
+import urllib.request
+
+import jax
+import numpy as np
+import optax
+import pytest
+
+from kubeml_tpu.api.types import TrainOptions, TrainRequest
+from kubeml_tpu.control.client import KubemlClient
+from kubeml_tpu.control.deployment import start_deployment
+from kubeml_tpu.control.health import HealthEvaluator
+from kubeml_tpu.control.httpd import http_json
+from kubeml_tpu.models import get_builtin
+from kubeml_tpu.parallel.kavg import KAvgEngine
+from kubeml_tpu.parallel.syncdp import SyncDPEngine
+from kubeml_tpu.train.checkpoint import load_checkpoint
+from kubeml_tpu.train.job import JobCallbacks, TrainJob
+
+from tests.test_control_plane import write_blob_files
+from tests.test_job import ToyDataset, make_blobs, make_task
+
+pytestmark = pytest.mark.health
+
+import jax.numpy as jnp  # noqa: E402
+
+# ------------------------------------------------------- engine-level
+
+
+def linear_loss(variables, batch, rng, sample_mask):
+    w = variables["params"]["w"]
+    pred = batch["x"] @ w
+    return (pred - batch["y"]) ** 2, {}
+
+
+def linear_metrics(variables, batch):
+    w = variables["params"]["w"]
+    pred = batch["x"] @ w
+    return {"loss": (pred - batch["y"]) ** 2,
+            "accuracy": (jnp.abs(pred - batch["y"]) < 0.5)
+            .astype(jnp.float32)}
+
+
+D = 4
+LR = 0.05
+
+
+def _round_inputs(seed=0, W=8, S=3, B=4, poison_worker=None):
+    rng = np.random.RandomState(seed)
+    xs = rng.randn(W, S, B, D).astype(np.float32)
+    ys = rng.randn(W, S, B).astype(np.float32)
+    if poison_worker is not None:
+        xs[poison_worker] = np.nan
+    w0 = rng.randn(D).astype(np.float32)
+    kw = dict(sample_mask=np.ones((W, S, B)), step_mask=np.ones((W, S)),
+              worker_mask=np.ones(W), rngs=np.zeros((W, S, 2), np.uint32),
+              lr=LR, epoch=0)
+    return xs, ys, w0, kw
+
+
+def _kavg_round(mesh, collect_stats, poison_worker=None):
+    xs, ys, w0, kw = _round_inputs(poison_worker=poison_worker)
+    engine = KAvgEngine(mesh, linear_loss, linear_metrics,
+                        lambda lr, epoch: optax.sgd(lr),
+                        collect_stats=collect_stats)
+    avg, stats = engine.train_round(
+        {"params": {"w": jnp.asarray(w0)}},
+        {"x": jnp.asarray(xs), "y": jnp.asarray(ys)}, **kw)
+    return avg, stats
+
+
+def test_kavg_stats_do_not_perturb_weights(mesh8):
+    """The non-perturbation guarantee at round granularity: the stat
+    lanes are pure extra outputs, so the merged weights are BIT-
+    identical with stats on or off."""
+    avg_off, stats_off = _kavg_round(mesh8, collect_stats=False)
+    avg_on, stats_on = _kavg_round(mesh8, collect_stats=True)
+    np.testing.assert_array_equal(np.asarray(avg_off["params"]["w"]),
+                                  np.asarray(avg_on["params"]["w"]))
+    assert stats_off.stat_device is None
+    assert stats_on.stat_device is not None
+
+
+def test_kavg_stat_lane_values(mesh8):
+    """Stat columns carry the real quantities: for plain SGD the update
+    is exactly -lr*grad, so usq == lr^2 * gsq per worker; and the
+    spread scalar equals the host-computed population std of the
+    per-worker mean losses."""
+    W, S = 8, 3
+    _, stats = _kavg_round(mesh8, collect_stats=True)
+    stat = np.asarray(stats.stat_device)
+    assert stat.shape == (W, 3)
+    gsq, usq, psq = stat[:, 0], stat[:, 1], stat[:, 2]
+    assert np.isfinite(stat).all()
+    assert (gsq > 0).all() and (psq > 0).all()
+    np.testing.assert_allclose(usq, LR ** 2 * gsq, rtol=1e-5)
+    worker_means = stats.loss_sum / S
+    host_spread = float(np.sqrt(np.mean(worker_means ** 2)
+                                - np.mean(worker_means) ** 2))
+    np.testing.assert_allclose(float(np.asarray(stats.spread_device)),
+                               host_spread, rtol=1e-4)
+
+
+def test_kavg_nan_worker_stat_rows_zeroed(mesh8):
+    """The guard's SELECT (not multiply: NaN*0 == NaN) must also cover
+    the stat lanes — a poisoned worker's rows come back zero, and the
+    spread is computed over the surviving workers only (finite)."""
+    _, stats = _kavg_round(mesh8, collect_stats=True, poison_worker=1)
+    dropped = np.asarray(stats.dropped)
+    assert dropped[1] == 1.0 and dropped.sum() == 1.0
+    stat = np.asarray(stats.stat_device)
+    assert np.isfinite(stat).all()
+    np.testing.assert_array_equal(stat[1], np.zeros(3))
+    keep = np.arange(8) != 1
+    assert (stat[keep, 0] > 0).all()
+    assert np.isfinite(float(np.asarray(stats.spread_device)))
+
+
+def test_round_stats_peek_is_non_blocking(mesh8):
+    """peek() is the sanctioned mid-epoch look: it returns None (round
+    still in flight) or the drained [W] loss sums, and NEVER forces a
+    device sync. After the synchronizing loss_sum read it returns the
+    same cached array."""
+    _, stats = _kavg_round(mesh8, collect_stats=True)
+    early = stats.peek()
+    assert early is None or isinstance(early, np.ndarray)
+    drained = stats.loss_sum  # the synchronizing read
+    peeked = stats.peek()
+    assert peeked is not None
+    np.testing.assert_array_equal(peeked, drained)
+    if early is not None:
+        np.testing.assert_array_equal(early, drained)
+
+
+def test_syncdp_stats_do_not_perturb_weights(mesh8):
+    """Same guarantee for the sync-DP engine: bit-identical params with
+    collect_stats on/off, and the [S, 3] lane obeys the SGD identity."""
+    model = get_builtin("mlp")(hidden=16, num_classes=4)
+    rng = np.random.RandomState(0)
+    S, B, lr = 4, 32, 0.1
+    y = rng.randint(0, 4, size=(S, B)).astype(np.int32)
+    x = rng.randn(S, B, 8).astype(np.float32)
+    variables = model.init_variables(jax.random.PRNGKey(0),
+                                     {"x": jnp.asarray(x[0])})
+    rngs = rng.randint(0, 2 ** 31, size=(S, 2)).astype(np.uint32)
+
+    def run(collect_stats):
+        eng = SyncDPEngine(mesh8, model.loss,
+                           lambda lr_, epoch: optax.sgd(lr_),
+                           donate=False, collect_stats=collect_stats)
+        state = eng.init_state(variables, lr=lr)
+        state, losses = eng.train_steps(
+            state, {"x": x, "y": y}, sample_mask=np.ones((S, B)),
+            rngs=rngs, lr=lr, epoch=0)
+        np.asarray(losses)  # drain the dispatch
+        return eng, state
+
+    eng_off, state_off = run(False)
+    eng_on, state_on = run(True)
+    for a, b in zip(jax.tree_util.tree_leaves(state_off["params"]),
+                    jax.tree_util.tree_leaves(state_on["params"])):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    assert eng_off.last_stats_device is None
+    stat = np.asarray(eng_on.last_stats_device)
+    assert stat.shape == (S, 3)
+    assert np.isfinite(stat).all() and (stat[:, 0] > 0).all()
+    np.testing.assert_allclose(stat[:, 1], lr ** 2 * stat[:, 0],
+                               rtol=1e-4)
+
+
+# ---------------------------------------------------------- job-level
+
+
+@pytest.fixture()
+def jobenv(tmp_path, tmp_home, mesh8):
+    from kubeml_tpu.data.registry import DatasetRegistry
+    from kubeml_tpu.train.history import HistoryStore
+    reg = DatasetRegistry()
+    make_blobs(reg)
+    return reg, HistoryStore(), mesh8
+
+
+def _run_job(jobenv, job_id, engine, train_stats, epochs=2):
+    reg, store, mesh = jobenv
+    task = make_task(job_id=job_id, epochs=epochs, parallelism=4, k=2,
+                     engine=engine)
+    task.parameters.options.train_stats = train_stats
+    published = []
+    job = TrainJob(task, get_builtin("mlp")(hidden=16, num_classes=4),
+                   ToyDataset(), mesh, registry=reg, history_store=store,
+                   callbacks=JobCallbacks(publish_metrics=published.append))
+    record = job.train()
+    return record, published
+
+
+@pytest.mark.parametrize("engine,n_stats",
+                         [("kavg", 4), ("syncdp", 1)])
+def test_job_weights_bit_identical_stats_on_off(jobenv, engine, n_stats):
+    """The acceptance proof: a full fixed-seed job trained with the
+    stat lanes on checkpoints the SAME BITS as with them off — for both
+    engines — while the on-run publishes real stats (n_stats entries:
+    per-worker under kavg, single-model under syncdp) and fills the
+    history summaries."""
+    rec_on, pub_on = _run_job(jobenv, f"hs-{engine}-on", engine, True)
+    rec_off, pub_off = _run_job(jobenv, f"hs-{engine}-off", engine, False)
+
+    v_on, _ = load_checkpoint(f"hs-{engine}-on")
+    v_off, _ = load_checkpoint(f"hs-{engine}-off")
+    for a, b in zip(jax.tree_util.tree_leaves(v_on),
+                    jax.tree_util.tree_leaves(v_off)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    m = pub_on[0]
+    assert len(m.grad_norms) == n_stats
+    assert all(g > 0 for g in m.grad_norms)
+    assert len(m.update_ratios) == n_stats
+    assert all(u > 0 for u in m.update_ratios)
+    assert len(m.worker_losses) == n_stats
+    assert pub_off[0].grad_norms == []
+    assert pub_off[0].update_ratios == []
+
+    # runtime introspection rides the same update regardless of stats
+    assert m.jit_compiles >= 1
+    assert m.hbm_in_use_bytes > 0 and m.hbm_peak_bytes > 0
+
+    # history per-epoch [min, mean, max] summaries (kubeml history list)
+    assert len(rec_on.data.grad_norm_summary) == 2
+    for lo, mean, hi in rec_on.data.grad_norm_summary:
+        assert 0 < lo <= mean <= hi
+    for lo, mean, hi in rec_on.data.update_ratio_summary:
+        assert 0 < lo <= mean <= hi
+    assert rec_off.data.grad_norm_summary == [[0.0, 0.0, 0.0]] * 2
+
+
+# ----------------------------------------------- fake-clock health rules
+
+
+class FakeClock:
+    def __init__(self, t=1000.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+
+def _sample(job_id="j1", **kw):
+    """A benign epoch update: no rule fires on these values."""
+    base = dict(job_id=job_id, train_loss=0.5, validation_loss=0.5,
+                accuracy=50.0, parallelism=2, epoch_duration=1.0,
+                dropped_workers=0.0, quarantined_workers=0,
+                grad_norms=[0.5, 0.6], update_ratios=[1e-3, 2e-3],
+                worker_losses=[0.5, 0.5], loss_spread=0.01,
+                phase_times={"dispatch": [0.1, 0.1, 0.1, 0.1]})
+    base.update(kw)
+    return base
+
+
+def test_health_benign_updates_are_healthy():
+    ev = HealthEvaluator(clock=FakeClock())
+    assert ev.observe(_sample()) == []
+    v = ev.verdict("j1")
+    assert v["state"] == "healthy" and v["reasons"] == []
+    assert v["latest"]["grad_norms"] == [0.5, 0.6]
+
+
+def test_health_unknown_before_any_sample():
+    ev = HealthEvaluator(clock=FakeClock())
+    assert ev.verdict("ghost")["state"] == "unknown"
+
+
+@pytest.mark.parametrize("kw,rule,severity", [
+    (dict(dropped_workers=1.0), "worker_divergence", "critical"),
+    (dict(quarantined_workers=2), "worker_divergence", "critical"),
+    (dict(grad_norms=[2e4]), "grad_explosion", "critical"),
+    (dict(loss_spread=1.0), "loss_divergence", "warning"),
+    (dict(phase_times={"dispatch": [0.1, 0.1, 0.1, 2.0]}),
+     "straggler", "warning"),
+])
+def test_health_single_epoch_rules_fire(kw, rule, severity):
+    ev = HealthEvaluator(clock=FakeClock())
+    new = ev.observe(_sample(**kw))
+    assert [r["rule"] for r in new] == [rule]
+    assert new[0]["severity"] == severity
+    v = ev.verdict("j1")
+    assert v["state"] == ("critical" if severity == "critical"
+                          else "warning")
+
+
+def test_health_grad_explosion_relative_to_window():
+    """A 60x jump over the window median fires even below the absolute
+    ceiling — divergence has a shape, not just a magnitude."""
+    ev = HealthEvaluator(clock=FakeClock())
+    assert ev.observe(_sample(grad_norms=[0.5])) == []
+    assert ev.observe(_sample(grad_norms=[0.6])) == []
+    new = ev.observe(_sample(grad_norms=[30.0]))
+    assert [r["rule"] for r in new] == ["grad_explosion"]
+    assert "median" in new[0]["detail"]
+
+
+def test_health_update_stall_needs_consecutive_epochs():
+    ev = HealthEvaluator(clock=FakeClock())
+    stalled = dict(update_ratios=[1e-9, 1e-9])
+    assert ev.observe(_sample(**stalled)) == []
+    assert ev.observe(_sample(**stalled)) == []
+    new = ev.observe(_sample(**stalled))
+    assert [r["rule"] for r in new] == ["update_stall"]
+    # one good epoch resets the streak
+    assert ev.observe(_sample()) == []
+    assert ev.verdict("j1")["state"] == "healthy"
+
+
+def test_health_alert_dedup_counts_onsets_not_epochs():
+    """observe() returns NEWLY-fired reasons only, so the PS alert
+    counter measures rule onsets; a rule that clears and re-fires is a
+    new onset."""
+    ev = HealthEvaluator(clock=FakeClock())
+    assert len(ev.observe(_sample(dropped_workers=1.0))) == 1
+    assert ev.observe(_sample(dropped_workers=1.0)) == []  # still firing
+    assert ev.observe(_sample()) == []                     # cleared
+    assert ev.verdict("j1")["state"] == "healthy"
+    assert len(ev.observe(_sample(dropped_workers=1.0))) == 1  # re-onset
+
+
+def test_health_window_expiry_goes_unknown():
+    """A job that stops reporting is not healthy — once every sample
+    ages out of the rolling window the verdict degrades to unknown."""
+    clock = FakeClock()
+    ev = HealthEvaluator(clock=clock, window_s=600.0)
+    ev.observe(_sample(dropped_workers=1.0))
+    assert ev.verdict("j1")["state"] == "critical"
+    clock.t += 601.0
+    v = ev.verdict("j1")
+    assert v["state"] == "unknown" and v["latest"] == {}
+
+
+def test_health_worst_severity_wins():
+    ev = HealthEvaluator(clock=FakeClock())
+    new = ev.observe(_sample(dropped_workers=1.0, loss_spread=1.0))
+    assert {r["rule"] for r in new} == {"worker_divergence",
+                                       "loss_divergence"}
+    v = ev.verdict("j1")
+    assert v["state"] == "critical"
+    # reasons sorted critical-first for the renderer
+    assert [r["severity"] for r in v["reasons"]] == ["critical", "warning"]
+
+
+# -------------------------------------------------------- wire + CLI
+
+
+@pytest.fixture()
+def stack(tmp_path, tmp_home, mesh8):
+    dep = start_deployment(mesh=mesh8)
+    client = KubemlClient(dep.controller_url)
+    yield dep, client, tmp_path
+    dep.stop()
+
+
+def test_health_endpoint_and_top_under_nan_faults(stack, capsys):
+    """E2E acceptance: a deterministic fault plan poisons worker 1 every
+    round; while the job runs, GET /health?id= serves a critical
+    verdict with a worker_divergence reason, the alert counter and the
+    one-hot health gauge land on /metrics, and `kubeml top` /
+    `kubeml health` render the live document. Finish clears the window:
+    the verdict degrades to unknown."""
+    from kubeml_tpu.cli.main import main as cli_main
+
+    dep, client, tmp_path = stack
+    paths = write_blob_files(tmp_path)
+    client.v1().datasets().create(
+        "blobs", paths["xtr"], paths["ytr"], paths["xte"], paths["yte"])
+    plan = [{"kind": "nan", "worker": 1}]  # every round, every epoch
+    req = TrainRequest(
+        model_type="mlp", batch_size=32, epochs=50, dataset="blobs",
+        lr=0.1, options=TrainOptions(
+            default_parallelism=4, static_parallelism=True, k=2,
+            fault_plan=json.dumps(plan), device_cache="off"))
+    job_id = client.v1().networks().train(req)
+
+    verdict = None
+    deadline = time.time() + 120
+    while time.time() < deadline:
+        doc = http_json("GET", f"{dep.ps.url}/health?id={job_id}")
+        if doc["state"] == "critical":
+            verdict = doc
+            break
+        time.sleep(0.1)
+    assert verdict is not None, "health never went critical"
+    rules = [r["rule"] for r in verdict["reasons"]]
+    assert rules.count("worker_divergence") == 1
+    assert verdict["latest"]["grad_norms"], "stat lanes missing on wire"
+
+    # bare /health keeps the liveness contract every service answers
+    assert http_json("GET", f"{dep.ps.url}/health") == {"ok": True}
+
+    # CLI: machine-readable verdict through the controller proxy...
+    cli_main(["--controller", dep.controller_url,
+              "health", "--id", job_id])
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["id"] == job_id and doc["state"] == "critical"
+
+    # ...and the one-shot top render (header, reason, worker table)
+    cli_main(["--controller", dep.controller_url, "top", "--id", job_id,
+              "--iterations", "1"])
+    out = capsys.readouterr().out
+    assert f"job {job_id}" in out and "state=critical" in out
+    assert "worker_divergence" in out
+    assert "WORKER" in out and "GRAD_NORM" in out
+    assert "hbm: peak=" in out and "jit compiles:" in out
+
+    # health families on the PS exposition while the job is alive
+    text = urllib.request.urlopen(dep.ps.url + "/metrics").read().decode()
+    assert ('kubeml_health_alerts_total{jobid="%s",'
+            'rule="worker_divergence"}' % job_id) in text
+    assert ('kubeml_job_health{jobid="%s",state="critical"} 1'
+            % job_id) in text
+
+    client.v1().tasks().stop(job_id)
+    assert dep.ps.wait_for_job(job_id, timeout=120)
+    # finish clears the rolling window and the gauges: an ended job is
+    # unknown, not frozen-healthy
+    assert http_json("GET",
+                     f"{dep.ps.url}/health?id={job_id}")["state"] \
+        == "unknown"
+    text = urllib.request.urlopen(dep.ps.url + "/metrics").read().decode()
+    assert f'kubeml_job_health{{jobid="{job_id}"' not in text

@@ -11,6 +11,13 @@ parser and enforces the house rules:
   * every family declares ``# HELP`` and ``# TYPE`` before its samples
   * no family is declared twice (duplicate registration)
   * counter families end in ``_total``
+  * gauge families do NOT end in ``_total`` (a ``_total`` gauge makes
+    scrapers apply rate() to a resettable value; the one grandfathered
+    exception is ``kubeml_job_running_total``, reference parity)
+  * cardinality guard: no per-worker/per-index family NAMES — a family
+    whose name embeds a worker index (``..._worker_3``, ``..._0``)
+    mints a new family per worker instead of a label per series, and
+    dashboards cannot aggregate over it
   * histogram ``le`` bounds are strictly increasing and finish with
     ``+Inf``; bucket counts are monotone cumulative; ``_count`` equals
     the ``+Inf`` bucket and ``_sum`` is present
@@ -26,9 +33,23 @@ validates a live exposition built from MetricsRegistry + HttpMetrics
 from __future__ import annotations
 
 import math
+import re
 import sys
 
 _HIST_SUFFIXES = ("_bucket", "_sum", "_count")
+
+# gauges named *_total that predate the rule and are asserted as gauges
+# by the tier-1 suite (reference parity: running-jobs is a level, but
+# the reference named it *_total — tests/test_metrics_prom.py)
+_TOTAL_GAUGE_ALLOW = {"kubeml_job_running_total"}
+
+# family names that smuggle a per-worker/per-index series into the NAME
+# instead of a label: a workerN/rankN/laneN segment anywhere
+# (kubeml_worker3_loss) or a trailing bare-integer segment
+# (kubeml_job_loss_0, optionally before a unit suffix)
+_INDEXED_NAME = re.compile(
+    r"_(?:worker|rank|lane)_?\d+(?:_|$)"
+    r"|_\d+(?:_total|_seconds|_bytes)?$")
 
 
 def _parse_label_block(s: str, lineno: int) -> dict:
@@ -227,6 +248,17 @@ def validate_exposition(text: str) -> list:
             continue
         if ftype == "counter" and not fam.endswith("_total"):
             errors.append(f"{fam}: counter families must end in _total")
+        if ftype == "gauge" and fam.endswith("_total") \
+                and fam not in _TOTAL_GAUGE_ALLOW:
+            errors.append(
+                f"{fam}: gauge families must not end in _total (scrapers "
+                "read _total as a monotone counter and rate() it)")
+        if _INDEXED_NAME.search(fam):
+            errors.append(
+                f"{fam}: per-worker/per-index series must use labels "
+                "(e.g. {worker=\"3\"}), not indexed family names — one "
+                "family per worker defeats aggregation and explodes "
+                "family cardinality")
         if ftype == "histogram":
             _validate_histogram(fam, entry, errors)
         else:
@@ -278,6 +310,28 @@ _BROKEN = {
         "# HELP kubeml_h_seconds x\n# TYPE kubeml_h_seconds histogram\n"
         'kubeml_h_seconds_bucket{le="+Inf"} 3\n'
         "kubeml_h_seconds_sum 1\nkubeml_h_seconds_count 7\n"),
+    "total-gauge": "# HELP kubeml_drops_total x\n"
+                   "# TYPE kubeml_drops_total gauge\n"
+                   "kubeml_drops_total 2\n",
+    "indexed-family": "# HELP kubeml_job_loss_0 x\n"
+                      "# TYPE kubeml_job_loss_0 gauge\n"
+                      "kubeml_job_loss_0 1\n",
+    "worker-family": "# HELP kubeml_worker3_grad_norm x\n"
+                     "# TYPE kubeml_worker3_grad_norm gauge\n"
+                     "kubeml_worker3_grad_norm 1\n",
+}
+
+# these must KEEP passing: the allowlisted _total gauge and a labelled
+# per-worker family (the correct spelling of what "indexed-family"
+# rejects)
+_GOOD_EDGE = {
+    "allowed-total-gauge": "# HELP kubeml_job_running_total x\n"
+                           "# TYPE kubeml_job_running_total gauge\n"
+                           'kubeml_job_running_total{state="train"} 1\n',
+    "labelled-worker": "# HELP kubeml_job_worker_grad_norm x\n"
+                       "# TYPE kubeml_job_worker_grad_norm gauge\n"
+                       'kubeml_job_worker_grad_norm'
+                       '{jobid="j",worker="3"} 0.5\n',
 }
 
 
@@ -288,6 +342,10 @@ def self_test() -> list:
     good_errors = validate_exposition(_GOOD)
     if good_errors:
         failures.append(f"clean exposition flagged: {good_errors}")
+    for tag, text in sorted(_GOOD_EDGE.items()):
+        errors = validate_exposition(text)
+        if errors:
+            failures.append(f"clean edge case {tag!r} flagged: {errors}")
     for tag, text in sorted(_BROKEN.items()):
         if not validate_exposition(text):
             failures.append(f"broken exposition {tag!r} passed validation")
@@ -314,7 +372,13 @@ def _live_exposition() -> str:
         job_id="lintjob", validation_loss=0.5, accuracy=0.9,
         train_loss=0.4, parallelism=8, epoch_duration=1.5,
         phase_times={"dispatch": [0.01, 0.2], "data_wait": [0.001],
-                     "device_drain": [0.05]}))
+                     "device_drain": [0.05]},
+        grad_norms=[0.5, 0.7], update_ratios=[1e-3, 2e-3],
+        worker_losses=[0.41, 0.39], loss_spread=0.01,
+        jit_compiles=2, hbm_peak_bytes=1 << 20,
+        hbm_in_use_bytes=1 << 19, trace_events_dropped=1))
+    reg.set_health("lintjob", "warning")
+    reg.note_health_alert("lintjob", "loss_divergence")
     reg.running_total.set("train", 1)
     reg.note_restart("lintjob")
     http = HttpMetrics("lint")
