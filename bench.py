@@ -384,6 +384,8 @@ def main():
         reassigned = num_makeup * (W - 1) * S
         return ckpt_s, resume_s, degraded_s, reassigned
 
+    serving = _measure_serving_arm()
+
     per_chip, cache_phases, cache_runtime = measure(
         cache_round, cache_rounds, 2, TIMED_EPOCHS)
     host_per_chip, host_phases, host_runtime = measure(
@@ -483,6 +485,16 @@ def main():
             "clean_single": clean_runtime,
             "faulted": faulted_runtime,
         },
+        # inference-plane arm (kubeml_tpu/serve/): closed-loop clients
+        # against the continuous-batching decode service. The design
+        # signal is dispatches_per_token: at concurrency 1 every request
+        # pays its own prefill+decode dispatches ((Tp+n)/n > 1); under
+        # continuous batching one dispatch advances every active stream,
+        # so the ratio drops below 1 as occupancy rises. The burst
+        # section shows admission control shedding with 429 once
+        # slots+queue are in flight. decode_compiles stays 1 across
+        # every arm — membership churn is data, never a new program.
+        "serving": serving,
     }))
 
 
@@ -563,6 +575,128 @@ def _measure_baseline_arm(model, x, y) -> tuple:
     hbm.sample()
     return (BASELINE_TIMED_EPOCHS * steps_per_epoch * B / elapsed,
             tracer.summary(), {**jt.snapshot(), **hbm.snapshot()})
+
+
+def _measure_serving_arm() -> dict:
+    """Inference-plane arm: closed-loop clients against the
+    continuous-batching decode service (kubeml_tpu/serve/), gpt-nano so
+    the arm is cheap on every backend. Each client thread loops
+    submit -> drain-stream until the shared request budget is spent, so
+    offered load tracks completion (closed loop) and the tail latencies
+    are honest. Two concurrencies: 1 (the sequential baseline — each
+    request pays its own prefill+decode dispatches) and the full slot
+    pool. A final open-loop burst overruns slots+queue to show the
+    admission path shedding with 429."""
+    import threading
+
+    import jax
+    import numpy as np
+
+    from kubeml_tpu.models import get_builtin
+    from kubeml_tpu.serve.engine import DecodeEngine
+    from kubeml_tpu.serve.service import ServeService
+    from kubeml_tpu.serve.slots import ServeSaturated
+
+    PROMPT_LEN, NEW_TOKENS, SLOTS, QUEUE = 8, 16, 16, 16
+
+    model = get_builtin("gpt-nano")()
+    module = model.module
+    variables = model.init_variables(
+        jax.random.PRNGKey(0),
+        {"x": np.ones((1, module.max_len), np.int32)})
+    engine = DecodeEngine(module, variables, slots=SLOTS)
+    svc = ServeService("bench", engine, max_queue=QUEUE).start()
+
+    def prompt(i):
+        return [(i * 7 + j) % (module.vocab_size - 1) + 1
+                for j in range(PROMPT_LEN)]
+
+    def drain(req):
+        for _ in req.events_iter(timeout=120.0):
+            pass
+        return req
+
+    # warmup: the engine's single compile lands here, outside every
+    # timed window (and decode_compiles must still read 1 at the end)
+    drain(svc.submit(prompt(0), max_new_tokens=NEW_TOKENS))
+
+    def pct(vals, q):
+        if not vals:
+            return 0.0
+        return round(vals[min(len(vals) - 1,
+                              int(q * (len(vals) - 1) + 0.5))], 6)
+
+    def closed_loop(concurrency, total_requests):
+        done = []
+        lock = threading.Lock()
+        budget = [total_requests]
+        before = dict(engine.stats)
+
+        def client(cid):
+            while True:
+                with lock:
+                    if budget[0] <= 0:
+                        return
+                    budget[0] -= 1
+                    i = budget[0]
+                req = svc.submit(prompt(cid * 1000 + i),
+                                 max_new_tokens=NEW_TOKENS)
+                drain(req)
+                with lock:
+                    done.append(req)
+
+        t0 = time.perf_counter()
+        threads = [threading.Thread(target=client, args=(c,))
+                   for c in range(concurrency)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        elapsed = time.perf_counter() - t0
+        delta = {k: engine.stats[k] - before[k] for k in before}
+        ttfts = sorted(r.first_token_at - r.submitted_at for r in done
+                       if r.first_token_at and r.submitted_at)
+        e2es = sorted(r.finished_at - r.submitted_at for r in done
+                      if r.finished_at and r.submitted_at)
+        toks = int(delta["generated_tokens"])
+        return {
+            "concurrency": concurrency,
+            "requests": len(done),
+            "goodput_tok_s": round(toks / elapsed, 1),
+            "dispatches_per_token": round(
+                delta["dispatches"] / max(1, toks), 4),
+            "mean_occupancy": round(
+                delta["occupancy_sum"] / max(1, delta["dispatches"]), 2),
+            "ttft_p50_s": pct(ttfts, 0.50),
+            "ttft_p99_s": pct(ttfts, 0.99),
+            "e2e_p50_s": pct(e2es, 0.50),
+            "e2e_p99_s": pct(e2es, 0.99),
+        }
+
+    arm_c1 = closed_loop(1, 8)
+    arm_cn = closed_loop(SLOTS, 4 * SLOTS)
+
+    # open-loop burst: submissions outrun the decode loop, so past
+    # slots+queue in flight the admission check sheds with 429
+    shed, burst = 0, []
+    for i in range(3 * SLOTS):
+        try:
+            burst.append(svc.submit(prompt(i), max_new_tokens=32))
+        except ServeSaturated:
+            shed += 1
+    for req in burst:
+        svc.cancel(req)
+    for req in burst:
+        req.wait(timeout=60.0)
+    svc.stop()
+    return {
+        "model": "gpt-nano", "slots": SLOTS, "queue": QUEUE,
+        "prompt_tokens": PROMPT_LEN, "new_tokens": NEW_TOKENS,
+        "decode_compiles": int(engine.stats["compiles"]),
+        "closed_loop": [arm_c1, arm_cn],
+        "burst_submitted": 3 * SLOTS,
+        "burst_shed_429": shed,
+    }
 
 
 if __name__ == "__main__":

@@ -327,6 +327,20 @@ def _render_top(doc: dict) -> str:
     for r in doc.get("reasons", []):
         lines.append(f"  [{r.get('severity', '?'):>8}] "
                      f"{r.get('rule', '?')}: {r.get('detail', '')}")
+    if latest.get("serve_slot_cap") is not None:
+        # serving pane: the serve:<model> pseudo job publishes slot /
+        # queue / KV occupancy and the recent-window TTFT percentiles
+        def _ms(x):
+            return f"{float(x) * 1000:.0f}ms" if x is not None else "-"
+        lines.append(
+            f"serve: slots {latest.get('serve_active_slots', 0):g}"
+            f"/{latest.get('serve_slot_cap', 0):g}  "
+            f"queue {latest.get('serve_queue_depth', 0):g}"
+            f"/{latest.get('serve_queue_cap', 0):g}  "
+            f"kv pages {float(latest.get('serve_kv_page_utilization', 0.0)):.0%}  "
+            f"ttft p50/p99 {_ms(latest.get('serve_ttft_p50'))}"
+            f"/{_ms(latest.get('serve_ttft_p99'))}  "
+            f"shed {latest.get('serve_rejected_total', 0):g}")
     worker_losses = latest.get("worker_losses") or []
     grad_norms = latest.get("grad_norms") or []
     update_ratios = latest.get("update_ratios") or []
@@ -407,7 +421,10 @@ def cmd_serve(args):
         svc = start_deployment(mesh=mesh,
                                use_default_ports=not args.free_ports,
                                standalone_jobs=args.standalone_jobs,
-                               job_partitions=partitions)
+                               job_partitions=partitions,
+                               infer_cache_size=args.infer_cache_size,
+                               serve_slots=args.serve_slots,
+                               serve_queue_depth=args.serve_queue_depth)
         print(f"controller: {svc.controller.url}")
         print(f"scheduler:  {svc.scheduler.url}")
         print(f"ps:         {svc.ps.url}  (metrics at {svc.ps.url}/metrics)")
@@ -426,7 +443,10 @@ def cmd_serve(args):
         svc = ParameterServer(mesh=mesh, port=args.port or const.PS_PORT,
                               scheduler_url=args.scheduler_url,
                               standalone_jobs=args.standalone_jobs or None,
-                              job_partitions=partitions)
+                              job_partitions=partitions,
+                              infer_cache_size=args.infer_cache_size,
+                              serve_slots=args.serve_slots,
+                              serve_queue_depth=args.serve_queue_depth)
     else:  # storage
         from kubeml_tpu.control.storage import StorageService
         svc = StorageService(port=args.port or const.STORAGE_PORT)
@@ -662,6 +682,19 @@ def build_parser() -> argparse.ArgumentParser:
                         "contain commas). A starting job leases a free "
                         "slot until its process exits; while every slot "
                         "is leased the scheduler requeues new tasks")
+    s.add_argument("--infer-cache-size", type=int, default=None,
+                   help="max checkpoints kept hot in the PS inference "
+                        "cache (KUBEML_INFER_CACHE_SIZE, default 4); "
+                        "entries are also evicted when the cache would "
+                        "exceed the serving HBM budget")
+    s.add_argument("--serve-slots", type=int, default=None,
+                   help="decode slots per served model — the concurrent "
+                        "stream cap for POST /generate "
+                        "(KUBEML_SERVE_SLOTS, default 8)")
+    s.add_argument("--serve-queue-depth", type=int, default=None,
+                   help="admission queue depth beyond the slot pool; "
+                        "past slots+queue, /generate sheds with 429 + "
+                        "Retry-After (KUBEML_SERVE_QUEUE, default 16)")
     s.set_defaults(fn=cmd_serve)
     return p
 

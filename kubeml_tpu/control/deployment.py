@@ -41,13 +41,17 @@ def start_deployment(mesh=None, controller_port: int = 0,
                      storage_port: int = 0,
                      use_default_ports: bool = False,
                      standalone_jobs: bool = False,
-                     job_partitions=None) -> Deployment:
+                     job_partitions=None,
+                     infer_cache_size: Optional[int] = None,
+                     serve_slots: Optional[int] = None,
+                     serve_queue_depth: Optional[int] = None) -> Deployment:
     """Start storage, PS, scheduler, controller wired together.
 
     Port 0 picks a free port (tests); use_default_ports uses the configured
     service ports (const.py) for a long-running host deployment.
     job_partitions: device-partition env dicts for concurrent standalone
-    jobs (ParameterServer docs).
+    jobs (ParameterServer docs). The serve knobs pass through to the
+    PS's inference plane (None keeps its env-var defaults).
     """
     if use_default_ports:
         controller_port = controller_port or const.CONTROLLER_PORT
@@ -60,7 +64,10 @@ def start_deployment(mesh=None, controller_port: int = 0,
 
     ps = ParameterServer(mesh=mesh, port=ps_port,
                          standalone_jobs=standalone_jobs or None,
-                         job_partitions=job_partitions)
+                         job_partitions=job_partitions,
+                         infer_cache_size=infer_cache_size,
+                         serve_slots=serve_slots,
+                         serve_queue_depth=serve_queue_depth)
     ps.start()
 
     scheduler = Scheduler(ps_url=ps.url, port=scheduler_port)

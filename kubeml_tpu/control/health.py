@@ -139,10 +139,49 @@ def _make_straggler(rel_limit: float, min_rounds: int):
     return check
 
 
+def _make_serve_saturation():
+    """Serving plane (serve/service.py snapshots): warn when admission
+    starts costing clients — the queue is at cap, or requests were shed
+    with 429 since the previous snapshot. Training samples carry none
+    of the serve_* fields, so this never fires for them."""
+    def check(window: List[dict]) -> Optional[str]:
+        m = _latest(window)
+        cap = m.get("serve_queue_cap")
+        if cap is None:
+            return None
+        depth = float(m.get("serve_queue_depth", 0.0))
+        rej = float(m.get("serve_rejected_total", 0.0))
+        prev = next((s for s in reversed(window[:-1])
+                     if s.get("serve_queue_cap") is not None), None)
+        rej_prev = float(prev.get("serve_rejected_total", 0.0)) \
+            if prev else 0.0
+        if rej > rej_prev:
+            return (f"{rej - rej_prev:g} request(s) shed with 429 since "
+                    f"the last snapshot (queue {depth:g}/{cap:g})")
+        if float(cap) > 0 and depth >= float(cap):
+            return (f"admission queue full ({depth:g}/{cap:g}); the next "
+                    f"request will be shed")
+        return None
+    return check
+
+
+def _make_serve_ttft_slo(slo_s: float):
+    def check(window: List[dict]) -> Optional[str]:
+        m = _latest(window)
+        p99 = m.get("serve_ttft_p99")
+        if p99 is None or float(p99) <= slo_s:
+            return None
+        return (f"p99 time-to-first-token {float(p99):.3g}s exceeds the "
+                f"{slo_s:g}s SLO (p50 "
+                f"{float(m.get('serve_ttft_p50', 0.0)):.3g}s)")
+    return check
+
+
 def default_rules(grad_abs: float = 1e4, grad_rel: float = 50.0,
                   spread_rel: float = 0.75, stall_floor: float = 1e-7,
                   stall_epochs: int = 3, straggler_rel: float = 5.0,
-                  straggler_min_rounds: int = 4) -> List[HealthRule]:
+                  straggler_min_rounds: int = 4,
+                  serve_ttft_slo_s: float = 2.0) -> List[HealthRule]:
     return [
         HealthRule("worker_divergence", "critical",
                    "non-finite guard dropped or quarantined workers",
@@ -159,6 +198,12 @@ def default_rules(grad_abs: float = 1e4, grad_rel: float = 50.0,
         HealthRule("straggler", "warning",
                    "one round dispatch far slower than the epoch median",
                    _make_straggler(straggler_rel, straggler_min_rounds)),
+        HealthRule("serve_saturation", "warning",
+                   "inference admission queue at cap or shedding 429s",
+                   _make_serve_saturation()),
+        HealthRule("serve_ttft_slo", "warning",
+                   "serving p99 time-to-first-token above the SLO",
+                   _make_serve_ttft_slo(serve_ttft_slo_s)),
     ]
 
 
@@ -168,7 +213,13 @@ _SAMPLE_FIELDS = ("train_loss", "validation_loss", "accuracy",
                   "parallelism", "epoch_duration", "dropped_workers",
                   "quarantined_workers", "grad_norms", "update_ratios",
                   "worker_losses", "loss_spread", "jit_compiles",
-                  "hbm_peak_bytes", "hbm_in_use_bytes", "phase_times")
+                  "hbm_peak_bytes", "hbm_in_use_bytes", "phase_times",
+                  # serving-plane snapshots (serve/service.py) ride the
+                  # same pipeline under the serve:<model> pseudo job id
+                  "serve_active_slots", "serve_slot_cap",
+                  "serve_queue_depth", "serve_queue_cap",
+                  "serve_kv_page_utilization", "serve_rejected_total",
+                  "serve_ttft_p50", "serve_ttft_p99")
 
 
 class HealthEvaluator:

@@ -27,13 +27,36 @@ logger = logging.getLogger("kubeml_tpu.http")
 
 
 class Raw:
-    """Non-JSON response (e.g. Prometheus text exposition)."""
+    """Non-JSON response (e.g. Prometheus text exposition).
+
+    `headers` adds extra response headers — e.g. the serving plane's
+    429s carry Retry-After so shed clients back off by contract."""
 
     def __init__(self, payload: bytes, content_type: str = "text/plain",
-                 status: int = 200):
+                 status: int = 200,
+                 headers: Optional[Dict[str, str]] = None):
         self.payload = payload
         self.content_type = content_type
         self.status = status
+        self.headers = headers
+
+
+class Stream:
+    """Chunked (streaming) response: `chunks` is an iterable of bytes,
+    written as HTTP/1.1 chunked transfer encoding as they are produced —
+    the serving plane's per-token /generate lines.
+
+    If the client disconnects mid-stream the iterator is close()d (a
+    generator sees GeneratorExit), which is the handler's cancellation
+    hook — wrap the body in try/finally to release the stream's slot."""
+
+    def __init__(self, chunks, content_type: str = "application/x-ndjson",
+                 status: int = 200,
+                 headers: Optional[Dict[str, str]] = None):
+        self.chunks = chunks
+        self.content_type = content_type
+        self.status = status
+        self.headers = headers
 
 
 class Route:
@@ -149,9 +172,11 @@ class JsonService:
                                       query=query, body=body, raw=raw,
                                       headers=dict(self.headers))
                         out = r.handler(req)
-                        if isinstance(out, Raw):
+                        if isinstance(out, Stream):
+                            self._reply_stream(out)
+                        elif isinstance(out, Raw):
                             self._reply(out.status, out.payload,
-                                        out.content_type)
+                                        out.content_type, out.headers)
                         else:
                             payload = json.dumps(out if out is not None
                                                  else {}).encode()
@@ -169,13 +194,55 @@ class JsonService:
                 ).encode())
 
             def _reply(self, code, payload: bytes,
-                       content_type: str = "application/json"):
+                       content_type: str = "application/json",
+                       headers: Optional[Dict[str, str]] = None):
                 self._status = code
                 self.send_response(code)
                 self.send_header("Content-Type", content_type)
                 self.send_header("Content-Length", str(len(payload)))
+                for key, value in (headers or {}).items():
+                    self.send_header(key, str(value))
                 self.end_headers()
                 self.wfile.write(payload)
+
+            def _reply_stream(self, out: "Stream"):
+                """Write a Stream as chunked transfer encoding. Once the
+                status line is on the wire nothing can turn a mid-stream
+                failure into a 500, so errors here only close the
+                connection; handler-side errors must surface as in-band
+                stream items instead."""
+                self._status = out.status
+                self.send_response(out.status)
+                self.send_header("Content-Type", out.content_type)
+                for key, value in (out.headers or {}).items():
+                    self.send_header(key, str(value))
+                self.send_header("Transfer-Encoding", "chunked")
+                self.end_headers()
+                try:
+                    for chunk in out.chunks:
+                        if not chunk:
+                            continue
+                        self.wfile.write(b"%x\r\n" % len(chunk)
+                                         + chunk + b"\r\n")
+                        self.wfile.flush()
+                    self.wfile.write(b"0\r\n\r\n")
+                except OSError:
+                    # client went away mid-stream: the finally clause
+                    # close()s the producer (its cancellation hook) and
+                    # this connection cannot be reused
+                    self.close_connection = True
+                except Exception:
+                    logger.exception("%s: stream producer failed",
+                                     service.name)
+                    self.close_connection = True
+                finally:
+                    close = getattr(out.chunks, "close", None)
+                    if close is not None:
+                        try:
+                            close()
+                        except Exception:
+                            logger.exception("%s: stream close failed",
+                                             service.name)
 
             def do_GET(self):
                 self._dispatch("GET")

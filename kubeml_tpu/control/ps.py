@@ -52,7 +52,8 @@ from kubeml_tpu.api.errors import (InvalidArgsError, JobNotFoundError,
                                    KubeMLException)
 from kubeml_tpu.api.types import MetricUpdate, TrainTask
 from kubeml_tpu.control.health import HealthEvaluator
-from kubeml_tpu.control.httpd import JsonService, Raw, Request, http_json
+from kubeml_tpu.control.httpd import (JsonService, Raw, Request, Stream,
+                                      http_json)
 from kubeml_tpu.data.registry import DatasetRegistry
 from kubeml_tpu.metrics.prom import MetricsRegistry
 from kubeml_tpu.models.base import InferenceInputError, KubeDataset
@@ -97,9 +98,11 @@ class InferBatcher:
 
     Disable with KUBEML_INFER_BATCH=0 (requests then run unbatched)."""
 
-    def __init__(self, window_s: float = 0.003, max_batch: int = 64):
+    def __init__(self, window_s: float = 0.003, max_batch: int = 64,
+                 timeout_s: float = 60.0):
         self.window_s = window_s
         self.max_batch = max_batch
+        self.timeout_s = timeout_s
         self._lock = threading.Lock()
         self._groups: Dict[tuple, list] = {}
         self._last_arrival: Dict[tuple, float] = {}
@@ -150,7 +153,20 @@ class InferBatcher:
         if not leader:
             # follower: the leader serves us (bounded wait: a crashed
             # leader must not hang the request forever)
-            if not slot.event.wait(timeout=60.0):
+            if not slot.event.wait(timeout=self.timeout_s):
+                # CANCEL before giving up: our row must leave the
+                # pending bucket, or a later flush of this key would
+                # scatter a result into a slot nobody is waiting on
+                # (and mis-align every row after ours). The group may
+                # already be gone (leader popped it and is about to set
+                # our event) — then removal no-ops and the result is
+                # simply dropped.
+                with self._lock:
+                    grp = self._groups.get(key)
+                    if grp is not None and slot in grp:
+                        grp.remove(slot)
+                        if not grp:
+                            del self._groups[key]
                 raise KubeMLException("batched inference timed out", 500)
             if slot.error is not None:
                 raise slot.error
@@ -249,7 +265,12 @@ class ParameterServer(JsonService):
                  scheduler_url: Optional[str] = None,
                  standalone_jobs: Optional[bool] = None,
                  job_env: Optional[Dict[str, str]] = None,
-                 job_partitions: Optional[List[Dict[str, str]]] = None):
+                 job_partitions: Optional[List[Dict[str, str]]] = None,
+                 infer_cache_size: Optional[int] = None,
+                 serve_slots: Optional[int] = None,
+                 serve_queue_depth: Optional[int] = None,
+                 serve_page_tokens: Optional[int] = None,
+                 serve_hbm_budget_mb: Optional[float] = None):
         super().__init__(port=port)
         # Lazy mesh: in standalone mode the PARENT must not initialize the
         # accelerator backend (on TPU, libtpu is single-process-exclusive —
@@ -279,6 +300,30 @@ class ParameterServer(JsonService):
         self._infer_cache: "collections.OrderedDict" = \
             collections.OrderedDict()
         self._infer_cache_lock = threading.Lock()
+        # checkpoint-LRU sizing (satellite of the serving plane): entry
+        # cap via flag/env, plus a shared HBM budget — deserialized
+        # checkpoints and the serving KV slabs draw from the same
+        # device memory, so cached entries yield to live KV pages
+        self.infer_cache_size = max(1, int(
+            infer_cache_size if infer_cache_size is not None
+            else os.environ.get("KUBEML_INFER_CACHE_SIZE", "4")))
+        self.serve_hbm_budget_bytes = int(float(
+            serve_hbm_budget_mb if serve_hbm_budget_mb is not None
+            else os.environ.get("KUBEML_SERVE_HBM_BUDGET_MB", "512"))
+            * (1 << 20))
+        # serving-plane knobs (serve/): slot pool width, admission queue
+        # cap, KV page size in tokens
+        self.serve_slots = int(
+            serve_slots if serve_slots is not None
+            else os.environ.get("KUBEML_SERVE_SLOTS", "8"))
+        self.serve_queue_depth = int(
+            serve_queue_depth if serve_queue_depth is not None
+            else os.environ.get("KUBEML_SERVE_QUEUE", "16"))
+        self.serve_page_tokens = int(
+            serve_page_tokens if serve_page_tokens is not None
+            else os.environ.get("KUBEML_SERVE_PAGE_TOKENS", "16"))
+        self._serve: Dict[str, tuple] = {}   # model_id -> (stamp, service)
+        self._serve_lock = threading.Lock()
         self._infer_batcher = InferBatcher() if InferBatcher.enabled() \
             else None
         self.metrics = MetricsRegistry()
@@ -313,6 +358,7 @@ class ParameterServer(JsonService):
         # health verdict
         self.route("GET", "/health", self._h_health)
         self.route("POST", "/infer", self._h_infer)
+        self.route("POST", "/generate", self._h_generate)
 
     @property
     def mesh(self):
@@ -415,17 +461,20 @@ class ParameterServer(JsonService):
         self._observe_health(m)
         return {"ok": True}
 
-    def _observe_health(self, m: MetricUpdate) -> None:
-        """Feed one epoch update through the health rules: bump the
-        alert counter once per rule ONSET (the evaluator dedupes
-        against already-active rules) and publish the verdict gauge."""
+    def _observe_health(self, m) -> None:
+        """Feed one update through the health rules: bump the alert
+        counter once per rule ONSET (the evaluator dedupes against
+        already-active rules) and publish the verdict gauge. Accepts a
+        MetricUpdate (training epochs) or a plain snapshot dict (the
+        serving loop's serve:<model> pseudo-job samples)."""
+        job_id = m["job_id"] if isinstance(m, dict) else m.job_id
         for reason in self.health.observe(m):
-            self.metrics.note_health_alert(m.job_id, reason["rule"])
-            logger.warning("job %s health alert [%s/%s]: %s", m.job_id,
+            self.metrics.note_health_alert(job_id, reason["rule"])
+            logger.warning("job %s health alert [%s/%s]: %s", job_id,
                            reason["severity"], reason["rule"],
                            reason["detail"])
         self.metrics.set_health(
-            m.job_id, self.health.verdict(m.job_id)["state"])
+            job_id, self.health.verdict(job_id)["state"])
 
     def _h_health(self, req: Request):
         """Bare GET /health keeps the liveness contract every service
@@ -562,7 +611,9 @@ class ParameterServer(JsonService):
                 hit = self._infer_cache.get(model_id)
                 if hit is not None and hit[0] == saved_at:
                     self._infer_cache.move_to_end(model_id)
+                    self.metrics.note_infer_cache(True)
                     return hit[1], hit[2]
+        self.metrics.note_infer_cache(False)
         variables, manifest = load_checkpoint(model_id)
         model_cls, _ = self.fn_registry.resolve(
             manifest.get("function") or manifest.get("model"))
@@ -574,9 +625,137 @@ class ParameterServer(JsonService):
             with self._infer_cache_lock:
                 self._infer_cache[model_id] = (key, model, variables)
                 self._infer_cache.move_to_end(model_id)
-                while len(self._infer_cache) > 4:
-                    self._infer_cache.popitem(last=False)
+                self._evict_infer_cache_locked()
+                self.metrics.set_infer_cache_entries(
+                    len(self._infer_cache))
         return model, variables
+
+    @staticmethod
+    def _variables_nbytes(variables) -> int:
+        import jax
+        return int(sum(getattr(leaf, "nbytes", 0)
+                       for leaf in jax.tree_util.tree_leaves(variables)))
+
+    def _evict_infer_cache_locked(self) -> None:
+        """LRU eviction under two pressures (cache lock held): the entry
+        cap (--infer-cache-size), and the serving HBM budget — the KV
+        slabs of live decode services and cached checkpoint weights
+        share device memory, so cached entries yield until the combined
+        footprint fits. The freshest entry always survives (the request
+        that just loaded it is about to use it)."""
+        while len(self._infer_cache) > self.infer_cache_size:
+            self._infer_cache.popitem(last=False)
+        budget = self.serve_hbm_budget_bytes - self._serve_hbm_bytes()
+        while len(self._infer_cache) > 1 \
+                and sum(self._variables_nbytes(e[2])
+                        for e in self._infer_cache.values()) > budget:
+            self._infer_cache.popitem(last=False)
+
+    def _serve_hbm_bytes(self) -> int:
+        with self._serve_lock:
+            return sum(svc.engine.slab.device_bytes
+                       for _, svc in self._serve.values())
+
+    # -------------------------------------------------------- serving plane
+
+    def _serve_service(self, model_id: str):
+        """The model's continuous-batching decode service, (re)built
+        when its checkpoint stamp changes — a newly published checkpoint
+        hot-swaps the serving weights; streams on the old service finish
+        against the weights they started with."""
+        from kubeml_tpu.serve.engine import DecodeEngine
+        from kubeml_tpu.serve.pager import PageGeometry
+        from kubeml_tpu.serve.service import ServeService
+        model, variables = self._load_for_infer(model_id)
+        stamp = checkpoint_saved_at(model_id)
+        with self._serve_lock:
+            cur = self._serve.get(model_id)
+            if cur is not None and cur[0] == stamp:
+                return cur[1]
+        module = getattr(model, "module", None)
+        try:
+            engine = DecodeEngine(
+                module, variables,
+                geom=PageGeometry.for_module(
+                    slots=self.serve_slots, page=self.serve_page_tokens,
+                    max_len=module.max_len))
+        except (ValueError, TypeError, AttributeError) as e:
+            # non-GPT modules (no paged decode step) are client errors
+            raise InvalidArgsError(
+                f"model {model_id} does not support streaming decode: "
+                f"{e}") from e
+        svc = ServeService(model_id, engine,
+                           max_queue=self.serve_queue_depth,
+                           metrics=self.metrics,
+                           health_cb=self._observe_health).start()
+        old = None
+        with self._serve_lock:
+            cur = self._serve.get(model_id)
+            if cur is not None and cur[0] == stamp:  # lost the race
+                old, svc = svc, cur[1]
+            else:
+                old = cur[1] if cur is not None else None
+                self._serve[model_id] = (stamp, svc)
+        if old is not None:
+            old.stop()
+        return svc
+
+    def _h_generate(self, req: Request):
+        """Streaming continuous-batching generation. Body:
+        {model_id, prompt: [token ids], max_new_tokens, temperature,
+        seed, eos_id, stream} — stream=true (default) answers ndjson
+        chunks ({"token": id} per token, then {"done": ..., "tokens":
+        [...]}) as the decode loop produces them; stream=false blocks
+        and answers one JSON document. Saturation answers 429 with
+        Retry-After (admission control, never unbounded queueing)."""
+        from kubeml_tpu.serve.slots import ServeSaturated
+        body = req.body if isinstance(req.body, dict) else {}
+        model_id = body.get("model_id")
+        if not model_id:
+            raise InvalidArgsError("model_id required")
+        prompt = body.get("prompt")
+        if prompt is None:
+            raise InvalidArgsError("prompt required (list of token ids)")
+        try:
+            prompt = [int(t) for t in prompt]
+        except (TypeError, ValueError) as e:
+            raise InvalidArgsError(
+                f"prompt must be a list of token ids: {e}") from e
+        svc = self._serve_service(model_id)
+        try:
+            r = svc.submit(
+                prompt,
+                max_new_tokens=int(body.get("max_new_tokens", 32)),
+                temperature=float(body.get("temperature", 0.0)),
+                seed=int(body.get("seed", 0)),
+                eos_id=body.get("eos_id"))
+        except InferenceInputError as e:
+            raise InvalidArgsError(str(e)) from e
+        except ServeSaturated as e:
+            retry = max(1, int(round(e.retry_after_s)))
+            return Raw(e.to_json().encode(), "application/json",
+                       status=e.status_code,
+                       headers={"Retry-After": str(retry)})
+        if body.get("stream", True):
+            return Stream(self._generate_chunks(svc, r))
+        if not r.wait(timeout=600.0):
+            svc.cancel(r)
+            raise KubeMLException("generation timed out", 504)
+        if r.outcome == "ok":
+            return {"tokens": r.tokens}
+        raise KubeMLException(r.error or f"generation {r.outcome}", 500)
+
+    def _generate_chunks(self, svc, r):
+        """ndjson producer for one stream; generator close() (client
+        disconnect — httpd Stream contract) cancels the request so its
+        slot and KV pages free immediately."""
+        import json as _json
+        try:
+            for ev in r.events_iter():
+                yield (_json.dumps(ev) + "\n").encode()
+        finally:
+            if not r.done:
+                svc.cancel(r)
 
     # ------------------------------------------------------------- job mgmt
 
@@ -991,6 +1170,14 @@ class ParameterServer(JsonService):
         analogue is pod garbage collection on PS teardown."""
         super().stop()
         self._reaper_stop.set()
+        # stop the serving loops first: they fail their in-flight
+        # streams with terminal events, so blocked /generate threads
+        # unwind instead of waiting out their stream timeout
+        with self._serve_lock:
+            serves = [svc for _, svc in self._serve.values()]
+            self._serve.clear()
+        for svc in serves:
+            svc.stop()
         with self._jobs_lock:
             self._stopping = True  # no further spawns or crash-restarts
             recs = list(self.jobs.values())

@@ -426,6 +426,56 @@ class MetricsRegistry:
             "kubeml_trace_events_dropped_total",
             "Tracer events dropped at the per-process ring cap for a job",
             "jobid")
+        # serving plane (serve/): per-model SLO latency distributions
+        # (TTFT = submit -> first token, TPOT = decode cadence after it,
+        # e2e = submit -> done) + the occupancy/queue/KV gauges the
+        # serve health rules and `kubeml top` read, keyed by MODEL, not
+        # job — serving outlives any one job and survives clear_job
+        self.serve_ttft_seconds = Histogram(
+            "kubeml_serve_ttft_seconds",
+            "Time to first generated token of a /generate request, by "
+            "served model", "model")
+        self.serve_tpot_seconds = Histogram(
+            "kubeml_serve_tpot_seconds",
+            "Mean per-output-token decode latency of a /generate "
+            "request after its first token, by served model", "model")
+        self.serve_e2e_seconds = Histogram(
+            "kubeml_serve_e2e_seconds",
+            "End-to-end latency of a /generate request, by served model",
+            "model")
+        self.serve_active_slots = Gauge(
+            "kubeml_serve_active_slots",
+            "Decode slots occupied by in-flight streams of a served "
+            "model", "model")
+        self.serve_queue_depth = Gauge(
+            "kubeml_serve_queue_depth",
+            "Admitted /generate requests waiting for a decode slot, by "
+            "served model", "model")
+        self.serve_kv_utilization = Gauge(
+            "kubeml_serve_kv_page_utilization",
+            "Fraction of a served model's KV cache pages in use", "model")
+        self.serve_requests_total = Counter(
+            "kubeml_serve_requests_total",
+            "Finished /generate requests by served model and outcome "
+            "(ok|rejected|cancelled|error)", ("model", "outcome"))
+        self.serve_tokens_total = Counter(
+            "kubeml_serve_tokens_total",
+            "Tokens generated by a served model", "model")
+        # checkpoint-LRU (infer cache) instrumentation: entries resident
+        # plus hit/miss traffic, labelled by cache in case more
+        # deserialization caches grow later
+        self.infer_cache_entries = Gauge(
+            "kubeml_infer_cache_entries",
+            "Deserialized checkpoints resident in an inference cache",
+            "cache")
+        self.infer_cache_hits_total = Counter(
+            "kubeml_infer_cache_hits_total",
+            "Inference-cache lookups served without touching storage",
+            "cache")
+        self.infer_cache_misses_total = Counter(
+            "kubeml_infer_cache_misses_total",
+            "Inference-cache lookups that deserialized a checkpoint",
+            "cache")
         # MetricUpdate carries these as cumulative-over-the-job values;
         # the counters advance by delta so they stay monotone even when
         # an update is replayed after a job restart
@@ -445,6 +495,17 @@ class MetricsRegistry:
         self._job_counters = [self.health_alerts_total,
                               self.jit_compiles_total,
                               self.trace_dropped_total]
+        self._serve_gauges = [self.serve_active_slots,
+                              self.serve_queue_depth,
+                              self.serve_kv_utilization,
+                              self.infer_cache_entries]
+        self._serve_hists = [self.serve_ttft_seconds,
+                             self.serve_tpot_seconds,
+                             self.serve_e2e_seconds]
+        self._serve_counters = [self.serve_requests_total,
+                                self.serve_tokens_total,
+                                self.infer_cache_hits_total,
+                                self.infer_cache_misses_total]
 
     def update_job(self, m) -> None:
         """Apply a MetricUpdate (ml/pkg/ps/metrics.go:90-99)."""
@@ -521,6 +582,47 @@ class MetricsRegistry:
     def note_health_alert(self, job_id: str, rule: str) -> None:
         self.health_alerts_total.inc((job_id, rule))
 
+    # ------------------------------------------------------- serving plane
+
+    def observe_serve_request(self, model: str, outcome: str) -> None:
+        self.serve_requests_total.inc((model, outcome))
+
+    def observe_serve_latency(self, model: str, ttft: float = None,
+                              tpot: float = None,
+                              e2e: float = None) -> None:
+        if ttft is not None:
+            self.serve_ttft_seconds.observe(model, ttft)
+        if tpot is not None:
+            self.serve_tpot_seconds.observe(model, tpot)
+        if e2e is not None:
+            self.serve_e2e_seconds.observe(model, e2e)
+
+    def set_serve_state(self, model: str, active_slots: float,
+                        queue_depth: float, kv_utilization: float) -> None:
+        self.serve_active_slots.set(model, active_slots)
+        self.serve_queue_depth.set(model, queue_depth)
+        self.serve_kv_utilization.set(model, kv_utilization)
+
+    def note_serve_tokens(self, model: str, n: int) -> None:
+        self.serve_tokens_total.inc(model, n)
+
+    def clear_serve(self, model: str) -> None:
+        for g in (self.serve_active_slots, self.serve_queue_depth,
+                  self.serve_kv_utilization):
+            g.clear(model)
+        for h in self._serve_hists:
+            h.clear(model)
+        for c in (self.serve_requests_total, self.serve_tokens_total):
+            c.clear_prefix(model)
+
+    def note_infer_cache(self, hit: bool, cache: str = "checkpoints") -> None:
+        (self.infer_cache_hits_total if hit
+         else self.infer_cache_misses_total).inc(cache)
+
+    def set_infer_cache_entries(self, n: int,
+                                cache: str = "checkpoints") -> None:
+        self.infer_cache_entries.set(cache, n)
+
     def clear_job(self, job_id: str) -> None:
         for g in self._job_gauges:
             g.clear(job_id)
@@ -541,5 +643,7 @@ class MetricsRegistry:
                                         self.health_alerts_total,
                                         self.jit_compiles_total,
                                         self.trace_dropped_total]
-                    + self._job_multi + self._job_hists)
+                    + self._job_multi + self._job_hists
+                    + self._serve_gauges + self._serve_counters
+                    + self._serve_hists)
         return "\n".join(f.collect() for f in families) + "\n"

@@ -425,6 +425,113 @@ def _prompt_lengths(window: np.ndarray) -> np.ndarray:
                     Tp - np.argmax(real[:, ::-1], axis=1), 0)
 
 
+def build_paged_decode_step(module: GPTModule):
+    """One-token-per-slot decode step over a PAGED KV cache — the
+    serving plane's persistent program (serve/engine.py).
+
+    The module's own decode path (decode=True above) grows one
+    contiguous [B, cache_len] cache per batch and retraces per (B, Tp,
+    n_new) shape — fine for offline generate(), wrong for serving where
+    requests join and leave continuously. This builder re-expresses the
+    SAME math (identical flax submodule kinds applied to the same
+    parameter subtrees, the same NEG_INF bias convention, the same
+    f32-softmax attention primitive) as a single fixed-shape step:
+
+      step(params, k_pages, v_pages, valid_pages,
+           tokens[S], pos[S], page_tables[S, Pmax],
+           write_page[S], write_off[S], active[S], temps[S],
+           key_data[S, 2])
+        -> (next_tokens[S], k_pages, v_pages, valid_pages)
+
+    Every per-request quantity is DATA (the kavg worker-mask trick), so
+    slot membership changes never recompile. Inactive slots compute
+    garbage rows whose K/V scatter lands on the reserved null page 0
+    with validity 0 — written but never attended. Each active slot
+    consumes its token at position pos (prompt tokens one per step
+    during its prefill phase, then its own previous output) and the
+    returned row is its next-token pick: greedy at temps<=0, else
+    categorical over logits/temp keyed by that slot's own key_data —
+    per-(request, position) keys, so sampling is independent of which
+    other requests happen to share the batch (bit-identity under
+    continuous batching, proven in tests/test_serving.py).
+
+    Slots are rows: no cross-slot reduction exists anywhere in the
+    step, which is what makes concurrent decode bit-identical to
+    running the same requests one at a time.
+    """
+    if module.n_experts or module.seq_axis is not None \
+            or module.tp_axis is not None:
+        raise ValueError(
+            "paged decode serves dense GPT modules only (no MoE, "
+            "sequence-parallel, or manual-TP variants)")
+    heads, hidden = module.heads, module.hidden
+    head_dim = hidden // heads
+    dtype = module.dtype
+    from kubeml_tpu.ops.attention import NEG_INF, multi_head_attention
+    tok_embed = nn.Embed(module.vocab_size, hidden, dtype=dtype)
+    pos_embed = nn.Embed(module.max_len, hidden, dtype=dtype)
+    ln = nn.LayerNorm(dtype=jnp.float32)
+    qkv = nn.DenseGeneral((heads, head_dim), dtype=dtype)
+    out_proj = nn.DenseGeneral(hidden, axis=(-2, -1), dtype=dtype)
+    ffn_in = nn.Dense(module.ffn, dtype=dtype)
+    ffn_out = nn.Dense(hidden, dtype=dtype)
+
+    def step(params, k_pages, v_pages, valid_pages, tokens, pos,
+             page_tables, write_page, write_off, active, temps, key_data):
+        S = tokens.shape[0]
+        G = valid_pages.shape[1]
+        C = page_tables.shape[1] * G
+        h = tok_embed.apply({"params": params["tok_embed"]}, tokens[:, None])
+        h = h + pos_embed.apply({"params": params["pos_embed"]},
+                                pos[:, None])
+        # this token's validity, written BEFORE the gather so a slot's
+        # first token attends to itself (offset-0 decode semantics of
+        # the contiguous path). Inactive slots write 0 to the null page.
+        tok_valid = active * (tokens != PAD_ID).astype(jnp.float32)
+        valid_pages = valid_pages.at[write_page, write_off].set(tok_valid)
+        ctx_valid = valid_pages[page_tables].reshape(S, C)
+        causal = (jnp.arange(C)[None, :] <= pos[:, None]) \
+            .astype(jnp.float32)
+        bias = (1.0 - ctx_valid * causal)[:, None, None, :] * NEG_INF
+        for i in range(module.layers):
+            p = params[f"layer_{i}"]
+            x = ln.apply({"params": p["LayerNorm_0"]}, h)
+            q = qkv.apply({"params": p["q"]}, x)
+            k = qkv.apply({"params": p["k"]}, x)
+            v = qkv.apply({"params": p["v"]}, x)
+            k_pages = k_pages.at[i, write_page, write_off].set(
+                k[:, 0].astype(dtype))
+            v_pages = v_pages.at[i, write_page, write_off].set(
+                v[:, 0].astype(dtype))
+            ck = k_pages[i][page_tables].reshape(S, C, heads, head_dim)
+            cv = v_pages[i][page_tables].reshape(S, C, heads, head_dim)
+            attn = multi_head_attention(q, ck, cv, bias)
+            attn = out_proj.apply({"params": p["out"]}, attn)
+            h = h + attn
+            x = ln.apply({"params": p["LayerNorm_1"]}, h)
+            x = ffn_in.apply({"params": p["Dense_0"]}, x)
+            x = nn.gelu(x)
+            x = ffn_out.apply({"params": p["Dense_1"]}, x)
+            h = h + x
+        h = ln.apply({"params": params["LayerNorm_0"]}, h)
+        logits = tok_embed.apply(
+            {"params": params["tok_embed"]}, h.astype(dtype),
+            method=tok_embed.attend).astype(jnp.float32)[:, 0]
+        logits = logits.at[:, PAD_ID].set(-jnp.inf)  # never emit PAD
+
+        def pick_one(kd, lg, t):
+            greedy = jnp.argmax(lg, axis=-1).astype(jnp.int32)
+            safe_t = jnp.where(t > 0, t, 1.0)
+            sampled = jax.random.categorical(
+                jax.random.wrap_key_data(kd), lg / safe_t).astype(jnp.int32)
+            return jnp.where(t > 0, sampled, greedy)
+
+        nxt = jax.vmap(pick_one)(key_data, logits, temps)
+        return nxt, k_pages, v_pages, valid_pages
+
+    return step
+
+
 def _lm_per_example(logits: jax.Array, x: jax.Array) -> jax.Array:
     """Per-sequence mean next-token cross-entropy [B] — THE LM loss
     definition shared by the dense and MoE model classes."""
@@ -1009,6 +1116,20 @@ class GPTMini(KubeModel):
                 fwd, mesh=mesh, in_specs=(P(), P(None, SEQ_AXIS)),
                 out_specs=P(None, SEQ_AXIS), check_vma=False))
         return self._sp_cache[key](variables, x)
+
+
+@register_model("gpt-nano")
+class GPTNano(GPTMini):
+    """~60k-param 2-layer LM for the CPU tier: serving smoke tests and
+    the bench closed-loop arm need a module whose paged decode step
+    compiles in seconds, not minutes. Same architecture/param tree as
+    gpt-mini, so everything that serves gpt-mini serves this."""
+
+    name = "gpt-nano"
+
+    def build(self):
+        return GPTModule(vocab_size=512, max_len=64, hidden=32, layers=2,
+                         heads=2, ffn=64, dropout=0.0)
 
 
 @register_model("gpt-moe-mini")

@@ -1,0 +1,29 @@
+"""Production inference plane: continuous-batching decode over a paged
+KV cache (ROADMAP item 1).
+
+The training side already solved "membership changes without
+recompiles" with mask lanes (parallel/kavg.py): the jitted program is
+fixed-shape, and who participates is DATA. Serving reuses exactly that
+trick for requests instead of workers — one persistent decode program
+over a fixed pool of S slots, where each dispatch advances every ACTIVE
+slot by one token and joins/leaves only flip host-side masks and page
+tables. The KV cache behind it is paged (vLLM/PagedAttention lineage):
+fixed-size token pages allocated from one HBM slab, a per-slot page
+table, pages recycled the moment a stream finishes.
+
+Modules:
+  pager.py    page geometry, the HBM slab arrays, host free-list allocator
+  engine.py   the jitted one-token-per-slot decode step + slot state
+  slots.py    request objects, event streams, admission errors
+  service.py  the background serving loop the PS mounts at POST /generate
+"""
+
+from kubeml_tpu.serve.engine import DecodeEngine
+from kubeml_tpu.serve.pager import KVPageSlab, PageAllocator, PageGeometry
+from kubeml_tpu.serve.service import ServeService
+from kubeml_tpu.serve.slots import GenerateRequest, ServeSaturated
+
+__all__ = [
+    "DecodeEngine", "GenerateRequest", "KVPageSlab", "PageAllocator",
+    "PageGeometry", "ServeSaturated", "ServeService",
+]

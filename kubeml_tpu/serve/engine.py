@@ -1,0 +1,253 @@
+"""Continuous-batching decode engine: slot state + the persistent step.
+
+One jitted program serves every stream: each dispatch advances every
+active slot by one token (prompt tokens during that slot's prefill
+phase — their logits are discarded until the last prompt token — then
+its own feedback). Joins and leaves are host-side edits to the active
+mask and page tables, so the program compiles ONCE per engine and the
+compile count stays flat no matter how requests churn (pinned by
+JitCompileTracker in tests/test_serving.py).
+
+Determinism contract (what the bit-identity tests rely on): slot math
+is row-independent, pages held by different requests are disjoint, the
+attention softmax always runs over the full fixed context C with
+invalid positions masked, and sampling keys derive from (request seed,
+position) only. A request therefore generates the exact same tokens
+whether it runs alone or packed with seven neighbours.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from typing import Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from kubeml_tpu.metrics.runtime import JitCompileTracker
+from kubeml_tpu.models.base import InferenceInputError
+from kubeml_tpu.models.gpt import PAD_ID, build_paged_decode_step
+from kubeml_tpu.serve.pager import KVPageSlab, PageAllocator, PageGeometry
+from kubeml_tpu.serve.slots import GenerateRequest
+
+logger = logging.getLogger("kubeml_tpu.serve.engine")
+
+
+class _Slot:
+    """Host-side state of one occupied decode slot."""
+
+    __slots__ = ("req", "pos", "prompt", "n_prompt", "seq")
+
+    def __init__(self, req: GenerateRequest, prompt: List[int], seq: int):
+        self.req = req
+        self.prompt = prompt
+        self.n_prompt = len(prompt)
+        self.pos = 0          # next position to consume
+        self.seq = seq        # admission order (newest-stall shedding)
+
+
+class DecodeEngine:
+    """Fixed pool of S decode slots over one paged KV slab.
+
+    Not thread-safe by itself: attach/step/cancel belong to the serving
+    loop thread (ServeService). Reads used for admission accounting
+    (free_slots, stats) are safe from other threads.
+    """
+
+    def __init__(self, module, variables, geom: Optional[PageGeometry] = None,
+                 slots: int = 8, page: int = 16,
+                 clock=time.perf_counter):
+        self.module = module
+        self._step_raw = build_paged_decode_step(module)  # validates module
+        self.geom = geom or PageGeometry.for_module(
+            slots=slots, page=page, max_len=module.max_len)
+        self.clock = clock
+        head_dim = module.hidden // module.heads
+        self.slab = KVPageSlab(self.geom, module.layers, module.heads,
+                               head_dim, module.dtype)
+        self.pager = PageAllocator(self.geom)
+        # donating the slab buffers keeps HBM flat across steps; the CPU
+        # backend warns (donation unimplemented), so gate on backend
+        donate = () if jax.default_backend() == "cpu" else (1, 2, 3)
+        self._step = jax.jit(self._step_raw, donate_argnums=donate)
+        self._params = jax.device_put(variables["params"])
+        S, Pmax = self.geom.slots, self.geom.pages_per_slot
+        self._tables = np.zeros((S, Pmax), np.int32)
+        self._slots: List[Optional[_Slot]] = [None] * S
+        self._seq = 0
+        self.compile_tracker = JitCompileTracker()
+        self.stats: Dict[str, float] = {
+            "dispatches": 0, "generated_tokens": 0, "occupancy_sum": 0,
+            "stalls": 0, "compiles": 0,
+        }
+
+    # ------------------------------------------------------------- capacity
+    @property
+    def slot_count(self) -> int:
+        return self.geom.slots
+
+    def active(self) -> int:
+        return sum(1 for s in self._slots if s is not None)
+
+    def free_slots(self) -> int:
+        return self.geom.slots - self.active()
+
+    def kv_utilization(self) -> float:
+        return self.pager.utilization()
+
+    # ------------------------------------------------------------ lifecycle
+    def check_admissible(self, prompt: List[int],
+                         max_new_tokens: int) -> List[int]:
+        """Validate + normalize a prompt at admission time (HTTP thread,
+        before the request ever reaches a slot). Trailing pads are
+        stripped — generate() conditions on the last REAL token, and
+        feeding trailing pads would burn context on masked garbage;
+        interior pads stay, as masked-but-position-holding context."""
+        prompt = [int(t) for t in prompt]
+        while prompt and prompt[-1] == PAD_ID:
+            prompt.pop()
+        if not prompt:
+            raise InferenceInputError(
+                "prompt needs at least one non-pad token")
+        if max_new_tokens < 1:
+            raise InferenceInputError("max_new_tokens must be >= 1")
+        limit = min(self.geom.context, self.module.max_len)
+        if len(prompt) + max_new_tokens > limit:
+            raise InferenceInputError(
+                f"prompt ({len(prompt)}) + max_new_tokens "
+                f"({max_new_tokens}) exceeds the serving context limit "
+                f"{limit} (min of KV pages per slot x page size and the "
+                f"model's max_len)")
+        return prompt
+
+    def attach(self, req: GenerateRequest) -> int:
+        """Claim a free slot for a validated request; returns the slot."""
+        prompt = self.check_admissible(req.prompt, req.max_new_tokens)
+        for s, cur in enumerate(self._slots):
+            if cur is None:
+                self._slots[s] = _Slot(req, prompt, self._seq)
+                self._seq += 1
+                return s
+        raise RuntimeError("attach() with no free slot — admission "
+                           "accounting is broken")
+
+    def release(self, s: int, outcome: str,
+                error: Optional[str] = None) -> None:
+        """Free a slot and its pages; emits the request's terminal event."""
+        slot = self._slots[s]
+        if slot is None:
+            return
+        held = [int(p) for p in self._tables[s] if p]
+        if held:
+            self.pager.free(held)
+        self._tables[s] = 0
+        self._slots[s] = None
+        slot.req.finished_at = self.clock()
+        slot.req.finish(outcome, error)
+
+    def cancel_request(self, req: GenerateRequest) -> bool:
+        for s, slot in enumerate(self._slots):
+            if slot is not None and slot.req is req:
+                self.release(s, "cancelled")
+                return True
+        return False
+
+    # ----------------------------------------------------------------- step
+    def step(self) -> List[GenerateRequest]:
+        """One dispatch: advance every active slot by one token. Returns
+        requests that reached a terminal state this step."""
+        S = self.geom.slots
+        G = self.geom.page
+        tokens = np.zeros(S, np.int32)
+        pos = np.zeros(S, np.int32)
+        write_page = np.zeros(S, np.int32)
+        write_off = np.zeros(S, np.int32)
+        active = np.zeros(S, np.float32)
+        temps = np.zeros(S, np.float32)
+        key_data = np.zeros((S, 2), np.uint32)
+        stalled: List[int] = []
+
+        # reap cancellations FIRST: a cancelled slot's pages go back to
+        # the pool before this dispatch's tables are snapshotted, so the
+        # device never writes through a freed page
+        finished: List[GenerateRequest] = []
+        for s, slot in enumerate(self._slots):
+            if slot is not None and slot.req.cancelled:
+                req = slot.req
+                self.release(s, "cancelled")
+                finished.append(req)
+
+        for s, slot in enumerate(self._slots):
+            if slot is None:
+                continue
+            pi = slot.pos // G
+            if self._tables[s, pi] == 0:
+                pid = self.pager.alloc()
+                if pid is None:
+                    stalled.append(s)   # no page: sit this step out
+                    continue
+                self._tables[s, pi] = pid
+            active[s] = 1.0
+            tokens[s] = slot.prompt[slot.pos] if slot.pos < slot.n_prompt \
+                else slot.req.tokens[-1]
+            pos[s] = slot.pos
+            write_page[s] = self._tables[s, pi]
+            write_off[s] = slot.pos % G
+            temps[s] = slot.req.temperature
+            # per-(request, position) key: sampling is independent of
+            # co-resident streams — the sampled-path bit-identity hinge
+            key_data[s] = (np.uint32(slot.req.seed & 0xFFFFFFFF),
+                           np.uint32(slot.pos))
+
+        n_active = int(active.sum())
+        if n_active == 0:
+            if stalled:
+                # every runnable slot is out of pages: shed the NEWEST
+                # stream (oldest is closest to finishing and freeing)
+                self.stats["stalls"] += len(stalled)
+                victim = max(stalled, key=lambda s: self._slots[s].seq)
+                req = self._slots[victim].req
+                logger.warning("KV slab exhausted with all slots stalled; "
+                               "shedding newest stream")
+                self.release(victim, "error",
+                             "KV cache pages exhausted; request shed")
+                finished.append(req)
+            return finished
+        if stalled:
+            self.stats["stalls"] += len(stalled)
+
+        before = self._step._cache_size()
+        t0 = self.clock()
+        nxt, self.slab.k, self.slab.v, self.slab.valid = self._step(
+            self._params, self.slab.k, self.slab.v, self.slab.valid,
+            jnp.asarray(tokens), jnp.asarray(pos),
+            jnp.asarray(self._tables), jnp.asarray(write_page),
+            jnp.asarray(write_off), jnp.asarray(active),
+            jnp.asarray(temps), jnp.asarray(key_data))
+        compiled = self._step._cache_size() > before
+        self.compile_tracker.note(compiled, self.clock() - t0)
+        self.stats["dispatches"] += 1
+        self.stats["compiles"] += int(compiled)
+        self.stats["occupancy_sum"] += n_active
+        nxt_host = np.asarray(nxt)
+
+        for s, slot in enumerate(self._slots):
+            if slot is None or active[s] == 0.0:
+                continue
+            p = slot.pos
+            slot.pos = p + 1
+            if p < slot.n_prompt - 1:
+                continue  # prefill phase: output discarded
+            tok = int(nxt_host[s])
+            if slot.req.first_token_at is None:
+                slot.req.first_token_at = self.clock()
+            slot.req.emit_token(tok)
+            self.stats["generated_tokens"] += 1
+            if (slot.req.eos_id is not None and tok == slot.req.eos_id) \
+                    or len(slot.req.tokens) >= slot.req.max_new_tokens:
+                self.release(s, "ok")
+                finished.append(slot.req)
+        return finished

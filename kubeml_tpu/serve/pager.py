@@ -1,0 +1,132 @@
+"""Paged KV cache: geometry, the HBM slab, and the host page allocator.
+
+Why pages instead of one [S, max_len] cache per slot: decode is
+HBM-bound (batch 16 gives 2,374 tok/s vs 251 at batch 1 on v5e —
+results/text-bench-v5e.jsonl), so cache capacity IS serving capacity.
+A contiguous per-slot cache reserves max_len tokens of HBM for every
+request up front; real streams vary wildly in length, so most of that
+is dead. Fixed-size pages from a shared slab (the PagedAttention idea)
+let a short stream hold two pages while a long one holds thirty, and a
+finished stream's pages go back to the pool the same step.
+
+Page 0 is RESERVED as the null page: inactive slots' scatter writes
+land there (the jitted step always writes S rows — masking is data, not
+shape), page-table tails point there, and its validity row stays zero
+so gathers through it never contribute to attention. The allocator
+simply never hands it out.
+
+Allocation is host-side (a free list) because page tables are host
+inputs to the jitted step — the device program only ever gathers
+through tables it is given, so there is no device-side bookkeeping to
+keep coherent.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Sequence
+
+import jax.numpy as jnp
+
+
+@dataclasses.dataclass(frozen=True)
+class PageGeometry:
+    """Static shape of the paged cache — any change here recompiles, so
+    everything per-request must live in the arrays, not here."""
+
+    slots: int            # S: concurrent streams the step serves
+    page: int             # G: tokens per page
+    pages: int            # P: physical pages in the slab, incl. null page 0
+    pages_per_slot: int   # Pmax: page-table width = context cap / G
+
+    def __post_init__(self):
+        if self.slots < 1 or self.page < 1 or self.pages_per_slot < 1:
+            raise ValueError(f"degenerate page geometry: {self}")
+        if self.pages < 2:
+            raise ValueError("need at least one usable page besides the "
+                             "reserved null page 0")
+
+    @property
+    def context(self) -> int:
+        """Max tokens (prompt + generated) one slot can hold."""
+        return self.pages_per_slot * self.page
+
+    @property
+    def usable_pages(self) -> int:
+        return self.pages - 1  # page 0 is the null page
+
+    @classmethod
+    def for_module(cls, slots: int, page: int, max_len: int,
+                   pages: int = 0) -> "PageGeometry":
+        """Geometry sized so a slot can reach the module's max_len; by
+        default the slab holds every slot at full context (no stalls),
+        a smaller explicit `pages` turns on real contention."""
+        pps = -(-max_len // page)
+        return cls(slots=slots, page=page,
+                   pages=pages or slots * pps + 1, pages_per_slot=pps)
+
+
+class KVPageSlab:
+    """The device-resident arrays: K/V pages for every layer plus the
+    shared per-page validity plane.
+
+    k/v: [L, P, G, H, Dh] in the module dtype — the jitted step scatters
+    one token row per active slot per dispatch and gathers each slot's
+    table-worth back as its attention context. valid: [P, G] float32 —
+    1.0 where a real (non-pad, active) token was written; multiplied
+    into the attention bias so null/stale positions read as masked, not
+    as garbage.
+    """
+
+    def __init__(self, geom: PageGeometry, layers: int, heads: int,
+                 head_dim: int, dtype=jnp.bfloat16):
+        self.geom = geom
+        shape = (layers, geom.pages, geom.page, heads, head_dim)
+        self.k = jnp.zeros(shape, dtype)
+        self.v = jnp.zeros(shape, dtype)
+        self.valid = jnp.zeros((geom.pages, geom.page), jnp.float32)
+
+    @property
+    def device_bytes(self) -> int:
+        return int(self.k.nbytes + self.v.nbytes + self.valid.nbytes)
+
+
+class PageAllocator:
+    """Host free-list over pages 1..P-1 (page 0 reserved null).
+
+    alloc() returns the lowest free id (deterministic — the bit-identity
+    tests replay the same allocation sequence) or None when the slab is
+    exhausted; the engine turns None into a slot STALL, never an error,
+    and the service sheds load before stalls can deadlock.
+    """
+
+    def __init__(self, geom: PageGeometry):
+        self.geom = geom
+        # pop() takes from the tail; store descending so ids come out 1, 2, …
+        self._free: List[int] = list(range(geom.pages - 1, 0, -1))
+
+    def alloc(self):
+        return self._free.pop() if self._free else None
+
+    def free(self, page_ids: Sequence[int]) -> None:
+        for pid in page_ids:
+            pid = int(pid)
+            if not 0 < pid < self.geom.pages:
+                raise ValueError(f"freeing page {pid} outside slab "
+                                 f"(1..{self.geom.pages - 1})")
+            if pid in self._free:
+                raise ValueError(f"double free of page {pid}")
+            self._free.append(pid)
+        # keep lowest-id-first allocation after churn (determinism)
+        self._free.sort(reverse=True)
+
+    @property
+    def free_pages(self) -> int:
+        return len(self._free)
+
+    @property
+    def in_use(self) -> int:
+        return self.geom.usable_pages - len(self._free)
+
+    def utilization(self) -> float:
+        return self.in_use / self.geom.usable_pages

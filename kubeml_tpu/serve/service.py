@@ -1,0 +1,222 @@
+"""The serving loop: admission control in front, the decode engine behind.
+
+One background thread per served model owns the engine (slot state and
+the jitted step are single-threaded by design); HTTP threads only
+enqueue validated requests and drain event queues. Admission is
+accounted with a single in-flight counter under the condition variable
+— capacity = slots + queue cap — so the 429 decision is deterministic
+and independent of how far the loop happens to have drained (the
+saturation tests rely on that).
+
+SLO telemetry: per-request TTFT/TPOT/e2e land in the serve Histogram
+families (metrics/prom.py), occupancy/queue/KV-utilization in gauges,
+and every loop pass publishes a health snapshot under the pseudo job id
+``serve:<model>`` so the PR-5 rule pipeline (control/health.py) and
+``kubeml top`` see the serving plane exactly like a training job.
+"""
+
+from __future__ import annotations
+
+import collections
+import logging
+import threading
+import time
+from typing import Callable, Deque, List, Optional
+
+from kubeml_tpu.serve.engine import DecodeEngine
+from kubeml_tpu.serve.slots import GenerateRequest, ServeSaturated
+
+logger = logging.getLogger("kubeml_tpu.serve.service")
+
+# recent-TTFT window for the host-side p50/p99 the health rules consume
+TTFT_WINDOW = 128
+
+
+def _percentile(sorted_vals: List[float], q: float) -> float:
+    if not sorted_vals:
+        return 0.0
+    idx = min(len(sorted_vals) - 1, int(q * (len(sorted_vals) - 1) + 0.5))
+    return sorted_vals[idx]
+
+
+class ServeService:
+    """Continuous-batching serving loop for one model."""
+
+    def __init__(self, model_id: str, engine: DecodeEngine,
+                 max_queue: int = 16, metrics=None,
+                 health_cb: Optional[Callable[[dict], None]] = None,
+                 clock=time.perf_counter):
+        self.model_id = model_id
+        self.engine = engine
+        self.max_queue = int(max_queue)
+        self.metrics = metrics
+        self.health_cb = health_cb
+        self.clock = clock
+        self._cv = threading.Condition()
+        self._pending: Deque[GenerateRequest] = collections.deque()
+        self._inflight = 0          # admitted, not yet terminal
+        self._stopped = False
+        self.rejected_total = 0
+        self._ttfts: Deque[float] = collections.deque(maxlen=TTFT_WINDOW)
+        self._thread = threading.Thread(
+            target=self._loop, name=f"serve-{model_id}", daemon=True)
+
+    # -------------------------------------------------------------- clients
+    def start(self) -> "ServeService":
+        self._thread.start()
+        return self
+
+    def submit(self, prompt, max_new_tokens: int = 32,
+               temperature: float = 0.0, seed: int = 0,
+               eos_id: Optional[int] = None) -> GenerateRequest:
+        """Admit a request or shed it. Raises InferenceInputError (400)
+        on a bad prompt, ServeSaturated (429) at capacity."""
+        req = GenerateRequest(prompt, max_new_tokens=max_new_tokens,
+                              temperature=temperature, seed=seed,
+                              eos_id=eos_id)
+        # validate on the HTTP thread: bad input must 400 before it
+        # costs a slot (also strips trailing pads)
+        req.prompt = self.engine.check_admissible(req.prompt,
+                                                  req.max_new_tokens)
+        with self._cv:
+            if self._stopped:
+                raise ServeSaturated(message="serving loop stopped")
+            if self._inflight >= self.engine.slot_count + self.max_queue:
+                self.rejected_total += 1
+                self._note_outcome("rejected")
+                raise ServeSaturated()
+            self._inflight += 1
+            req.submitted_at = self.clock()
+            self._pending.append(req)
+            self._cv.notify()
+        return req
+
+    def cancel(self, req: GenerateRequest) -> None:
+        req.cancel()
+        with self._cv:
+            self._cv.notify()
+
+    def stop(self, timeout: float = 10.0) -> None:
+        with self._cv:
+            self._stopped = True
+            self._cv.notify()
+        if self._thread.is_alive():
+            self._thread.join(timeout)
+
+    # ----------------------------------------------------------------- loop
+    def _loop(self) -> None:
+        while True:
+            with self._cv:
+                while not self._stopped and not self._pending \
+                        and self.engine.active() == 0:
+                    self._publish()
+                    self._cv.wait()
+                if self._stopped:
+                    break
+                while self._pending and self.engine.free_slots() > 0:
+                    req = self._pending.popleft()
+                    if req.cancelled:
+                        self._terminal(req, "cancelled")
+                        continue
+                    try:
+                        self.engine.attach(req)
+                    except Exception as e:  # geometry raced a config change
+                        self._terminal(req, "error", str(e))
+            try:
+                finished = self.engine.step()
+            except Exception as e:
+                logger.exception("decode step failed; failing active "
+                                 "streams")
+                with self._cv:
+                    for s in range(self.engine.slot_count):
+                        slot = self.engine._slots[s]
+                        if slot is not None:
+                            req = slot.req
+                            self.engine.release(s, "error",
+                                                f"decode step failed: {e}")
+                            self._terminal(req, None)
+                continue
+            with self._cv:
+                for req in finished:
+                    self._terminal(req, None)
+            self._publish()
+        # drained on stop: fail whatever is left so no client hangs
+        with self._cv:
+            while self._pending:
+                self._terminal(self._pending.popleft(), "error",
+                               "serving loop stopped")
+            for s in range(self.engine.slot_count):
+                slot = self.engine._slots[s]
+                if slot is not None:
+                    req = slot.req
+                    self.engine.release(s, "error", "serving loop stopped")
+                    self._terminal(req, None)
+        self._publish()
+
+    def _terminal(self, req: GenerateRequest, outcome: Optional[str],
+                  error: Optional[str] = None) -> None:
+        """Account one request reaching a terminal state (cv held).
+        outcome None means the engine already called req.finish()."""
+        if outcome is not None:
+            if req.finished_at is None:
+                req.finished_at = self.clock()
+            req.finish(outcome, error)
+        self._inflight = max(0, self._inflight - 1)
+        self._observe(req)
+
+    # ------------------------------------------------------------ telemetry
+    def _note_outcome(self, outcome: str) -> None:
+        if self.metrics is not None:
+            self.metrics.observe_serve_request(self.model_id, outcome)
+
+    def _observe(self, req: GenerateRequest) -> None:
+        self._note_outcome(req.outcome or "error")
+        if req.first_token_at is not None and req.submitted_at is not None:
+            self._ttfts.append(req.first_token_at - req.submitted_at)
+        if self.metrics is None:
+            return
+        if req.tokens:
+            self.metrics.note_serve_tokens(self.model_id, len(req.tokens))
+        if req.outcome == "ok" and req.submitted_at is not None \
+                and req.first_token_at is not None \
+                and req.finished_at is not None:
+            decode = req.finished_at - req.first_token_at
+            self.metrics.observe_serve_latency(
+                self.model_id,
+                ttft=req.first_token_at - req.submitted_at,
+                tpot=decode / max(1, len(req.tokens) - 1),
+                e2e=req.finished_at - req.submitted_at)
+
+    def ttft_percentiles(self) -> dict:
+        vals = sorted(self._ttfts)
+        return {"p50": _percentile(vals, 0.50),
+                "p99": _percentile(vals, 0.99)}
+
+    def snapshot(self) -> dict:
+        """Health-pipeline sample for the serve:<model> pseudo job."""
+        p = self.ttft_percentiles()
+        return {
+            "job_id": f"serve:{self.model_id}",
+            "serve_active_slots": self.engine.active(),
+            "serve_slot_cap": self.engine.slot_count,
+            "serve_queue_depth": len(self._pending),
+            "serve_queue_cap": self.max_queue,
+            "serve_kv_page_utilization": round(
+                self.engine.kv_utilization(), 4),
+            "serve_rejected_total": self.rejected_total,
+            "serve_ttft_p50": round(p["p50"], 6),
+            "serve_ttft_p99": round(p["p99"], 6),
+        }
+
+    def _publish(self) -> None:
+        snap = self.snapshot()
+        if self.metrics is not None:
+            self.metrics.set_serve_state(
+                self.model_id, snap["serve_active_slots"],
+                snap["serve_queue_depth"],
+                snap["serve_kv_page_utilization"])
+        if self.health_cb is not None:
+            try:
+                self.health_cb(snap)
+            except Exception:
+                logger.exception("serve health callback failed")

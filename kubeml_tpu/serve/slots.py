@@ -1,0 +1,109 @@
+"""Request objects and admission errors for the decode service.
+
+A GenerateRequest is the handle shared between the submitting HTTP
+thread and the serving loop: the loop pushes per-token events onto the
+request's queue as they come off the device, the HTTP thread drains
+them into chunked-response lines. Cancellation is a flag the loop
+checks each step — the device program itself never blocks on a client.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import List, Optional
+
+from kubeml_tpu.api.errors import KubeMLException
+
+
+class ServeSaturated(KubeMLException):
+    """Admission refused: every slot busy and the queue at cap. Maps to
+    429 + Retry-After — the load-shedding contract is that saturation
+    costs the CLIENT a retry, never the server unbounded queue memory."""
+
+    def __init__(self, retry_after_s: float = 1.0,
+                 message: str = "serving at capacity: all decode slots "
+                                "busy and admission queue full"):
+        super().__init__(message, 429)
+        self.retry_after_s = retry_after_s
+
+
+class GenerateRequest:
+    """One generation stream, from admission to EOS/cancel/shed.
+
+    Token ids only (the framework has no tokenizer — same contract as
+    /infer): `prompt` is a list of ints, generated ids accumulate in
+    `tokens`. Timestamps are filled by the service for the SLO
+    histograms: TTFT = first_token_at - submitted_at, e2e =
+    finished_at - submitted_at, TPOT = decode cadence after the first
+    token.
+    """
+
+    def __init__(self, prompt: List[int], max_new_tokens: int = 32,
+                 temperature: float = 0.0, seed: int = 0,
+                 eos_id: Optional[int] = None):
+        self.prompt = [int(t) for t in prompt]
+        self.max_new_tokens = int(max_new_tokens)
+        self.temperature = float(temperature)
+        self.seed = int(seed)
+        self.eos_id = None if eos_id is None else int(eos_id)
+        self.tokens: List[int] = []          # generated ids, in order
+        self.events: "queue.Queue[dict]" = queue.Queue()
+        self.outcome: Optional[str] = None   # ok|cancelled|error (terminal)
+        self.error: Optional[str] = None
+        self.submitted_at: Optional[float] = None
+        self.first_token_at: Optional[float] = None
+        self.finished_at: Optional[float] = None
+        self._cancel = threading.Event()
+        self._done = threading.Event()
+
+    # ------------------------------------------------------------- client side
+    def cancel(self) -> None:
+        self._cancel.set()
+
+    @property
+    def cancelled(self) -> bool:
+        return self._cancel.is_set()
+
+    @property
+    def done(self) -> bool:
+        return self._done.is_set()
+
+    def wait(self, timeout: Optional[float] = None) -> bool:
+        return self._done.wait(timeout)
+
+    def events_iter(self, timeout: float = 120.0):
+        """Yield event dicts ({"token": id} per token, then one
+        {"done"/"error": ...}) until the stream ends. The timeout guards
+        against a dead serving loop — a stalled stream ends with an
+        error event rather than hanging its HTTP thread forever."""
+        while True:
+            try:
+                ev = self.events.get(timeout=timeout)
+            except queue.Empty:
+                yield {"error": f"stream stalled for {timeout:g}s"}
+                return
+            yield ev
+            if "done" in ev or "error" in ev:
+                return
+
+    # ------------------------------------------------------------ engine side
+    def emit_token(self, token: int) -> None:
+        self.tokens.append(int(token))
+        self.events.put({"token": int(token)})
+
+    def finish(self, outcome: str, error: Optional[str] = None) -> None:
+        """Terminal transition; exactly one per request (the serving
+        loop owns it). Emits the closing event and releases waiters."""
+        if self.outcome is not None:
+            return
+        self.outcome = outcome
+        self.error = error
+        if outcome == "ok":
+            self.events.put({"done": True, "tokens": list(self.tokens)})
+        elif outcome == "cancelled":
+            self.events.put({"done": True, "cancelled": True,
+                             "tokens": list(self.tokens)})
+        else:
+            self.events.put({"error": error or outcome})
+        self._done.set()

@@ -1,0 +1,457 @@
+"""Inference-plane tests (kubeml_tpu/serve/ + the PS /generate route).
+
+The contracts pinned here are the ones the subsystem is built around:
+
+  * bit-identity — a request generates the SAME tokens continuously
+    batched with neighbours as it does running alone (slot math is
+    row-independent, pages disjoint, sampling keys per (seed, pos))
+  * compile pinning — joins/leaves/EOS churn slot membership as DATA;
+    the decode program compiles exactly once per engine
+    (JitCompileTracker), never per membership change
+  * page accounting — KV pages free on EOS/cancel and return to the
+    pool; exhaustion sheds the newest stream instead of deadlocking
+  * admission control — past slots+queue the PS answers 429 with
+    Retry-After; bad prompts 400 before costing a slot
+  * telemetry — serve histogram/gauge families pass the metrics lint
+    from the live PS exposition, and serve:<model> snapshots flow
+    through the health-rule pipeline into `kubeml top`
+"""
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+pytestmark = pytest.mark.serving
+
+
+def _nano():
+    import jax
+
+    from kubeml_tpu.models import get_builtin
+    model = get_builtin("gpt-nano")()
+    module = model.module
+    variables = model.init_variables(
+        jax.random.PRNGKey(0),
+        {"x": np.ones((1, module.max_len), np.int32)})
+    return model, module, variables
+
+
+def _drive(engine, limit=10_000):
+    """Step the engine until every slot drains; returns finished reqs."""
+    finished = []
+    while engine.active():
+        finished.extend(engine.step())
+        limit -= 1
+        assert limit > 0, "engine failed to drain"
+    return finished
+
+
+# ------------------------------------------------------------------ engine
+
+def test_concurrent_decode_bit_identical_to_sequential():
+    """Greedy and sampled requests produce identical tokens whether
+    they share the engine with neighbours or run one at a time."""
+    from kubeml_tpu.serve.engine import DecodeEngine
+    from kubeml_tpu.serve.slots import GenerateRequest
+
+    _model, module, variables = _nano()
+    specs = [([5, 6, 7], 6, 0.0, 0),
+             ([9, 10, 11, 12], 8, 0.7, 1),
+             ([3], 4, 1.3, 7)]
+
+    def make():
+        return [GenerateRequest(list(p), max_new_tokens=n, temperature=t,
+                                seed=s) for p, n, t, s in specs]
+
+    packed = DecodeEngine(module, variables, slots=4, page=4)
+    reqs_packed = make()
+    for r in reqs_packed:
+        packed.attach(r)
+    _drive(packed)
+
+    alone = DecodeEngine(module, variables, slots=4, page=4)
+    reqs_alone = make()
+    for r in reqs_alone:
+        alone.attach(r)
+        _drive(alone)
+
+    assert all(r.outcome == "ok" for r in reqs_packed + reqs_alone)
+    assert [r.tokens for r in reqs_packed] == [r.tokens for r in reqs_alone]
+    # sampled rows really sampled (different seeds diverge from greedy)
+    assert reqs_packed[1].tokens != reqs_packed[0].tokens[:8]
+
+
+def test_greedy_engine_matches_generate():
+    """The paged decode path reproduces the model's own KV-cache
+    generate() exactly for greedy decoding."""
+    from kubeml_tpu.serve.engine import DecodeEngine
+    from kubeml_tpu.serve.slots import GenerateRequest
+
+    model, module, variables = _nano()
+    prompt = [5, 6, 7, 8]
+    n_new = 6
+    ref = model.generate(variables, np.asarray([prompt], np.int32),
+                         max_new_tokens=n_new, temperature=0.0)
+    engine = DecodeEngine(module, variables, slots=2, page=8)
+    req = GenerateRequest(prompt, max_new_tokens=n_new)
+    engine.attach(req)
+    _drive(engine)
+    assert req.outcome == "ok"
+    assert req.tokens == ref[0, len(prompt):].tolist()
+
+
+def test_join_leave_never_recompiles():
+    """Membership churn — join mid-generation, cancel, EOS — is pure
+    data; the decode program compiles exactly once per engine."""
+    from kubeml_tpu.serve.engine import DecodeEngine
+    from kubeml_tpu.serve.slots import GenerateRequest
+
+    _model, module, variables = _nano()
+    engine = DecodeEngine(module, variables, slots=4, page=4)
+
+    a = GenerateRequest([5, 6, 7], max_new_tokens=12)
+    engine.attach(a)
+    for _ in range(4):
+        engine.step()
+    assert engine.stats["compiles"] == 1  # first dispatch compiled
+
+    b = GenerateRequest([9, 10], max_new_tokens=8, temperature=0.5, seed=3)
+    engine.attach(b)  # join mid-generation
+    for _ in range(3):
+        engine.step()
+    b.cancel()  # leave mid-generation
+    engine.step()
+    assert b.outcome == "cancelled"
+
+    c = GenerateRequest([11], max_new_tokens=4)
+    engine.attach(c)  # join after a leave
+    _drive(engine)
+    assert a.outcome == "ok" and c.outcome == "ok"
+    assert engine.stats["compiles"] == 1
+    assert engine.compile_tracker.compiles == 1
+    assert engine.compile_tracker.dispatches == engine.stats["dispatches"]
+
+
+def test_pages_free_on_eos_and_return_to_pool():
+    """EOS finishes the stream early, its pages free, and the pool
+    drains back to zero in-use after every stream completes."""
+    from kubeml_tpu.serve.engine import DecodeEngine
+    from kubeml_tpu.serve.slots import GenerateRequest
+
+    _model, module, variables = _nano()
+    engine = DecodeEngine(module, variables, slots=2, page=4)
+    total_pages = engine.pager.free_pages
+
+    probe = GenerateRequest([5, 6, 7], max_new_tokens=6)
+    engine.attach(probe)
+    _drive(engine)
+    assert probe.outcome == "ok"
+    assert engine.pager.in_use == 0
+    assert engine.pager.free_pages == total_pages
+    assert (engine._tables == 0).all()
+
+    # same stream with eos_id = its own first token: one token, done
+    eos = GenerateRequest([5, 6, 7], max_new_tokens=6,
+                          eos_id=probe.tokens[0])
+    engine.attach(eos)
+    _drive(engine)
+    assert eos.outcome == "ok"
+    assert eos.tokens == probe.tokens[:1]
+    assert engine.pager.in_use == 0
+    assert engine.kv_utilization() == 0.0
+
+
+def test_kv_exhaustion_sheds_newest_stream():
+    """With every runnable slot stalled on an empty page pool, the
+    NEWEST stream is shed with an error and the oldest finishes."""
+    from kubeml_tpu.serve.engine import DecodeEngine
+    from kubeml_tpu.serve.pager import PageGeometry
+    from kubeml_tpu.serve.slots import GenerateRequest
+
+    _model, module, variables = _nano()
+    # 2 usable pages of 4 tokens; each request spans 8 tokens = 2 pages
+    geom = PageGeometry(slots=2, page=4, pages=3, pages_per_slot=2)
+    engine = DecodeEngine(module, variables, geom=geom)
+    old = GenerateRequest([5, 6, 7, 8], max_new_tokens=4)
+    new = GenerateRequest([9, 10, 11, 12], max_new_tokens=4)
+    engine.attach(old)
+    engine.attach(new)
+    _drive(engine)
+    assert old.outcome == "ok" and len(old.tokens) == 4
+    assert new.outcome == "error"
+    assert "pages exhausted" in (new.error or "")
+    assert engine.stats["stalls"] > 0
+    assert engine.pager.in_use == 0  # everything returned to the pool
+
+
+# ------------------------------------------------------------- PS /generate
+
+@pytest.fixture()
+def serve_ps(tmp_home):
+    """A live PS with a gpt-nano checkpoint published for serving.
+    Tiny slot pool (2) + queue (1) so saturation is reachable."""
+    from kubeml_tpu.control.ps import ParameterServer
+    from kubeml_tpu.train.checkpoint import save_checkpoint
+
+    model, _module, variables = _nano()
+    save_checkpoint("servenano", variables,
+                    {"model": "gpt-nano", "function": "gpt-nano",
+                     "parallelism": 1, "epoch": 0})
+    ps = ParameterServer(serve_slots=2, serve_queue_depth=1)
+    ps.start()
+    yield ps, model, variables
+    ps.stop()
+
+
+def _post(url, body, timeout=60.0):
+    req = urllib.request.Request(
+        url, data=json.dumps(body).encode(), method="POST",
+        headers={"Content-Type": "application/json"})
+    return urllib.request.urlopen(req, timeout=timeout)
+
+
+def test_generate_stream_e2e(serve_ps):
+    """POST /generate streams ndjson per-token chunks, the terminal
+    event carries the full token list, and the non-stream mode and the
+    model's own generate() agree with it."""
+    ps, model, variables = serve_ps
+    prompt, n_new = [5, 6, 7, 8], 6
+    ref = model.generate(variables, np.asarray([prompt], np.int32),
+                         max_new_tokens=n_new, temperature=0.0)
+    expected = ref[0, len(prompt):].tolist()
+
+    resp = _post(f"{ps.url}/generate",
+                 {"model_id": "servenano", "prompt": prompt,
+                  "max_new_tokens": n_new})
+    assert resp.headers.get("Content-Type") == "application/x-ndjson"
+    events = [json.loads(line) for line in resp.read().splitlines()]
+    assert [e["token"] for e in events[:-1]] == expected
+    assert events[-1] == {"done": True, "tokens": expected}
+
+    doc = json.loads(_post(f"{ps.url}/generate",
+                           {"model_id": "servenano", "prompt": prompt,
+                            "max_new_tokens": n_new,
+                            "stream": False}).read())
+    assert doc == {"tokens": expected}
+
+
+def test_generate_validates_before_costing_a_slot(serve_ps):
+    ps, _model, _variables = serve_ps
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        _post(f"{ps.url}/generate",
+              {"model_id": "servenano", "prompt": [0, 0]})
+    assert ei.value.code == 400
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        _post(f"{ps.url}/generate", {"model_id": "servenano"})
+    assert ei.value.code == 400
+
+
+def test_generate_saturation_sheds_429_with_retry_after(serve_ps):
+    """Slots 2 + queue 1 = capacity 3: a burst of 6 concurrent streams
+    sheds the overflow with 429 + Retry-After while admitted streams
+    complete normally."""
+    ps, _model, _variables = serve_ps
+    results = [None] * 6
+
+    def client(i):
+        try:
+            resp = _post(f"{ps.url}/generate",
+                         {"model_id": "servenano", "prompt": [5, 6, 7, 8],
+                          "max_new_tokens": 40})
+            resp.read()
+            results[i] = (resp.status, None)
+        except urllib.error.HTTPError as e:
+            results[i] = (e.code, e.headers.get("Retry-After"))
+
+    # serialize the first request alone so the decode service exists
+    # (and its one compile lands) before the burst measures admission
+    client(0)
+    threads = [threading.Thread(target=client, args=(i,))
+               for i in range(1, 6)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+
+    codes = [r[0] for r in results]
+    assert codes.count(200) >= 3
+    shed = [r for r in results if r[0] == 429]
+    assert shed, f"no request shed at capacity 3 with 6 offered: {codes}"
+    assert all(int(retry) >= 1 for _, retry in shed)
+
+
+def test_live_exposition_and_serve_health(serve_ps):
+    """After serving traffic the PS /metrics passes the lint with the
+    serve + infer-cache families present, and the serve:<model> pseudo
+    job carries its snapshot through GET /health."""
+    from tools.check_metrics import validate_exposition
+
+    ps, _model, _variables = serve_ps
+    _post(f"{ps.url}/generate",
+          {"model_id": "servenano", "prompt": [5, 6, 7],
+           "max_new_tokens": 4}).read()
+    text = urllib.request.urlopen(f"{ps.url}/metrics").read().decode()
+    assert validate_exposition(text) == []
+    for family in ("kubeml_serve_ttft_seconds", "kubeml_serve_tpot_seconds",
+                   "kubeml_serve_e2e_seconds", "kubeml_serve_active_slots",
+                   "kubeml_serve_kv_page_utilization",
+                   "kubeml_serve_requests_total",
+                   "kubeml_serve_tokens_total",
+                   "kubeml_infer_cache_entries",
+                   "kubeml_infer_cache_misses_total"):
+        assert f"# TYPE {family}" in text, family
+
+    deadline = time.time() + 10
+    while time.time() < deadline:
+        doc = json.loads(urllib.request.urlopen(
+            f"{ps.url}/health?id=serve:servenano").read())
+        if doc.get("latest", {}).get("serve_slot_cap") is not None:
+            break
+        time.sleep(0.05)
+    assert doc["state"] in ("healthy", "warning")
+    latest = doc["latest"]
+    assert latest["serve_slot_cap"] == 2
+    assert latest["serve_queue_cap"] == 1
+    assert "serve_ttft_p99" in latest
+
+
+# ------------------------------------------------- infer cache + batcher
+
+def test_infer_cache_entry_cap_evicts_lru(tmp_home):
+    from kubeml_tpu.control.ps import ParameterServer
+    from kubeml_tpu.train.checkpoint import save_checkpoint
+
+    _model, _module, variables = _nano()
+    for i in range(3):
+        save_checkpoint(f"nano{i}", variables,
+                        {"model": "gpt-nano", "function": "gpt-nano",
+                         "parallelism": 1, "epoch": 0})
+    ps = ParameterServer(infer_cache_size=2)
+    for i in range(3):
+        ps._load_for_infer(f"nano{i}")
+    assert list(ps._infer_cache) == ["nano1", "nano2"]
+    # hit refreshes recency; metrics reflect the traffic
+    ps._load_for_infer("nano1")
+    assert list(ps._infer_cache) == ["nano2", "nano1"]
+    text = ps.metrics.exposition()
+    assert 'kubeml_infer_cache_entries{cache="checkpoints"} 2' in text
+    assert 'kubeml_infer_cache_hits_total{cache="checkpoints"} 1' in text
+    assert 'kubeml_infer_cache_misses_total{cache="checkpoints"} 3' in text
+
+
+def test_infer_cache_yields_to_hbm_budget(tmp_home):
+    """With the serving HBM budget exhausted, the cache keeps only the
+    freshest entry (the request that just loaded it is using it)."""
+    from kubeml_tpu.control.ps import ParameterServer
+    from kubeml_tpu.train.checkpoint import save_checkpoint
+
+    _model, _module, variables = _nano()
+    for i in range(2):
+        save_checkpoint(f"tiny{i}", variables,
+                        {"model": "gpt-nano", "function": "gpt-nano",
+                         "parallelism": 1, "epoch": 0})
+    ps = ParameterServer(infer_cache_size=4, serve_hbm_budget_mb=0.0)
+    ps._load_for_infer("tiny0")
+    ps._load_for_infer("tiny1")
+    assert list(ps._infer_cache) == ["tiny1"]
+
+
+def test_infer_batcher_follower_timeout_leaves_no_dead_row():
+    """A follower that times out removes its row from the pending
+    bucket, so the leader's flush only serves live waiters."""
+    from kubeml_tpu.api.errors import KubeMLException
+    from kubeml_tpu.control.ps import InferBatcher
+
+    b = InferBatcher(window_s=0.3, max_batch=8, timeout_s=0.05)
+    key = ("m", (2,), "float32")
+    b._last_arrival[key] = time.monotonic()  # force the dense window
+    stacked_sizes = []
+
+    def run(stacked):
+        stacked_sizes.append(len(stacked))
+        return np.zeros((len(stacked), 1))
+
+    leader_done = threading.Event()
+
+    def leader():
+        leader_out.append(b.submit(key, np.zeros((1, 2)), run))
+        leader_done.set()
+
+    leader_out = []
+    t = threading.Thread(target=leader)
+    t.start()
+    time.sleep(0.05)  # leader is inside its 0.3s collection window
+    with pytest.raises(KubeMLException) as ei:
+        b.submit(key, np.zeros((1, 2)), run)  # follower, times out
+    assert "timed out" in ei.value.message
+    assert leader_done.wait(5.0)
+    t.join()
+    # the flush saw ONLY the leader's row — the dead row left the bucket
+    assert stacked_sizes == [1]
+    assert len(leader_out[0]) == 1
+    assert key not in b._groups
+
+
+# --------------------------------------------------- health rules + top
+
+def test_serve_health_rules_fire_on_onset():
+    from kubeml_tpu.control.health import HealthEvaluator
+
+    t = [0.0]
+    ev = HealthEvaluator(clock=lambda: t[0])
+    base = {"job_id": "serve:m", "serve_active_slots": 1,
+            "serve_slot_cap": 2, "serve_queue_depth": 0,
+            "serve_queue_cap": 2, "serve_kv_page_utilization": 0.1,
+            "serve_rejected_total": 0, "serve_ttft_p50": 0.01,
+            "serve_ttft_p99": 0.02}
+    assert ev.observe(dict(base)) == []
+    t[0] += 1.0
+    fired = ev.observe(dict(base, serve_rejected_total=3))
+    assert [f["rule"] for f in fired] == ["serve_saturation"]
+    assert "429" in fired[0]["detail"]
+    t[0] += 1.0
+    # shedding stopped, but the queue sits at cap -> still saturated;
+    # p99 TTFT above the 2s SLO newly fires
+    fired = ev.observe(dict(base, serve_rejected_total=3,
+                            serve_queue_depth=2, serve_ttft_p99=5.0))
+    assert [f["rule"] for f in fired] == ["serve_ttft_slo"]
+    doc = ev.verdict("serve:m")
+    assert doc["state"] == "warning"
+    assert {r["rule"] for r in doc["reasons"]} == {"serve_saturation",
+                                                   "serve_ttft_slo"}
+
+
+def test_serve_rules_ignore_training_samples():
+    from kubeml_tpu.control.health import HealthEvaluator
+
+    ev = HealthEvaluator(clock=lambda: 0.0)
+    fired = ev.observe({"job_id": "job1", "train_loss": 0.4,
+                        "grad_norms": [0.5], "loss_spread": 0.01})
+    assert [f["rule"] for f in fired] == []
+    assert "serve_queue_cap" not in ev.verdict("job1")["latest"]
+
+
+def test_top_renders_serving_pane():
+    from kubeml_tpu.cli.main import _render_top
+
+    doc = {"id": "serve:m1", "state": "healthy", "reasons": [],
+           "latest": {"serve_active_slots": 2, "serve_slot_cap": 8,
+                      "serve_queue_depth": 1, "serve_queue_cap": 16,
+                      "serve_kv_page_utilization": 0.25,
+                      "serve_rejected_total": 3,
+                      "serve_ttft_p50": 0.010, "serve_ttft_p99": 0.020}}
+    out = _render_top(doc)
+    assert "serve: slots 2/8" in out
+    assert "queue 1/16" in out
+    assert "kv pages 25%" in out
+    assert "ttft p50/p99 10ms/20ms" in out
+    assert "shed 3" in out
+    # a training job's screen has no serving pane
+    plain = _render_top({"id": "job1", "state": "healthy", "reasons": [],
+                         "latest": {"train_loss": 0.5}})
+    assert "serve:" not in plain

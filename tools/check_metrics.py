@@ -21,6 +21,15 @@ parser and enforces the house rules:
   * histogram ``le`` bounds are strictly increasing and finish with
     ``+Inf``; bucket counts are monotone cumulative; ``_count`` equals
     the ``+Inf`` bucket and ``_sum`` is present
+  * latency histograms (name ends in ``_seconds``) need a usable bucket
+    grid: every bound positive, at least 4 finite bounds, and the
+    finite bounds spanning at least 100x — a 0.1/1/+Inf grid renders a
+    TTFT SLO dashboard as two bars and hides the p99 the serving health
+    rules alert on.  Scoped to ``_seconds``: a ``_bytes`` histogram may
+    legitimately be narrow
+  * every sample in a family carries the SAME label keys (``le``
+    aside) — label drift within a family (one series with ``model``,
+    another without) splits PromQL aggregations silently
 
 Run directly (exit 1 on violation) or via tests/test_metrics_prom.py,
 which keeps the lint itself in the tier-1 suite.  With no argument it
@@ -174,6 +183,48 @@ def parse_exposition(text: str) -> dict:
     return families
 
 
+# latency-grid floor for *_seconds histograms: finite bounds needed and
+# the min span (max finite bound / min finite bound) for the grid to
+# resolve both the median and the multi-second tail
+_SECONDS_MIN_FINITE = 4
+_SECONDS_MIN_SPAN = 100.0
+
+
+def _validate_seconds_grid(fam: str, bounds: list, where: str,
+                           errors: list) -> None:
+    """Bucket-grid rules for latency (``_seconds``) histograms."""
+    finite = [b for b in bounds if b != math.inf]
+    if any(b <= 0 for b in finite):
+        errors.append(f"{where}: _seconds histogram has a non-positive "
+                      f"le bound: {finite}")
+        return
+    if len(finite) < _SECONDS_MIN_FINITE:
+        errors.append(
+            f"{where}: _seconds histogram has only {len(finite)} finite "
+            f"bucket bound(s); latency families need at least "
+            f"{_SECONDS_MIN_FINITE} to resolve a percentile")
+        return
+    if finite and max(finite) / min(finite) < _SECONDS_MIN_SPAN:
+        errors.append(
+            f"{where}: _seconds bucket bounds span only "
+            f"{max(finite) / min(finite):.0f}x ({min(finite)} .. "
+            f"{max(finite)}); latency grids must span >= "
+            f"{_SECONDS_MIN_SPAN:g}x to cover both median and tail")
+
+
+def _validate_label_keys(fam: str, entry: dict, errors: list) -> None:
+    """Every sample in a family must carry the same label keys.
+    Histogram children are normalized by dropping ``le``."""
+    seen: dict = {}
+    for name, labels, _v in entry["samples"]:
+        keys = frozenset(k for k in labels if k != "le")
+        seen.setdefault(keys, name)
+    if len(seen) > 1:
+        variants = sorted(sorted(k) for k in seen)
+        errors.append(f"{fam}: label keys drift within the family: "
+                      f"{variants} — aggregations silently split")
+
+
 def _validate_histogram(fam: str, entry: dict, errors: list) -> None:
     # group by labelset minus `le`
     groups: dict = {}
@@ -219,6 +270,8 @@ def _validate_histogram(fam: str, entry: dict, errors: list) -> None:
                 and g["count"] != counts[-1]:
             errors.append(f"{where}: _count {g['count']} != +Inf bucket "
                           f"{counts[-1]}")
+        if fam.endswith("_seconds"):
+            _validate_seconds_grid(fam, bounds, where, errors)
 
 
 def validate_exposition(text: str) -> list:
@@ -259,6 +312,7 @@ def validate_exposition(text: str) -> list:
                 "(e.g. {worker=\"3\"}), not indexed family names — one "
                 "family per worker defeats aggregation and explodes "
                 "family cardinality")
+        _validate_label_keys(fam, entry, errors)
         if ftype == "histogram":
             _validate_histogram(fam, entry, errors)
         else:
@@ -273,8 +327,10 @@ def validate_exposition(text: str) -> list:
 _GOOD = """\
 # HELP kubeml_demo_seconds demo latency
 # TYPE kubeml_demo_seconds histogram
-kubeml_demo_seconds_bucket{op="x",le="0.1"} 1
-kubeml_demo_seconds_bucket{op="x",le="1"} 2
+kubeml_demo_seconds_bucket{op="x",le="0.005"} 0
+kubeml_demo_seconds_bucket{op="x",le="0.05"} 1
+kubeml_demo_seconds_bucket{op="x",le="0.5"} 2
+kubeml_demo_seconds_bucket{op="x",le="5"} 3
 kubeml_demo_seconds_bucket{op="x",le="+Inf"} 3
 kubeml_demo_seconds_sum{op="x"} 2.5
 kubeml_demo_seconds_count{op="x"} 3
@@ -319,6 +375,20 @@ _BROKEN = {
     "worker-family": "# HELP kubeml_worker3_grad_norm x\n"
                      "# TYPE kubeml_worker3_grad_norm gauge\n"
                      "kubeml_worker3_grad_norm 1\n",
+    # a latency histogram whose grid cannot resolve a percentile: two
+    # finite bounds, dashboarded SLOs collapse into +Inf
+    "narrow-seconds": (
+        "# HELP kubeml_ttft_seconds x\n"
+        "# TYPE kubeml_ttft_seconds histogram\n"
+        'kubeml_ttft_seconds_bucket{le="0.1"} 1\n'
+        'kubeml_ttft_seconds_bucket{le="1"} 2\n'
+        'kubeml_ttft_seconds_bucket{le="+Inf"} 2\n'
+        "kubeml_ttft_seconds_sum 0.4\nkubeml_ttft_seconds_count 2\n"),
+    # same label keys on every series of a family, or aggregations split
+    "label-drift": (
+        "# HELP kubeml_slots x\n# TYPE kubeml_slots gauge\n"
+        'kubeml_slots{model="a"} 1\n'
+        "kubeml_slots 2\n"),
 }
 
 # these must KEEP passing: the allowlisted _total gauge and a labelled
@@ -332,6 +402,15 @@ _GOOD_EDGE = {
                        "# TYPE kubeml_job_worker_grad_norm gauge\n"
                        'kubeml_job_worker_grad_norm'
                        '{jobid="j",worker="3"} 0.5\n',
+    # the _seconds grid rules are scoped by unit: a narrow _bytes
+    # histogram is fine (payload sizes can legitimately cluster)
+    "bytes-histogram": (
+        "# HELP kubeml_payload_bytes x\n"
+        "# TYPE kubeml_payload_bytes histogram\n"
+        'kubeml_payload_bytes_bucket{le="1024"} 1\n'
+        'kubeml_payload_bytes_bucket{le="4096"} 2\n'
+        'kubeml_payload_bytes_bucket{le="+Inf"} 2\n'
+        "kubeml_payload_bytes_sum 2048\nkubeml_payload_bytes_count 2\n"),
 }
 
 
@@ -381,6 +460,17 @@ def _live_exposition() -> str:
     reg.note_health_alert("lintjob", "loss_divergence")
     reg.running_total.set("train", 1)
     reg.note_restart("lintjob")
+    # serving-plane + inference-cache families (serve/service.py and
+    # control/ps.py feed these on the live PS)
+    reg.observe_serve_request("lintmodel", "ok")
+    reg.observe_serve_request("lintmodel", "rejected")
+    reg.observe_serve_latency("lintmodel", ttft=0.02, tpot=0.004, e2e=0.1)
+    reg.set_serve_state("lintmodel", active_slots=3, queue_depth=1,
+                        kv_utilization=0.25)
+    reg.note_serve_tokens("lintmodel", 17)
+    reg.note_infer_cache(True)
+    reg.note_infer_cache(False)
+    reg.set_infer_cache_entries(2)
     http = HttpMetrics("lint")
     http.observe("GET", "/metrics", 200, 0.002)
     http.observe("POST", "/update/{jobId}", 404, 0.1)
