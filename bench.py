@@ -71,6 +71,34 @@ ROUNDS_PER_DISPATCH = 4
 FAULT_TIMED_EPOCHS = 1
 FAULT_EVERY = 4
 
+# comm-proxy levers reported in the JSON artifact: the sync-round wire
+# plans the merge strategies (kubeml_tpu/parallel/merge.py) would
+# produce for this model. The numbers are pure functions of the
+# parameter tree — no device work — so they are DETERMINISTIC on the
+# CPU tier and tests/test_merge.py pins them exactly.
+COMM_PROXY_LEVERS = {
+    "monolithic": {},
+    "bucketed_4mb": dict(bucket_mb=4.0),
+    "ef_bf16": dict(compress="bf16"),
+    "ef_int8": dict(compress="int8"),
+}
+
+
+def comm_proxy_block(variables, rounds_per_epoch, dispatches_per_epoch,
+                     programs_compiled):
+    """Deterministic sync-round comm metrics for the bench JSON: per
+    merge lever the payload bytes / bucket / dispatch counts one round
+    costs on the cross-slice wire, plus the run's measured dispatch
+    grouping and compiled-program count. Pure host arithmetic over the
+    parameter tree — identical on CPU and TPU tiers."""
+    from kubeml_tpu.parallel import merge as merge_lib
+    block = {name: merge_lib.merge_comm_proxy(variables, **kw)
+             for name, kw in COMM_PROXY_LEVERS.items()}
+    block["dispatches_per_round"] = round(
+        dispatches_per_epoch / max(1, rounds_per_epoch), 4)
+    block["programs_compiled"] = int(programs_compiled)
+    return block
+
 
 def main():
     import subprocess
@@ -414,6 +442,15 @@ def main():
     # identical on both arms and excluded.
     payload_host = int(flat_x.nbytes + flat_y.nbytes)
     payload_cache = int(idx1.nbytes)
+    # deterministic sync-round comm proxy (merge levers + this run's
+    # dispatch grouping and compile count) — pure host arithmetic over
+    # the parameter tree, pinned exactly by tests/test_merge.py
+    proxy_vars = model.init_variables(
+        jax.random.PRNGKey(0), {"x": jnp.asarray(x[0, 0])})
+    comm_proxy = comm_proxy_block(
+        proxy_vars, rounds_per_epoch,
+        dispatches_per_epoch=groups + tail,
+        programs_compiled=engine.programs_compiled)
     # extra keys (ignored by the driver parser) make the numbers
     # auditable from the artifact alone: both arms' absolutes are
     # recorded, so vs_baseline and the payload reduction can be
@@ -432,6 +469,12 @@ def main():
         "round_payload_bytes_cache": payload_cache,
         "round_payload_reduction_x": round(payload_host
                                            / max(1, payload_cache), 1),
+        # sync-round comm proxy: per merge lever (parallel/merge.py)
+        # the deterministic per-round wire payload/bucket/dispatch
+        # numbers for this model, plus the run's dispatch grouping and
+        # compiled-program count — comparable across tiers because the
+        # wire plan is a pure function of the parameter tree.
+        "comm_proxy": comm_proxy,
         "timed_epochs": TIMED_EPOCHS,
         "host_timed_epochs": HOST_TIMED_EPOCHS,
         "baseline_timed_epochs": BASELINE_TIMED_EPOCHS,

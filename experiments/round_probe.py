@@ -24,6 +24,16 @@ backend result cache can serve them):
                  K-step scan of fwd+bwd with summed grads, no optimizer,
                  no merge — per-round dispatches
   grads_scan_8   the same, 8 rounds per dispatch
+  bucketed_4mb   per_round with the merge split into 4 MB buckets whose
+                 psums issue as their leaves finalize (parallel/merge.py
+                 overlap lever), lax apply — isolates bucketing/overlap
+  fused_merge    bucketed_4mb with the fused merge+optimizer Pallas
+                 kernel auto-enabled (ops/pallas/fused_merge.py; lax
+                 fallback on CPU, so the delta only shows on TPU)
+  ef_bf16 / ef_int8
+                 per_round with error-feedback compressed merge payloads
+                 (2x / ~4x fewer cross-slice wire bytes, residual carry
+                 in the round program)
 
 If scan_R recovers most of (ceiling - per_round), the residual gap is
 dispatch, and batching rounds per dispatch is the fix; if it moves
@@ -126,6 +136,48 @@ def main(argv=None) -> int:
         t0 = time.perf_counter()
         v2 = multi(ROUNDS, v2)
         emit(f"scan_{R}", time.perf_counter() - t0, ROUNDS)
+
+    # ---- arms: merge overlap / compression levers --------------------
+    # Same device-resident per-round loop as per_round, fresh engine per
+    # arm so each compiles its own round program. bucketed_4mb splits
+    # the merge into size-capped buckets whose psums issue early (lax
+    # apply, merge_fused=False); fused_merge layers the Pallas
+    # merge-apply kernel on top (auto-gated: TPU only, lax fallback
+    # elsewhere — on CPU this arm should match bucketed_4mb); the EF
+    # arms compress the cross-slice payload with residual carry. Deltas
+    # vs per_round attribute each lever; the comm proxy row records the
+    # deterministic wire plan next to the measured time.
+    merge_arms = (
+        ("bucketed_4mb", dict(merge_bucket_mb=4.0, merge_fused=False)),
+        ("fused_merge", dict(merge_bucket_mb=4.0)),
+        ("ef_bf16", dict(merge_compress="bf16")),
+        ("ef_int8", dict(merge_compress="int8")),
+    )
+    for arm_name, merge_kw in merge_arms:
+        eng = KAvgEngine(mesh, model.loss, model.metrics,
+                         model.configure_optimizers, donate=False,
+                         **merge_kw)
+
+        def merge_arm(n, vars_):
+            for i in range(n):
+                rngs = rng.randint(0, 2**31,
+                                   size=(W, S, 2)).astype(np.uint32)
+                vars_, _ = eng.train_round(vars_, batch, rngs=rngs,
+                                           lr=0.1, epoch=0, **masks)
+            anchor(vars_)
+            return vars_
+
+        vm = merge_arm(WARM_ROUNDS, variables)
+        t0 = time.perf_counter()
+        vm = merge_arm(ROUNDS, vm)
+        seconds = time.perf_counter() - t0
+        sps = ROUNDS * W * S * B / seconds / n_chips
+        row = {"arm": arm_name, "seconds": round(seconds, 4),
+               "rounds": ROUNDS,
+               "samples_per_sec_per_chip": round(sps, 1),
+               "comm_proxy": eng.merge_comm_proxy(variables)}
+        rows.append(row)
+        print(json.dumps(row), flush=True)
 
     # ---- arms: dispatch-payload attribution (device cache) -----------
     # The per_round/scan_R arms above hold the batch DEVICE-RESIDENT, so

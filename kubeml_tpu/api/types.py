@@ -153,6 +153,26 @@ class TrainOptions:
     # are added — so they default ON; turn off to shave the (small)
     # extra FLOPs and HBM of the stat outputs.
     train_stats: bool = True
+    # net-new sync-round comm levers (parallel/merge.py; docs/
+    # performance.md "Merge overlap & compression"):
+    # merge_dtype = '' keeps full-f32 merge payloads; 'bf16' halves the
+    # cross-slice wire bytes by casting the payload (NO error feedback —
+    # each round independently rounds to bf16). Kavg engine only.
+    merge_dtype: str = ""
+    # merge_compress = 'none' | 'bf16' | 'int8': error-feedback
+    # compressed merge payloads — the per-lane quantization error is
+    # carried as a persistent residual and added back into the next
+    # round's payload, so the quantization bias cancels over rounds.
+    # int8 adds a shared per-bucket scale (4 B/bucket). Mutually
+    # exclusive with merge_dtype. Residuals are zeroed for lanes the
+    # non-finite guard drops, so quarantine semantics survive.
+    merge_compress: str = "none"
+    # merge_bucket_mb > 0 splits the merge into consecutive-leaf buckets
+    # of at most this many MB (f32 accounting) and issues each bucket's
+    # collective independently, so early buckets overlap the rest of the
+    # round's compute; 0 keeps the monolithic per-leaf merge. Bucketing
+    # is bit-identical to the monolithic merge (tests/test_merge.py).
+    merge_bucket_mb: float = 0.0
 
     def to_dict(self) -> dict:
         return {
@@ -183,6 +203,9 @@ class TrainOptions:
             "checkpoint_every_rounds": self.checkpoint_every_rounds,
             "reassign_on_quarantine": self.reassign_on_quarantine,
             "train_stats": self.train_stats,
+            "merge_dtype": self.merge_dtype,
+            "merge_compress": self.merge_compress,
+            "merge_bucket_mb": self.merge_bucket_mb,
         }
 
     @classmethod
@@ -216,6 +239,9 @@ class TrainOptions:
             reassign_on_quarantine=bool(d.get("reassign_on_quarantine",
                                               False)),
             train_stats=bool(d.get("train_stats", True)),
+            merge_dtype=d.get("merge_dtype", ""),
+            merge_compress=d.get("merge_compress", "none"),
+            merge_bucket_mb=float(d.get("merge_bucket_mb", 0.0)),
         )
 
 

@@ -58,6 +58,11 @@ def cmd_train(args):
         _fail("--pp-microbatches must be >= 0")
     if args.rounds_per_dispatch < 1:
         _fail("--rounds-per-dispatch must be >= 1")
+    if args.merge_bucket_mb < 0:
+        _fail("--merge-bucket-mb must be >= 0")
+    if args.merge_dtype and args.merge_compress != "none":
+        _fail("--merge-dtype and --merge-compress are mutually exclusive "
+              "(the wire cast has no residual; pick one)")
     if args.fsdp and args.engine != "syncdp":
         _fail("--fsdp requires --engine syncdp")
     if args.pipeline_parallel > 1 and \
@@ -107,6 +112,9 @@ def cmd_train(args):
             pp_microbatches=args.pp_microbatches,
             fsdp=args.fsdp,
             rounds_per_dispatch=args.rounds_per_dispatch,
+            merge_dtype=args.merge_dtype,
+            merge_compress=args.merge_compress,
+            merge_bucket_mb=args.merge_bucket_mb,
             seq_impl=args.seq_impl,
             tp_impl=args.tp_impl,
             max_parallelism=args.max_parallelism,
@@ -364,6 +372,16 @@ def _render_top(doc: dict) -> str:
             f"dispatch: n={len(dispatch)} "
             f"mean={sum(dispatch) / len(dispatch):.3f}s "
             f"max={max(dispatch):.3f}s")
+    # merge split: merge_wait is blocking drain time, merge_overlap is
+    # host bookkeeping hidden behind device execution (merge.py levers);
+    # device_drain is the pre-split name for the blocking portion
+    wait = [float(t) for t in (phases.get("merge_wait", [])
+                               or phases.get("device_drain", []))]
+    overlap = [float(t) for t in phases.get("merge_overlap", [])]
+    if wait or overlap:
+        lines.append(
+            f"merge: wait={sum(wait):.3f}s/{len(wait)} "
+            f"overlap={sum(overlap):.3f}s/{len(overlap)}")
     lines.append(
         f"hbm: peak={_fmt_bytes(latest.get('hbm_peak_bytes'))} "
         f"in_use={_fmt_bytes(latest.get('hbm_in_use_bytes'))}   "
@@ -534,6 +552,21 @@ def build_parser() -> argparse.ArgumentParser:
                         "amortizes per-round submission overhead on "
                         "high-latency backends (~2-3% measured on "
                         "tunneled v5e)")
+    t.add_argument("--merge-dtype", choices=("", "bf16"), default="",
+                   help="lossy wire dtype for the kavg weight merge "
+                        "(no residual; kavg engine only)")
+    t.add_argument("--merge-compress", choices=("none", "bf16", "int8"),
+                   default="none",
+                   help="error-feedback compressed cross-slice merges: "
+                        "bf16 (2x) or symmetric int8 (~4x) payloads with "
+                        "persistent per-lane residuals "
+                        "(docs/performance.md)")
+    t.add_argument("--merge-bucket-mb", type=float, default=0.0,
+                   metavar="MB",
+                   help="size cap for bucketed merge overlap: each "
+                        "bucket's reduction issues as its leaves "
+                        "finalize; 0 = monolithic (bit-identical either "
+                        "way)")
     t.add_argument("--seq-impl", choices=("ring", "ulysses"),
                    default="ring",
                    help="sequence-parallel attention implementation")

@@ -295,14 +295,22 @@ class HttpMetrics:
                 + self.duration.collect() + "\n")
 
 
-# Tracer span name -> (histogram attribute, family name) for the phase
-# latencies pushed per epoch via MetricUpdate.phase_times.  device_drain
-# is the blocking merged-loss readback — the host-visible "merge" cost
-# (the weight merge itself is fused on-device into the dispatch).
+# Tracer span name -> histogram attribute for the phase latencies
+# pushed per epoch via MetricUpdate.phase_times.  The merge cost splits
+# into two spans: merge_wait is the BLOCKING portion (the epoch-end
+# drain where the host actually waits on outstanding merges and the
+# merged-loss readback), merge_overlap is merge-adjacent host
+# bookkeeping done while the next dispatch is already executing on
+# device — time the overlap pipeline hides.  device_drain is the
+# pre-split name for the blocking portion; it stays mapped so traces
+# from older processes (and the bench harness's drain spans) keep
+# landing in kubeml_job_merge_seconds.
 PHASE_HISTOGRAMS = {
     "dispatch": "dispatch_seconds",
     "data_wait": "data_wait_seconds",
     "device_drain": "merge_seconds",
+    "merge_wait": "merge_seconds",
+    "merge_overlap": "merge_overlap_seconds",
 }
 
 
@@ -391,6 +399,10 @@ class MetricsRegistry:
             "kubeml_job_merge_seconds",
             "Merged-result readback (device drain) latency of a job",
             "jobid")
+        self.merge_overlap_seconds = Histogram(
+            "kubeml_job_merge_overlap_seconds",
+            "Merge-adjacent host bookkeeping of a job overlapped with "
+            "device execution (hidden by the dispatch pipeline)", "jobid")
         # training-health telemetry (on-device stat lanes riding
         # MetricUpdate + control/health.py rule verdicts): per-worker
         # stats carry the worker as a LABEL (cardinality rule), the
@@ -489,7 +501,7 @@ class MetricsRegistry:
                             self.checkpoint_drops, self.heartbeat_epoch,
                             self.heartbeat_round, self.loss_spread]
         self._job_hists = [self.dispatch_seconds, self.data_wait_seconds,
-                           self.merge_seconds]
+                           self.merge_seconds, self.merge_overlap_seconds]
         self._job_multi = [self.job_health, self.worker_grad_norm,
                            self.worker_update_ratio, self.hbm_bytes]
         self._job_counters = [self.health_alerts_total,

@@ -51,6 +51,7 @@ import optax
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from kubeml_tpu.parallel import merge as merge_lib
 from kubeml_tpu.parallel.mesh import DATA_AXIS, SEQ_AXIS
 
 PyTree = Any
@@ -253,7 +254,10 @@ class KAvgEngine:
                  merge_dtype: Any = None, unroll: int = 8,
                  batch_seq_dims: Optional[Dict[str, int]] = None,
                  manual_inner: bool = False,
-                 collect_stats: bool = False):
+                 collect_stats: bool = False,
+                 merge_bucket_mb: float = 0.0,
+                 merge_compress: str = "none",
+                 merge_fused: Optional[bool] = None):
         """donate=True donates the input variables buffer to each
         train_round (frees a full model copy of HBM) — the caller must then
         always continue from the *returned* variables, never reuse the
@@ -308,7 +312,28 @@ class KAvgEngine:
         back into the optimizer chain, so the merged weights are
         bit-identical with stats on or off (tests/test_health.py proves
         it), and like the loss they accumulate lazily on device (zero
-        extra host syncs mid-epoch)."""
+        extra host syncs mid-epoch).
+
+        merge_bucket_mb > 0 splits the merge into size-capped flat
+        buckets, each reduced with ONE collective (parallel/merge.py):
+        fewer, larger psums whose independence lets XLA overlap early
+        buckets' collectives with the round's scan tail. The f32
+        bucketed merge is bit-identical to the monolithic one.
+
+        merge_compress in {"bf16", "int8"} turns on error-feedback
+        compressed merges: per-lane quantized payloads with persistent
+        residuals carried as extra (donated) round state, zeroed for
+        lanes whose workers were all masked/quarantined/NaN-dropped.
+        Mutually exclusive with merge_dtype (EF owns the wire dtype);
+        implies bucketing (merge.DEFAULT_EF_BUCKET_MB cap when
+        merge_bucket_mb is unset).
+
+        merge_fused: force the fused merge-apply Pallas kernel
+        (ops/pallas/fused_merge.py) on (True) or off (False) for the
+        bucketed strategies; None auto-selects it on TPU backends where
+        a Mosaic kernel may be emitted, falling back to the
+        bit-identical lax chain elsewhere (always the fallback under
+        JAX_PLATFORMS=cpu)."""
         self.mesh = mesh
         self.loss_fn = loss_fn
         self.metrics_fn = metrics_fn
@@ -322,20 +347,70 @@ class KAvgEngine:
         self._seq_train = (mesh.shape[SEQ_AXIS] > 1
                            and bool(self.batch_seq_dims))
         self._full_manual = self._seq_train or bool(manual_inner)
-        # compressed merges on meshes with Auto inner axes must ride the
+        # sub-f32 wires on meshes with Auto inner axes must ride the
         # ppermute ring: a sub-f32 lax.psum fatally miscompiles in the
         # partially-manual partitioner (parallel/collectives.py). Fully-
         # manual rounds (seq-parallel / manual-TP) psum directly.
+        self._wire_ring = (mesh.size != self.n_lanes
+                           and not self._full_manual)
         self._compressed_ring = (merge_dtype is not None
-                                 and mesh.size != self.n_lanes
-                                 and not self._full_manual)
+                                 and self._wire_ring)
         if merge_dtype is not None:
             if not jnp.issubdtype(jnp.dtype(merge_dtype), jnp.floating):
                 raise ValueError(
                     f"merge_dtype must be a floating dtype, got "
                     f"{jnp.dtype(merge_dtype)}")
+        self.merge_bucket_mb = float(merge_bucket_mb)
+        self.merge_compress = str(merge_compress or "none")
+        self._merge = merge_lib.make_strategy(
+            merge_dtype=merge_dtype, bucket_mb=self.merge_bucket_mb,
+            compress=self.merge_compress, use_ring=self._wire_ring,
+            fused=merge_fused)
+        self._ef = self._merge.needs_residual
+        # per-lane EF residuals: dict of flat [D * L_bucket] f32 arrays
+        # sharded over `data`, threaded through (and donated to) every
+        # train dispatch; None until the first compressed round
+        self._ef_state: Optional[Dict[str, jax.Array]] = None
         self._train_cache: Dict[Any, Callable] = {}
         self._eval_cache: Dict[Any, Callable] = {}
+
+    @property
+    def merge_strategy(self) -> str:
+        """Registered name of the active merge strategy
+        (parallel/merge.py MERGE_STRATEGIES)."""
+        return self._merge.name
+
+    @property
+    def programs_compiled(self) -> int:
+        """Distinct train-round programs built by this engine — the
+        bench comm-proxy's compiled-program count."""
+        return len(self._train_cache)
+
+    def merge_comm_proxy(self, variables: PyTree) -> Dict[str, int]:
+        """Deterministic per-round wire numbers for this engine's merge
+        strategy over `variables` (see merge.MergeStrategy.comm_proxy)."""
+        out = self._merge.comm_proxy(variables)
+        out["strategy"] = self._merge.name
+        return out
+
+    def reset_merge_residuals(self) -> None:
+        """Drop the EF residual state (membership/shape changes, or a
+        cold restart where carrying stale error would be wrong)."""
+        self._ef_state = None
+
+    def _ef_residuals(self, variables: PyTree) -> Dict[str, jax.Array]:
+        """Current per-lane EF residuals, zero-initialized on first use."""
+        sizes = self._merge.residual_sizes(variables)
+        if (self._ef_state is not None
+                and set(self._ef_state) == set(sizes)
+                and all(self._ef_state[k].shape[0] == self.n_lanes * n
+                        for k, n in sizes.items())):
+            return self._ef_state
+        sh = NamedSharding(self.mesh, P(DATA_AXIS))
+        self._ef_state = {
+            k: jax.device_put(np.zeros(self.n_lanes * n, np.float32), sh)
+            for k, n in sizes.items()}
+        return self._ef_state
 
     def _shmap_manual_kwargs(self) -> Dict[str, Any]:
         """shard_map manual-axes kwargs shared by the train and eval
@@ -461,7 +536,7 @@ class KAvgEngine:
             return new_vars, out.sum(), None
 
         def lane_fn(variables, batch, sample_mask, step_mask, worker_mask,
-                    rngs, lr, epoch):
+                    rngs, lr, epoch, resid=None):
             # per-lane shapes: batch [W/D, S, B, ...], masks likewise, all
             # already sliced by shard_map over the data axis.
             contrib = jax.tree_util.tree_map(
@@ -514,48 +589,16 @@ class KAvgEngine:
 
             raw_count = lax.psum(eff_count, DATA_AXIS)
             count = jnp.maximum(raw_count, 1.0)  # guard 0-contributor divide
-            merge_dtype = self.merge_dtype
-            use_ring = self._compressed_ring
-
-            def merge_leaf(c, ref):
-                # integer leaves (BatchNorm counters) stay uncompressed:
-                # bf16's 8-bit mantissa would drift a counter > 256 even
-                # when every worker agrees, breaking the exact average-
-                # and-truncate contract above
-                if (merge_dtype is not None
-                        and jnp.issubdtype(ref.dtype, jnp.floating)):
-                    # compress at the communication boundary only: local
-                    # accumulation stays f32, the wire carries merge_dtype.
-                    # Error: ~2^-8 relative per cast PLUS the reduction
-                    # chain accumulating through bf16 hops, so worst case
-                    # grows with the lane count (~D*2^-8) — acceptable
-                    # for weight averaging, not for exact counters
-                    # (skipped above). Full-manual meshes use the direct
-                    # sub-f32 psum; Auto-inner meshes must take the
-                    # ppermute ring (collectives.py: the partial-manual
-                    # sub-f32 psum is a fatal partitioner miscompile).
-                    if use_ring:
-                        from kubeml_tpu.parallel.collectives import \
-                            ring_psum
-                        s = ring_psum(c, DATA_AXIS, merge_dtype)
-                    else:
-                        s = lax.psum(c.astype(merge_dtype), DATA_AXIS
-                                     ).astype(jnp.float32)
-                    merged = (s / count).astype(ref.dtype)
-                else:
-                    merged = (lax.psum(c, DATA_AXIS) / count
-                              ).astype(ref.dtype)
-                # every contributor dropped (all workers non-finite this
-                # round): contrib is all-zero and dividing by the clamped
-                # count would SILENTLY ZERO the weights. Carry the round-
-                # start variables forward instead — the round becomes a
-                # no-op and the job-level abort_after policy decides
-                # whether to keep going. For raw_count > 0 the select
-                # picks the identical merged value, so the normal path
-                # stays bit-identical.
-                return jnp.where(raw_count > 0, merged, ref)
-
-            avg = jax.tree_util.tree_map(merge_leaf, contrib, variables)
+            # the strategy object (parallel/merge.py, selected at engine
+            # construction) owns the cross-lane wire: per-leaf psums
+            # (monolithic), flat size-capped buckets (bucketed, one
+            # collective each), or EF-compressed buckets with per-lane
+            # residual carry. All variants preserve the all-dropped
+            # carry-forward (raw_count == 0 returns `variables`) and the
+            # SELECT-not-multiply drop guard applied to `contrib` above.
+            avg, new_resid = self._merge.lane_merge(
+                contrib, variables, raw_count, count,
+                lane_alive=eff_count > 0, residual=resid)
             if collect:
                 # cross-worker loss spread: population std of the merged
                 # workers' per-step mean losses, computed with two psums
@@ -563,9 +606,13 @@ class KAvgEngine:
                 m1 = lax.psum(spread_m1, DATA_AXIS) / count
                 m2 = lax.psum(spread_m2, DATA_AXIS) / count
                 spread = jnp.sqrt(jnp.maximum(m2 - m1 * m1, 0.0))
-                return avg, (jnp.stack(loss_sums), jnp.stack(dropped),
-                             jnp.stack(stat_rows), spread)
-            return avg, (jnp.stack(loss_sums), jnp.stack(dropped))
+                outs = (jnp.stack(loss_sums), jnp.stack(dropped),
+                        jnp.stack(stat_rows), spread)
+            else:
+                outs = (jnp.stack(loss_sums), jnp.stack(dropped))
+            if self._ef:
+                return avg, outs, new_resid
+            return avg, outs
 
         return lane_fn
 
@@ -579,18 +626,33 @@ class KAvgEngine:
             return (P(DATA_AXIS), P())
         return (lift(P(DATA_AXIS)), P(None))
 
+    def _ef_specs(self) -> tuple:
+        """Extra in/out spec tail for the EF residual dict: per-lane
+        flat buckets live as [D * L] arrays sharded over `data` (the
+        spec is a pytree prefix over the dict). Empty when the strategy
+        carries no residual."""
+        return (P(DATA_AXIS),) if self._ef else ()
+
+    def _donate(self, resid_arg: int) -> tuple:
+        """Donated argnums: the variables buffer plus — for EF
+        strategies — the residual carry at position `resid_arg` (both
+        are replaced by the round's outputs)."""
+        if not self.donate:
+            return ()
+        return (0, resid_arg) if self._ef else (0,)
+
     def _build_train_round(self, w_per_lane: int, batch_template=None):
         """Compile the sync-round program: one sync round per dispatch."""
         sharded = compat.shard_map(
             self._make_lane_fn(w_per_lane), mesh=self.mesh,
             in_specs=(P(), self._batch_in_specs(batch_template),
                       P(DATA_AXIS), P(DATA_AXIS),
-                      P(DATA_AXIS), P(DATA_AXIS), P(), P()),
+                      P(DATA_AXIS), P(DATA_AXIS), P(), P())
+            + self._ef_specs(),
             out_specs=(P(), (P(DATA_AXIS), P(DATA_AXIS))
-                       + self._stat_out_specs()),
+                       + self._stat_out_specs()) + self._ef_specs(),
             **self._shmap_kwargs())
-        donate = (0,) if self.donate else ()
-        return jax.jit(sharded, donate_argnums=donate)
+        return jax.jit(sharded, donate_argnums=self._donate(8))
 
     def _build_train_rounds(self, w_per_lane: int, batch_template=None):
         """Compile the R-round program: a lax.scan of the SAME per-lane
@@ -602,16 +664,28 @@ class KAvgEngine:
         quantifies it). R is baked into the program via the leading axis
         of every non-variables input."""
         lane_fn = self._make_lane_fn(w_per_lane)
+        ef = self._ef
 
         def multi_lane(variables, batch, sample_mask, step_mask,
-                       worker_mask, rngs, lr, epoch):
-            def one(vars_, xs):
+                       worker_mask, rngs, lr, epoch, *resid):
+            # EF residuals ride the round scan as part of the carry:
+            # round r+1's payload re-injects round r's cast error.
+            def one(carry, xs):
+                vars_, rs = carry
                 b, sm, stm, wm, rg = xs
-                return lane_fn(vars_, b, sm, stm, wm, rg, lr, epoch)
+                out = lane_fn(vars_, b, sm, stm, wm, rg, lr, epoch, rs)
+                if ef:
+                    avg, outs, new_rs = out
+                    return (avg, new_rs), outs
+                avg, outs = out
+                return (avg, None), outs
 
-            return lax.scan(one, variables,
-                            (batch, sample_mask, step_mask, worker_mask,
-                             rngs))
+            (vars_, rs), outs = lax.scan(
+                one, (variables, resid[0] if ef else None),
+                (batch, sample_mask, step_mask, worker_mask, rngs))
+            if ef:
+                return vars_, outs, rs
+            return vars_, outs
 
         def lift(spec: P) -> P:
             return P(None, *spec)
@@ -624,12 +698,22 @@ class KAvgEngine:
             multi_lane, mesh=self.mesh,
             in_specs=(P(), batch_specs,
                       lift(P(DATA_AXIS)), lift(P(DATA_AXIS)),
-                      lift(P(DATA_AXIS)), lift(P(DATA_AXIS)), P(), P()),
+                      lift(P(DATA_AXIS)), lift(P(DATA_AXIS)), P(), P())
+            + self._ef_specs(),
             out_specs=(P(), (lift(P(DATA_AXIS)), lift(P(DATA_AXIS)))
-                       + self._stat_out_specs(lift)),
+                       + self._stat_out_specs(lift)) + self._ef_specs(),
             **self._shmap_kwargs())
-        donate = (0,) if self.donate else ()
-        return jax.jit(sharded, donate_argnums=donate)
+        return jax.jit(sharded, donate_argnums=self._donate(8))
+
+    def _dispatch(self, fn: Callable, variables: PyTree, *args):
+        """Invoke a compiled round program, threading (and re-stashing)
+        the EF residual carry when the strategy keeps one."""
+        if self._ef:
+            resid = self._ef_residuals(variables)
+            avg, outs, new_resid = fn(variables, *args, resid)
+            self._ef_state = new_resid
+            return avg, outs
+        return fn(variables, *args)
 
     def train_rounds(self, variables: PyTree, batch: PyTree,
                      sample_mask: np.ndarray, step_mask: np.ndarray,
@@ -654,8 +738,8 @@ class KAvgEngine:
         if compiled:
             self._train_cache[key] = self._build_train_rounds(
                 w_per_lane, batch_template=batch)
-        avg, (loss_sums, dropped, *extra) = self._train_cache[key](
-            variables, batch,
+        avg, (loss_sums, dropped, *extra) = self._dispatch(
+            self._train_cache[key], variables, batch,
             jnp.asarray(sample_mask, jnp.float32),
             jnp.asarray(step_mask, jnp.float32),
             jnp.asarray(worker_mask, jnp.float32),
@@ -697,8 +781,8 @@ class KAvgEngine:
 
         # shard_map slices dim 0 contiguously: lane d owns virtual workers
         # [d*W/D, (d+1)*W/D) — matching the reference's contiguous doc shards.
-        avg, (loss_sums, dropped, *extra) = self._train_cache[key](
-            variables, batch,
+        avg, (loss_sums, dropped, *extra) = self._dispatch(
+            self._train_cache[key], variables, batch,
             jnp.asarray(sample_mask, jnp.float32),
             jnp.asarray(step_mask, jnp.float32),
             jnp.asarray(worker_mask, jnp.float32),
@@ -732,7 +816,8 @@ class KAvgEngine:
         device_transform = cache.device_transform
 
         def indexed_lane(variables, cache_arrays, idx, sample_mask,
-                         step_mask, worker_mask, rngs, lr, epoch):
+                         step_mask, worker_mask, rngs, lr, epoch,
+                         resid=None):
             # sharded layout: the [D, L, ...] slab arrives per-lane as
             # [1, L, ...]; indices are lane-local into that slab.
             # replicated layout: the full [n, ...] split, global indices.
@@ -743,7 +828,7 @@ class KAvgEngine:
             else:
                 batch = {k: v[idx] for k, v in src.items()}
             return lane_fn(variables, batch, sample_mask, step_mask,
-                           worker_mask, rngs, lr, epoch)
+                           worker_mask, rngs, lr, epoch, resid)
 
         return indexed_lane
 
@@ -756,30 +841,40 @@ class KAvgEngine:
             self._indexed_lane_fn(w_per_lane, cache), mesh=self.mesh,
             in_specs=(P(), self._cache_in_specs(cache),
                       P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS),
-                      P(DATA_AXIS), P(DATA_AXIS), P(), P()),
+                      P(DATA_AXIS), P(DATA_AXIS), P(), P())
+            + self._ef_specs(),
             out_specs=(P(), (P(DATA_AXIS), P(DATA_AXIS))
-                       + self._stat_out_specs()),
+                       + self._stat_out_specs()) + self._ef_specs(),
             **self._shmap_kwargs())
-        # donate only the variables — the cache (arg 1) must outlive
-        # every round of the job
-        donate = (0,) if self.donate else ()
-        return jax.jit(sharded, donate_argnums=donate)
+        # donate only the variables (and the EF residual carry) — the
+        # cache (arg 1) must outlive every round of the job
+        return jax.jit(sharded, donate_argnums=self._donate(9))
 
     def _build_train_rounds_indexed(self, w_per_lane: int, cache):
         indexed = self._indexed_lane_fn(w_per_lane, cache)
+        ef = self._ef
 
         def multi_lane(variables, cache_arrays, idx, sample_mask,
-                       step_mask, worker_mask, rngs, lr, epoch):
-            def one(vars_, xs):
+                       step_mask, worker_mask, rngs, lr, epoch, *resid):
+            def one(carry, xs):
+                vars_, rs = carry
                 ix, sm, stm, wm, rg = xs
-                return indexed(vars_, cache_arrays, ix, sm, stm, wm, rg,
-                               lr, epoch)
+                out = indexed(vars_, cache_arrays, ix, sm, stm, wm, rg,
+                              lr, epoch, rs)
+                if ef:
+                    avg, outs, new_rs = out
+                    return (avg, new_rs), outs
+                avg, outs = out
+                return (avg, None), outs
 
             # the cache rides the scan as a closed-over constant: R
             # rounds of indices scan over it without it ever moving
-            return lax.scan(one, variables,
-                            (idx, sample_mask, step_mask, worker_mask,
-                             rngs))
+            (vars_, rs), outs = lax.scan(
+                one, (variables, resid[0] if ef else None),
+                (idx, sample_mask, step_mask, worker_mask, rngs))
+            if ef:
+                return vars_, outs, rs
+            return vars_, outs
 
         def lift(spec: P) -> P:
             return P(None, *spec)
@@ -789,12 +884,11 @@ class KAvgEngine:
             in_specs=(P(), self._cache_in_specs(cache),
                       lift(P(DATA_AXIS)), lift(P(DATA_AXIS)),
                       lift(P(DATA_AXIS)), lift(P(DATA_AXIS)),
-                      lift(P(DATA_AXIS)), P(), P()),
+                      lift(P(DATA_AXIS)), P(), P()) + self._ef_specs(),
             out_specs=(P(), (lift(P(DATA_AXIS)), lift(P(DATA_AXIS)))
-                       + self._stat_out_specs(lift)),
+                       + self._stat_out_specs(lift)) + self._ef_specs(),
             **self._shmap_kwargs())
-        donate = (0,) if self.donate else ()
-        return jax.jit(sharded, donate_argnums=donate)
+        return jax.jit(sharded, donate_argnums=self._donate(9))
 
     def train_round_indexed(self, variables: PyTree, cache,
                             idx: np.ndarray, sample_mask: np.ndarray,
@@ -819,8 +913,8 @@ class KAvgEngine:
         if compiled:
             self._train_cache[key] = self._build_train_round_indexed(
                 w_per_lane, cache)
-        avg, (loss_sums, dropped, *extra) = self._train_cache[key](
-            variables, cache.arrays,
+        avg, (loss_sums, dropped, *extra) = self._dispatch(
+            self._train_cache[key], variables, cache.arrays,
             jnp.asarray(idx, jnp.int32),
             jnp.asarray(sample_mask, jnp.float32),
             jnp.asarray(step_mask, jnp.float32),
@@ -860,8 +954,8 @@ class KAvgEngine:
         if compiled:
             self._train_cache[key] = self._build_train_rounds_indexed(
                 w_per_lane, cache)
-        avg, (loss_sums, dropped, *extra) = self._train_cache[key](
-            variables, cache.arrays,
+        avg, (loss_sums, dropped, *extra) = self._dispatch(
+            self._train_cache[key], variables, cache.arrays,
             jnp.asarray(idx, jnp.int32),
             jnp.asarray(sample_mask, jnp.float32),
             jnp.asarray(step_mask, jnp.float32),

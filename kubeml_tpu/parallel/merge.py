@@ -1,0 +1,476 @@
+"""Bucket planner + merge-strategy objects shared by both engines.
+
+The sync round's communication step — mask-guarded cross-lane averaging
+in the K-avg engine, the gradient all-reduce in the sync-DP engine —
+used to live as a monolithic per-leaf `lax.psum` inline in each engine.
+This module factors it into one place with two orthogonal levers:
+
+  * BUCKETING (DDP-style): consecutive leaves are packed into size-capped
+    flat f32 buckets and each bucket is reduced with ONE collective.
+    Fewer, larger collectives amortize per-collective latency, and the
+    independent per-bucket psums give XLA's latency-hiding scheduler
+    freedom to overlap early buckets' collectives with the tail of the
+    round's compute (the `lax.scan` of local steps) — the overlap model
+    docs/performance.md describes. The f32 bucketed merge is BIT-IDENTICAL
+    to the monolithic merge: a psum is elementwise over lanes, so
+    psum(concat(a, b)) == concat(psum(a), psum(b)) exactly, and the
+    guard-select/divide/cast chain applies the same IEEE ops per element.
+
+  * ERROR-FEEDBACK COMPRESSION (1-bit-SGD / EF-SignSGD family): each
+    lane quantizes payload = contribution + residual to bf16 (cast) or
+    int8 (shared per-bucket scale from a cross-lane max), ships the
+    quantized bucket, and keeps residual' = payload - decode(payload) for
+    the next round, so quantization error is re-injected instead of lost.
+    Residuals are per-lane persistent state threaded through the round
+    programs as extra (donated) carry; they are ZEROED for lanes with no
+    live contributor this round (quarantined / NaN-dropped workers), so
+    the non-finite merge guard's semantics survive compression — a
+    revived worker never replays a stale or poisoned residual.
+
+Strategy registry: every variant is registered by name below and
+`tools/check_merge_parity.py` lints that each registered name is covered
+by a bit-identity or bounded-divergence test in tests/.
+
+Wire-safety rules inherited from the engines (parallel/collectives.py):
+a sub-f32 `lax.psum` fatally miscompiles in the partially-manual
+partitioner, so compressed wires ride the ppermute ring on meshes with
+Auto inner axes (`use_ring=True`) and psum directly only on fully-manual
+rounds. The int8 strategy sidesteps the issue entirely: quantized values
+are integer-valued f32 (exact in f32 psums up to 2^24), so its wire
+collective is always a plain f32 psum of small integers plus one pmax
+for the shared scale.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from kubeml_tpu.parallel.mesh import DATA_AXIS
+
+PyTree = Any
+
+# default size cap for EF-compressed buckets when the caller sets a
+# compression scheme but no explicit merge_bucket_mb
+DEFAULT_EF_BUCKET_MB = 4.0
+
+
+def _leaf_elems(leaf) -> int:
+    return int(np.prod(np.shape(leaf))) if np.ndim(leaf) else 1
+
+
+def _leaf_float(leaf) -> bool:
+    return jnp.issubdtype(jnp.asarray(leaf).dtype
+                          if not hasattr(leaf, "dtype")
+                          else jnp.dtype(leaf.dtype), jnp.floating)
+
+
+@dataclass(frozen=True)
+class Bucket:
+    """One merge bucket: a run of consecutive tree leaves reduced with a
+    single flat collective. `compressible` buckets hold only floating
+    leaves (wire compression / EF may apply); exact buckets hold integer
+    leaves (BatchNorm counters etc.) whose average-and-truncate contract
+    requires a full-precision wire."""
+    indices: Tuple[int, ...]     # leaf positions in tree_leaves order
+    sizes: Tuple[int, ...]       # element count per leaf
+    length: int                  # total elements in the bucket
+    compressible: bool
+
+
+@dataclass(frozen=True)
+class BucketPlan:
+    buckets: Tuple[Bucket, ...]
+    n_leaves: int
+
+    @property
+    def n_buckets(self) -> int:
+        return len(self.buckets)
+
+
+def plan_buckets(leaves, bucket_mb: float) -> BucketPlan:
+    """Pack consecutive leaves into size-capped buckets.
+
+    Leaves keep their tree order (stable: jax's tree flatten sorts dict
+    keys), consecutive float leaves pack greedily until the bucket would
+    exceed `bucket_mb` MB of f32 payload (a single leaf larger than the
+    cap gets its own bucket), and integer leaves never share a bucket
+    with float ones so exact and compressible wires stay separable.
+    bucket_mb <= 0 means "one bucket per kind" (no size cap).
+    Accepts arrays or ShapeDtypeStructs — only shape/dtype are read."""
+    cap_elems = int(bucket_mb * 1024 * 1024 / 4) if bucket_mb > 0 else 0
+    buckets: List[Bucket] = []
+    cur_idx: List[int] = []
+    cur_sizes: List[int] = []
+    cur_len = 0
+    cur_float = True
+
+    def flush():
+        nonlocal cur_idx, cur_sizes, cur_len
+        if cur_idx:
+            buckets.append(Bucket(tuple(cur_idx), tuple(cur_sizes),
+                                  cur_len, cur_float))
+        cur_idx, cur_sizes, cur_len = [], [], 0
+
+    for i, leaf in enumerate(leaves):
+        n = _leaf_elems(leaf)
+        is_float = _leaf_float(leaf)
+        if cur_idx and (is_float != cur_float
+                        or (cap_elems and cur_len + n > cap_elems)):
+            flush()
+        cur_float = is_float
+        cur_idx.append(i)
+        cur_sizes.append(n)
+        cur_len += n
+    flush()
+    return BucketPlan(tuple(buckets), len(list(leaves)))
+
+
+def _ring_psum(x, wire_dtype):
+    from kubeml_tpu.parallel.collectives import ring_psum
+    return ring_psum(x, DATA_AXIS, wire_dtype)
+
+
+# --------------------------------------------------------------- registry
+
+MERGE_STRATEGIES: Dict[str, Callable[..., "MergeStrategy"]] = {}
+
+
+def _register(name: str):
+    def deco(cls):
+        MERGE_STRATEGIES[name] = cls
+        cls.name = name
+        return cls
+    return deco
+
+
+def make_strategy(merge_dtype: Any = None, bucket_mb: float = 0.0,
+                  compress: str = "none", use_ring: bool = False,
+                  fused: Optional[bool] = None) -> "MergeStrategy":
+    """Map the engine knobs to a registered strategy instance.
+
+    merge_dtype: legacy wire cast (no EF) applied to float payloads.
+    bucket_mb > 0 selects the bucketed strategy; compress in
+    {"bf16", "int8"} selects the EF strategies (implies bucketing, with
+    a DEFAULT_EF_BUCKET_MB cap when bucket_mb is unset). merge_dtype
+    and compress are mutually exclusive: EF already owns the wire."""
+    compress = str(compress or "none")
+    if compress not in ("none", "bf16", "int8"):
+        raise ValueError(f"merge_compress must be none|bf16|int8, "
+                         f"got {compress!r}")
+    if compress != "none":
+        if merge_dtype is not None:
+            raise ValueError("merge_dtype and merge_compress are mutually "
+                             "exclusive (EF compression owns the wire "
+                             "dtype)")
+        mb = bucket_mb if bucket_mb > 0 else DEFAULT_EF_BUCKET_MB
+        cls = MERGE_STRATEGIES["ef_bf16" if compress == "bf16"
+                               else "ef_int8"]
+        return cls(bucket_mb=mb, use_ring=use_ring, fused=fused)
+    if bucket_mb > 0:
+        return MERGE_STRATEGIES["bucketed"](
+            wire_dtype=merge_dtype, bucket_mb=bucket_mb, use_ring=use_ring,
+            fused=fused)
+    return MERGE_STRATEGIES["monolithic"](
+        wire_dtype=merge_dtype, use_ring=use_ring)
+
+
+class MergeStrategy:
+    """One sync-round cross-lane merge, called INSIDE the engines'
+    shard_map lane body.
+
+    lane_merge(contrib, ref, raw_count, count, lane_alive, residual):
+      contrib    per-lane f32 contribution tree (masked sums)
+      ref        round-start variables tree (carry-forward + dtype source)
+      raw_count  psum'd live-contributor count (0 => all dropped)
+      count      max(raw_count, 1) — safe divisor
+      lane_alive scalar bool: this lane shipped >= 1 live contribution
+      residual   per-lane EF residual dict (needs_residual only)
+    returns (avg_tree, new_residual_or_None). The all-dropped guard is
+    part of the contract: raw_count == 0 must return `ref` unchanged."""
+
+    name = "?"
+    needs_residual = False
+
+    def residual_sizes(self, variables: PyTree) -> Dict[str, int]:
+        """Per-lane flat residual lengths keyed by bucket name ({} for
+        strategies without EF state)."""
+        return {}
+
+    def lane_merge(self, contrib, ref, raw_count, count,
+                   lane_alive=None, residual=None):
+        raise NotImplementedError
+
+    def comm_proxy(self, variables: PyTree) -> Dict[str, int]:
+        """Deterministic CPU-tier communication proxy for one merge:
+        wire payload bytes per lane per round and collective/bucket
+        counts — computable from leaf shapes alone, so bench can assert
+        them stable without an accelerator."""
+        raise NotImplementedError
+
+
+def _wire_bytes(dtype) -> int:
+    return jnp.dtype(dtype).itemsize
+
+
+@_register("monolithic")
+class MonolithicMerge(MergeStrategy):
+    """The pre-bucketing merge, verbatim: one masked psum per tree leaf,
+    optional lossy wire cast on float leaves. The reference baseline all
+    bit-identity tests anchor on."""
+
+    def __init__(self, wire_dtype: Any = None, use_ring: bool = False,
+                 **_):
+        self.wire_dtype = wire_dtype
+        self.use_ring = bool(use_ring) and wire_dtype is not None
+
+    def lane_merge(self, contrib, ref, raw_count, count,
+                   lane_alive=None, residual=None):
+        merge_dtype = self.wire_dtype
+        use_ring = self.use_ring
+
+        def merge_leaf(c, r):
+            # integer leaves (BatchNorm counters) stay uncompressed:
+            # bf16's 8-bit mantissa would drift a counter > 256 even
+            # when every worker agrees, breaking the exact average-
+            # and-truncate contract
+            if (merge_dtype is not None
+                    and jnp.issubdtype(r.dtype, jnp.floating)):
+                # compress at the communication boundary only: local
+                # accumulation stays f32, the wire carries merge_dtype.
+                # Error: ~2^-8 relative per cast PLUS the reduction
+                # chain accumulating through bf16 hops (~D*2^-8 worst
+                # case). Full-manual meshes psum the sub-f32 values
+                # directly; Auto-inner meshes must take the ppermute
+                # ring (a partial-manual sub-f32 psum is a fatal
+                # partitioner miscompile — parallel/collectives.py).
+                if use_ring:
+                    s = _ring_psum(c, merge_dtype)
+                else:
+                    s = lax.psum(c.astype(merge_dtype), DATA_AXIS
+                                 ).astype(jnp.float32)
+                merged = (s / count).astype(r.dtype)
+            else:
+                merged = (lax.psum(c, DATA_AXIS) / count).astype(r.dtype)
+            # every contributor dropped (all workers non-finite this
+            # round): contrib is all-zero and dividing by the clamped
+            # count would SILENTLY ZERO the weights. Carry the round-
+            # start variables forward instead. For raw_count > 0 the
+            # select picks the identical merged value, so the normal
+            # path stays bit-identical.
+            return jnp.where(raw_count > 0, merged, r)
+
+        return (jax.tree_util.tree_map(merge_leaf, contrib, ref), None)
+
+    def comm_proxy(self, variables):
+        leaves = jax.tree_util.tree_leaves(variables)
+        payload = 0
+        for leaf in leaves:
+            wb = (_wire_bytes(self.wire_dtype)
+                  if self.wire_dtype is not None and _leaf_float(leaf)
+                  else 4)
+            payload += _leaf_elems(leaf) * wb
+        return {"merge_payload_bytes": payload,
+                "buckets_per_round": len(leaves),
+                "collectives_per_round": len(leaves)}
+
+
+class _BucketedBase(MergeStrategy):
+    """Shared flat-bucket machinery: concat a bucket's leaves into one
+    f32 vector, reduce it with one collective, apply avg+guard-select
+    over the flat vector (via the fused Pallas kernel on TPU, a lax
+    fallback elsewhere — bit-identical math), then split and cast back
+    per leaf. Cast/select commute elementwise with the monolithic
+    per-leaf chain, which is what makes the f32 variant bit-identical."""
+
+    def __init__(self, bucket_mb: float, use_ring: bool = False,
+                 fused: Optional[bool] = None, **_):
+        self.bucket_mb = float(bucket_mb)
+        self.use_ring = bool(use_ring)
+        self.fused = fused
+
+    def _flat(self, leaves, bucket: Bucket):
+        parts = [leaves[i].reshape(-1).astype(jnp.float32)
+                 for i in bucket.indices]
+        return parts[0] if len(parts) == 1 else jnp.concatenate(parts)
+
+    def _apply(self, s, ref_f32, raw_count, count):
+        from kubeml_tpu.ops.pallas.fused_merge import fused_avg_select
+        return fused_avg_select(s, ref_f32, count, raw_count,
+                                fused=self.fused)
+
+    def _split(self, merged_flat, ref_leaves, bucket: Bucket, out):
+        off = 0
+        for i, n in zip(bucket.indices, bucket.sizes):
+            r = ref_leaves[i]
+            out[i] = merged_flat[off:off + n].reshape(r.shape
+                                                      ).astype(r.dtype)
+            off += n
+
+    def _reduce_bucket(self, flat_c, bucket: Bucket, lane_alive, residual):
+        """Returns (summed_f32, new_residual_or_None) for one bucket."""
+        raise NotImplementedError
+
+    def lane_merge(self, contrib, ref, raw_count, count,
+                   lane_alive=None, residual=None):
+        leaves_c, treedef = jax.tree_util.tree_flatten(contrib)
+        leaves_r = jax.tree_util.tree_leaves(ref)
+        plan = plan_buckets(leaves_r, self.bucket_mb)
+        merged: List[Any] = [None] * plan.n_leaves
+        new_resid: Dict[str, Any] = {}
+        for bi, bucket in enumerate(plan.buckets):
+            flat_c = self._flat(leaves_c, bucket)
+            ref_f32 = self._flat(leaves_r, bucket)
+            r_in = (residual.get(f"b{bi}")
+                    if self.needs_residual and residual is not None
+                    else None)
+            s, r_out = self._reduce_bucket(flat_c, bucket, lane_alive,
+                                           r_in)
+            if r_out is not None:
+                new_resid[f"b{bi}"] = r_out
+            m = self._apply(s, ref_f32, raw_count, count)
+            self._split(m, leaves_r, bucket, merged)
+        avg = jax.tree_util.tree_unflatten(treedef, merged)
+        return avg, (new_resid if self.needs_residual else None)
+
+    def residual_sizes(self, variables):
+        if not self.needs_residual:
+            return {}
+        plan = plan_buckets(jax.tree_util.tree_leaves(variables),
+                            self.bucket_mb)
+        return {f"b{bi}": b.length
+                for bi, b in enumerate(plan.buckets) if b.compressible}
+
+    def _bucket_wire_bytes(self, bucket: Bucket) -> int:
+        return bucket.length * 4
+
+    def comm_proxy(self, variables):
+        plan = plan_buckets(jax.tree_util.tree_leaves(variables),
+                            self.bucket_mb)
+        payload = sum(self._bucket_wire_bytes(b) for b in plan.buckets)
+        return {"merge_payload_bytes": payload,
+                "buckets_per_round": plan.n_buckets,
+                "collectives_per_round": plan.n_buckets}
+
+
+@_register("bucketed")
+class BucketedMerge(_BucketedBase):
+    """Size-capped flat-bucket merge. f32 wire (default) is bit-identical
+    to the monolithic merge; an optional wire_dtype cast (legacy
+    merge_dtype knob) compresses float buckets like the monolithic path
+    does per leaf — bounded-divergence there, since ring chunking over
+    the flat bucket rounds in a different order than per-leaf rings."""
+
+    def __init__(self, wire_dtype: Any = None, bucket_mb: float = 0.0,
+                 use_ring: bool = False, fused: Optional[bool] = None,
+                 **_):
+        super().__init__(bucket_mb, use_ring=use_ring, fused=fused)
+        self.wire_dtype = wire_dtype
+
+    def _reduce_bucket(self, flat_c, bucket, lane_alive, residual):
+        if self.wire_dtype is not None and bucket.compressible:
+            if self.use_ring:
+                return _ring_psum(flat_c, self.wire_dtype), None
+            return lax.psum(flat_c.astype(self.wire_dtype), DATA_AXIS
+                            ).astype(jnp.float32), None
+        return lax.psum(flat_c, DATA_AXIS), None
+
+    def _bucket_wire_bytes(self, bucket):
+        if self.wire_dtype is not None and bucket.compressible:
+            return bucket.length * _wire_bytes(self.wire_dtype)
+        return bucket.length * 4
+
+
+@_register("ef_bf16")
+class EFBf16Merge(_BucketedBase):
+    """Error-feedback bf16 merge: payload = contribution + residual is
+    cast to bf16 per lane, the bf16 values cross the wire (direct psum
+    on fully-manual rounds, f32-accumulating ppermute ring with bf16
+    hops on Auto-inner meshes), and residual' = payload - decode(payload)
+    carries the cast error to the next round. Residuals for dead lanes
+    (no live contributor: quarantined or NaN-dropped) are zeroed."""
+
+    needs_residual = True
+
+    def _reduce_bucket(self, flat_c, bucket, lane_alive, residual):
+        if not bucket.compressible:
+            return lax.psum(flat_c, DATA_AXIS), None
+        p = jnp.where(lane_alive, flat_c + residual, 0.0)
+        q = p.astype(jnp.bfloat16)
+        decoded = q.astype(jnp.float32)
+        new_r = jnp.where(lane_alive, p - decoded, 0.0)
+        if self.use_ring:
+            s = _ring_psum(decoded, jnp.bfloat16)
+        else:
+            s = lax.psum(q, DATA_AXIS).astype(jnp.float32)
+        return s, new_r
+
+    def _bucket_wire_bytes(self, bucket):
+        return bucket.length * (2 if bucket.compressible else 4)
+
+
+@_register("ef_int8")
+class EFInt8Merge(_BucketedBase):
+    """Error-feedback int8 merge with a SHARED per-bucket scale: one
+    cross-lane pmax fixes scale = max|payload| / 127, every lane ships
+    round(payload/scale) — integer-valued and exactly representable in
+    f32, so the wire collective is an ordinary f32 psum (safe on every
+    mesh, no ring needed) whose sum is exact; decode multiplies the
+    summed integers by the shared scale. residual' = payload -
+    round(payload/scale)*scale is exact per lane. Dead lanes ship zeros
+    and zero their residual."""
+
+    needs_residual = True
+
+    def _reduce_bucket(self, flat_c, bucket, lane_alive, residual):
+        if not bucket.compressible:
+            return lax.psum(flat_c, DATA_AXIS), None
+        p = jnp.where(lane_alive, flat_c + residual, 0.0)
+        amax = lax.pmax(jnp.max(jnp.abs(p)), DATA_AXIS)
+        scale = amax / 127.0
+        safe = jnp.where(scale > 0, scale, 1.0)
+        q = jnp.where(scale > 0, jnp.round(p / safe), 0.0)
+        decoded = q * scale
+        new_r = jnp.where(lane_alive, p - decoded, 0.0)
+        s = lax.psum(q, DATA_AXIS) * scale
+        return s, new_r
+
+    def _bucket_wire_bytes(self, bucket):
+        # 1 byte/element + one broadcast f32 scale per bucket
+        if bucket.compressible:
+            return bucket.length + 4
+        return bucket.length * 4
+
+
+def strategy_by_name(name: str, wire_dtype: Any = None,
+                     bucket_mb: float = 0.0, use_ring: bool = False,
+                     fused: Optional[bool] = None) -> "MergeStrategy":
+    """Instantiate a registered strategy by name (the sync-DP engine's
+    explicit-merge knob). EF strategies get the default bucket cap when
+    bucket_mb is unset."""
+    if name not in MERGE_STRATEGIES:
+        raise ValueError(f"unknown merge strategy {name!r}; registered: "
+                         f"{sorted(MERGE_STRATEGIES)}")
+    cls = MERGE_STRATEGIES[name]
+    if getattr(cls, "needs_residual", False) and bucket_mb <= 0:
+        bucket_mb = DEFAULT_EF_BUCKET_MB
+    return cls(wire_dtype=wire_dtype, bucket_mb=bucket_mb,
+               use_ring=use_ring, fused=fused)
+
+
+def merge_comm_proxy(variables: PyTree, merge_dtype: Any = None,
+                     bucket_mb: float = 0.0, compress: str = "none"
+                     ) -> Dict[str, int]:
+    """Module-level comm proxy for bench/tests: build the strategy the
+    engine would pick for these knobs and report its deterministic
+    per-round wire numbers."""
+    strategy = make_strategy(merge_dtype=merge_dtype, bucket_mb=bucket_mb,
+                             compress=compress)
+    out = strategy.comm_proxy(variables)
+    out["strategy"] = strategy.name
+    return out

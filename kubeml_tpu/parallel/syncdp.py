@@ -52,6 +52,8 @@ import optax
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from kubeml_tpu import compat
+from kubeml_tpu.parallel import merge as merge_lib
 from kubeml_tpu.parallel.kavg import (_select_tree, masked_scalar_loss,
                                       tree_all_finite, tree_sq_norm)
 from kubeml_tpu.parallel.mesh import DATA_AXIS
@@ -68,7 +70,10 @@ class SyncDPEngine:
 
     def __init__(self, mesh: Mesh, loss_fn: Callable, tx_factory: Callable,
                  zero1: bool = True, fsdp: bool = False,
-                 donate: bool = True, collect_stats: bool = False):
+                 donate: bool = True, collect_stats: bool = False,
+                 merge_strategy: Optional[str] = None,
+                 merge_bucket_mb: float = 0.0,
+                 merge_fused: Optional[bool] = None):
         """zero1=True shards optimizer state over the data axis (ZeRO-1);
         fsdp=True additionally shards the PARAMETERS over the data axis
         (ZeRO-3 / FSDP: each chip stores 1/D of the model and GSPMD
@@ -81,7 +86,26 @@ class SyncDPEngine:
         the scan — pure EXTRA outputs computed from values the step
         already produces, so trained weights are bit-identical with the
         flag on or off, and they stay on device until the job's
-        epoch-end drain (no mid-epoch host syncs)."""
+        epoch-end drain (no mid-epoch host syncs).
+
+        merge_strategy selects an EXPLICIT gradient merge through the
+        shared strategy objects of parallel/merge.py instead of the
+        implicit GSPMD all-reduce: per-lane gradient sums computed under
+        a shard_map over `data`, reduced by the named strategy
+        ("monolithic" | "bucketed" | "ef_bf16" | "ef_int8", with
+        merge_bucket_mb sizing the flat buckets), then normalized by the
+        global real-sample count — the same masked-mean semantics as
+        the implicit path, so skip-step guards and stat lanes carry
+        over unchanged. "bucketed" is bit-identical to "monolithic";
+        EF strategies keep per-lane residual state inside the carried
+        train state (key "merge_resid", zeroed on skipped steps and for
+        fully-masked lanes). Model-state float leaves (batch stats)
+        come back as the cross-lane mean — per-lane statistics, the
+        DDP convention — where the implicit path computes global-batch
+        statistics; stick to the implicit path when that distinction
+        matters. Incompatible with fsdp (sharded params need GSPMD's
+        reduce-scatter). merge_fused forwards to the bucketed apply
+        kernel (ops/pallas/fused_merge.py)."""
         self.mesh = mesh
         self.loss_fn = loss_fn
         self.tx_factory = tx_factory
@@ -90,6 +114,15 @@ class SyncDPEngine:
         self.donate = donate
         self.collect_stats = bool(collect_stats)
         self.n_lanes = mesh.shape[DATA_AXIS]
+        if merge_strategy is not None and fsdp:
+            raise ValueError("explicit merge strategies are incompatible "
+                             "with fsdp (sharded params rely on GSPMD's "
+                             "gradient reduce-scatter)")
+        self._merge = (merge_lib.strategy_by_name(
+            merge_strategy, bucket_mb=merge_bucket_mb,
+            use_ring=mesh.size != self.n_lanes, fused=merge_fused)
+            if merge_strategy is not None else None)
+        self._ef = self._merge is not None and self._merge.needs_residual
         self._cache: Dict[Any, Callable] = {}
         self._opt_specs: Optional[PyTree] = None
         self._param_specs: Optional[PyTree] = None
@@ -109,6 +142,28 @@ class SyncDPEngine:
         # discipline as last_skipped_device — keep on device, reduce at
         # epoch end. None when collect_stats is off.
         self.last_stats_device: Optional[jax.Array] = None
+
+    @property
+    def merge_strategy(self) -> Optional[str]:
+        """Registered name of the explicit merge strategy, or None when
+        the implicit GSPMD all-reduce is in charge."""
+        return self._merge.name if self._merge is not None else None
+
+    @property
+    def programs_compiled(self) -> int:
+        """Distinct train programs built by this engine."""
+        return len(self._cache)
+
+    def merge_comm_proxy(self, variables: PyTree) -> Dict[str, int]:
+        """Deterministic per-step gradient-merge wire numbers. The
+        implicit GSPMD path is reported as the monolithic strategy over
+        the params (one full-f32 all-reduce of the gradient tree)."""
+        strategy = self._merge or merge_lib.MERGE_STRATEGIES["monolithic"]()
+        out = strategy.comm_proxy(variables["params"]
+                                  if "params" in variables else variables)
+        out["strategy"] = (self._merge.name if self._merge is not None
+                           else "monolithic")
+        return out
 
     # ----------------------------------------------------------------- state
 
@@ -141,34 +196,151 @@ class SyncDPEngine:
         shardings = jax.tree_util.tree_map(
             lambda spec: NamedSharding(self.mesh, spec), self._opt_specs)
         opt_state = jax.jit(tx.init, out_shardings=shardings)(params)
-        return {
+        state = {
             "params": params,
             "model_state": {k: v for k, v in variables.items()
                             if k != "params"},
             "opt_state": opt_state,
         }
+        if self._ef:
+            # per-lane EF residuals live INSIDE the carried train state
+            # (donated and threaded like opt_state): flat [D * L_bucket]
+            # f32 per bucket, sharded over `data` so each lane owns its
+            # slice. Zero-initialized — a fresh state carries no error.
+            sizes = self._merge.residual_sizes(state["params"])
+            sh = NamedSharding(self.mesh, P(DATA_AXIS))
+            state["merge_resid"] = {
+                k: jax.device_put(np.zeros(self.n_lanes * n, np.float32),
+                                  sh)
+                for k, n in sizes.items()}
+        return state
 
     def variables(self, state: PyTree) -> PyTree:
         """Flax-style variable dict view (for eval/checkpoint/serving)."""
         return {"params": state["params"], **state["model_state"]}
 
+    def _state_shardings(self, state: PyTree) -> PyTree:
+        """NamedSharding tree for the carried train state (jit in/out
+        shardings): params/opt per the ZeRO rule, model_state
+        replicated, EF residuals lane-sharded over `data`."""
+        sh = {
+            "params": jax.tree_util.tree_map(
+                lambda spec: NamedSharding(self.mesh, spec),
+                self._param_specs),
+            "model_state": jax.tree_util.tree_map(
+                lambda _: NamedSharding(self.mesh, P()),
+                state["model_state"]),
+            "opt_state": jax.tree_util.tree_map(
+                lambda spec: NamedSharding(self.mesh, spec),
+                self._opt_specs),
+        }
+        if self._ef:
+            sh["merge_resid"] = jax.tree_util.tree_map(
+                lambda _: NamedSharding(self.mesh, P(DATA_AXIS)),
+                state["merge_resid"])
+        return sh
+
     # ----------------------------------------------------------------- train
+
+    def _lane_grad_fn(self):
+        """shard_map'd per-lane gradient + strategy merge for the
+        EXPLICIT merge path: each lane computes the gradient of its
+        UNNORMALIZED masked loss sum over its batch shard, the strategy
+        object reduces the per-lane sums (one bucketed/compressed
+        collective set instead of GSPMD's implicit all-reduce), and the
+        caller divides by the psum'd real-sample count — algebraically
+        the same masked-mean gradient as the implicit path."""
+        loss_fn = self.loss_fn
+        strategy = self._merge
+        ef = self._ef
+        n_lanes = self.n_lanes
+
+        def lane(params, model_state, mb, smask, rng, *resid):
+            def local_sum(p):
+                per_ex, new_state = loss_fn(
+                    {"params": p, **model_state}, mb,
+                    jax.random.wrap_key_data(rng), smask)
+                return (per_ex * smask).sum(), new_state
+
+            (lsum, new_state), g = jax.value_and_grad(
+                local_sum, has_aux=True)(params)
+            lane_n = smask.sum()
+            denom = lax.psum(lane_n, DATA_AXIS)
+            # a lane whose local grads went non-finite poisons the step
+            # for everyone (skip-step semantics, same as the implicit
+            # path) — but EF payload masking below would HIDE its NaN
+            # from the merged grads, so the bad-lane count travels
+            # explicitly and the caller folds it into grads_ok.
+            lane_finite = jnp.logical_and(tree_all_finite(g),
+                                          jnp.isfinite(lsum))
+            bad = lax.psum(1.0 - lane_finite.astype(jnp.float32),
+                           DATA_AXIS)
+            alive = jnp.logical_and(lane_n > 0, lane_finite)
+            raw = lax.psum(alive.astype(jnp.float32), DATA_AXIS)
+            # SUM the per-lane grads (count=1; normalization by the
+            # global sample count happens outside): ref is a zero tree,
+            # so an all-dead step merges to zero grads.
+            zeros = jax.tree_util.tree_map(
+                lambda x: jnp.zeros_like(x, jnp.float32), g)
+            gsum, new_resid = strategy.lane_merge(
+                g, zeros, raw, jnp.float32(1.0),
+                lane_alive=alive, residual=resid[0] if ef else None)
+            loss_tot = lax.psum(jnp.where(lane_finite, lsum, 0.0),
+                                DATA_AXIS)
+            # model_state: float leaves (batch stats) come back as the
+            # cross-lane mean (per-lane statistics, DDP convention);
+            # integer leaves (step counters) advance identically on
+            # every lane and pass through.
+            new_state = jax.tree_util.tree_map(
+                lambda l: ((lax.psum(l.astype(jnp.float32), DATA_AXIS)
+                            / n_lanes).astype(l.dtype)
+                           if jnp.issubdtype(l.dtype, jnp.inexact)
+                           else l),
+                new_state)
+            out = (gsum, loss_tot, denom, bad, new_state)
+            return out + ((new_resid,) if ef else ())
+
+        kw = dict(check_vma=False)
+        if self.mesh.size != self.n_lanes:
+            kw["axis_names"] = {DATA_AXIS}
+        ef_specs = (P(DATA_AXIS),) if ef else ()
+        return compat.shard_map(
+            lane, mesh=self.mesh,
+            in_specs=(P(), P(), P(DATA_AXIS), P(DATA_AXIS), P())
+            + ef_specs,
+            out_specs=(P(), P(), P(), P(), P()) + ef_specs,
+            **kw)
 
     def _build(self, opt_specs, param_specs):
         mesh = self.mesh
         loss_fn = self.loss_fn
         tx_factory = self.tx_factory
         collect = self.collect_stats
+        explicit = self._merge is not None
+        ef = self._ef
+        lane_grads = self._lane_grad_fn() if explicit else None
 
         def run(state, batch, sample_mask, rngs, lr, epoch):
             tx = tx_factory(lr, epoch)
 
             def step(carry, xs):
-                params, model_state, opt_state = carry
+                if ef:
+                    params, model_state, opt_state, resid = carry
+                else:
+                    params, model_state, opt_state = carry
+                    resid = None
                 mb, smask, rng = xs
-                (loss, new_state), grads = jax.value_and_grad(
-                    masked_scalar_loss(loss_fn, model_state, mb, rng,
-                                       smask), has_aux=True)(params)
+                if explicit:
+                    out = lane_grads(params, model_state, mb, smask, rng,
+                                     *((resid,) if ef else ()))
+                    gsum, loss_tot, denom, bad, new_state = out[:5]
+                    dn = jnp.maximum(denom, 1.0)
+                    grads = jax.tree_util.tree_map(lambda x: x / dn, gsum)
+                    loss = loss_tot / dn
+                else:
+                    (loss, new_state), grads = jax.value_and_grad(
+                        masked_scalar_loss(loss_fn, model_state, mb, rng,
+                                           smask), has_aux=True)(params)
                 updates, new_opt = tx.update(grads, opt_state, params)
                 new_params = optax.apply_updates(params, updates)
                 # skip-step guard: when the GLOBAL (all-reduced) gradient
@@ -179,6 +351,11 @@ class SyncDPEngine:
                 # escapes into the carry.
                 grads_ok = jnp.logical_and(tree_all_finite(grads),
                                            jnp.isfinite(loss))
+                if explicit:
+                    # EF payload masking hides a poisoned lane's NaN from
+                    # the merged grads; the explicit bad-lane count keeps
+                    # skip-step semantics identical to the implicit path
+                    grads_ok = jnp.logical_and(grads_ok, bad == 0)
                 real = (smask.sum() > 0).astype(jnp.float32)
                 # an all-masked step (ragged epoch tail) must be a true
                 # no-op: zero grads alone would still move adam's momentum
@@ -214,15 +391,40 @@ class SyncDPEngine:
                                    tree_sq_norm(new_params)]),
                         jnp.zeros((3,), jnp.float32))
                     outs = outs + (stat,)
+                if ef:
+                    # EF residual bookkeeping across the skip-step guard:
+                    # applied step -> keep the strategy's residual;
+                    # skipped (non-finite) step -> ZERO it (its payload
+                    # was wasted and may descend from poisoned values);
+                    # all-masked step (pure no-op) -> carry the old
+                    # residual, as if the step never happened.
+                    nr = out[5]
+                    new_resid = {
+                        k: jnp.where(stmask > 0, nr[k],
+                                     jnp.where(real > 0,
+                                               jnp.zeros_like(nr[k]),
+                                               resid[k]))
+                        for k in nr}
+                    new_resid = jax.tree_util.tree_map(
+                        lambda x: lax.with_sharding_constraint(
+                            x, NamedSharding(mesh, P(DATA_AXIS))),
+                        new_resid)
+                    return (new_params, new_state, new_opt,
+                            new_resid), outs
                 return (new_params, new_state, new_opt), outs
 
-            (params, model_state, opt_state), outs = lax.scan(
-                step, (state["params"], state["model_state"],
-                       state["opt_state"]),
-                (batch, sample_mask, rngs))
+            carry0 = (state["params"], state["model_state"],
+                      state["opt_state"])
+            if ef:
+                carry0 = carry0 + (state["merge_resid"],)
+            carry, outs = lax.scan(step, carry0,
+                                   (batch, sample_mask, rngs))
+            params, model_state, opt_state = carry[:3]
             losses, skipped = outs[0], outs[1]
             new_state = {"params": params, "model_state": model_state,
                          "opt_state": opt_state}
+            if ef:
+                new_state["merge_resid"] = carry[3]
             if collect:
                 return new_state, losses, skipped, outs[2]
             return new_state, losses, skipped
@@ -254,17 +456,7 @@ class SyncDPEngine:
             batch_sh = jax.tree_util.tree_map(
                 lambda _: NamedSharding(self.mesh, P(None, DATA_AXIS)),
                 batch)
-            state_sh = {
-                "params": jax.tree_util.tree_map(
-                    lambda spec: NamedSharding(self.mesh, spec),
-                    self._param_specs),
-                "model_state": jax.tree_util.tree_map(
-                    lambda _: NamedSharding(self.mesh, P()),
-                    state["model_state"]),
-                "opt_state": jax.tree_util.tree_map(
-                    lambda spec: NamedSharding(self.mesh, spec),
-                    self._opt_specs),
-            }
+            state_sh = self._state_shardings(state)
             rep = NamedSharding(self.mesh, P())
             mask_sh = NamedSharding(self.mesh, P(None, DATA_AXIS))
             self._cache[key] = jax.jit(
@@ -329,17 +521,7 @@ class SyncDPEngine:
         key = ("idx", (S, G), cache.signature, self.collect_stats)
         self.last_compiled = key not in self._cache
         if self.last_compiled:
-            state_sh = {
-                "params": jax.tree_util.tree_map(
-                    lambda spec: NamedSharding(self.mesh, spec),
-                    self._param_specs),
-                "model_state": jax.tree_util.tree_map(
-                    lambda _: NamedSharding(self.mesh, P()),
-                    state["model_state"]),
-                "opt_state": jax.tree_util.tree_map(
-                    lambda spec: NamedSharding(self.mesh, spec),
-                    self._opt_specs),
-            }
+            state_sh = self._state_shardings(state)
             rep = NamedSharding(self.mesh, P())
             cache_sh = jax.tree_util.tree_map(lambda _: rep, cache.arrays)
             idx_sh = NamedSharding(self.mesh, P(None, DATA_AXIS))

@@ -871,13 +871,42 @@ class TrainJob:
         # (tests/test_health.py), so it defaults ON and exists only as
         # an escape hatch
         collect_stats = bool(getattr(opts, "train_stats", True))
+        # ---- sync-round comm levers (parallel/merge.py) ----
+        merge_dtype_opt = getattr(opts, "merge_dtype", "") or ""
+        merge_compress = getattr(opts, "merge_compress", "none") or "none"
+        merge_bucket_mb = float(getattr(opts, "merge_bucket_mb", 0.0))
+        if merge_dtype_opt not in ("", "bf16"):
+            raise KubeMLException(
+                f"merge_dtype must be '' or 'bf16', got "
+                f"{merge_dtype_opt!r}", 400)
+        if merge_compress not in ("none", "bf16", "int8"):
+            raise KubeMLException(
+                f"merge_compress must be 'none', 'bf16' or 'int8', got "
+                f"{merge_compress!r}", 400)
+        if merge_dtype_opt and merge_compress != "none":
+            raise KubeMLException(
+                "merge_dtype and merge_compress are mutually exclusive: "
+                "merge_dtype is a plain lossy wire cast, merge_compress "
+                "is error-feedback compression with residual carry", 400)
+        if getattr(opts, "fsdp", False) and (
+                merge_compress != "none" or merge_bucket_mb > 0):
+            raise KubeMLException(
+                "merge_compress / merge_bucket_mb require an unsharded "
+                "merge payload; fsdp reduce-scatters grads leaf-by-leaf "
+                "under GSPMD, so the explicit merge path is unavailable",
+                400)
+        kavg_merge_dtype = jnp.bfloat16 if merge_dtype_opt == "bf16" \
+            else None
         self._engine = KAvgEngine(
             self.mesh, self.model.loss, self.model.metrics,
             self.model.configure_optimizers,
             batch_seq_dims=(self.model.seq_batch_dims
                             if n_seq > 1 else None),
             manual_inner=self._manual_tp or self._pp,
-            collect_stats=collect_stats)
+            collect_stats=collect_stats,
+            merge_dtype=kavg_merge_dtype,
+            merge_bucket_mb=merge_bucket_mb,
+            merge_compress=merge_compress)
         self._sync_engine = None
         self._sync_state = None
         if getattr(opts, "fsdp", False) and engine_kind != "syncdp":
@@ -888,10 +917,21 @@ class TrainJob:
                 "per-step gradient-averaging engine", 400)
         if engine_kind == "syncdp":
             from kubeml_tpu.parallel.syncdp import SyncDPEngine
+            if merge_dtype_opt:
+                raise KubeMLException(
+                    "merge_dtype applies to the kavg engine's weight "
+                    "merge only; for syncdp use merge_compress "
+                    "(error-feedback gradient compression)", 400)
+            sync_strategy = {"bf16": "ef_bf16", "int8": "ef_int8"}.get(
+                merge_compress)
+            if sync_strategy is None and merge_bucket_mb > 0:
+                sync_strategy = "bucketed"
             self._sync_engine = SyncDPEngine(
                 self.mesh, self.model.loss, self.model.configure_optimizers,
                 fsdp=bool(getattr(opts, "fsdp", False)),
-                collect_stats=collect_stats)
+                collect_stats=collect_stats,
+                merge_strategy=sync_strategy,
+                merge_bucket_mb=merge_bucket_mb)
         from jax.sharding import NamedSharding, PartitionSpec
         from kubeml_tpu.parallel.kavg import seq_batch_spec
         from kubeml_tpu.parallel.mesh import DATA_AXIS
@@ -1468,6 +1508,34 @@ class TrainJob:
                 epoch, cursor, guard, step_counts, dev_losses,
                 dev_dropped, loss_base, dropped_base)
 
+        # ---- double-buffered grouped dispatch: the previous group's
+        # host bookkeeping (step-count mask sums, the tiny eager
+        # per-group device reductions) is DEFERRED until the next group
+        # has been dispatched, so it runs while the device is already
+        # executing that next group.  Two donated param/opt buffers are
+        # then in flight at any time — group N's donated output (held
+        # as self.variables) feeding group N+1's dispatch, with group
+        # N's stats arrays still alive in `pending`.  The deferred work
+        # is timed as merge_overlap: merge-adjacent host time the
+        # pipeline hides (vs merge_wait, the blocking epoch-end drain).
+        pending = None  # (stats, worker_mask, rounds) of the last group
+
+        def note_group(stats, worker_mask, rounds):
+            nonlocal step_counts, stat_rounds
+            if step_counts.size == 0:
+                step_counts = np.zeros(stats.step_count.shape[1])
+            step_counts += (stats.step_count * worker_mask).sum(axis=0)
+            # one tiny eager sum per GROUP keeps the reducer's leaf
+            # shapes uniform with single rounds ([W])
+            dev_losses.append(stats.loss_sum_device.sum(axis=0))
+            dev_dropped.append(stats.dropped_device.sum(axis=0))
+            if stats.stat_device is not None:
+                # [R, W, 3] -> [W, 3] and [R] -> scalar, same
+                # uniform-leaf-shape discipline as the loss
+                dev_stats.append(stats.stat_device.sum(axis=0))
+                dev_spread.append(stats.spread_device.sum())
+                stat_rounds += rounds
+
         for rb in self._epoch_round_iter(plan, epoch, transform,
                                          group=group, source=source):
             if isinstance(rb, RoundGroup):
@@ -1487,20 +1555,10 @@ class TrainJob:
                             lr=self.req.lr, epoch=epoch)
                     round_times.append((time.time() - t_r, rb.rounds,
                                         stats.compiled))
-                if step_counts.size == 0:
-                    step_counts = np.zeros(stats.step_count.shape[1])
-                step_counts += (stats.step_count * rb.worker_mask
-                                ).sum(axis=0)
-                # one tiny eager sum per GROUP keeps the reducer's leaf
-                # shapes uniform with single rounds ([W])
-                dev_losses.append(stats.loss_sum_device.sum(axis=0))
-                dev_dropped.append(stats.dropped_device.sum(axis=0))
-                if stats.stat_device is not None:
-                    # [R, W, 3] -> [W, 3] and [R] -> scalar, same
-                    # uniform-leaf-shape discipline as the loss
-                    dev_stats.append(stats.stat_device.sum(axis=0))
-                    dev_spread.append(stats.spread_device.sum())
-                    stat_rounds += rb.rounds
+                if pending is not None:
+                    with self.tracer.span("merge_overlap"):
+                        note_group(*pending)
+                pending = (stats, rb.worker_mask, rb.rounds)
                 continue
             dispatch_round(rb)
             rounds_done = rb.round_index + 1
@@ -1530,6 +1588,13 @@ class TrainJob:
                                    train_state=round_state(rounds_done)))
                 raise JobPreemptedError(self.task.job_id, epoch,
                                         rounds_done)
+
+        if pending is not None:
+            # last group's deferred bookkeeping — the device may still
+            # be executing it, so this too overlaps
+            with self.tracer.span("merge_overlap"):
+                note_group(*pending)
+            pending = None
 
         # ---- mid-epoch work reassignment (elastic degraded mode):
         # re-deal quarantined workers' unconsumed rounds to the
@@ -1572,7 +1637,10 @@ class TrainJob:
                 self._reduce_losses(dev_dropped)).sum())
                 if dev_dropped else 0.0)
             self._epoch_quarantined = 0
-        with self.tracer.span("device_drain"):
+        # merge_wait: the BLOCKING merge cost — the epoch-end readback
+        # that waits on every outstanding merge (pre-split span name:
+        # device_drain; PHASE_HISTOGRAMS maps both to merge_seconds)
+        with self.tracer.span("merge_wait"):
             loss_sums = np.asarray(self._reduce_losses(dev_losses)) \
                 if dev_losses else np.zeros(0)
         if loss_base is not None:
@@ -1747,7 +1815,7 @@ class TrainJob:
             self._reduce_losses(dev_skipped)).sum()) if dev_skipped else 0.0
         self._epoch_dropped = skipped_total
         self._epoch_quarantined = 0
-        with self.tracer.span("device_drain"):
+        with self.tracer.span("merge_wait"):
             loss_sums = np.asarray(self._reduce_losses(dev_losses)) \
                 if dev_losses else np.zeros(0)
         if real_steps == 0:  # zero-round epoch: _sync_state may still be None

@@ -198,8 +198,9 @@ def test_job_logs_trace_summary(tmp_path, tmp_home, mesh8):
     # span precedes it on epochs where the device dataset cache laid
     # out or verified its slabs)
     assert len(re.findall(
-        r"\[(?:cache_upload=\S+ )?data_wait=\S+ device_drain=\S+ "
-        r"dispatch=\S+ epoch=\S+ round=\S+\]", text)) == 2
+        r"\[(?:cache_upload=\S+ )?data_wait=\S+ dispatch=\S+ "
+        r"epoch=\S+ (?:merge_overlap=\S+ )?merge_wait=\S+ "
+        r"round=\S+\]", text)) == 2
 
     # the same run left a whole-job Chrome timeline in the trace dir:
     # one trace id, round spans nested under epoch spans, dispatch

@@ -1,0 +1,115 @@
+#!/usr/bin/env python3
+"""Lint: every registered merge strategy must have a parity test.
+
+The merge strategies in kubeml_tpu/parallel/merge.py are drop-in
+replacements for the engines' monolithic merge: bucketed/fused variants
+promise BIT-IDENTITY to it, compressed (error-feedback) variants promise
+bounded divergence with exact residual bookkeeping. A strategy without a
+test making one of those claims is an unverified wire format — so this
+lint walks the `@_register("<name>")` decorations in merge.py and fails
+unless each name appears (quoted, in executable code) in some tests/
+file that also carries a parity assertion (assert_array_equal /
+assert_allclose).
+
+Run directly (exit 1 on violation) or via tests/test_merge.py, which
+keeps the lint itself in the tier-1 suite:
+
+    python tools/check_merge_parity.py [repo_root]
+"""
+
+from __future__ import annotations
+
+import io
+import os
+import re
+import sys
+import tokenize
+
+# an assertion that makes a parity claim: exactness (bit-identity) or
+# closeness (bounded divergence)
+PARITY_TOKENS = (
+    "assert_array_equal",
+    "assert_allclose",
+)
+
+_REGISTER_RE = re.compile(r"@_register\(\s*['\"]([A-Za-z0-9_]+)['\"]\s*\)")
+
+
+def registered_strategies(merge_path: str) -> list:
+    """Strategy names declared via @_register("name") in merge.py."""
+    with open(merge_path, encoding="utf-8") as f:
+        return _REGISTER_RE.findall(f.read())
+
+
+def _code_lines(path: str):
+    """Yield (lineno, source) for non-comment code lines. STRING tokens
+    are KEPT (strategy names appear as string literals in tests);
+    comments are dropped so a mention in prose doesn't count."""
+    with open(path, "rb") as f:
+        src = f.read()
+    lines = {}
+    try:
+        for tok in tokenize.tokenize(io.BytesIO(src).readline):
+            if tok.type in (tokenize.COMMENT, tokenize.ENCODING):
+                continue
+            lines.setdefault(tok.start[0], []).append(tok.string)
+    except tokenize.TokenError:
+        # fall back to raw lines; better a false positive than a skip
+        for i, line in enumerate(src.decode("utf-8", "replace").split("\n")):
+            lines.setdefault(i + 1, []).append(line)
+    for no in sorted(lines):
+        yield no, "".join(lines[no])
+
+
+def file_covers(path: str, name: str) -> bool:
+    """True when `path` names the strategy (quoted, in code) AND makes a
+    parity assertion somewhere in its code."""
+    quoted = (f'"{name}"', f"'{name}'")
+    named = has_parity = False
+    for _no, code in _code_lines(path):
+        if not named and any(q in code for q in quoted):
+            named = True
+        if not has_parity and any(t in code for t in PARITY_TOKENS):
+            has_parity = True
+        if named and has_parity:
+            return True
+    return False
+
+
+def uncovered_strategies(merge_path: str, tests_dir: str) -> list:
+    names = registered_strategies(merge_path)
+    test_files = []
+    for dirpath, _dirs, files in os.walk(tests_dir):
+        for fname in sorted(files):
+            if fname.startswith("test_") and fname.endswith(".py"):
+                test_files.append(os.path.join(dirpath, fname))
+    return [n for n in names
+            if not any(file_covers(p, n) for p in test_files)]
+
+
+def main(argv) -> int:
+    root = argv[1] if len(argv) > 1 else os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__)))
+    merge_path = os.path.join(root, "kubeml_tpu", "parallel", "merge.py")
+    tests_dir = os.path.join(root, "tests")
+    names = registered_strategies(merge_path)
+    if not names:
+        print(f"{merge_path}: no @_register(...) strategies found — "
+              "lint is miswired", file=sys.stderr)
+        return 1
+    missing = uncovered_strategies(merge_path, tests_dir)
+    for n in missing:
+        print(f"merge strategy {n!r} has no parity test: no tests/ file "
+              f"both names it and asserts exactness/closeness "
+              f"({' / '.join(PARITY_TOKENS)})", file=sys.stderr)
+    if missing:
+        print(f"\n{len(missing)} unverified merge strateg"
+              f"{'y' if len(missing) == 1 else 'ies'}: every variant "
+              "registered in kubeml_tpu/parallel/merge.py needs a "
+              "bit-identity or bounded-divergence test", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv))
