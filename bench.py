@@ -413,6 +413,7 @@ def main():
         return ckpt_s, resume_s, degraded_s, reassigned
 
     serving = _measure_serving_arm()
+    serving_prefill = _measure_prefill_arm()
 
     per_chip, cache_phases, cache_runtime = measure(
         cache_round, cache_rounds, 2, TIMED_EPOCHS)
@@ -530,14 +531,23 @@ def main():
         },
         # inference-plane arm (kubeml_tpu/serve/): closed-loop clients
         # against the continuous-batching decode service. The design
-        # signal is dispatches_per_token: at concurrency 1 every request
-        # pays its own prefill+decode dispatches ((Tp+n)/n > 1); under
-        # continuous batching one dispatch advances every active stream,
-        # so the ratio drops below 1 as occupancy rises. The burst
-        # section shows admission control shedding with 429 once
-        # slots+queue are in flight. decode_compiles stays 1 across
-        # every arm — membership churn is data, never a new program.
+        # signal is dispatches_per_token: at concurrency 1 a request's
+        # decode dispatches are all its own; under continuous batching
+        # one dispatch advances every active stream, so the ratio drops
+        # below 1 as occupancy rises (prompt work rides the chunked
+        # prefill program and is counted separately). The burst section
+        # shows admission control shedding with 429 once slots+queue
+        # are in flight. decode_compiles stays 1 across every arm —
+        # membership churn is data, never a new program.
         "serving": serving,
+        # long-prompt arm (chunked prefill + prefix cache): 512-token
+        # prompts at chunk C=16 pin prefill dispatches to ceil(511/16)
+        # per prompt (dispatches_per_prompt_token == 1/C), and the
+        # serial repeated-prefix mix pins fully cached re-admissions to
+        # ZERO prefill dispatches — TTFT collapses to one decode
+        # dispatch. Values are exact on the CPU tier (greedy, unique
+        # prompts concurrent, repeats serial).
+        "serving_prefill": serving_prefill,
     }))
 
 
@@ -739,6 +749,168 @@ def _measure_serving_arm() -> dict:
         "closed_loop": [arm_c1, arm_cn],
         "burst_submitted": 3 * SLOTS,
         "burst_shed_429": shed,
+    }
+
+
+def _measure_prefill_arm() -> dict:
+    """Long-prompt arm: chunked prefill + prefix caching. 512-token
+    prompts, 64 generated, chunk C=16. Two sections:
+
+    - concurrent: 16 clients with UNIQUE prompts (no sharing), so the
+      pinned signal is the prefill program itself — ceil(511/16) = 32
+      dispatches per prompt, dispatches_per_prompt_token 32/512 = 1/C.
+    - prefix_mix: a serial repeated-prefix workload (4 prompts, each
+      submitted twice). The repeats are fully cached (512 % 16 == 0 —
+      every prompt page registered), so they cost ZERO prefill
+      dispatches and their TTFT collapses to a single decode dispatch.
+
+    Everything here is deterministic on the CPU tier, so the arm
+    asserts its own pins instead of leaving them to the reader."""
+    import threading
+
+    import jax
+    import numpy as np
+
+    from kubeml_tpu.models.gpt import GPTMini, GPTModule
+    from kubeml_tpu.serve.engine import DecodeEngine
+    from kubeml_tpu.serve.service import ServeService
+
+    PROMPT_LEN, NEW_TOKENS, CHUNK, SLOTS = 512, 64, 16, 16
+    CHUNKS_PER_PROMPT = -(-(PROMPT_LEN - 1) // CHUNK)   # last token decodes
+
+    class LongCtxGPT(GPTMini):
+        """gpt-nano-sized blocks with a window that fits 512+64 tokens
+        (the registered gpt-nano stops at max_len=64)."""
+
+        def build(self):
+            return GPTModule(vocab_size=512,
+                             max_len=PROMPT_LEN + NEW_TOKENS, hidden=32,
+                             layers=2, heads=2, ffn=64, dropout=0.0)
+
+    model = LongCtxGPT()
+    module = model.module
+    variables = model.init_variables(
+        jax.random.PRNGKey(0),
+        {"x": np.ones((1, module.max_len), np.int32)})
+
+    def prompt(i):
+        return [(i * 131 + 7 * j) % (module.vocab_size - 1) + 1
+                for j in range(PROMPT_LEN)]
+
+    def drain(req):
+        for _ in req.events_iter(timeout=600.0):
+            pass
+        return req
+
+    def pct(vals, q):
+        if not vals:
+            return 0.0
+        vals = sorted(vals)
+        return round(vals[min(len(vals) - 1,
+                              int(q * (len(vals) - 1) + 0.5))], 6)
+
+    def fresh_service():
+        engine = DecodeEngine(module, variables, slots=SLOTS, page=CHUNK,
+                              prefill_chunk=CHUNK)
+        svc = ServeService("bench-prefill", engine, max_queue=SLOTS).start()
+        # warmup: both compiles (chunked prefill + decode) land here,
+        # outside every timed window
+        drain(svc.submit(prompt(9999), max_new_tokens=NEW_TOKENS))
+        return engine, svc
+
+    # -- concurrent, unique prompts: pin the prefill dispatch count ----
+    engine, svc = fresh_service()
+    before = dict(engine.stats)
+    done, lock = [], threading.Lock()
+
+    def client(cid):
+        req = drain(svc.submit(prompt(cid), max_new_tokens=NEW_TOKENS))
+        with lock:
+            done.append(req)
+
+    t0 = time.perf_counter()
+    threads = [threading.Thread(target=client, args=(c,))
+               for c in range(SLOTS)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    elapsed = time.perf_counter() - t0
+    delta = {k: engine.stats[k] - before[k] for k in before}
+    assert delta["prefill_dispatches"] == SLOTS * CHUNKS_PER_PROMPT, \
+        f"prefill dispatch pin broke: {delta['prefill_dispatches']}"
+    per_prompt_token = (delta["prefill_dispatches"]
+                        / (PROMPT_LEN * len(done)))
+    assert per_prompt_token <= 1.0 / CHUNK + 1e-12, per_prompt_token
+    ttfts = [r.first_token_at - r.submitted_at for r in done
+             if r.first_token_at and r.submitted_at]
+    concurrent = {
+        "concurrency": SLOTS,
+        "requests": len(done),
+        "prefill_dispatches": int(delta["prefill_dispatches"]),
+        "dispatches_per_prompt_token": round(per_prompt_token, 6),
+        "prefill_tokens": int(delta["prefill_tokens"]),
+        "prefix_hits": int(delta["prefix_hits"]),
+        "prefix_misses": int(delta["prefix_misses"]),
+        "goodput_tok_s": round(delta["generated_tokens"] / elapsed, 1),
+        "ttft_p50_s": pct(ttfts, 0.50),
+        "ttft_p99_s": pct(ttfts, 0.99),
+    }
+    prefill_compiles = int(engine.stats["prefill_compiles"])
+    decode_compiles = int(engine.stats["compiles"])
+    svc.stop()
+
+    # -- serial repeated-prefix mix: pin the cache to zero prefill -----
+    engine, svc = fresh_service()
+    REPEATS = 4
+    before = dict(engine.stats)
+    ttfts_cold = []
+    for i in range(REPEATS):
+        r = drain(svc.submit(prompt(100 + i), max_new_tokens=NEW_TOKENS))
+        ttfts_cold.append(r.first_token_at - r.submitted_at)
+    mid = dict(engine.stats)
+    ttfts_warm = []
+    for i in range(REPEATS):
+        r = drain(svc.submit(prompt(100 + i), max_new_tokens=NEW_TOKENS))
+        ttfts_warm.append(r.first_token_at - r.submitted_at)
+    after = dict(engine.stats)
+    svc.stop()
+
+    cold_dispatches = (mid["prefill_dispatches"]
+                       - before["prefill_dispatches"])
+    warm_dispatches = (after["prefill_dispatches"]
+                       - mid["prefill_dispatches"])
+    hits = after["prefix_hits"] - before["prefix_hits"]
+    misses = after["prefix_misses"] - before["prefix_misses"]
+    hit_rate = hits / max(1, hits + misses)
+    assert cold_dispatches == REPEATS * CHUNKS_PER_PROMPT, cold_dispatches
+    assert warm_dispatches == 0, \
+        f"fully cached prompts dispatched prefill: {warm_dispatches}"
+    assert hit_rate >= 0.5, hit_rate
+    prefix_mix = {
+        "distinct_prompts": REPEATS,
+        "repeats": REPEATS,
+        "cold_prefill_dispatches": int(cold_dispatches),
+        "warm_prefill_dispatches": int(warm_dispatches),
+        "prefix_hits": int(hits),
+        "prefix_misses": int(misses),
+        "prefix_hit_rate": round(hit_rate, 4),
+        "cow_splits": int(after["cow_splits"] - before["cow_splits"]),
+        "ttft_cold_p50_s": pct(ttfts_cold, 0.50),
+        "ttft_cold_p99_s": pct(ttfts_cold, 0.99),
+        "ttft_warm_p50_s": pct(ttfts_warm, 0.50),
+        "ttft_warm_p99_s": pct(ttfts_warm, 0.99),
+    }
+    return {
+        "model": "gpt-longctx-bench",
+        "slots": SLOTS,
+        "prompt_tokens": PROMPT_LEN,
+        "new_tokens": NEW_TOKENS,
+        "prefill_chunk": CHUNK,
+        "prefill_compiles": prefill_compiles,
+        "decode_compiles": decode_compiles,
+        "concurrent": concurrent,
+        "prefix_mix": prefix_mix,
     }
 
 

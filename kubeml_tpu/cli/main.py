@@ -348,7 +348,10 @@ def _render_top(doc: dict) -> str:
             f"kv pages {float(latest.get('serve_kv_page_utilization', 0.0)):.0%}  "
             f"ttft p50/p99 {_ms(latest.get('serve_ttft_p50'))}"
             f"/{_ms(latest.get('serve_ttft_p99'))}  "
-            f"shed {latest.get('serve_rejected_total', 0):g}")
+            f"shed {latest.get('serve_rejected_total', 0):g}  "
+            f"prefill backlog "
+            f"{latest.get('serve_prefill_backlog_tokens', 0):g}  "
+            f"prefix hit {latest.get('serve_prefix_hit_pct', 0):g}%")
     worker_losses = latest.get("worker_losses") or []
     grad_norms = latest.get("grad_norms") or []
     update_ratios = latest.get("update_ratios") or []
@@ -415,6 +418,13 @@ def cmd_top(args):
 
 # --------------------------------------------------------------------- serve
 
+def _prefix_cache_opt(args):
+    """--serve-prefix-cache on|off -> bool, None = env/default."""
+    if args.serve_prefix_cache is None:
+        return None
+    return args.serve_prefix_cache == "on"
+
+
 def cmd_serve(args):
     """Role mux, parity with the reference's single binary whose role is
     chosen by flag (ml/cmd/ml/main.go:60-156): --role all boots the whole
@@ -442,7 +452,9 @@ def cmd_serve(args):
                                job_partitions=partitions,
                                infer_cache_size=args.infer_cache_size,
                                serve_slots=args.serve_slots,
-                               serve_queue_depth=args.serve_queue_depth)
+                               serve_queue_depth=args.serve_queue_depth,
+                               serve_prefill_chunk=args.serve_prefill_chunk,
+                               serve_prefix_cache=_prefix_cache_opt(args))
         print(f"controller: {svc.controller.url}")
         print(f"scheduler:  {svc.scheduler.url}")
         print(f"ps:         {svc.ps.url}  (metrics at {svc.ps.url}/metrics)")
@@ -464,7 +476,9 @@ def cmd_serve(args):
                               job_partitions=partitions,
                               infer_cache_size=args.infer_cache_size,
                               serve_slots=args.serve_slots,
-                              serve_queue_depth=args.serve_queue_depth)
+                              serve_queue_depth=args.serve_queue_depth,
+                              serve_prefill_chunk=args.serve_prefill_chunk,
+                              serve_prefix_cache=_prefix_cache_opt(args))
     else:  # storage
         from kubeml_tpu.control.storage import StorageService
         svc = StorageService(port=args.port or const.STORAGE_PORT)
@@ -728,6 +742,17 @@ def build_parser() -> argparse.ArgumentParser:
                    help="admission queue depth beyond the slot pool; "
                         "past slots+queue, /generate sheds with 429 + "
                         "Retry-After (KUBEML_SERVE_QUEUE, default 16)")
+    s.add_argument("--serve-prefill-chunk", type=int, default=None,
+                   help="prompt tokens per chunked-prefill dispatch; 0 "
+                        "feeds prompts through the decode program one "
+                        "token per dispatch "
+                        "(KUBEML_SERVE_PREFILL_CHUNK, default 16)")
+    s.add_argument("--serve-prefix-cache", choices=("on", "off"),
+                   default=None,
+                   help="share full prompt pages across /generate "
+                        "requests by content hash, with copy-on-write "
+                        "on divergence "
+                        "(KUBEML_SERVE_PREFIX_CACHE, default on)")
     s.set_defaults(fn=cmd_serve)
     return p
 

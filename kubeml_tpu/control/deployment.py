@@ -44,7 +44,9 @@ def start_deployment(mesh=None, controller_port: int = 0,
                      job_partitions=None,
                      infer_cache_size: Optional[int] = None,
                      serve_slots: Optional[int] = None,
-                     serve_queue_depth: Optional[int] = None) -> Deployment:
+                     serve_queue_depth: Optional[int] = None,
+                     serve_prefill_chunk: Optional[int] = None,
+                     serve_prefix_cache: Optional[bool] = None) -> Deployment:
     """Start storage, PS, scheduler, controller wired together.
 
     Port 0 picks a free port (tests); use_default_ports uses the configured
@@ -67,7 +69,9 @@ def start_deployment(mesh=None, controller_port: int = 0,
                          job_partitions=job_partitions,
                          infer_cache_size=infer_cache_size,
                          serve_slots=serve_slots,
-                         serve_queue_depth=serve_queue_depth)
+                         serve_queue_depth=serve_queue_depth,
+                         serve_prefill_chunk=serve_prefill_chunk,
+                         serve_prefix_cache=serve_prefix_cache)
     ps.start()
 
     scheduler = Scheduler(ps_url=ps.url, port=scheduler_port)

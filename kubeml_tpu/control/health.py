@@ -219,7 +219,8 @@ _SAMPLE_FIELDS = ("train_loss", "validation_loss", "accuracy",
                   "serve_active_slots", "serve_slot_cap",
                   "serve_queue_depth", "serve_queue_cap",
                   "serve_kv_page_utilization", "serve_rejected_total",
-                  "serve_ttft_p50", "serve_ttft_p99")
+                  "serve_ttft_p50", "serve_ttft_p99",
+                  "serve_prefill_backlog_tokens", "serve_prefix_hit_pct")
 
 
 class HealthEvaluator:

@@ -270,7 +270,9 @@ class ParameterServer(JsonService):
                  serve_slots: Optional[int] = None,
                  serve_queue_depth: Optional[int] = None,
                  serve_page_tokens: Optional[int] = None,
-                 serve_hbm_budget_mb: Optional[float] = None):
+                 serve_hbm_budget_mb: Optional[float] = None,
+                 serve_prefill_chunk: Optional[int] = None,
+                 serve_prefix_cache: Optional[bool] = None):
         super().__init__(port=port)
         # Lazy mesh: in standalone mode the PARENT must not initialize the
         # accelerator backend (on TPU, libtpu is single-process-exclusive —
@@ -322,6 +324,17 @@ class ParameterServer(JsonService):
         self.serve_page_tokens = int(
             serve_page_tokens if serve_page_tokens is not None
             else os.environ.get("KUBEML_SERVE_PAGE_TOKENS", "16"))
+        # chunked prefill + prefix cache (PR 8): prompt tokens per
+        # prefill dispatch (0 = token-by-token), and whether full
+        # prompt pages are shared across requests by content hash
+        self.serve_prefill_chunk = int(
+            serve_prefill_chunk if serve_prefill_chunk is not None
+            else os.environ.get("KUBEML_SERVE_PREFILL_CHUNK", "16"))
+        if serve_prefix_cache is None:
+            serve_prefix_cache = os.environ.get(
+                "KUBEML_SERVE_PREFIX_CACHE", "on").lower() \
+                not in ("0", "off", "false", "no")
+        self.serve_prefix_cache = bool(serve_prefix_cache)
         self._serve: Dict[str, tuple] = {}   # model_id -> (stamp, service)
         self._serve_lock = threading.Lock()
         self._infer_batcher = InferBatcher() if InferBatcher.enabled() \
@@ -678,12 +691,15 @@ class ParameterServer(JsonService):
                 module, variables,
                 geom=PageGeometry.for_module(
                     slots=self.serve_slots, page=self.serve_page_tokens,
-                    max_len=module.max_len))
+                    max_len=module.max_len),
+                prefill_chunk=self.serve_prefill_chunk,
+                prefix_cache=self.serve_prefix_cache)
         except (ValueError, TypeError, AttributeError) as e:
-            # non-GPT modules (no paged decode step) are client errors
+            # non-GPT modules (no paged decode step) and invalid serve
+            # knobs (e.g. a negative prefill chunk) are client errors
             raise InvalidArgsError(
-                f"model {model_id} does not support streaming decode: "
-                f"{e}") from e
+                f"model {model_id} does not support streaming decode "
+                f"with the configured serve knobs: {e}") from e
         svc = ServeService(model_id, engine,
                            max_queue=self.serve_queue_depth,
                            metrics=self.metrics,

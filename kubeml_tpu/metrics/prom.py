@@ -473,6 +473,30 @@ class MetricsRegistry:
         self.serve_tokens_total = Counter(
             "kubeml_serve_tokens_total",
             "Tokens generated by a served model", "model")
+        # chunked prefill + prefix cache (PR 8): where prompt tokens are
+        # spent (bulk prefill vs per-token decode), how often full
+        # prompt pages are served from the content-hash cache, and the
+        # prompt work queued ahead of any new request's first token
+        self.serve_prefill_tokens_total = Counter(
+            "kubeml_serve_prefill_tokens_total",
+            "Prompt tokens bulk-loaded through the chunked-prefill "
+            "program, by served model", "model")
+        self.serve_decode_tokens_total = Counter(
+            "kubeml_serve_decode_tokens_total",
+            "Tokens advanced through the decode program across all "
+            "slots, by served model", "model")
+        self.serve_prefix_hits_total = Counter(
+            "kubeml_serve_prefix_cache_hits_total",
+            "Prompt pages attached from the shared prefix cache instead "
+            "of being re-prefilled, by served model", "model")
+        self.serve_prefix_misses_total = Counter(
+            "kubeml_serve_prefix_cache_misses_total",
+            "Prompt prefix-cache lookups that found no resident page, "
+            "by served model", "model")
+        self.serve_prefill_backlog = Gauge(
+            "kubeml_serve_prefill_backlog_tokens",
+            "Prompt tokens admitted but not yet prefilled, by served "
+            "model", "model")
         # checkpoint-LRU (infer cache) instrumentation: entries resident
         # plus hit/miss traffic, labelled by cache in case more
         # deserialization caches grow later
@@ -510,12 +534,17 @@ class MetricsRegistry:
         self._serve_gauges = [self.serve_active_slots,
                               self.serve_queue_depth,
                               self.serve_kv_utilization,
+                              self.serve_prefill_backlog,
                               self.infer_cache_entries]
         self._serve_hists = [self.serve_ttft_seconds,
                              self.serve_tpot_seconds,
                              self.serve_e2e_seconds]
         self._serve_counters = [self.serve_requests_total,
                                 self.serve_tokens_total,
+                                self.serve_prefill_tokens_total,
+                                self.serve_decode_tokens_total,
+                                self.serve_prefix_hits_total,
+                                self.serve_prefix_misses_total,
                                 self.infer_cache_hits_total,
                                 self.infer_cache_misses_total]
 
@@ -610,21 +639,39 @@ class MetricsRegistry:
             self.serve_e2e_seconds.observe(model, e2e)
 
     def set_serve_state(self, model: str, active_slots: float,
-                        queue_depth: float, kv_utilization: float) -> None:
+                        queue_depth: float, kv_utilization: float,
+                        prefill_backlog: float = 0.0) -> None:
         self.serve_active_slots.set(model, active_slots)
         self.serve_queue_depth.set(model, queue_depth)
         self.serve_kv_utilization.set(model, kv_utilization)
+        self.serve_prefill_backlog.set(model, prefill_backlog)
 
     def note_serve_tokens(self, model: str, n: int) -> None:
         self.serve_tokens_total.inc(model, n)
 
+    def note_serve_prefill(self, model: str, n: int) -> None:
+        self.serve_prefill_tokens_total.inc(model, n)
+
+    def note_serve_decode(self, model: str, n: int) -> None:
+        self.serve_decode_tokens_total.inc(model, n)
+
+    def note_serve_prefix_hits(self, model: str, n: int) -> None:
+        self.serve_prefix_hits_total.inc(model, n)
+
+    def note_serve_prefix_misses(self, model: str, n: int) -> None:
+        self.serve_prefix_misses_total.inc(model, n)
+
     def clear_serve(self, model: str) -> None:
         for g in (self.serve_active_slots, self.serve_queue_depth,
-                  self.serve_kv_utilization):
+                  self.serve_kv_utilization, self.serve_prefill_backlog):
             g.clear(model)
         for h in self._serve_hists:
             h.clear(model)
-        for c in (self.serve_requests_total, self.serve_tokens_total):
+        for c in (self.serve_requests_total, self.serve_tokens_total,
+                  self.serve_prefill_tokens_total,
+                  self.serve_decode_tokens_total,
+                  self.serve_prefix_hits_total,
+                  self.serve_prefix_misses_total):
             c.clear_prefix(model)
 
     def note_infer_cache(self, hit: bool, cache: str = "checkpoints") -> None:

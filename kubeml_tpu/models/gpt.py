@@ -440,7 +440,7 @@ def build_paged_decode_step(module: GPTModule):
       step(params, k_pages, v_pages, valid_pages,
            tokens[S], pos[S], page_tables[S, Pmax],
            write_page[S], write_off[S], active[S], temps[S],
-           key_data[S, 2])
+           key_data[S, 2], copy_src[S], copy_dst[S])
         -> (next_tokens[S], k_pages, v_pages, valid_pages)
 
     Every per-request quantity is DATA (the kavg worker-mask trick), so
@@ -454,6 +454,15 @@ def build_paged_decode_step(module: GPTModule):
     per-(request, position) keys, so sampling is independent of which
     other requests happen to share the batch (bit-identity under
     continuous batching, proven in tests/test_serving.py).
+
+    copy_src/copy_dst are the prefix cache's COPY-ON-WRITE lane: before
+    anything else, page copy_src[s] is duplicated into page copy_dst[s]
+    (K, V, and validity) for every slot. A slot about to write into a
+    page it shares with other streams gets a private copy this way —
+    inside the SAME dispatch as the write, so CoW costs zero extra
+    programs and the compile count stays pinned at two (prefill +
+    decode). Slots with nothing to split pass 0 -> 0, a no-op through
+    the null page.
 
     Slots are rows: no cross-slot reduction exists anywhere in the
     step, which is what makes concurrent decode bit-identical to
@@ -477,10 +486,18 @@ def build_paged_decode_step(module: GPTModule):
     ffn_out = nn.Dense(hidden, dtype=dtype)
 
     def step(params, k_pages, v_pages, valid_pages, tokens, pos,
-             page_tables, write_page, write_off, active, temps, key_data):
+             page_tables, write_page, write_off, active, temps, key_data,
+             copy_src, copy_dst):
         S = tokens.shape[0]
         G = valid_pages.shape[1]
         C = page_tables.shape[1] * G
+        # copy-on-write splits first: the gather of copy_src pages
+        # happens before any scatter in this dispatch (functional
+        # update semantics), so splitting a page and reusing its id are
+        # safe in the same step. 0 -> 0 rows are null-page no-ops.
+        k_pages = k_pages.at[:, copy_dst].set(k_pages[:, copy_src])
+        v_pages = v_pages.at[:, copy_dst].set(v_pages[:, copy_src])
+        valid_pages = valid_pages.at[copy_dst].set(valid_pages[copy_src])
         h = tok_embed.apply({"params": params["tok_embed"]}, tokens[:, None])
         h = h + pos_embed.apply({"params": params["pos_embed"]},
                                 pos[:, None])
@@ -530,6 +547,99 @@ def build_paged_decode_step(module: GPTModule):
         return nxt, k_pages, v_pages, valid_pages
 
     return step
+
+
+def build_paged_prefill_step(module: GPTModule, chunk: int):
+    """Chunked prefill over the paged KV cache: C prompt tokens for ONE
+    slot per dispatch — the serving plane's second (and last) persistent
+    program (serve/engine.py).
+
+    Without this, prompts ride the decode step one token per dispatch: a
+    512-token prompt costs ~512 full-batch dispatches before its first
+    sampled token, and every co-resident stream pays the queueing. This
+    program bulk-writes a fixed-size chunk of prompt KV instead:
+
+      prefill(params, k_pages, v_pages, valid_pages,
+              tokens[C], pos[C], page_table[Pmax],
+              write_pages[C], write_offs[C], in_chunk[C])
+        -> (k_pages, v_pages, valid_pages)
+
+    The chunk size C is static (one compile, amortized forever); real
+    chunk length is DATA — prompts shorter than C pad the tail with
+    in_chunk = 0 rows whose writes land on the null page 0 with validity
+    0, so prompt lengths never recompile. No logits, no sampling: the
+    LAST prompt token always goes through the decode step (which samples
+    the first output), keeping this program shape-free of the vocab and
+    the emission path bit-identical to token-by-token prefill.
+
+    Bit-identity with the decode-step prefill it replaces: queries are
+    the chunk rows, context is the slot's whole page table, and the bias
+    keeps kv position j for query position p iff valid[j] * (j <= p) —
+    the same mask the decode step applies one row at a time. Chunk
+    tokens' K/V (and validity) are written BEFORE the gather, exactly
+    like the decode step's write-then-attend, so within-chunk causal
+    attention sees the same bytes token-by-token dispatches would have
+    produced; positions after p inside the chunk are excluded by the
+    causal term just as they would not yet exist in the sequential
+    schedule.
+    """
+    if chunk < 1:
+        raise ValueError(f"prefill chunk must be >= 1, got {chunk}")
+    if module.n_experts or module.seq_axis is not None \
+            or module.tp_axis is not None:
+        raise ValueError(
+            "paged prefill serves dense GPT modules only (no MoE, "
+            "sequence-parallel, or manual-TP variants)")
+    heads, hidden = module.heads, module.hidden
+    head_dim = hidden // heads
+    dtype = module.dtype
+    from kubeml_tpu.ops.attention import NEG_INF, multi_head_attention
+    tok_embed = nn.Embed(module.vocab_size, hidden, dtype=dtype)
+    pos_embed = nn.Embed(module.max_len, hidden, dtype=dtype)
+    ln = nn.LayerNorm(dtype=jnp.float32)
+    qkv = nn.DenseGeneral((heads, head_dim), dtype=dtype)
+    out_proj = nn.DenseGeneral(hidden, axis=(-2, -1), dtype=dtype)
+    ffn_in = nn.Dense(module.ffn, dtype=dtype)
+    ffn_out = nn.Dense(hidden, dtype=dtype)
+
+    def prefill(params, k_pages, v_pages, valid_pages, tokens, pos,
+                page_table, write_pages, write_offs, in_chunk):
+        G = valid_pages.shape[1]
+        C = page_table.shape[0] * G
+        h = tok_embed.apply({"params": params["tok_embed"]}, tokens[None, :])
+        h = h + pos_embed.apply({"params": params["pos_embed"]},
+                                pos[None, :])
+        # chunk validity lands before the gather (write-then-attend,
+        # like the decode step); pad-tail rows write 0 to the null page
+        tok_valid = in_chunk * (tokens != PAD_ID).astype(jnp.float32)
+        valid_pages = valid_pages.at[write_pages, write_offs].set(tok_valid)
+        ctx_valid = valid_pages[page_table].reshape(C)
+        causal = (jnp.arange(C)[None, :] <= pos[:, None]) \
+            .astype(jnp.float32)                      # [chunk, C]
+        bias = (1.0 - ctx_valid[None, :] * causal)[None, None] * NEG_INF
+        for i in range(module.layers):
+            p = params[f"layer_{i}"]
+            x = ln.apply({"params": p["LayerNorm_0"]}, h)
+            q = qkv.apply({"params": p["q"]}, x)
+            k = qkv.apply({"params": p["k"]}, x)
+            v = qkv.apply({"params": p["v"]}, x)
+            k_pages = k_pages.at[i, write_pages, write_offs].set(
+                k[0].astype(dtype))
+            v_pages = v_pages.at[i, write_pages, write_offs].set(
+                v[0].astype(dtype))
+            ck = k_pages[i][page_table].reshape(1, C, heads, head_dim)
+            cv = v_pages[i][page_table].reshape(1, C, heads, head_dim)
+            attn = multi_head_attention(q, ck, cv, bias)
+            attn = out_proj.apply({"params": p["out"]}, attn)
+            h = h + attn
+            x = ln.apply({"params": p["LayerNorm_1"]}, h)
+            x = ffn_in.apply({"params": p["Dense_0"]}, x)
+            x = nn.gelu(x)
+            x = ffn_out.apply({"params": p["Dense_1"]}, x)
+            h = h + x
+        return k_pages, v_pages, valid_pages
+
+    return prefill
 
 
 def _lm_per_example(logits: jax.Array, x: jax.Array) -> jax.Array:

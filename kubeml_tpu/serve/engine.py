@@ -1,19 +1,38 @@
-"""Continuous-batching decode engine: slot state + the persistent step.
+"""Continuous-batching decode engine: slot state + the persistent steps.
 
-One jitted program serves every stream: each dispatch advances every
-active slot by one token (prompt tokens during that slot's prefill
-phase — their logits are discarded until the last prompt token — then
-its own feedback). Joins and leaves are host-side edits to the active
-mask and page tables, so the program compiles ONCE per engine and the
-compile count stays flat no matter how requests churn (pinned by
-JitCompileTracker in tests/test_serving.py).
+TWO jitted programs serve every stream (compile count pinned at exactly
+two by tests/test_serving.py, no matter how requests churn):
+
+  decode   — every dispatch advances every active slot by one token
+             (its own feedback, or its final prompt token);
+  prefill  — one slot per dispatch, C prompt tokens bulk-written into
+             its KV pages (fixed chunk size, padded + masked, so prompt
+             lengths never recompile).
+
+A token-budget scheduler in step() interleaves the two: each engine
+step spends at most `prefill_budget` prompt tokens on prefill chunks
+(FIFO over admission order), then runs one decode dispatch for the
+streams that are past their prompt — so in-flight streams' inter-token
+latency stays bounded while new prompts load, instead of every stream
+stalling behind a 512-token prompt fed one token per dispatch.
+
+Prefix caching rides the same page tables: at attach, the engine walks
+the prompt's full pages through the allocator's content-hash index
+(pager.chain_hash) and any already-resident prefix is SHARED — the slot
+takes references on the cached pages and its prefill cursor skips past
+them (a fully cached prompt costs zero prefill dispatches). Writes into
+shared or registered pages are COPY-ON-WRITE: the decode program copies
+the page before the write, in the same dispatch, so sharing never adds
+a third program.
 
 Determinism contract (what the bit-identity tests rely on): slot math
-is row-independent, pages held by different requests are disjoint, the
-attention softmax always runs over the full fixed context C with
-invalid positions masked, and sampling keys derive from (request seed,
-position) only. A request therefore generates the exact same tokens
-whether it runs alone or packed with seven neighbours.
+is row-independent, writable pages held by different requests are
+disjoint (shared pages are read-only until CoW-split), the attention
+softmax always runs over the full fixed context with invalid positions
+masked, and sampling keys derive from (request seed, position) only. A
+request therefore generates the exact same tokens whether it runs alone
+or packed with seven neighbours, chunked or token-by-token, cache hit
+or cache miss.
 """
 
 from __future__ import annotations
@@ -29,17 +48,33 @@ import numpy as np
 
 from kubeml_tpu.metrics.runtime import JitCompileTracker
 from kubeml_tpu.models.base import InferenceInputError
-from kubeml_tpu.models.gpt import PAD_ID, build_paged_decode_step
-from kubeml_tpu.serve.pager import KVPageSlab, PageAllocator, PageGeometry
+from kubeml_tpu.models.gpt import (PAD_ID, build_paged_decode_step,
+                                   build_paged_prefill_step)
+from kubeml_tpu.serve.pager import (KVPageSlab, PageAllocator, PageGeometry,
+                                    chain_hash)
 from kubeml_tpu.serve.slots import GenerateRequest
 
 logger = logging.getLogger("kubeml_tpu.serve.engine")
+
+# Every serving-path variant MUST have a quoted-name bit-identity test
+# in tests/ (enforced by tools/check_serve_parity.py, wired like
+# check_merge_parity.py): chunked prefill and the prefix cache are
+# throughput levers, never correctness dials — each name below is a
+# distinct code path that must produce token-for-token identical output.
+SERVE_PATH_VARIANTS = (
+    "prefill_token_by_token",   # chunk 0: prompt rides the decode program
+    "prefill_chunked",          # chunked-prefill program loads the prompt
+    "prefix_cache_miss",        # cold cache: pages written, then registered
+    "prefix_cache_hit",         # warm cache: shared pages, prefill skipped
+    "prefix_cow_split",         # write into a shared page copies it first
+)
 
 
 class _Slot:
     """Host-side state of one occupied decode slot."""
 
-    __slots__ = ("req", "pos", "prompt", "n_prompt", "seq")
+    __slots__ = ("req", "pos", "prompt", "n_prompt", "seq",
+                 "hash_chain", "hashed_pages", "cached_pages")
 
     def __init__(self, req: GenerateRequest, prompt: List[int], seq: int):
         self.req = req
@@ -47,6 +82,9 @@ class _Slot:
         self.n_prompt = len(prompt)
         self.pos = 0          # next position to consume
         self.seq = seq        # admission order (newest-stall shedding)
+        self.hash_chain = b""   # rolling digest over hashed_pages pages
+        self.hashed_pages = 0   # prompt pages matched or registered so far
+        self.cached_pages = 0   # prompt pages attached from the cache
 
 
 class DecodeEngine:
@@ -54,17 +92,39 @@ class DecodeEngine:
 
     Not thread-safe by itself: attach/step/cancel belong to the serving
     loop thread (ServeService). Reads used for admission accounting
-    (free_slots, stats) are safe from other threads.
+    (free_slots, stats, prefill_backlog_tokens) are safe from other
+    threads.
+
+    prefill_chunk: prompt tokens per prefill dispatch (C). 0 disables
+    the prefill program entirely — prompts ride the decode step one
+    token per dispatch (the PR-6 path, kept as the parity reference).
+    prefix_cache: share full prompt pages across requests by content
+    hash (pager.py). prefill_budget: prompt tokens the scheduler may
+    spend on prefill per engine step (default: one chunk).
     """
 
     def __init__(self, module, variables, geom: Optional[PageGeometry] = None,
                  slots: int = 8, page: int = 16,
-                 clock=time.perf_counter):
+                 clock=time.perf_counter, prefill_chunk: int = 16,
+                 prefix_cache: bool = True,
+                 prefill_budget: Optional[int] = None):
+        prefill_chunk = int(prefill_chunk)
+        if prefill_chunk < 0:
+            raise ValueError(
+                f"serve prefill chunk must be >= 0 (0 disables chunked "
+                f"prefill), got {prefill_chunk}")
         self.module = module
         self._step_raw = build_paged_decode_step(module)  # validates module
         self.geom = geom or PageGeometry.for_module(
             slots=slots, page=page, max_len=module.max_len)
         self.clock = clock
+        self.prefill_chunk = prefill_chunk
+        self.prefix_cache = bool(prefix_cache)
+        self.prefill_budget = int(prefill_budget) if prefill_budget \
+            else max(prefill_chunk, 1)
+        if self.prefill_budget < 1:
+            raise ValueError(
+                f"prefill budget must be >= 1, got {self.prefill_budget}")
         head_dim = module.hidden // module.heads
         self.slab = KVPageSlab(self.geom, module.layers, module.heads,
                                head_dim, module.dtype)
@@ -73,15 +133,25 @@ class DecodeEngine:
         # backend warns (donation unimplemented), so gate on backend
         donate = () if jax.default_backend() == "cpu" else (1, 2, 3)
         self._step = jax.jit(self._step_raw, donate_argnums=donate)
+        self._prefill = None
+        if prefill_chunk > 0:
+            self._prefill = jax.jit(
+                build_paged_prefill_step(module, prefill_chunk),
+                donate_argnums=donate)
         self._params = jax.device_put(variables["params"])
         S, Pmax = self.geom.slots, self.geom.pages_per_slot
         self._tables = np.zeros((S, Pmax), np.int32)
         self._slots: List[Optional[_Slot]] = [None] * S
         self._seq = 0
         self.compile_tracker = JitCompileTracker()
+        # "dispatches"/"compiles" are DECODE-only (the PR-6 meaning the
+        # bench and pinning tests rely on); prefill has its own lane
         self.stats: Dict[str, float] = {
             "dispatches": 0, "generated_tokens": 0, "occupancy_sum": 0,
             "stalls": 0, "compiles": 0,
+            "prefill_dispatches": 0, "prefill_tokens": 0,
+            "prefill_compiles": 0, "decode_tokens": 0,
+            "prefix_hits": 0, "prefix_misses": 0, "cow_splits": 0,
         }
 
     # ------------------------------------------------------------- capacity
@@ -97,6 +167,13 @@ class DecodeEngine:
 
     def kv_utilization(self) -> float:
         return self.pager.utilization()
+
+    def prefill_backlog_tokens(self) -> int:
+        """Prompt tokens admitted to slots but not yet prefilled — the
+        work queued ahead of any new request's first token (admission
+        folds this into Retry-After; exported as a gauge)."""
+        return sum(max(0, sl.n_prompt - 1 - sl.pos)
+                   for sl in self._slots if sl is not None)
 
     # ------------------------------------------------------------ lifecycle
     def check_admissible(self, prompt: List[int],
@@ -124,19 +201,70 @@ class DecodeEngine:
         return prompt
 
     def attach(self, req: GenerateRequest) -> int:
-        """Claim a free slot for a validated request; returns the slot."""
+        """Claim a free slot for a validated request; returns the slot.
+        With the prefix cache on, the prompt's full pages are matched
+        against the content-hash index and every hit is shared into the
+        slot's table — the prefill cursor starts past the matched run."""
         prompt = self.check_admissible(req.prompt, req.max_new_tokens)
         for s, cur in enumerate(self._slots):
             if cur is None:
-                self._slots[s] = _Slot(req, prompt, self._seq)
+                slot = _Slot(req, prompt, self._seq)
                 self._seq += 1
+                self._slots[s] = slot
+                if self.prefix_cache:
+                    self._match_prefix(s, slot)
                 return s
         raise RuntimeError("attach() with no free slot — admission "
                            "accounting is broken")
 
+    def _match_prefix(self, s: int, slot: _Slot) -> None:
+        """Walk the prompt's full pages through the prefix cache; stop
+        at the first miss (the chain hash makes any later page
+        unmatchable anyway)."""
+        G = self.geom.page
+        k = 0
+        chain = b""
+        while (k + 1) * G <= slot.n_prompt and k < self.geom.pages_per_slot:
+            digest = chain_hash(chain, slot.prompt[k * G:(k + 1) * G])
+            pid = self.pager.lookup_prefix(digest)
+            if pid is None:
+                self.stats["prefix_misses"] += 1
+                break
+            self._tables[s, k] = pid
+            chain = digest
+            k += 1
+            self.stats["prefix_hits"] += 1
+        slot.hash_chain = chain
+        slot.hashed_pages = k
+        slot.cached_pages = k
+        # the cached KV is bit-identical to what prefill would write
+        # (same program, same params, same tokens/positions), so the
+        # cursor jumps straight past it; the LAST prompt token always
+        # goes through decode, which samples the first output
+        slot.pos = min(k * G, slot.n_prompt - 1)
+
+    def _register_full_pages(self, s: int, slot: _Slot) -> None:
+        """Publish the slot's newly-completed full prompt pages under
+        their chain hashes. Pages matched at attach are already in the
+        chain; CoW copies are never re-registered (their hash already
+        maps to the original page)."""
+        G = self.geom.page
+        while (slot.hashed_pages + 1) * G <= slot.n_prompt \
+                and slot.pos >= (slot.hashed_pages + 1) * G:
+            pi = slot.hashed_pages
+            digest = chain_hash(slot.hash_chain,
+                                slot.prompt[pi * G:(pi + 1) * G])
+            self.pager.register_prefix(int(self._tables[s, pi]), digest)
+            slot.hash_chain = digest
+            slot.hashed_pages += 1
+
     def release(self, s: int, outcome: str,
                 error: Optional[str] = None) -> None:
-        """Free a slot and its pages; emits the request's terminal event."""
+        """Free a slot and drop its page references (shared prefix pages
+        survive in the cache for the next hit — pager.free semantics);
+        emits the request's terminal event. Covers cancel/disconnect at
+        ANY phase, including mid-prefill: partially-written pages are in
+        the table, so they go back to the pool here like any others."""
         slot = self._slots[s]
         if slot is None:
             return
@@ -155,23 +283,75 @@ class DecodeEngine:
                 return True
         return False
 
+    # -------------------------------------------------------------- prefill
+    def _dispatch_prefill(self, s: int, slot: _Slot) -> int:
+        """One prefill chunk for slot s: grant pages, bulk-write up to C
+        prompt tokens of KV, advance the cursor. Returns the number of
+        prompt tokens processed; 0 means the slot STALLED on page
+        exhaustion before making any progress."""
+        G = self.geom.page
+        C = self.prefill_chunk
+        start = slot.pos
+        end = min(start + C, slot.n_prompt - 1)
+        for pi in range(start // G, (end - 1) // G + 1):
+            if self._tables[s, pi] == 0:
+                pid = self.pager.alloc()
+                if pid is None:
+                    # shrink the chunk to the pages we hold; a partial
+                    # chunk still makes progress, zero progress stalls
+                    end = min(end, pi * G)
+                    break
+                self._tables[s, pi] = pid
+        n = end - start
+        if n <= 0:
+            return 0
+        tokens = np.zeros(C, np.int32)
+        pos = np.zeros(C, np.int32)
+        write_pages = np.zeros(C, np.int32)
+        write_offs = np.zeros(C, np.int32)
+        in_chunk = np.zeros(C, np.float32)
+        for j in range(n):
+            p = start + j
+            tokens[j] = slot.prompt[p]
+            pos[j] = p
+            write_pages[j] = self._tables[s, p // G]
+            write_offs[j] = p % G
+            in_chunk[j] = 1.0
+        before = self._prefill._cache_size()
+        t0 = self.clock()
+        self.slab.k, self.slab.v, self.slab.valid = self._prefill(
+            self._params, self.slab.k, self.slab.v, self.slab.valid,
+            jnp.asarray(tokens), jnp.asarray(pos),
+            jnp.asarray(self._tables[s]), jnp.asarray(write_pages),
+            jnp.asarray(write_offs), jnp.asarray(in_chunk))
+        compiled = self._prefill._cache_size() > before
+        self.compile_tracker.note(compiled, self.clock() - t0)
+        self.stats["prefill_dispatches"] += 1
+        self.stats["prefill_compiles"] += int(compiled)
+        self.stats["prefill_tokens"] += n
+        slot.pos = end
+        if self.prefix_cache:
+            self._register_full_pages(s, slot)
+        return n
+
+    def _in_prefill(self, slot: _Slot) -> bool:
+        """Chunked-prefill phase: positions [pos, n_prompt-1) still owed
+        to the prefill program. With chunking off every position rides
+        decode, so no slot is ever 'in prefill'."""
+        return self._prefill is not None and slot.pos < slot.n_prompt - 1
+
     # ----------------------------------------------------------------- step
     def step(self) -> List[GenerateRequest]:
-        """One dispatch: advance every active slot by one token. Returns
-        requests that reached a terminal state this step."""
+        """One scheduler round: up to prefill_budget prompt tokens of
+        prefill chunks (FIFO), then one decode dispatch advancing every
+        decode-phase slot by one token. Returns requests that reached a
+        terminal state this round."""
         S = self.geom.slots
         G = self.geom.page
-        tokens = np.zeros(S, np.int32)
-        pos = np.zeros(S, np.int32)
-        write_page = np.zeros(S, np.int32)
-        write_off = np.zeros(S, np.int32)
-        active = np.zeros(S, np.float32)
-        temps = np.zeros(S, np.float32)
-        key_data = np.zeros((S, 2), np.uint32)
         stalled: List[int] = []
 
         # reap cancellations FIRST: a cancelled slot's pages go back to
-        # the pool before this dispatch's tables are snapshotted, so the
+        # the pool before this round's tables are snapshotted, so the
         # device never writes through a freed page
         finished: List[GenerateRequest] = []
         for s, slot in enumerate(self._slots):
@@ -180,21 +360,66 @@ class DecodeEngine:
                 self.release(s, "cancelled")
                 finished.append(req)
 
+        # ------------------------------------------------- prefill lane
+        progressed = False
+        if self._prefill is not None:
+            budget = self.prefill_budget
+            order = sorted(
+                (s for s, sl in enumerate(self._slots)
+                 if sl is not None and self._in_prefill(sl)),
+                key=lambda s: self._slots[s].seq)
+            for s in order:
+                slot = self._slots[s]
+                while budget > 0 and slot.pos < slot.n_prompt - 1:
+                    n = self._dispatch_prefill(s, slot)
+                    if n == 0:
+                        stalled.append(s)
+                        break
+                    progressed = True
+                    budget -= n
+                if budget <= 0:
+                    break
+
+        # -------------------------------------------------- decode lane
+        tokens = np.zeros(S, np.int32)
+        pos = np.zeros(S, np.int32)
+        write_page = np.zeros(S, np.int32)
+        write_off = np.zeros(S, np.int32)
+        active = np.zeros(S, np.float32)
+        temps = np.zeros(S, np.float32)
+        key_data = np.zeros((S, 2), np.uint32)
+        copy_src = np.zeros(S, np.int32)
+        copy_dst = np.zeros(S, np.int32)
+
         for s, slot in enumerate(self._slots):
-            if slot is None:
+            if slot is None or self._in_prefill(slot):
                 continue
             pi = slot.pos // G
-            if self._tables[s, pi] == 0:
+            pid = int(self._tables[s, pi])
+            if pid == 0:
                 pid = self.pager.alloc()
                 if pid is None:
-                    stalled.append(s)   # no page: sit this step out
+                    stalled.append(s)   # no page: sit this round out
                     continue
                 self._tables[s, pi] = pid
+            elif not self.pager.writable(pid):
+                # shared or cache-registered page: copy-on-write split
+                # inside this dispatch (copies run before any write)
+                dst = self.pager.alloc()
+                if dst is None:
+                    stalled.append(s)
+                    continue
+                copy_src[s] = pid
+                copy_dst[s] = dst
+                self._tables[s, pi] = dst
+                self.pager.free([pid])  # drop this slot's share
+                self.stats["cow_splits"] += 1
+                pid = dst
             active[s] = 1.0
             tokens[s] = slot.prompt[slot.pos] if slot.pos < slot.n_prompt \
                 else slot.req.tokens[-1]
             pos[s] = slot.pos
-            write_page[s] = self._tables[s, pi]
+            write_page[s] = pid
             write_off[s] = slot.pos % G
             temps[s] = slot.req.temperature
             # per-(request, position) key: sampling is independent of
@@ -205,16 +430,18 @@ class DecodeEngine:
         n_active = int(active.sum())
         if n_active == 0:
             if stalled:
-                # every runnable slot is out of pages: shed the NEWEST
-                # stream (oldest is closest to finishing and freeing)
                 self.stats["stalls"] += len(stalled)
-                victim = max(stalled, key=lambda s: self._slots[s].seq)
-                req = self._slots[victim].req
-                logger.warning("KV slab exhausted with all slots stalled; "
-                               "shedding newest stream")
-                self.release(victim, "error",
-                             "KV cache pages exhausted; request shed")
-                finished.append(req)
+                if not progressed:
+                    # every runnable slot is out of pages and nothing
+                    # moved this round: shed the NEWEST stream (oldest
+                    # is closest to finishing and freeing)
+                    victim = max(stalled, key=lambda s: self._slots[s].seq)
+                    req = self._slots[victim].req
+                    logger.warning("KV slab exhausted with all slots "
+                                   "stalled; shedding newest stream")
+                    self.release(victim, "error",
+                                 "KV cache pages exhausted; request shed")
+                    finished.append(req)
             return finished
         if stalled:
             self.stats["stalls"] += len(stalled)
@@ -226,12 +453,14 @@ class DecodeEngine:
             jnp.asarray(tokens), jnp.asarray(pos),
             jnp.asarray(self._tables), jnp.asarray(write_page),
             jnp.asarray(write_off), jnp.asarray(active),
-            jnp.asarray(temps), jnp.asarray(key_data))
+            jnp.asarray(temps), jnp.asarray(key_data),
+            jnp.asarray(copy_src), jnp.asarray(copy_dst))
         compiled = self._step._cache_size() > before
         self.compile_tracker.note(compiled, self.clock() - t0)
         self.stats["dispatches"] += 1
         self.stats["compiles"] += int(compiled)
         self.stats["occupancy_sum"] += n_active
+        self.stats["decode_tokens"] += n_active
         nxt_host = np.asarray(nxt)
 
         for s, slot in enumerate(self._slots):
@@ -239,8 +468,12 @@ class DecodeEngine:
                 continue
             p = slot.pos
             slot.pos = p + 1
+            if self.prefix_cache:
+                # a prompt whose length is a page multiple completes its
+                # final page on this very advance — publish it
+                self._register_full_pages(s, slot)
             if p < slot.n_prompt - 1:
-                continue  # prefill phase: output discarded
+                continue  # token-by-token prefill: output discarded
             tok = int(nxt_host[s])
             if slot.req.first_token_at is None:
                 slot.req.first_token_at = self.clock()

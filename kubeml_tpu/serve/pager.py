@@ -19,14 +19,49 @@ Allocation is host-side (a free list) because page tables are host
 inputs to the jitted step — the device program only ever gathers
 through tables it is given, so there is no device-side bookkeeping to
 keep coherent.
+
+Prefix caching (PR 8) turns the free list into a three-state page pool:
+
+  FREE       on the free list, contents meaningless
+  REFERENCED refcount >= 1 — one or more slots gather through it.
+             A page full of prompt tokens can additionally be
+             REGISTERED under its chain hash (see chain_hash), at
+             which point later requests with the same prefix attach
+             to it instead of re-prefilling (refcount goes up).
+  CACHED     refcount == 0 but still registered: no slot needs it,
+             yet its KV bytes are intact, so a future prefix hit can
+             revive it for free. Cached pages sit in an LRU and are
+             the allocator's SECOND source of pages — alloc() prefers
+             the free list, then evicts the least-recently-used
+             cached page, and only then reports exhaustion.
+
+Sharing is what makes copy-on-write necessary: a slot may only scatter
+into a page it exclusively owns (`writable()`), otherwise the engine
+allocates a fresh page and the jitted step copies the shared page's
+contents before the write (engine.py).
 """
 
 from __future__ import annotations
 
 import dataclasses
-from typing import List, Sequence
+import hashlib
+from collections import OrderedDict
+from typing import Dict, List, Optional, Sequence
 
 import jax.numpy as jnp
+import numpy as np
+
+
+def chain_hash(prefix_digest: bytes, tokens: Sequence[int]) -> bytes:
+    """Rolling content hash for prefix caching: the key of page i is
+    H(key of page i-1, tokens of page i), with b"" as the root. Keying
+    on the whole chain (not just the page's own tokens) means two
+    prompts share a page ONLY when everything before it matches too —
+    positional embeddings make identical tokens at different offsets
+    produce different KV, so a flat per-page hash would alias them."""
+    h = hashlib.sha256(prefix_digest)
+    h.update(np.asarray(tokens, np.int32).tobytes())
+    return h.digest()
 
 
 @dataclasses.dataclass(frozen=True)
@@ -92,41 +127,133 @@ class KVPageSlab:
 
 
 class PageAllocator:
-    """Host free-list over pages 1..P-1 (page 0 reserved null).
+    """Refcounted host allocator over pages 1..P-1 (page 0 reserved null)
+    with an optional prefix-cache layer (module docstring for the page
+    state machine).
 
     alloc() returns the lowest free id (deterministic — the bit-identity
-    tests replay the same allocation sequence) or None when the slab is
-    exhausted; the engine turns None into a slot STALL, never an error,
-    and the service sheds load before stalls can deadlock.
+    tests replay the same allocation sequence), falls back to evicting
+    the LRU unreferenced cached page, and returns None only when every
+    page is actively referenced; the engine turns None into a slot
+    STALL, never an error, and sheds load before stalls can deadlock.
+
+    Every page handed to a slot carries one reference; sharing a cached
+    page via lookup_prefix() adds one more. free() drops exactly one
+    reference per listed page — the engine's release path does not know
+    (or need to know) which pages are shared.
     """
 
     def __init__(self, geom: PageGeometry):
         self.geom = geom
         # pop() takes from the tail; store descending so ids come out 1, 2, …
         self._free: List[int] = list(range(geom.pages - 1, 0, -1))
+        self._refs: Dict[int, int] = {}          # pid -> refcount (>= 1)
+        self._hash_of: Dict[int, bytes] = {}     # registered pid -> chain hash
+        self._by_hash: Dict[bytes, int] = {}     # chain hash -> pid
+        # refcount-0 registered pages, oldest first (eviction order)
+        self._lru: "OrderedDict[int, None]" = OrderedDict()
+        self.evictions = 0
 
-    def alloc(self):
-        return self._free.pop() if self._free else None
+    # ------------------------------------------------------------ allocation
+    def alloc(self) -> Optional[int]:
+        if self._free:
+            pid = self._free.pop()
+        elif self._lru:
+            # revivable but unreferenced: the cheapest page to sacrifice
+            pid, _ = self._lru.popitem(last=False)
+            self._unregister(pid)
+            self.evictions += 1
+        else:
+            return None
+        self._refs[pid] = 1
+        return pid
 
     def free(self, page_ids: Sequence[int]) -> None:
+        """Drop ONE reference per listed page. A page whose refcount
+        reaches 0 returns to the free list — unless it is registered in
+        the prefix cache, in which case it parks in the LRU with its
+        contents intact, awaiting a hit or eviction."""
+        released = False
         for pid in page_ids:
             pid = int(pid)
             if not 0 < pid < self.geom.pages:
                 raise ValueError(f"freeing page {pid} outside slab "
                                  f"(1..{self.geom.pages - 1})")
-            if pid in self._free:
+            if pid not in self._refs:
                 raise ValueError(f"double free of page {pid}")
-            self._free.append(pid)
-        # keep lowest-id-first allocation after churn (determinism)
-        self._free.sort(reverse=True)
+            self._refs[pid] -= 1
+            if self._refs[pid] > 0:
+                continue
+            del self._refs[pid]
+            if pid in self._hash_of:
+                self._lru[pid] = None      # newest at the end
+            else:
+                self._free.append(pid)
+                released = True
+        if released:
+            # keep lowest-id-first allocation after churn (determinism)
+            self._free.sort(reverse=True)
 
+    # ---------------------------------------------------------- prefix cache
+    def register_prefix(self, pid: int, digest: bytes) -> bool:
+        """Publish a referenced, fully-written prompt page under its
+        chain hash so later requests can share it. Returns False (no-op)
+        when the hash is already mapped — first writer wins; the
+        duplicate page stays a private unregistered page."""
+        if pid not in self._refs:
+            raise ValueError(f"registering unreferenced page {pid}")
+        if digest in self._by_hash or pid in self._hash_of:
+            return False
+        self._hash_of[pid] = digest
+        self._by_hash[digest] = pid
+        return True
+
+    def lookup_prefix(self, digest: bytes) -> Optional[int]:
+        """Prefix-cache hit: take one reference on the page registered
+        under `digest`, reviving it from the LRU if it was parked there.
+        Returns None on miss."""
+        pid = self._by_hash.get(digest)
+        if pid is None:
+            return None
+        self._lru.pop(pid, None)
+        self._refs[pid] = self._refs.get(pid, 0) + 1
+        return pid
+
+    def writable(self, pid: int) -> bool:
+        """True when a slot may scatter into the page in place: exactly
+        one reference and not published in the prefix cache. A shared or
+        registered page must be copy-on-write split first — another slot
+        (or a future cache hit) reads those bytes."""
+        return self._refs.get(pid, 0) == 1 and pid not in self._hash_of
+
+    def refcount(self, pid: int) -> int:
+        return self._refs.get(int(pid), 0)
+
+    def _unregister(self, pid: int) -> None:
+        digest = self._hash_of.pop(pid, None)
+        if digest is not None:
+            self._by_hash.pop(digest, None)
+
+    # ------------------------------------------------------------ accounting
     @property
     def free_pages(self) -> int:
         return len(self._free)
 
     @property
+    def evictable_pages(self) -> int:
+        """Cached (registered, refcount-0) pages alloc() may evict."""
+        return len(self._lru)
+
+    @property
+    def cached_pages(self) -> int:
+        """Pages registered in the prefix cache (referenced or parked)."""
+        return len(self._hash_of)
+
+    @property
     def in_use(self) -> int:
-        return self.geom.usable_pages - len(self._free)
+        """Pages some slot currently references. Cached-but-unreferenced
+        pages are reclaimable on demand, so they do not count."""
+        return len(self._refs)
 
     def utilization(self) -> float:
         return self.in_use / self.geom.usable_pages

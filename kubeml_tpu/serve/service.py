@@ -31,6 +31,12 @@ logger = logging.getLogger("kubeml_tpu.serve.service")
 # recent-TTFT window for the host-side p50/p99 the health rules consume
 TTFT_WINDOW = 128
 
+# Retry-After sizing for the prefill backlog: a conservative host-tier
+# prompt-loading rate. The hint only needs the right ORDER — a client
+# told to come back after the backlog drains stops hammering a server
+# that is mid-way through loading long prompts.
+PREFILL_DRAIN_TOKENS_PER_S = 256.0
+
 
 def _percentile(sorted_vals: List[float], q: float) -> float:
     if not sorted_vals:
@@ -57,6 +63,7 @@ class ServeService:
         self._inflight = 0          # admitted, not yet terminal
         self._stopped = False
         self.rejected_total = 0
+        self._counters_seen: dict = {}   # engine stat -> last published
         self._ttfts: Deque[float] = collections.deque(maxlen=TTFT_WINDOW)
         self._thread = threading.Thread(
             target=self._loop, name=f"serve-{model_id}", daemon=True)
@@ -84,7 +91,12 @@ class ServeService:
             if self._inflight >= self.engine.slot_count + self.max_queue:
                 self.rejected_total += 1
                 self._note_outcome("rejected")
-                raise ServeSaturated()
+                # Retry-After accounts the prefill backlog: prompt
+                # tokens already owed to admitted streams are work the
+                # retrying client queues behind
+                backlog = self._backlog_tokens()
+                raise ServeSaturated(retry_after_s=1.0 + (
+                    backlog / PREFILL_DRAIN_TOKENS_PER_S))
             self._inflight += 1
             req.submitted_at = self.clock()
             self._pending.append(req)
@@ -192,9 +204,18 @@ class ServeService:
         return {"p50": _percentile(vals, 0.50),
                 "p99": _percentile(vals, 0.99)}
 
+    def _backlog_tokens(self) -> int:
+        """Prompt tokens owed before new work gets its first token:
+        unfilled prompt positions in attached slots plus the whole
+        prompts still waiting in the admission queue."""
+        return self.engine.prefill_backlog_tokens() + sum(
+            max(0, len(r.prompt) - 1) for r in self._pending)
+
     def snapshot(self) -> dict:
         """Health-pipeline sample for the serve:<model> pseudo job."""
         p = self.ttft_percentiles()
+        st = self.engine.stats
+        hits, misses = st["prefix_hits"], st["prefix_misses"]
         return {
             "job_id": f"serve:{self.model_id}",
             "serve_active_slots": self.engine.active(),
@@ -206,6 +227,9 @@ class ServeService:
             "serve_rejected_total": self.rejected_total,
             "serve_ttft_p50": round(p["p50"], 6),
             "serve_ttft_p99": round(p["p99"], 6),
+            "serve_prefill_backlog_tokens": self._backlog_tokens(),
+            "serve_prefix_hit_pct": round(
+                100.0 * hits / max(1, hits + misses), 1),
         }
 
     def _publish(self) -> None:
@@ -214,7 +238,21 @@ class ServeService:
             self.metrics.set_serve_state(
                 self.model_id, snap["serve_active_slots"],
                 snap["serve_queue_depth"],
-                snap["serve_kv_page_utilization"])
+                snap["serve_kv_page_utilization"],
+                snap["serve_prefill_backlog_tokens"])
+            # engine stats are cumulative; prometheus counters take
+            # deltas (the loop thread is the only publisher)
+            for stat, note in (
+                    ("prefill_tokens", self.metrics.note_serve_prefill),
+                    ("decode_tokens", self.metrics.note_serve_decode),
+                    ("prefix_hits", self.metrics.note_serve_prefix_hits),
+                    ("prefix_misses",
+                     self.metrics.note_serve_prefix_misses)):
+                cur = int(self.engine.stats[stat])
+                delta = cur - self._counters_seen.get(stat, 0)
+                if delta > 0:
+                    note(self.model_id, delta)
+                    self._counters_seen[stat] = cur
         if self.health_cb is not None:
             try:
                 self.health_cb(snap)

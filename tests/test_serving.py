@@ -107,7 +107,8 @@ def test_greedy_engine_matches_generate():
 
 def test_join_leave_never_recompiles():
     """Membership churn — join mid-generation, cancel, EOS — is pure
-    data; the decode program compiles exactly once per engine."""
+    data; the engine compiles exactly TWO programs (prefill + decode),
+    each once, no matter how requests churn or prompt lengths vary."""
     from kubeml_tpu.serve.engine import DecodeEngine
     from kubeml_tpu.serve.slots import GenerateRequest
 
@@ -118,10 +119,11 @@ def test_join_leave_never_recompiles():
     engine.attach(a)
     for _ in range(4):
         engine.step()
-    assert engine.stats["compiles"] == 1  # first dispatch compiled
+    assert engine.stats["compiles"] == 1          # decode compiled once
+    assert engine.stats["prefill_compiles"] == 1  # prefill compiled once
 
     b = GenerateRequest([9, 10], max_new_tokens=8, temperature=0.5, seed=3)
-    engine.attach(b)  # join mid-generation
+    engine.attach(b)  # join mid-generation (different prompt length)
     for _ in range(3):
         engine.step()
     b.cancel()  # leave mid-generation
@@ -133,8 +135,10 @@ def test_join_leave_never_recompiles():
     _drive(engine)
     assert a.outcome == "ok" and c.outcome == "ok"
     assert engine.stats["compiles"] == 1
-    assert engine.compile_tracker.compiles == 1
-    assert engine.compile_tracker.dispatches == engine.stats["dispatches"]
+    assert engine.stats["prefill_compiles"] == 1
+    assert engine.compile_tracker.compiles == 2   # two programs, total
+    assert engine.compile_tracker.dispatches == \
+        engine.stats["dispatches"] + engine.stats["prefill_dispatches"]
 
 
 def test_pages_free_on_eos_and_return_to_pool():
@@ -318,6 +322,23 @@ def test_live_exposition_and_serve_health(serve_ps):
     assert latest["serve_slot_cap"] == 2
     assert latest["serve_queue_cap"] == 1
     assert "serve_ttft_p99" in latest
+    assert latest["serve_prefill_backlog_tokens"] == 0
+    assert "serve_prefix_hit_pct" in latest
+
+    # the prefill/decode token counters publish as deltas right after
+    # the request drains; poll the scrape briefly for the new families
+    wanted = ("kubeml_serve_prefill_tokens_total",
+              "kubeml_serve_decode_tokens_total",
+              "kubeml_serve_prefill_backlog_tokens")
+    deadline = time.time() + 10
+    while time.time() < deadline:
+        text = urllib.request.urlopen(f"{ps.url}/metrics").read().decode()
+        if all(f"# TYPE {family}" in text for family in wanted):
+            break
+        time.sleep(0.05)
+    for family in wanted:
+        assert f"# TYPE {family}" in text, family
+    assert validate_exposition(text) == []
 
 
 # ------------------------------------------------- infer cache + batcher
@@ -434,6 +455,336 @@ def test_serve_rules_ignore_training_samples():
                         "grad_norms": [0.5], "loss_spread": 0.01})
     assert [f["rule"] for f in fired] == []
     assert "serve_queue_cap" not in ev.verdict("job1")["latest"]
+
+
+# ----------------------------------- chunked prefill + prefix cache (PR 8)
+#
+# Bit-identity matrix for the serving-path variants registered in
+# engine.SERVE_PATH_VARIANTS — every quoted name below is load-bearing:
+# tools/check_serve_parity.py fails unless each variant name appears in
+# a test file that also asserts exactness.
+
+def _run_engine(module, variables, specs, **engine_kw):
+    """Run request specs [(prompt, n_new, temp, seed)] through a fresh
+    engine, attached together; returns the finished requests."""
+    from kubeml_tpu.serve.engine import DecodeEngine
+    from kubeml_tpu.serve.slots import GenerateRequest
+
+    engine = DecodeEngine(module, variables, **engine_kw)
+    reqs = [GenerateRequest(list(p), max_new_tokens=n, temperature=t,
+                            seed=s) for p, n, t, s in specs]
+    for r in reqs:
+        engine.attach(r)
+    _drive(engine)
+    return engine, reqs
+
+
+def test_chunked_prefill_bit_identical_to_token_by_token():
+    """'prefill_chunked' == 'prefill_token_by_token' == generate(),
+    token for token, for greedy AND sampled streams — with the chunk
+    size deliberately not a multiple of the page size so chunks span
+    page boundaries."""
+    model, module, variables = _nano()
+    prompt = list(range(5, 25))              # 20 tokens, pages of 4
+    specs = [(prompt, 8, 0.0, 0), (prompt[2:], 6, 0.9, 11)]
+    ref = model.generate(variables, np.asarray([prompt], np.int32),
+                         max_new_tokens=8, temperature=0.0)
+
+    tbt_engine, tbt = _run_engine(module, variables, specs, slots=2,
+                                  page=4, prefill_chunk=0,
+                                  prefix_cache=False)
+    chk_engine, chk = _run_engine(module, variables, specs, slots=2,
+                                  page=4, prefill_chunk=6)
+    assert all(r.outcome == "ok" for r in tbt + chk)
+    for a, b in zip(tbt, chk):
+        np.testing.assert_array_equal(np.asarray(a.tokens),
+                                      np.asarray(b.tokens))
+    np.testing.assert_array_equal(np.asarray(chk[0].tokens),
+                                  ref[0, len(prompt):])
+    # the chunked engine really chunked: 19+17 prefill positions at
+    # C=6 is 4+3 dispatches, vs 36 token-by-token decode dispatches
+    assert tbt_engine.stats["prefill_dispatches"] == 0
+    assert chk_engine.stats["prefill_dispatches"] == 7
+    assert chk_engine.stats["prefill_tokens"] == 36
+    assert chk_engine.stats["prefill_compiles"] == 1
+
+
+def test_prefix_cache_hit_and_miss_bit_identical():
+    """'prefix_cache_miss' (cold) and 'prefix_cache_hit' (warm, shared
+    pages) both reproduce the cache-off tokens exactly; a fully cached
+    prompt costs ZERO prefill dispatches."""
+    from kubeml_tpu.serve.engine import DecodeEngine
+    from kubeml_tpu.serve.slots import GenerateRequest
+
+    _model, module, variables = _nano()
+    prompt = list(range(30, 46))             # 16 tokens = 4 full pages
+    _, ref = _run_engine(module, variables, [(prompt, 6, 0.0, 0)],
+                         slots=2, page=4, prefill_chunk=0,
+                         prefix_cache=False)
+
+    engine = DecodeEngine(module, variables, slots=2, page=4,
+                          prefill_chunk=4, prefix_cache=True)
+    cold = GenerateRequest(prompt, max_new_tokens=6)
+    engine.attach(cold)
+    _drive(engine)
+    assert engine.stats["prefix_hits"] == 0
+    assert engine.stats["prefix_misses"] == 1
+    dispatches_cold = engine.stats["prefill_dispatches"]
+    assert dispatches_cold > 0
+
+    warm = GenerateRequest(prompt, max_new_tokens=6)
+    engine.attach(warm)
+    _drive(engine)
+    assert engine.stats["prefix_hits"] == 4          # all 4 pages shared
+    assert engine.stats["prefill_dispatches"] == dispatches_cold  # zero new
+    assert engine.stats["cow_splits"] >= 1   # final page split for decode
+
+    np.testing.assert_array_equal(np.asarray(cold.tokens),
+                                  np.asarray(ref[0].tokens))
+    np.testing.assert_array_equal(np.asarray(warm.tokens),
+                                  np.asarray(ref[0].tokens))
+    # everything drains: cached pages park in the LRU, nothing leaks
+    assert engine.pager.in_use == 0
+    assert engine.pager.free_pages + engine.pager.evictable_pages == \
+        engine.geom.usable_pages
+
+
+def test_prefix_cow_split_bit_identical_under_sharing():
+    """'prefix_cow_split': a stream whose decode write lands in a page
+    it shares with a live neighbour gets a private copy inside the same
+    dispatch — both streams produce exactly their solo tokens."""
+    from kubeml_tpu.serve.engine import DecodeEngine
+    from kubeml_tpu.serve.slots import GenerateRequest
+
+    _model, module, variables = _nano()
+    prompt = list(range(100, 112))           # 12 tokens = 3 full pages
+    solo_specs = [(prompt, 8, 0.0, 0), (prompt, 8, 1.1, 5)]
+    solo = [_run_engine(module, variables, [spec], slots=2, page=4,
+                        prefill_chunk=0, prefix_cache=False)[1][0]
+            for spec in solo_specs]
+
+    engine = DecodeEngine(module, variables, slots=2, page=4,
+                          prefill_chunk=4, prefix_cache=True)
+    first = GenerateRequest(prompt, max_new_tokens=8)
+    engine.attach(first)
+    # run until the prompt pages are registered (first token emitted)
+    guard = 100
+    while not first.tokens:
+        engine.step()
+        guard -= 1
+        assert guard > 0
+    second = GenerateRequest(prompt, max_new_tokens=8, temperature=1.1,
+                             seed=5)
+    engine.attach(second)   # attaches to first's pages while it decodes
+    assert engine.stats["prefix_hits"] == 3
+    _drive(engine)
+    assert engine.stats["cow_splits"] >= 1
+    np.testing.assert_array_equal(np.asarray(first.tokens),
+                                  np.asarray(solo[0].tokens))
+    np.testing.assert_array_equal(np.asarray(second.tokens),
+                                  np.asarray(solo[1].tokens))
+    assert engine.pager.in_use == 0
+
+
+def test_pager_refcount_share_cow_evict_readmit_cycle():
+    """Allocator state machine: register -> share -> CoW-split -> park
+    in LRU -> re-admit -> evict, with the double-free guard intact."""
+    from kubeml_tpu.serve.pager import (PageAllocator, PageGeometry,
+                                        chain_hash)
+
+    geom = PageGeometry(slots=2, page=4, pages=6, pages_per_slot=4)
+    pager = PageAllocator(geom)
+    p1 = pager.alloc()
+    assert p1 == 1 and pager.writable(p1)
+
+    digest = chain_hash(b"", [7, 8, 9, 10])
+    assert pager.register_prefix(p1, digest)
+    assert not pager.writable(p1)            # registered => read-only
+    assert not pager.register_prefix(p1, digest)  # idempotent no-op
+
+    # share: a second stream attaches to the cached page
+    assert pager.lookup_prefix(digest) == p1
+    assert pager.refcount(p1) == 2
+    # CoW split: the sharer takes a private page, drops its shared ref
+    dst = pager.alloc()
+    pager.free([p1])
+    assert pager.refcount(p1) == 1 and pager.writable(dst)
+
+    # last ref gone: the page PARKS in the LRU, it does not free
+    pager.free([p1])
+    assert pager.refcount(p1) == 0
+    assert pager.evictable_pages == 1
+    with pytest.raises(ValueError):
+        pager.free([p1])                     # double free still guarded
+
+    # re-admit: a warm lookup revives it from the LRU
+    assert pager.lookup_prefix(digest) == p1
+    assert pager.refcount(p1) == 1 and pager.evictable_pages == 0
+    pager.free([p1])                          # park again
+
+    # eviction: exhaust the free list, next alloc takes the LRU page
+    while pager.free_pages:
+        pager.alloc()
+    evicted = pager.alloc()
+    assert evicted == p1 and pager.evictions == 1
+    assert pager.lookup_prefix(digest) is None   # unregistered on evict
+    assert pager.alloc() is None                 # now truly exhausted
+
+
+def test_exhaustion_evicts_cached_pages_before_shedding():
+    """A full pool with unreferenced cached pages evicts them instead
+    of shedding the stream — the cache never costs capacity."""
+    from kubeml_tpu.serve.engine import DecodeEngine
+    from kubeml_tpu.serve.pager import PageGeometry
+    from kubeml_tpu.serve.slots import GenerateRequest
+
+    _model, module, variables = _nano()
+    geom = PageGeometry(slots=2, page=4, pages=3, pages_per_slot=2)
+    engine = DecodeEngine(module, variables, geom=geom, prefill_chunk=4)
+    first = GenerateRequest([5, 6, 7, 8], max_new_tokens=4)
+    engine.attach(first)
+    _drive(engine)
+    assert first.outcome == "ok"
+    assert engine.pager.evictable_pages == 1   # its full prompt page
+
+    # needs both usable pages; only one is free -> must evict, not shed
+    second = GenerateRequest([9, 10, 11, 12], max_new_tokens=4)
+    engine.attach(second)
+    _drive(engine)
+    assert second.outcome == "ok" and len(second.tokens) == 4
+    assert engine.pager.evictions >= 1
+
+
+def test_cancel_during_prefill_restores_free_list():
+    """Client cancel mid-prefill releases the partially-written pages:
+    the free list returns to its pre-request size (cache off), and with
+    the cache on every prefix ref is dropped too."""
+    from kubeml_tpu.serve.engine import DecodeEngine
+    from kubeml_tpu.serve.slots import GenerateRequest
+
+    _model, module, variables = _nano()
+    engine = DecodeEngine(module, variables, slots=2, page=4,
+                          prefill_chunk=2, prefix_cache=False)
+    pre = engine.pager.free_pages
+    req = GenerateRequest(list(range(1, 20)), max_new_tokens=4)
+    engine.attach(req)
+    engine.step()                      # budget C=2: one chunk, mid-prefill
+    assert engine.stats["prefill_dispatches"] == 1
+    assert engine._slots[0].pos < len(req.prompt) - 1   # still mid-prefill
+    assert engine.pager.free_pages < pre
+    req.cancel()
+    engine.step()
+    assert req.outcome == "cancelled"
+    assert engine.pager.free_pages == pre
+    assert (engine._tables == 0).all()
+
+    # cache on: a canceled sharer drops its refs; the cached pages stay
+    cached = DecodeEngine(module, variables, slots=2, page=4,
+                          prefill_chunk=2, prefix_cache=True)
+    warmup = GenerateRequest(list(range(1, 13)), max_new_tokens=2)
+    cached.attach(warmup)
+    _drive(cached)
+    assert cached.pager.evictable_pages == 3
+    sharer = GenerateRequest(list(range(1, 13)) + [40, 41, 42, 43],
+                             max_new_tokens=2)
+    cached.attach(sharer)              # takes 3 prefix refs
+    assert cached.pager.in_use == 3
+    cached.step()                      # mid-prefill of the tail
+    sharer.cancel()
+    cached.step()
+    assert sharer.outcome == "cancelled"
+    assert cached.pager.in_use == 0
+    assert cached.pager.evictable_pages == 3
+    assert cached.pager.free_pages + cached.pager.evictable_pages == \
+        cached.geom.usable_pages
+
+
+def test_prefill_backlog_in_retry_after_and_snapshot():
+    """Saturation's Retry-After grows with the queued prompt work, and
+    the snapshot carries backlog + prefix-hit% for health/top."""
+    from kubeml_tpu.serve.engine import DecodeEngine
+    from kubeml_tpu.serve.service import (PREFILL_DRAIN_TOKENS_PER_S,
+                                          ServeService)
+    from kubeml_tpu.serve.slots import ServeSaturated
+
+    _model, module, variables = _nano()
+    engine = DecodeEngine(module, variables, slots=1, page=8)
+    svc = ServeService("m", engine, max_queue=1)   # loop NOT started
+    svc.submit(list(range(1, 41)), max_new_tokens=8)
+    svc.submit(list(range(1, 41)), max_new_tokens=8)
+    with pytest.raises(ServeSaturated) as ei:
+        svc.submit(list(range(1, 41)), max_new_tokens=8)
+    expect = 1.0 + (2 * 39) / PREFILL_DRAIN_TOKENS_PER_S
+    assert abs(ei.value.retry_after_s - expect) < 1e-9
+    snap = svc.snapshot()
+    assert snap["serve_prefill_backlog_tokens"] == 2 * 39
+    assert snap["serve_prefix_hit_pct"] == 0.0
+
+
+def test_serve_prefill_metric_families_lint_clean():
+    from kubeml_tpu.metrics.prom import MetricsRegistry
+    from tools.check_metrics import validate_exposition
+
+    m = MetricsRegistry()
+    m.note_serve_prefill("m1", 32)
+    m.note_serve_decode("m1", 5)
+    m.note_serve_prefix_hits("m1", 3)
+    m.note_serve_prefix_misses("m1", 1)
+    m.set_serve_state("m1", 1, 0, 0.5, prefill_backlog=7)
+    text = m.exposition()
+    assert validate_exposition(text) == []
+    assert 'kubeml_serve_prefill_tokens_total{model="m1"} 32' in text
+    assert 'kubeml_serve_decode_tokens_total{model="m1"} 5' in text
+    assert 'kubeml_serve_prefix_cache_hits_total{model="m1"} 3' in text
+    assert 'kubeml_serve_prefix_cache_misses_total{model="m1"} 1' in text
+    assert 'kubeml_serve_prefill_backlog_tokens{model="m1"} 7' in text
+    m.clear_serve("m1")
+    assert 'model="m1"' not in m.exposition()
+
+
+def test_check_serve_parity_lint_passes_on_repo():
+    """The lint itself, run over the real tree: every registered
+    serving-path variant is covered by this file's tests."""
+    import os
+
+    from kubeml_tpu.serve.engine import SERVE_PATH_VARIANTS
+    from tools.check_serve_parity import main, path_variants
+
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    engine_path = os.path.join(root, "kubeml_tpu", "serve", "engine.py")
+    assert tuple(path_variants(engine_path)) == SERVE_PATH_VARIANTS
+    assert main(["check_serve_parity.py", root]) == 0
+
+
+def test_check_serve_parity_lint_selftest(tmp_path):
+    """The lint catches an uncovered variant, ignores comment-only
+    mentions, and fails loudly when the registry is missing."""
+    from tools.check_serve_parity import main, uncovered_variants
+
+    eng_dir = tmp_path / "kubeml_tpu" / "serve"
+    eng_dir.mkdir(parents=True)
+    tests_dir = tmp_path / "tests"
+    tests_dir.mkdir()
+    engine = eng_dir / "engine.py"
+    engine.write_text(
+        'SERVE_PATH_VARIANTS = (\n    "covered_path",\n'
+        '    "naked_path",\n)\n')
+    (tests_dir / "test_ok.py").write_text(
+        'import numpy as np\n'
+        'def test_covered():\n'
+        '    # naked_path mentioned in a comment only: does not count\n'
+        '    variant = "covered_path"\n'
+        '    np.testing.assert_array_equal([1], [1])\n')
+    assert uncovered_variants(str(engine), str(tests_dir)) == ["naked_path"]
+    assert main(["lint", str(tmp_path)]) == 1
+    (tests_dir / "test_fix.py").write_text(
+        'import numpy as np\n'
+        'def test_naked():\n'
+        '    assert "naked_path"\n'
+        '    np.testing.assert_array_equal([2], [2])\n')
+    assert main(["lint", str(tmp_path)]) == 0
+    engine.write_text("SERVE_PATH_VARIANTS = ()\n")
+    assert main(["lint", str(tmp_path)]) == 1
 
 
 def test_top_renders_serving_pane():
