@@ -259,6 +259,12 @@ class TrainRequest:
     # warm-start from another job's checkpoint (net-new: the reference
     # deletes weights at job end and has no resume path, SURVEY.md §5)
     resume_from: str = ""
+    # cluster-allocator admission (control/cluster.py; defaults keep old
+    # clients/manifests parsing): higher priority places first and may
+    # preempt strictly-lower-priority work; the tenant keys quota and
+    # weighted-fair-share accounting
+    priority: int = 0
+    tenant: str = ""
 
     def to_dict(self) -> dict:
         return {
@@ -270,6 +276,8 @@ class TrainRequest:
             "function_name": self.function_name or self.model_type,
             "options": self.options.to_dict(),
             "resume_from": self.resume_from,
+            "priority": self.priority,
+            "tenant": self.tenant,
         }
 
     @classmethod
@@ -283,6 +291,8 @@ class TrainRequest:
             function_name=d.get("function_name", ""),
             options=TrainOptions.from_dict(d.get("options", {})),
             resume_from=d.get("resume_from", ""),
+            priority=int(d.get("priority", 0)),
+            tenant=d.get("tenant", ""),
         )
 
 
@@ -305,6 +315,11 @@ class TrainTask:
     # restarts consumed and graceful preemption handoffs survived
     restarts: int = 0
     preemptions: int = 0
+    # cluster-allocator admission keys, copied off the request at
+    # enqueue so the scheduler/PS wire carries them without reparsing
+    # parameters (control/cluster.py; defaults keep old payloads valid)
+    priority: int = 0
+    tenant: str = ""
 
     def to_dict(self) -> dict:
         return {
@@ -316,6 +331,8 @@ class TrainTask:
             "trace_id": self.trace_id,
             "restarts": self.restarts,
             "preemptions": self.preemptions,
+            "priority": self.priority,
+            "tenant": self.tenant,
         }
 
     @classmethod
@@ -329,6 +346,8 @@ class TrainTask:
             trace_id=d.get("trace_id", ""),
             restarts=int(d.get("restarts", 0)),
             preemptions=int(d.get("preemptions", 0)),
+            priority=int(d.get("priority", 0)),
+            tenant=d.get("tenant", ""),
         )
 
 

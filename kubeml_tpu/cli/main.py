@@ -97,6 +97,7 @@ def cmd_train(args):
         model_type=args.function, batch_size=args.batch, epochs=args.epochs,
         dataset=args.dataset, lr=args.lr, function_name=args.function,
         resume_from=args.resume_from,
+        priority=args.priority, tenant=args.tenant,
         options=TrainOptions(
             default_parallelism=args.parallelism,
             static_parallelism=args.static,
@@ -352,6 +353,34 @@ def _render_top(doc: dict) -> str:
             f"prefill backlog "
             f"{latest.get('serve_prefill_backlog_tokens', 0):g}  "
             f"prefix hit {latest.get('serve_prefix_hit_pct', 0):g}%")
+    if latest.get("cluster_pool_lanes") is not None:
+        # cluster pane: the `cluster` pseudo job publishes the allocator
+        # snapshot — pool utilization, per-tenant share vs quota, queue
+        # depth by priority, and the lifetime preemption count
+        pool = float(latest.get("cluster_pool_lanes", 0) or 0)
+        used = float(latest.get("cluster_lanes_in_use", 0) or 0)
+        util = used / pool if pool else 0.0
+        lines.append(
+            f"cluster: lanes {used:g}/{pool:g} ({util:.0%})  "
+            f"running {latest.get('cluster_running_jobs', 0):g}  "
+            f"queued {latest.get('cluster_queue_depth', 0):g}  "
+            f"oldest wait {float(latest.get('cluster_oldest_wait_s', 0.0)):.1f}s  "
+            f"preemptions {latest.get('cluster_preemptions_total', 0):g}")
+        by_prio = latest.get("cluster_queue_by_priority") or {}
+        if by_prio:
+            depths = "  ".join(
+                f"p{p}:{by_prio[p]:g}"
+                for p in sorted(by_prio, key=lambda x: -int(x)))
+            lines.append(f"  queue by priority: {depths}")
+        tenant_lanes = latest.get("cluster_tenant_lanes") or {}
+        quotas = latest.get("cluster_tenant_quota") or {}
+        for tname in sorted(tenant_lanes):
+            share = float(tenant_lanes[tname]) / pool if pool else 0.0
+            quota = quotas.get(tname)
+            lines.append(
+                f"  tenant {tname:<12} lanes {tenant_lanes[tname]:g}"
+                f"/{quota if quota is not None else pool:g} "
+                f"share {share:.0%}")
     worker_losses = latest.get("worker_losses") or []
     grad_norms = latest.get("grad_norms") or []
     update_ratios = latest.get("update_ratios") or []
@@ -454,7 +483,10 @@ def cmd_serve(args):
                                serve_slots=args.serve_slots,
                                serve_queue_depth=args.serve_queue_depth,
                                serve_prefill_chunk=args.serve_prefill_chunk,
-                               serve_prefix_cache=_prefix_cache_opt(args))
+                               serve_prefix_cache=_prefix_cache_opt(args),
+                               cluster_lanes=args.cluster_lanes,
+                               cluster_tenants=args.cluster_tenant,
+                               cluster_aging_s=args.cluster_aging_s)
         print(f"controller: {svc.controller.url}")
         print(f"scheduler:  {svc.scheduler.url}")
         print(f"ps:         {svc.ps.url}  (metrics at {svc.ps.url}/metrics)")
@@ -465,9 +497,13 @@ def cmd_serve(args):
                          ps_url=args.ps_url, storage_url=args.storage_url,
                          port=args.port or const.CONTROLLER_PORT)
     elif args.role == "scheduler":
+        from kubeml_tpu.control.deployment import build_allocator
         from kubeml_tpu.control.scheduler import Scheduler
         svc = Scheduler(ps_url=args.ps_url,
-                        port=args.port or const.SCHEDULER_PORT)
+                        port=args.port or const.SCHEDULER_PORT,
+                        allocator=build_allocator(args.cluster_lanes,
+                                                  args.cluster_tenant,
+                                                  args.cluster_aging_s))
     elif args.role == "ps":
         from kubeml_tpu.control.ps import ParameterServer
         svc = ParameterServer(mesh=mesh, port=args.port or const.PS_PORT,
@@ -609,6 +645,16 @@ def build_parser() -> argparse.ArgumentParser:
                    help="mask a worker out for the rest of the epoch "
                         "after Q consecutive non-finite rounds (0 = "
                         "off; per-round device readback cost)")
+    t.add_argument("--priority", type=int, default=0, metavar="P",
+                   help="cluster-allocator priority: higher-priority "
+                        "jobs place first and may preempt (drain + "
+                        "checkpoint + requeue, no restart budget spent) "
+                        "strictly lower-priority running jobs; ignored "
+                        "without --cluster-lanes on the deployment")
+    t.add_argument("--tenant", default="",
+                   help="cluster-allocator tenant for quota and "
+                        "weighted-fair-share accounting (default: the "
+                        "shared 'default' tenant)")
     t.add_argument("--reassign-on-quarantine", action="store_true",
                    help="elastic degraded mode: when a worker is "
                         "quarantined mid-epoch, re-deal its unconsumed "
@@ -753,6 +799,25 @@ def build_parser() -> argparse.ArgumentParser:
                         "requests by content hash, with copy-on-write "
                         "on divergence "
                         "(KUBEML_SERVE_PREFIX_CACHE, default on)")
+    s.add_argument("--cluster-lanes", type=int, default=None, metavar="N",
+                   help="turn on the cluster allocator over N shared "
+                        "worker lanes: gang placement, priority "
+                        "preemption and weighted fair sharing "
+                        "(control/cluster.py); default off = legacy "
+                        "one-job-at-a-time scheduling")
+    s.add_argument("--cluster-tenant", action="append",
+                   metavar="NAME=WEIGHT[:QUOTA]",
+                   help="declare a tenant's fair-share weight and "
+                        "optional lane quota; repeat per tenant (e.g. "
+                        "--cluster-tenant prod=3:6 "
+                        "--cluster-tenant batch=1). Undeclared tenants "
+                        "get weight 1 and no quota")
+    s.add_argument("--cluster-aging-s", type=float, default=None,
+                   metavar="S",
+                   help="queue-aging period: a parked job gains one "
+                        "effective priority level per S seconds waited "
+                        "so low-priority gangs cannot starve "
+                        "(default 30; <= 0 disables aging)")
     s.set_defaults(fn=cmd_serve)
     return p
 

@@ -1,0 +1,418 @@
+"""Cluster allocator — gang placement, priority preemption, fair sharing.
+
+The per-job `ThroughputBasedPolicy` (control/policy.py) sizes ONE job's
+parallelism from its own epoch times, exactly as the reference KubeML
+did. Under multi-tenant load many jobs contend for one shared device
+mesh, so this module adds the cluster-level layer the reference never
+had — in the spirit of Gandiva/DRF-style GPU-cluster schedulers, built
+on the repo's preemption grace (SIGTERM → drain → round-granular
+checkpoint → budget-free reschedule, docs/architecture.md):
+
+  - gang placement: a job's N worker lanes place atomically or not at
+    all — never a partially placed job. The scheduler's 503-defer path
+    is reserved for true pool exhaustion; an arrival the pool cannot
+    hold YET simply queues here until lanes free.
+  - priority preemption: a strictly-higher-priority arrival that cannot
+    place selects the cheapest-to-displace running victims (lowest
+    priority, then fewest lanes, then least sunk time). The scheduler
+    SIGTERMs each victim, which drains its in-flight round, checkpoints,
+    and requeues WITHOUT consuming `max_restarts`.
+  - weighted fair sharing with per-tenant quotas: deficit-tracked
+    shares decide which tenant grows when the pool frees; a tenant at
+    its quota is clamped (its jobs wait on its OWN lanes) before any
+    under-quota tenant is held back. Aging raises a parked job's
+    effective priority over time so sustained higher-priority load can
+    never starve it.
+
+The allocator is PURE LOGIC: no HTTP, no threads of its own, and an
+injectable clock (the HealthEvaluator/_scan_heartbeats determinism
+discipline), so every decision path is unit-testable and the bench.py
+cluster arm can drive it with a fake clock. Decisions are explicit
+`Decision` records whose `path` names one of DECISION_PATHS below;
+tools/check_sched_invariants.py fails the build unless each named path
+has a quoted-name test in tests/.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+from typing import Callable, Dict, List, Optional, Tuple
+
+# Pseudo job id under which the scheduler feeds allocator snapshots
+# through the PS health pipeline (the serve:<model> idiom), so
+# `kubeml top --id cluster` renders the cluster pane from GET /health.
+CLUSTER_JOB_ID = "cluster"
+
+# Named decision paths. Every entry must be exercised by a test that
+# names it (quoted, in an assertion) — tools/check_sched_invariants.py
+# walks this literal and lints tests/ for coverage.
+DECISION_PATHS = {
+    "gang-atomicity": "a job's N lanes place atomically or not at all",
+    "no-starvation": "aging raises a parked job's effective priority "
+                     "until it places under sustained load",
+    "quota-clamp": "an over-quota tenant is clamped before any "
+                   "under-quota tenant is held back",
+    "preempt-cheapest": "a higher-priority arrival displaces the "
+                        "cheapest-to-displace lower-priority victims",
+}
+
+DEFAULT_TENANT = "default"
+# seconds of queue wait per +1 effective priority (0 disables aging)
+DEFAULT_AGING_S = 30.0
+
+
+@dataclasses.dataclass(frozen=True)
+class Decision:
+    """One explicit allocator decision, for the scheduler to apply.
+
+    action: 'place'   — start job_id with `lanes` workers (atomic gang)
+            'queue'   — job_id stays parked until lanes free
+            'preempt' — SIGTERM `victim` to make room for job_id
+            'resize'  — running job_id's next-epoch width is `lanes`
+    path names the DECISION_PATHS entry that drove the choice."""
+
+    action: str
+    job_id: str
+    lanes: int = 0
+    victim: str = ""
+    path: str = ""
+    detail: str = ""
+
+
+@dataclasses.dataclass
+class _Pending:
+    job_id: str
+    tenant: str
+    priority: int
+    lanes: int          # requested gang size (clamped to the pool)
+    enqueued_at: float
+
+
+@dataclasses.dataclass
+class _Running:
+    job_id: str
+    tenant: str
+    priority: int
+    lanes: int
+    placed_at: float
+    preempting: bool = False  # victim selected; lanes free on release
+
+
+def parse_tenant_spec(spec: str) -> Tuple[str, float, Optional[int]]:
+    """Parse a CLI tenant spec ``name=weight[:quota]`` — e.g.
+    ``teamA=2:4`` (weight 2, at most 4 lanes) or ``teamB=1`` (weight 1,
+    quota = whole pool)."""
+    name, _, rest = spec.partition("=")
+    name = name.strip()
+    if not name or not rest:
+        raise ValueError(f"bad tenant spec {spec!r}; want name=weight[:quota]")
+    weight_s, _, quota_s = rest.partition(":")
+    weight = float(weight_s)
+    if weight <= 0:
+        raise ValueError(f"tenant {name!r}: weight must be > 0")
+    quota = None
+    if quota_s:
+        quota = int(quota_s)
+        if quota < 1:
+            raise ValueError(f"tenant {name!r}: quota must be >= 1 lane")
+    return name, weight, quota
+
+
+class ClusterAllocator:
+    """Owns the shared pool of worker lanes between the scheduler and
+    the PS. All methods are synchronous, deterministic given `clock`,
+    and safe to call from the scheduler loop and its HTTP handlers."""
+
+    def __init__(self, pool_lanes: int,
+                 tenant_weights: Optional[Dict[str, float]] = None,
+                 tenant_quotas: Optional[Dict[str, int]] = None,
+                 clock: Callable[[], float] = time.monotonic,
+                 aging_s: float = DEFAULT_AGING_S):
+        if pool_lanes < 1:
+            raise ValueError("pool must have at least one lane")
+        self.pool_lanes = int(pool_lanes)
+        self.tenant_weights = dict(tenant_weights or {})
+        self.tenant_quotas = dict(tenant_quotas or {})
+        self.clock = clock
+        self.aging_s = float(aging_s)
+        self._running: Dict[str, _Running] = {}
+        self._pending: List[_Pending] = []
+        # weighted-fair deficit per tenant: accrues (weight-shared) as
+        # lanes free, spends as that tenant's jobs place — the
+        # tie-break among equal effective priorities, so the tenant the
+        # pool has shortchanged longest grows first
+        self._deficit: Dict[str, float] = {}
+        # lifetime counters (cumulative; snapshot() exports them and
+        # metrics/prom.py turns deltas into Prometheus counters)
+        self.gang_placements = 0
+        self.preemptions = 0
+        self.aged_grants = 0
+        self.quota_clamps = 0
+        self._lock = threading.Lock()
+
+    # ------------------------------------------------------------ internals
+
+    def _free(self) -> int:
+        return self.pool_lanes - sum(r.lanes
+                                     for r in self._running.values())
+
+    def _in_use(self, tenant: str) -> int:
+        return sum(r.lanes for r in self._running.values()
+                   if r.tenant == tenant)
+
+    def _quota(self, tenant: str) -> int:
+        return int(self.tenant_quotas.get(tenant, self.pool_lanes))
+
+    def _weight(self, tenant: str) -> float:
+        return float(self.tenant_weights.get(tenant, 1.0))
+
+    def _eff_priority(self, p: _Pending, now: float) -> int:
+        if self.aging_s <= 0:
+            return p.priority
+        return p.priority + int((now - p.enqueued_at) // self.aging_s)
+
+    def _accrue_deficit(self, freed: int) -> None:
+        """Freed lanes accrue deficit to every tenant with parked work,
+        split by weight — the DRR quantum. Bounded to one pool so an
+        idle tenant can't bank unbounded future claim."""
+        tenants = {p.tenant for p in self._pending}
+        if not tenants or freed <= 0:
+            return
+        total_w = sum(self._weight(t) for t in tenants)
+        for t in tenants:
+            d = self._deficit.get(t, 0.0) \
+                + freed * self._weight(t) / total_w
+            self._deficit[t] = min(d, float(self.pool_lanes))
+
+    def _grants(self, now: float) -> List[Decision]:
+        """Head-of-line gang placement over the parked queue, ordered by
+        effective priority (aging included), then tenant deficit, then
+        FIFO. A quota-blocked head is SKIPPED (it waits on its own
+        tenant's lanes, and must not hold back under-quota tenants —
+        the quota-clamp ordering); a size-blocked head HOLDS the line
+        (no backfill behind it, so a wide gang is never starved by a
+        stream of narrow ones — aging alone then guarantees it runs)."""
+        decisions: List[Decision] = []
+        progressed = True
+        while progressed and self._pending:
+            progressed = False
+            order = sorted(
+                self._pending,
+                key=lambda p: (-self._eff_priority(p, now),
+                               -self._deficit.get(p.tenant, 0.0),
+                               p.enqueued_at, p.job_id))
+            for p in order:
+                room = self._quota(p.tenant) - self._in_use(p.tenant)
+                if room < 1:
+                    continue  # over-quota tenant: never holds the line
+                # only an EXPLICIT quota clamps the gang below its ask;
+                # the default quota (= the whole pool) must not, or any
+                # wide gang would silently shrink to whatever is free
+                lanes = min(p.lanes, room) \
+                    if p.tenant in self.tenant_quotas else p.lanes
+                if lanes > self._free():
+                    break  # size-blocked head holds the line: no backfill
+                self._pending.remove(p)
+                self._running[p.job_id] = _Running(
+                    p.job_id, p.tenant, p.priority, lanes, placed_at=now)
+                self.gang_placements += 1
+                aged = self._eff_priority(p, now) > p.priority
+                clamped = lanes < p.lanes
+                if aged:
+                    self.aged_grants += 1
+                if clamped:
+                    self.quota_clamps += 1
+                self._deficit[p.tenant] = \
+                    self._deficit.get(p.tenant, 0.0) - lanes
+                if aged:
+                    path = "no-starvation"
+                    detail = (f"placed after aging to effective priority "
+                              f"{self._eff_priority(p, now)} "
+                              f"(base {p.priority})")
+                elif clamped:
+                    path = "quota-clamp"
+                    detail = (f"gang clamped {p.lanes}->{lanes}: tenant "
+                              f"{p.tenant} quota "
+                              f"{self._quota(p.tenant)} lanes")
+                else:
+                    path = "gang-atomicity"
+                    detail = f"all {lanes} lanes placed atomically"
+                decisions.append(Decision("place", p.job_id, lanes=lanes,
+                                          path=path, detail=detail))
+                progressed = True
+                break  # state changed: re-rank before the next grant
+        return decisions
+
+    def _preempt_for(self, p: _Pending, now: float) -> List[Decision]:
+        """Greedy cheapest-first victim selection for a parked arrival
+        that outranks running work: candidates are strictly-lower-RAW-
+        priority running jobs (aging confers ordering, never the right
+        to displace), cheapest = lowest priority, then fewest lanes,
+        then least sunk time. Victims only marked — their lanes free
+        when the drained process actually exits and release() runs."""
+        # as in _grants: only an EXPLICIT quota bounds how many lanes
+        # the arrival may claim — the default quota equals the pool and
+        # would otherwise collapse `need` to 1 whenever the pool is
+        # full, displacing too few victims to ever seat the gang
+        if p.tenant in self.tenant_quotas:
+            need = min(p.lanes,
+                       max(1, self._quota(p.tenant)
+                           - self._in_use(p.tenant)))
+        else:
+            need = p.lanes
+        avail = self._free() + sum(r.lanes
+                                   for r in self._running.values()
+                                   if r.preempting)
+        if need <= avail:
+            return []  # enough already freeing; wait for release()
+        cands = sorted(
+            (r for r in self._running.values()
+             if not r.preempting and r.priority < p.priority),
+            key=lambda r: (r.priority, r.lanes, -r.placed_at, r.job_id))
+        victims: List[_Running] = []
+        for r in cands:
+            if avail >= need:
+                break
+            victims.append(r)
+            avail += r.lanes
+        if avail < need:
+            return []  # even preempting every candidate won't fit: wait
+        decisions = []
+        for v in victims:
+            v.preempting = True
+            self.preemptions += 1
+            decisions.append(Decision(
+                "preempt", p.job_id, victim=v.job_id,
+                path="preempt-cheapest",
+                detail=(f"priority {p.priority} arrival needs {need} "
+                        f"lane(s); displacing {v.job_id} (priority "
+                        f"{v.priority}, {v.lanes} lane(s))")))
+        return decisions
+
+    # -------------------------------------------------------------- surface
+
+    def submit(self, job_id: str, tenant: str = DEFAULT_TENANT,
+               priority: int = 0, lanes: int = 1) -> List[Decision]:
+        """Admit one job's gang request. Returns the decisions to apply:
+        an immediate atomic 'place', or 'queue' (possibly alongside
+        'preempt' decisions naming the victims making room)."""
+        with self._lock:
+            now = self.clock()
+            lanes = max(1, min(int(lanes), self.pool_lanes))
+            tenant = tenant or DEFAULT_TENANT
+            if job_id in self._running \
+                    or any(p.job_id == job_id for p in self._pending):
+                raise ValueError(f"job {job_id} already admitted")
+            p = _Pending(job_id, tenant, int(priority), lanes,
+                         enqueued_at=now)
+            self._pending.append(p)
+            decisions = self._grants(now)
+            if any(p.job_id == job_id for p in self._pending):
+                decisions += self._preempt_for(p, now)
+                decisions.append(Decision(
+                    "queue", job_id, lanes=lanes,
+                    detail=f"parked: {self._free()} free lane(s), "
+                           f"gang wants {lanes}"))
+            return decisions
+
+    def release(self, job_id: str) -> List[Decision]:
+        """A job left the pool (finished, failed, or a preempted victim
+        exited after its drain) or abandoned the queue. Frees its
+        lanes, accrues the weighted-fair deficit, and returns any
+        'place' grants the freed lanes unlock."""
+        with self._lock:
+            now = self.clock()
+            rec = self._running.pop(job_id, None)
+            if rec is None:
+                self._pending = [p for p in self._pending
+                                 if p.job_id != job_id]
+                return []
+            self._accrue_deficit(rec.lanes)
+            return self._grants(now)
+
+    def resize(self, job_id: str, requested: int) -> List[Decision]:
+        """The per-job advisor (ThroughputBasedPolicy) asked for a new
+        width. Shrinks always land (frees lanes → may grant parked
+        work); grows are clamped by free lanes, the tenant quota, and
+        parked equal-or-higher-priority work (freed lanes go to the
+        queue first). First decision is always the 'resize' answer."""
+        with self._lock:
+            now = self.clock()
+            requested = max(1, int(requested))
+            rec = self._running.get(job_id)
+            if rec is None:
+                return [Decision("resize", job_id, lanes=requested,
+                                 detail="job not pool-managed; advisor "
+                                        "width passes through")]
+            quota_cap = self._quota(rec.tenant) \
+                - self._in_use(rec.tenant) + rec.lanes \
+                if rec.tenant in self.tenant_quotas else self.pool_lanes
+            allowed = min(requested, quota_cap)
+            if allowed > rec.lanes:
+                grow_cap = rec.lanes + self._free()
+                if any(self._eff_priority(p, now) >= rec.priority
+                       for p in self._pending):
+                    grow_cap = rec.lanes  # parked peers claim frees first
+                allowed = min(allowed, grow_cap)
+            allowed = max(1, allowed)
+            path = detail = ""
+            if allowed < min(requested, quota_cap):
+                detail = (f"grow {rec.lanes}->{requested} clamped to "
+                          f"{allowed}: free lanes/parked work")
+            if quota_cap < requested:
+                path = "quota-clamp"
+                self.quota_clamps += 1
+                detail = (f"advisor asked {requested}, tenant "
+                          f"{rec.tenant} quota {self._quota(rec.tenant)} "
+                          f"lane(s) allows {allowed}")
+            decisions = [Decision("resize", job_id, lanes=allowed,
+                                  path=path, detail=detail)]
+            if allowed != rec.lanes:
+                freed = rec.lanes - allowed
+                rec.lanes = allowed
+                if freed > 0:
+                    self._accrue_deficit(freed)
+                    decisions += self._grants(now)
+            return decisions
+
+    # ------------------------------------------------------------ telemetry
+
+    def snapshot(self) -> dict:
+        """The cluster telemetry sample: fed to the PS (POST /cluster)
+        for the Prometheus gauges, and through the health pipeline
+        under CLUSTER_JOB_ID for the queue-starvation rule and the
+        `kubeml top` cluster pane."""
+        with self._lock:
+            now = self.clock()
+            in_use = self.pool_lanes - self._free()
+            by_prio: Dict[str, int] = {}
+            for p in self._pending:
+                key = str(p.priority)
+                by_prio[key] = by_prio.get(key, 0) + 1
+            tenants = sorted(set(self.tenant_weights)
+                             | set(self.tenant_quotas)
+                             | {r.tenant for r in self._running.values()}
+                             | {p.tenant for p in self._pending})
+            oldest = max((now - p.enqueued_at for p in self._pending),
+                         default=0.0)
+            return {
+                "job_id": CLUSTER_JOB_ID,
+                "cluster_pool_lanes": self.pool_lanes,
+                "cluster_lanes_in_use": in_use,
+                "cluster_running_jobs": len(self._running),
+                "cluster_queue_depth": len(self._pending),
+                "cluster_queue_by_priority": by_prio,
+                "cluster_oldest_wait_s": oldest,
+                "cluster_tenant_lanes": {
+                    t: self._in_use(t) for t in tenants},
+                "cluster_tenant_quota": {
+                    t: self._quota(t) for t in tenants},
+                "cluster_tenant_weight": {
+                    t: self._weight(t) for t in tenants},
+                "cluster_gang_placements_total": self.gang_placements,
+                "cluster_preemptions_total": self.preemptions,
+                "cluster_aged_grants_total": self.aged_grants,
+                "cluster_quota_clamps_total": self.quota_clamps,
+            }

@@ -54,6 +54,7 @@ class Controller(JsonService):
         self.route("DELETE", "/dataset/{name}", self._h_dataset_delete)
         self.route("GET", "/tasks", self._h_tasks)
         self.route("DELETE", "/tasks/{jobId}", self._h_task_stop)
+        self.route("GET", "/cluster", self._h_cluster)
         self.route("GET", "/trace/{jobId}", self._h_trace)
         # /health stays the gateway's own liveness probe; the job-health
         # verdict gets its own path segment
@@ -119,6 +120,14 @@ class Controller(JsonService):
         return http_json(
             "DELETE",
             f"{self._need(self.ps_url, 'PS')}/stop/{req.params['jobId']}")
+
+    def _h_cluster(self, req: Request):
+        """Cluster-allocator snapshot (pool, queues, tenant shares), proxied
+        to the scheduler which owns the allocator; 503 when the deployment
+        runs without cluster mode."""
+        return http_json(
+            "GET",
+            f"{self._need(self.scheduler_url, 'scheduler')}/cluster")
 
     def _h_trace(self, req: Request):
         """Merged job timeline, proxied to the PS (which owns the trace

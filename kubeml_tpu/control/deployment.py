@@ -14,10 +14,36 @@ import dataclasses
 from typing import Optional
 
 from kubeml_tpu.api import const
+from kubeml_tpu.control.cluster import ClusterAllocator, parse_tenant_spec
 from kubeml_tpu.control.controller import Controller
 from kubeml_tpu.control.ps import ParameterServer
 from kubeml_tpu.control.scheduler import Scheduler
 from kubeml_tpu.control.storage import StorageService
+
+
+def build_allocator(cluster_lanes, cluster_tenants=None,
+                    aging_s=None) -> Optional[ClusterAllocator]:
+    """Build the scheduler's ClusterAllocator from deployment knobs.
+    cluster_lanes <= 0 / None disables cluster mode (legacy FIFO).
+    cluster_tenants: iterable of ``name=weight[:quota]`` specs (the
+    --cluster-tenant CLI flag) or a {name: (weight, quota)} mapping."""
+    if not cluster_lanes or int(cluster_lanes) <= 0:
+        return None
+    weights, quotas = {}, {}
+    if isinstance(cluster_tenants, dict):
+        for name, (weight, quota) in cluster_tenants.items():
+            weights[name] = float(weight)
+            if quota is not None:
+                quotas[name] = int(quota)
+    else:
+        for spec in cluster_tenants or ():
+            name, weight, quota = parse_tenant_spec(spec)
+            weights[name] = weight
+            if quota is not None:
+                quotas[name] = quota
+    kwargs = {} if aging_s is None else {"aging_s": float(aging_s)}
+    return ClusterAllocator(int(cluster_lanes), tenant_weights=weights,
+                            tenant_quotas=quotas, **kwargs)
 
 
 @dataclasses.dataclass
@@ -46,7 +72,10 @@ def start_deployment(mesh=None, controller_port: int = 0,
                      serve_slots: Optional[int] = None,
                      serve_queue_depth: Optional[int] = None,
                      serve_prefill_chunk: Optional[int] = None,
-                     serve_prefix_cache: Optional[bool] = None) -> Deployment:
+                     serve_prefix_cache: Optional[bool] = None,
+                     cluster_lanes: Optional[int] = None,
+                     cluster_tenants=None,
+                     cluster_aging_s: Optional[float] = None) -> Deployment:
     """Start storage, PS, scheduler, controller wired together.
 
     Port 0 picks a free port (tests); use_default_ports uses the configured
@@ -54,6 +83,10 @@ def start_deployment(mesh=None, controller_port: int = 0,
     job_partitions: device-partition env dicts for concurrent standalone
     jobs (ParameterServer docs). The serve knobs pass through to the
     PS's inference plane (None keeps its env-var defaults).
+    cluster_lanes > 0 turns on the cluster allocator (control/cluster.py)
+    over that many shared worker lanes, with cluster_tenants
+    (``name=weight[:quota]`` specs) keying quotas and weighted fair
+    shares; None/0 keeps the legacy single-job scheduling path.
     """
     if use_default_ports:
         controller_port = controller_port or const.CONTROLLER_PORT
@@ -74,7 +107,10 @@ def start_deployment(mesh=None, controller_port: int = 0,
                          serve_prefix_cache=serve_prefix_cache)
     ps.start()
 
-    scheduler = Scheduler(ps_url=ps.url, port=scheduler_port)
+    scheduler = Scheduler(ps_url=ps.url, port=scheduler_port,
+                          allocator=build_allocator(cluster_lanes,
+                                                    cluster_tenants,
+                                                    cluster_aging_s))
     scheduler.start()
     ps.scheduler_url = scheduler.url
 

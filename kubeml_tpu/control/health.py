@@ -165,6 +165,29 @@ def _make_serve_saturation():
     return check
 
 
+def _make_queue_starvation(wait_limit_s: float):
+    """Cluster allocator (control/cluster.py snapshots under the
+    `cluster` pseudo job id): warn when a parked job has waited past
+    the limit — either aging is disabled/too slow, or quotas have
+    wedged the queue behind a full pool. Training/serving samples
+    carry no cluster_* fields, so this never fires for them."""
+    def check(window: List[dict]) -> Optional[str]:
+        m = _latest(window)
+        lanes = m.get("cluster_pool_lanes")
+        if lanes is None:
+            return None
+        depth = float(m.get("cluster_queue_depth", 0.0))
+        wait = float(m.get("cluster_oldest_wait_s", 0.0))
+        if depth > 0 and wait > wait_limit_s:
+            in_use = float(m.get("cluster_lanes_in_use", 0.0))
+            return (f"oldest parked job has waited {wait:.0f}s "
+                    f"(> {wait_limit_s:g}s) with {depth:g} job(s) "
+                    f"queued and {in_use:g}/{float(lanes):g} lanes "
+                    f"leased — queue is starving")
+        return None
+    return check
+
+
 def _make_serve_ttft_slo(slo_s: float):
     def check(window: List[dict]) -> Optional[str]:
         m = _latest(window)
@@ -181,7 +204,8 @@ def default_rules(grad_abs: float = 1e4, grad_rel: float = 50.0,
                   spread_rel: float = 0.75, stall_floor: float = 1e-7,
                   stall_epochs: int = 3, straggler_rel: float = 5.0,
                   straggler_min_rounds: int = 4,
-                  serve_ttft_slo_s: float = 2.0) -> List[HealthRule]:
+                  serve_ttft_slo_s: float = 2.0,
+                  queue_starvation_s: float = 120.0) -> List[HealthRule]:
     return [
         HealthRule("worker_divergence", "critical",
                    "non-finite guard dropped or quarantined workers",
@@ -204,6 +228,9 @@ def default_rules(grad_abs: float = 1e4, grad_rel: float = 50.0,
         HealthRule("serve_ttft_slo", "warning",
                    "serving p99 time-to-first-token above the SLO",
                    _make_serve_ttft_slo(serve_ttft_slo_s)),
+        HealthRule("queue_starvation", "warning",
+                   "a cluster-parked job has waited past the limit",
+                   _make_queue_starvation(queue_starvation_s)),
     ]
 
 
@@ -220,7 +247,19 @@ _SAMPLE_FIELDS = ("train_loss", "validation_loss", "accuracy",
                   "serve_queue_depth", "serve_queue_cap",
                   "serve_kv_page_utilization", "serve_rejected_total",
                   "serve_ttft_p50", "serve_ttft_p99",
-                  "serve_prefill_backlog_tokens", "serve_prefix_hit_pct")
+                  "serve_prefill_backlog_tokens", "serve_prefix_hit_pct",
+                  # cluster-allocator snapshots (control/cluster.py)
+                  # ride the same pipeline under the `cluster` pseudo
+                  # job id; `kubeml top --id cluster` renders them
+                  "cluster_pool_lanes", "cluster_lanes_in_use",
+                  "cluster_running_jobs", "cluster_queue_depth",
+                  "cluster_queue_by_priority", "cluster_oldest_wait_s",
+                  "cluster_tenant_lanes", "cluster_tenant_quota",
+                  "cluster_tenant_weight",
+                  "cluster_gang_placements_total",
+                  "cluster_preemptions_total",
+                  "cluster_aged_grants_total",
+                  "cluster_quota_clamps_total")
 
 
 class HealthEvaluator:

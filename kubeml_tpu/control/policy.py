@@ -12,6 +12,13 @@ Parity with ml/pkg/scheduler/policy.go:18-102:
              in between              -> unchanged, cache NOT refreshed
              (the reference keeps the old reference time on the
              keep-parallelism branch, policy.go:91-93).
+
+Under the cluster allocator (control/cluster.py) a policy is the
+PER-JOB WIDTH ADVISOR only: its requested parallelism becomes the gang
+ask on admission and the resize ask between epochs, and the allocator
+may clamp it to free lanes, the tenant quota, or parked higher-priority
+work. Without an allocator the policy's answer is applied as-is (the
+reference behavior).
 """
 
 from __future__ import annotations

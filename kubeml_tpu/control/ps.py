@@ -222,13 +222,20 @@ class _JobRecord:
         self.partition: Optional[int] = None  # device-partition slot
         self.next_parallelism: Optional[int] = None
         self.update_event = threading.Event()
-        self.restarts = 0  # checkpoint-based crash restarts consumed
+        # lifecycle counters seed from the task so an allocator requeue
+        # (task handed back to the scheduler and re-/start-ed as a new
+        # record) carries the job's cumulative history forward
+        self.restarts = task.restarts  # crash restarts consumed
         self.restarting = False  # watchdog respawn claimed, in progress
         self.preempted = False  # child announced a graceful preemption
-        self.preemptions = 0  # reschedules consumed (do NOT count as
-        #                       restarts: preemption is the platform's
-        #                       doing, not the job's, so it must not eat
-        #                       the max_restarts crash budget)
+        self.preemptions = task.preemptions  # reschedules consumed (do
+        #                       NOT count as restarts: preemption is the
+        #                       platform's doing, not the job's, so it
+        #                       must not eat the max_restarts crash budget)
+        self.requeue_on_exit = False  # cluster-allocator preemption: on
+        #                       exit, hand the task BACK to the scheduler
+        #                       queue (freeing the lanes/partition) instead
+        #                       of respawning in place
         self.last_heartbeat: Optional[float] = None  # monotonic stamp
         self.heartbeat_progress = (0, 0)  # (epoch, round) last reported
 
@@ -361,6 +368,8 @@ class ParameterServer(JsonService):
         self.route("POST", "/metrics/{jobId}", self._h_metrics)
         self.route("POST", "/finish/{jobId}", self._h_finish)
         self.route("POST", "/preempted/{jobId}", self._h_preempted)
+        self.route("POST", "/preempt/{jobId}", self._h_preempt)
+        self.route("POST", "/cluster", self._h_cluster)
         self.route("POST", "/heartbeat/{jobId}", self._h_heartbeat)
         self.route("DELETE", "/stop/{jobId}", self._h_stop)
         self.route("GET", "/tasks", self._h_tasks)
@@ -529,6 +538,46 @@ class ParameterServer(JsonService):
                        "reschedule from its round checkpoint", job_id,
                        body.get("epoch"), body.get("round"))
         self.metrics.note_preemption(job_id)
+        return {"ok": True}
+
+    def _h_preempt(self, req: Request):
+        """Cluster-allocator preemption (control/cluster.py): SIGTERM
+        the victim's standalone child so it drains its in-flight round,
+        checkpoints at the round cursor, posts /preempted and exits —
+        then the watchdog hands its task BACK to the scheduler queue
+        (requeue_on_exit) instead of respawning in place, so the freed
+        lanes go to the higher-priority arrival. No restart budget is
+        consumed anywhere on this path."""
+        job_id = req.params["jobId"]
+        with self._jobs_lock:
+            rec = self.jobs.get(job_id)
+            if rec is None:
+                raise JobNotFoundError(job_id)
+            if rec.proc is None:
+                # threaded jobs share one process — there is no SIGTERM
+                # grace path to drain them individually
+                raise KubeMLException(
+                    f"job {job_id} is not a standalone child; "
+                    "allocator preemption requires standalone job mode",
+                    503)
+            rec.requeue_on_exit = True
+            proc = rec.proc
+        logger.warning("job %s: allocator preemption — sending SIGTERM "
+                       "for drain + checkpoint + requeue", job_id)
+        proc.terminate()
+        return {"ok": True}
+
+    def _h_cluster(self, req: Request):
+        """Cluster-allocator telemetry push from the scheduler: the
+        snapshot lands on the Prometheus cluster families and rides the
+        health pipeline under the `cluster` pseudo job id (the
+        serve:<model> idiom), so the queue-starvation rule and the
+        `kubeml top` cluster pane see it via GET /health?id=cluster."""
+        snap = req.body if isinstance(req.body, dict) else {}
+        if not snap.get("job_id"):
+            raise InvalidArgsError("cluster snapshot requires job_id")
+        self.metrics.update_cluster(snap)
+        self._observe_health(snap)
         return {"ok": True}
 
     def _h_heartbeat(self, req: Request):
@@ -1000,7 +1049,22 @@ class ParameterServer(JsonService):
             # for reschedule (given a checkpoint) and exempt from the
             # max_restarts crash budget
             preempted, rec.preempted = rec.preempted, False
-            eligible = (not self._stopping
+            # cluster-allocator preemption (POST /preempt): the task
+            # goes BACK to the scheduler queue so the freed lanes serve
+            # the higher-priority arrival — instead of respawning here.
+            # Covers a child that crashed DURING the drain too (the
+            # eviction was the platform's doing either way, so neither
+            # path consumes max_restarts); without a checkpoint there
+            # is nothing to requeue and the exit fails as before.
+            requeue = (rec.requeue_on_exit
+                       and self.scheduler_url is not None
+                       and not self._stopping
+                       and rec.task.state != "stopping"
+                       and has_checkpoint)
+            if requeue:
+                self.jobs.pop(job_id, None)
+            eligible = (not requeue
+                        and not self._stopping
                         and rec.task.state != "stopping"
                         and (preempted or rec.restarts < opts.max_restarts)
                         and has_checkpoint)
@@ -1012,6 +1076,9 @@ class ParameterServer(JsonService):
                 rec.restarting = True
                 rec.last_heartbeat = None  # fresh liveness window
                 rec.task.parameters.resume_from = job_id
+        if requeue:
+            self._requeue_preempted(job_id, rec)
+            return
         if not preempted:
             logger.warning("job %s process exited without finishing "
                            "(rc=%s)", job_id, rc)
@@ -1039,6 +1106,31 @@ class ParameterServer(JsonService):
                                f"checkpoint restart failed: {e}")
             return
         rec.restarting = False
+
+    def _requeue_preempted(self, job_id: str, rec: _JobRecord) -> None:
+        """Hand an allocator-preempted task back to the scheduler queue
+        (the record is already popped; the child process has exited, so
+        its device partition frees immediately). The task carries the
+        cumulative restart/preemption counters and resumes from its own
+        round-granular checkpoint when the allocator re-places it."""
+        self._release_partition(rec)
+        self.metrics.running_total.inc("train", -1.0)
+        task = rec.task
+        task.state = "queued"
+        task.elapsed_time_s = -1.0
+        task.parameters.resume_from = job_id
+        task.restarts = rec.restarts
+        task.preemptions = rec.preemptions
+        logger.warning("job %s: handing preempted task back to the "
+                       "scheduler queue (preemptions=%d, restarts=%d)",
+                       job_id, rec.preemptions, rec.restarts)
+        try:
+            http_json("POST", f"{self.scheduler_url}/requeue",
+                      task.to_dict(), trace_id=task.trace_id or None)
+        except KubeMLException as e:
+            logger.error("requeue of preempted job %s failed: %s — the "
+                         "job is stranded until resubmitted", job_id,
+                         e.message)
 
     def _wait_job_ready(self, proc: subprocess.Popen, port_file: str,
                         timeout: Optional[float] = None) -> str:

@@ -12,6 +12,14 @@ Parity with ml/pkg/scheduler/ (scheduler.go, api.go, queue.go):
   - POST /infer: inference relay (api.go:119-162; the reference invokes the
     Fission function directly — here the PS runs it from the checkpoint);
   - DELETE /finish/{taskId}: drop policy state (api.go:165-181).
+
+Net-new cluster mode (control/cluster.py, opt-in via `allocator=`): a
+ClusterAllocator owning the shared lane pool sits between this queue
+and the PS — arrivals gang-place atomically, queue under priority +
+aging + weighted-fair deficits, or preempt cheaper running work (the
+victim drains, checkpoints, and comes back through POST /requeue
+without consuming max_restarts). The ThroughputBasedPolicy stays on as
+the per-job width ADVISOR whose requested N the allocator may clamp.
 """
 
 from __future__ import annotations
@@ -21,10 +29,11 @@ import logging
 import random
 import threading
 import time
-from typing import Deque, Dict, Optional
+from typing import Deque, Dict, List, Optional
 
 from kubeml_tpu.api.errors import InvalidArgsError, KubeMLException
 from kubeml_tpu.api.types import TrainRequest, TrainTask
+from kubeml_tpu.control.cluster import ClusterAllocator, Decision
 from kubeml_tpu.control.httpd import JsonService, Request, http_json
 from kubeml_tpu.control.policy import SchedulerPolicy, ThroughputBasedPolicy
 from kubeml_tpu.utils.ids import make_job_id
@@ -68,23 +77,43 @@ class Scheduler(JsonService):
     name = "scheduler"
 
     def __init__(self, ps_url: Optional[str] = None, port: int = 0,
-                 policy: Optional[SchedulerPolicy] = None):
+                 policy: Optional[SchedulerPolicy] = None,
+                 allocator: Optional[ClusterAllocator] = None,
+                 rng: Optional[random.Random] = None):
         super().__init__(port=port)
         self.ps_url = ps_url
         self.policy = policy or ThroughputBasedPolicy()
+        # cluster mode (opt-in): a ClusterAllocator owning the shared
+        # lane pool gang-places/queues/preempts arrivals, with the
+        # policy demoted to a per-job width advisor the allocator may
+        # clamp. None keeps the legacy single-job FIFO path untouched.
+        self.allocator = allocator
         self.queue = SchedulerQueue()
         # capacity-deferred tasks parked with a not-before stamp so the
-        # backoff applies per task, not to the whole scheduling loop
+        # backoff applies per task, not to the whole scheduling loop.
+        # Guarded by _defer_lock: the loop re-admits ripe entries while
+        # /finish drops a dead job's parked task from another thread
         self._deferred: list = []  # [(not_before_monotonic, task)]
+        self._defer_lock = threading.Lock()
         # consecutive deferrals per task id (loop thread owns it), reset
         # on successful dispatch — drives the capped exponential backoff
         self._defer_counts: Dict[str, int] = {}
+        # backoff jitter source, injectable so tests pin exact delays
+        # instead of sleeping past randomized ones
+        self._rng = rng if rng is not None else random.Random()
+        # cluster mode: tasks the allocator parked ('queue' decisions),
+        # and lane grants awaiting their dispatch pass through the queue
+        self._parked: Dict[str, TrainTask] = {}
+        self._granted: Dict[str, int] = {}
+        self._cluster_lock = threading.Lock()
         self._stop = threading.Event()
         self._loop_thread: Optional[threading.Thread] = None
 
         self.route("POST", "/train", self._h_train)
         self.route("POST", "/job", self._h_job)
         self.route("POST", "/infer", self._h_infer)
+        self.route("POST", "/requeue", self._h_requeue)
+        self.route("GET", "/cluster", self._h_cluster)
         self.route("DELETE", "/finish/{taskId}", self._h_finish)
 
     # ------------------------------------------------------------ lifecycle
@@ -114,7 +143,9 @@ class Scheduler(JsonService):
         # by the middleware) to the task: the scheduling loop runs in
         # another thread, so the id must ride the task, not the context
         task = TrainTask(job_id=make_job_id(), parameters=train_req,
-                         trace_id=get_trace_context() or make_trace_id())
+                         trace_id=get_trace_context() or make_trace_id(),
+                         priority=train_req.priority,
+                         tenant=train_req.tenant)
         tracer = Tracer(trace_id=task.trace_id)
         with tracer.span("scheduler.enqueue", job_id=task.job_id):
             self.queue.push(task)
@@ -139,24 +170,71 @@ class Scheduler(JsonService):
         return http_json("POST", f"{self.ps_url}/infer", req.body)
 
     def _h_finish(self, req: Request):
-        self.policy.task_finished(req.params["taskId"])
+        task_id = req.params["taskId"]
+        self.policy.task_finished(task_id)
         # drop any backoff streak so the id doesn't linger forever
         # (single-key dict pop — safe against the loop thread's reads)
-        self._defer_counts.pop(req.params["taskId"], None)
+        self._defer_counts.pop(task_id, None)
+        # a job that finished (or aborted) while PARKED must drop its
+        # deferred entry too, or a dead job's task would be re-admitted
+        # and re-dispatched once its backoff ripens
+        with self._defer_lock:
+            self._deferred = [(nb, t) for nb, t in self._deferred
+                              if t.job_id != task_id]
+        if self.allocator is not None:
+            with self._cluster_lock:
+                self._parked.pop(task_id, None)
+                self._granted.pop(task_id, None)
+            # freed lanes may grant parked work
+            self._apply_decisions(self.allocator.release(task_id))
+            self._push_cluster_state()
         return {"ok": True}
+
+    def _h_requeue(self, req: Request):
+        """A preempted job's task handed back by the PS (the allocator
+        SIGTERMed it to make room; it drained, checkpointed, and its
+        lanes are free). Re-enters the queue as a fresh arrival — its
+        resume_from already points at its own checkpoint, and the
+        policy forgets it so the next decision takes the /start path."""
+        task = TrainTask.from_dict(req.body)
+        self.policy.task_finished(task.job_id)
+        task.state = "queued"
+        task.elapsed_time_s = -1.0
+        if self.allocator is not None:
+            # the victim's lanes free NOW (its process is gone); any
+            # parked higher-priority arrival places on this release
+            self._apply_decisions(self.allocator.release(task.job_id))
+        logger.info("requeued preempted task %s (preemptions=%d)",
+                    task.job_id, task.preemptions)
+        self.queue.push(task)
+        if self.allocator is not None:
+            self._push_cluster_state()
+        return {"ok": True}
+
+    def _h_cluster(self, req: Request):
+        if self.allocator is None:
+            raise KubeMLException("cluster allocator not configured", 503)
+        return self.allocator.snapshot()
 
     # ----------------------------------------------------------------- loop
 
+    def _defer_delay(self, n: int) -> float:
+        """Capped exponential backoff for the n-th consecutive deferral,
+        with +/-25% jitter from the injectable RNG so tasks deferred in
+        the same sweep don't re-arrive as a synchronized burst."""
+        return min(DEFER_CAP_S, DEFER_BASE_S * (2 ** n)) \
+            * (0.75 + 0.5 * self._rng.random())
+
     def _schedule_loop(self):
         while not self._stop.is_set():
-            # re-admit ripe deferred tasks (loop thread owns _deferred)
-            if self._deferred:
+            # re-admit ripe deferred tasks
+            with self._defer_lock:
                 now = time.monotonic()
                 ripe = [t for nb, t in self._deferred if nb <= now]
                 self._deferred = [(nb, t) for nb, t in self._deferred
                                   if nb > now]
-                for t in ripe:
-                    self.queue.push(t)
+            for t in ripe:
+                self.queue.push(t)
             task = self.queue.pop(timeout=0.5)
             if task is None:
                 continue
@@ -179,9 +257,10 @@ class Scheduler(JsonService):
                     # inline sleep here would stall the whole loop)
                     n = self._defer_counts.get(task.job_id, 0)
                     self._defer_counts[task.job_id] = n + 1
-                    delay = min(DEFER_CAP_S, DEFER_BASE_S * (2 ** n)) \
-                        * (0.75 + 0.5 * random.random())
-                    self._deferred.append((time.monotonic() + delay, task))
+                    delay = self._defer_delay(n)
+                    with self._defer_lock:
+                        self._deferred.append(
+                            (time.monotonic() + delay, task))
                 else:
                     logger.exception("scheduling task %s failed",
                                      task.job_id)
@@ -189,6 +268,9 @@ class Scheduler(JsonService):
                 logger.exception("scheduling task %s failed", task.job_id)
 
     def _schedule(self, task: TrainTask):
+        if self.allocator is not None:
+            self._schedule_cluster(task)
+            return
         parallelism, is_new = self.policy.calculate_parallelism(task)
         task.parallelism = parallelism
         if self.ps_url is None:
@@ -206,3 +288,116 @@ class Scheduler(JsonService):
             http_json("POST", f"{self.ps_url}/update/{task.job_id}",
                       {"parallelism": parallelism},
                       trace_id=task.trace_id or None)
+
+    # -------------------------------------------------------- cluster mode
+
+    def _schedule_cluster(self, task: TrainTask):
+        """One queue pass in cluster mode. Three cases:
+
+        - the allocator already granted this task lanes ('place'
+          decision re-pushed it): prime the advisor and /start with the
+          granted gang width;
+        - a RUNNING job asked to re-parallelize (the advisor knows it):
+          the advisor's width goes through allocator.resize, which may
+          clamp it to quota/free lanes;
+        - a fresh arrival: the advisor's requested width becomes the
+          gang ask; the allocator places it atomically, parks it, or
+          preempts cheaper work to make room. A parked task leaves the
+          policy cache so its eventual grant takes the /start path."""
+        job_id = task.job_id
+        with self._cluster_lock:
+            granted = self._granted.pop(job_id, None)
+        if granted is not None:
+            # prime the advisor (first call caches the reference slot)
+            # but dispatch at the allocator's width, not the advisor's
+            self.policy.calculate_parallelism(task)
+            task.parallelism = granted
+            if self.ps_url is None:
+                logger.warning("no PS configured; dropping task %s", job_id)
+                return
+            logger.info("starting task %s with %d allocator-granted "
+                        "lane(s)", job_id, granted)
+            try:
+                http_json("POST", f"{self.ps_url}/start", task.to_dict(),
+                          trace_id=task.trace_id or None)
+            except KubeMLException as e:
+                if e.status_code == 503:
+                    # true pool exhaustion at the PS (e.g. partitions
+                    # narrower than the lane pool): give the lanes back
+                    # before the generic defer path parks the task
+                    self._apply_decisions(self.allocator.release(job_id))
+                raise
+            self._push_cluster_state()
+            return
+        parallelism, is_new = self.policy.calculate_parallelism(task)
+        if not is_new:
+            decisions = self.allocator.resize(job_id, parallelism)
+            lanes = next((d.lanes for d in decisions
+                          if d.action == "resize"), parallelism)
+            task.parallelism = lanes
+            if self.ps_url is not None:
+                logger.info("updating task %s to %d lane(s) (advisor "
+                            "asked %d)", job_id, lanes, parallelism)
+                http_json("POST", f"{self.ps_url}/update/{job_id}",
+                          {"parallelism": lanes},
+                          trace_id=task.trace_id or None)
+            self._apply_decisions(decisions)
+            self._push_cluster_state()
+            return
+        # fresh arrival: forget the advisor's priming — the granted
+        # dispatch above re-primes, so it still takes the /start path
+        self.policy.task_finished(job_id)
+        with self._cluster_lock:
+            self._parked[job_id] = task
+        ask = parallelism or task.parameters.options.default_parallelism
+        self._apply_decisions(self.allocator.submit(
+            job_id, tenant=task.tenant, priority=task.priority,
+            lanes=ask))
+        self._push_cluster_state()
+
+    def _apply_decisions(self, decisions: List[Decision]):
+        """Apply allocator decisions: 'place' re-pushes the parked task
+        through the queue with its granted lanes; 'preempt' asks the PS
+        to SIGTERM the victim (it drains, checkpoints, and requeues
+        through POST /requeue without consuming max_restarts); 'queue'
+        and 'resize' need no action here."""
+        for d in decisions:
+            if d.action == "place":
+                with self._cluster_lock:
+                    task = self._parked.pop(d.job_id, None)
+                    if task is not None:
+                        self._granted[d.job_id] = d.lanes
+                if task is None:
+                    # finished/aborted while parked: give the lanes
+                    # back, and apply any grants they unlock in turn
+                    self._apply_decisions(
+                        self.allocator.release(d.job_id))
+                    continue
+                logger.info("allocator placed %s: %d lane(s) [%s] %s",
+                            d.job_id, d.lanes, d.path, d.detail)
+                self.queue.push(task)
+            elif d.action == "preempt":
+                logger.warning("allocator preempting %s for %s [%s] %s",
+                               d.victim, d.job_id, d.path, d.detail)
+                if self.ps_url is None:
+                    continue
+                try:
+                    http_json("POST",
+                              f"{self.ps_url}/preempt/{d.victim}")
+                except KubeMLException as e:
+                    # victim already gone (finish raced the decision):
+                    # its release path frees the lanes either way
+                    logger.warning("preempt of %s failed: %s", d.victim,
+                                   e.message)
+
+    def _push_cluster_state(self):
+        """Feed the allocator snapshot to the PS: Prometheus gauges
+        (POST /cluster) + the health pipeline under the `cluster`
+        pseudo job id, which `kubeml top --id cluster` renders."""
+        if self.allocator is None or self.ps_url is None:
+            return
+        try:
+            http_json("POST", f"{self.ps_url}/cluster",
+                      self.allocator.snapshot())
+        except KubeMLException as e:
+            logger.warning("cluster state push failed: %s", e.message)

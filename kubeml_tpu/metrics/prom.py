@@ -512,6 +512,51 @@ class MetricsRegistry:
             "kubeml_infer_cache_misses_total",
             "Inference-cache lookups that deserialized a checkpoint",
             "cache")
+        # cluster allocator (control/cluster.py), fed by the scheduler's
+        # snapshot pushes (POST /cluster): pool occupancy, queue depth
+        # by priority, per-tenant lanes vs quota/weighted share, and
+        # the lifetime decision counters (placements/preemptions/aged
+        # grants/quota clamps — snapshots carry cumulative values, the
+        # counters advance by delta like jit_compiles_total)
+        self.cluster_pool_lanes = Gauge(
+            "kubeml_cluster_pool_lanes",
+            "Worker lanes in the shared cluster pool", "pool")
+        self.cluster_lanes_in_use = Gauge(
+            "kubeml_cluster_lanes_in_use",
+            "Worker lanes currently leased to placed jobs", "pool")
+        self.cluster_running_jobs = Gauge(
+            "kubeml_cluster_running_jobs",
+            "Jobs holding lanes in the shared pool", "pool")
+        self.cluster_queue_depth = Gauge(
+            "kubeml_cluster_queue_depth",
+            "Jobs parked by the cluster allocator, by priority",
+            "priority")
+        self.cluster_oldest_wait = Gauge(
+            "kubeml_cluster_oldest_wait_seconds",
+            "Queue wait of the longest-parked job", "pool")
+        self.cluster_tenant_lanes = Gauge(
+            "kubeml_cluster_tenant_lanes",
+            "Worker lanes leased to a tenant's running jobs", "tenant")
+        self.cluster_tenant_quota = Gauge(
+            "kubeml_cluster_tenant_quota_lanes",
+            "Lane quota of a tenant (hard cap)", "tenant")
+        self.cluster_tenant_share = Gauge(
+            "kubeml_cluster_tenant_share",
+            "Fraction of the pool a tenant's running jobs hold",
+            "tenant")
+        self.cluster_gang_placements_total = Counter(
+            "kubeml_cluster_gang_placements_total",
+            "Atomic gang placements by the cluster allocator", "pool")
+        self.cluster_preemptions_total = Counter(
+            "kubeml_cluster_preemptions_total",
+            "Victims displaced by higher-priority arrivals", "pool")
+        self.cluster_aged_grants_total = Counter(
+            "kubeml_cluster_aged_grants_total",
+            "Placements that needed aging to outrank newer arrivals",
+            "pool")
+        self.cluster_quota_clamps_total = Counter(
+            "kubeml_cluster_quota_clamps_total",
+            "Gang or resize asks clamped to a tenant quota", "pool")
         # MetricUpdate carries these as cumulative-over-the-job values;
         # the counters advance by delta so they stay monotone even when
         # an update is replayed after a job restart
@@ -547,6 +592,21 @@ class MetricsRegistry:
                                 self.serve_prefix_misses_total,
                                 self.infer_cache_hits_total,
                                 self.infer_cache_misses_total]
+        self._cluster_gauges = [self.cluster_pool_lanes,
+                                self.cluster_lanes_in_use,
+                                self.cluster_running_jobs,
+                                self.cluster_queue_depth,
+                                self.cluster_oldest_wait,
+                                self.cluster_tenant_lanes,
+                                self.cluster_tenant_quota,
+                                self.cluster_tenant_share]
+        self._cluster_counters = [self.cluster_gang_placements_total,
+                                  self.cluster_preemptions_total,
+                                  self.cluster_aged_grants_total,
+                                  self.cluster_quota_clamps_total]
+        # cumulative counter values seen per snapshot field, for the
+        # delta advance in update_cluster
+        self._cluster_seen: Dict[str, float] = {}
 
     def update_job(self, m) -> None:
         """Apply a MetricUpdate (ml/pkg/ps/metrics.go:90-99)."""
@@ -674,6 +734,54 @@ class MetricsRegistry:
                   self.serve_prefix_misses_total):
             c.clear_prefix(model)
 
+    # ---------------------------------------------------- cluster allocator
+
+    def update_cluster(self, snap: dict) -> None:
+        """Apply one allocator snapshot (control/cluster.py
+        ClusterAllocator.snapshot(), pushed by the scheduler). Gauges
+        mirror the snapshot; per-priority/per-tenant series absent from
+        it zero out (a drained priority level must not linger at its
+        last depth); lifetime counters advance by delta so replays
+        after a scheduler restart stay monotone."""
+        self.cluster_pool_lanes.set(
+            "shared", float(snap.get("cluster_pool_lanes", 0)))
+        self.cluster_lanes_in_use.set(
+            "shared", float(snap.get("cluster_lanes_in_use", 0)))
+        self.cluster_running_jobs.set(
+            "shared", float(snap.get("cluster_running_jobs", 0)))
+        self.cluster_oldest_wait.set(
+            "shared", float(snap.get("cluster_oldest_wait_s", 0.0)))
+        by_prio = snap.get("cluster_queue_by_priority") or {}
+        with self.cluster_queue_depth._lock:
+            stale = [k for k in self.cluster_queue_depth._values
+                     if k not in by_prio]
+        for k in stale:
+            self.cluster_queue_depth.set(k, 0.0)
+        for prio, depth in by_prio.items():
+            self.cluster_queue_depth.set(str(prio), float(depth))
+        pool = float(snap.get("cluster_pool_lanes", 0)) or 1.0
+        lanes = snap.get("cluster_tenant_lanes") or {}
+        quotas = snap.get("cluster_tenant_quota") or {}
+        for t, n in lanes.items():
+            self.cluster_tenant_lanes.set(t, float(n))
+            self.cluster_tenant_share.set(t, float(n) / pool)
+        for t, q in quotas.items():
+            self.cluster_tenant_quota.set(t, float(q))
+        for field, counter in (
+                ("cluster_gang_placements_total",
+                 self.cluster_gang_placements_total),
+                ("cluster_preemptions_total",
+                 self.cluster_preemptions_total),
+                ("cluster_aged_grants_total",
+                 self.cluster_aged_grants_total),
+                ("cluster_quota_clamps_total",
+                 self.cluster_quota_clamps_total)):
+            cum = float(snap.get(field, 0))
+            seen = self._cluster_seen.get(field, 0.0)
+            if cum > seen:
+                counter.inc("shared", cum - seen)
+                self._cluster_seen[field] = cum
+
     def note_infer_cache(self, hit: bool, cache: str = "checkpoints") -> None:
         (self.infer_cache_hits_total if hit
          else self.infer_cache_misses_total).inc(cache)
@@ -704,5 +812,6 @@ class MetricsRegistry:
                                         self.trace_dropped_total]
                     + self._job_multi + self._job_hists
                     + self._serve_gauges + self._serve_counters
-                    + self._serve_hists)
+                    + self._serve_hists
+                    + self._cluster_gauges + self._cluster_counters)
         return "\n".join(f.collect() for f in families) + "\n"

@@ -147,6 +147,106 @@ def test_epoch_boundary_preempt_checkpoints_next_epoch(jobenv):
     assert all(np.isfinite(record.data.train_loss))
 
 
+def test_allocator_preempt_decision_resumes_bit_identical(jobenv):
+    """End-to-end cluster preemption: the real ClusterAllocator picks
+    the victim (preempt-cheapest path), the victim drains through the
+    PR-4 grace (the FaultPlan `preempt` stands in for the SIGTERM the
+    scheduler sends), its lanes seat the high-priority arrival on
+    release, and the budget-free requeue re-places and finishes the
+    victim with weights bit-identical to an uninterrupted run."""
+    from kubeml_tpu.control.cluster import ClusterAllocator
+
+    clean = _make_job(jobenv, "elgclean")
+    clean.train()
+
+    t = [0.0]
+    alloc = ClusterAllocator(2, clock=lambda: t[0], aging_s=0.0)
+    (d,) = [d for d in alloc.submit("elgvic", lanes=2) if d.action == "place"]
+    assert d.lanes == 2
+    t[0] = 1.0
+    ds = alloc.submit("elghi", priority=2, lanes=2)
+    (p,) = [d for d in ds if d.action == "preempt"]
+    assert p.victim == "elgvic"
+    assert p.path == "preempt-cheapest"
+    assert alloc.preemptions == 1
+
+    # the scheduler SIGTERMs the victim; in-process that is the
+    # `preempt` fault -> drain the in-flight round, checkpoint, raise
+    plan = json.dumps([{"kind": "preempt", "epoch": 0, "round": 3}])
+    victim = _make_job(jobenv, "elgvic", fault_plan=plan,
+                       checkpoint_every_rounds=2)
+    with pytest.raises(JobPreemptedError):
+        victim.train()
+    assert victim.task.state == "preempted"
+    _, manifest = _weights("elgvic")
+    assert (manifest["train_state"]["epoch"],
+            manifest["train_state"]["round"]) == (0, 4)
+
+    # the drained victim exits -> its lanes seat the arrival whole
+    t[0] = 2.0
+    (d,) = [d for d in alloc.release("elgvic") if d.action == "place"]
+    assert (d.job_id, d.lanes) == ("elghi", 2)
+    hi = _make_job(jobenv, "elghi")
+    hi.train()
+    assert hi.task.state == "finished"
+
+    # requeue (resume_from = own id): re-admitted, re-placed, and the
+    # restart budget is untouched — preemption is not a crash
+    t[0] = 3.0
+    alloc.release("elghi")
+    (d,) = [d for d in alloc.submit("elgvic", lanes=2)
+            if d.action == "place"]
+    assert d.path == "gang-atomicity"
+    resumed = _make_job(jobenv, "elgvic", resume=True, fault_plan=plan,
+                        checkpoint_every_rounds=2)
+    record = resumed.train()
+    assert resumed.task.state == "finished"
+    assert resumed.task.restarts == 0
+    assert len(record.data.train_loss) == 2
+    _assert_same_weights("elgvic", "elgclean")
+    snap = alloc.snapshot()
+    assert snap["cluster_preemptions_total"] == 1
+    assert snap["cluster_gang_placements_total"] == 3
+
+
+def test_crash_during_preemption_drain_restarts_cleanly(jobenv):
+    """A process death in the middle of the preemption drain (preempt
+    event set, drain checkpoint never written) must leave a plain
+    'failed' job that restarts from the last cadence checkpoint and
+    finishes bit-identical — the grace path degrades to the ordinary
+    crash path, never a wedged 'preempted' state with a stale cursor."""
+    clean = _make_job(jobenv, "eldclean", checkpoint_every_rounds=1)
+    clean.train()
+
+    plan = json.dumps([{"kind": "preempt", "epoch": 0, "round": 3}])
+
+    def crash_hook(rb):
+        # the plan has already fired for this round (plan runs first),
+        # so the preempt event is set; dying here models SIGKILL
+        # mid-drain, before the cursor checkpoint lands
+        if rb.round_index == 3:
+            raise EmulatedCrash("died mid-drain")
+        return rb
+
+    job = _make_job(jobenv, "eldrain", fault_plan=plan,
+                    checkpoint_every_rounds=1, round_hook=crash_hook)
+    with pytest.raises(EmulatedCrash):
+        job.train()
+    assert job.task.state == "failed"
+    _, manifest = _weights("eldrain")
+    ts = manifest["train_state"]
+    # rounds 0..2 saved by the cadence; the drain's round-4 cursor
+    # must NOT exist — the crash beat it
+    assert (ts["epoch"], ts["round"]) == (0, 3)
+
+    resumed = _make_job(jobenv, "eldrain", resume=True,
+                        checkpoint_every_rounds=1)
+    record = resumed.train()
+    assert resumed.task.state == "finished"
+    assert len(record.data.train_loss) == 2
+    _assert_same_weights("eldrain", "eldclean")
+
+
 # -------------------------------------------- round-granular resume
 
 

@@ -471,6 +471,19 @@ def _live_exposition() -> str:
     reg.note_infer_cache(True)
     reg.note_infer_cache(False)
     reg.set_infer_cache_entries(2)
+    # cluster-allocator families (scheduler POST /cluster feeds these)
+    reg.update_cluster({
+        "job_id": "cluster", "cluster_pool_lanes": 8,
+        "cluster_lanes_in_use": 6, "cluster_running_jobs": 2,
+        "cluster_queue_depth": 1, "cluster_queue_by_priority": {"1": 1},
+        "cluster_oldest_wait_s": 0.5,
+        "cluster_tenant_lanes": {"lint-tenant": 6},
+        "cluster_tenant_quota": {"lint-tenant": 6},
+        "cluster_tenant_weight": {"lint-tenant": 2.0},
+        "cluster_gang_placements_total": 3,
+        "cluster_preemptions_total": 1,
+        "cluster_aged_grants_total": 1,
+        "cluster_quota_clamps_total": 1})
     http = HttpMetrics("lint")
     http.observe("GET", "/metrics", 200, 0.002)
     http.observe("POST", "/update/{jobId}", 404, 0.1)

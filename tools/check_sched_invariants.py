@@ -1,0 +1,110 @@
+#!/usr/bin/env python3
+"""Lint: every cluster-allocator decision path must have a named test.
+
+The ClusterAllocator (kubeml_tpu/control/cluster.py) tags each Decision
+with a `path` naming the invariant that drove it — the DECISION_PATHS
+literal: gang-atomicity, no-starvation, quota-clamp, preempt-cheapest.
+A path nobody asserts on is an unverified scheduling invariant — so
+this lint walks the DECISION_PATHS keys and fails unless each name
+appears QUOTED on an assertion line (a non-comment code line that also
+carries an `assert` token) in some tests/ file; tests naturally write
+
+    assert d.path == "gang-atomicity"
+
+Run directly (exit 1 on violation) or via tests/test_cluster.py, which
+keeps the lint itself in the tier-1 suite:
+
+    python tools/check_sched_invariants.py [repo_root]
+"""
+
+from __future__ import annotations
+
+import ast
+import io
+import os
+import sys
+import tokenize
+
+
+def decision_paths(cluster_path: str) -> list:
+    """Path names declared in the DECISION_PATHS dict literal."""
+    with open(cluster_path, encoding="utf-8") as f:
+        tree = ast.parse(f.read(), filename=cluster_path)
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign) and any(
+                isinstance(t, ast.Name) and t.id == "DECISION_PATHS"
+                for t in node.targets):
+            if isinstance(node.value, ast.Dict):
+                return [k.value for k in node.value.keys
+                        if isinstance(k, ast.Constant)
+                        and isinstance(k.value, str)]
+    return []
+
+
+def _code_lines(path: str):
+    """Yield (lineno, source) for non-comment code lines. STRING tokens
+    are KEPT (path names appear as string literals in assertions);
+    comments are dropped so a mention in prose doesn't count."""
+    with open(path, "rb") as f:
+        src = f.read()
+    lines = {}
+    try:
+        for tok in tokenize.tokenize(io.BytesIO(src).readline):
+            if tok.type in (tokenize.COMMENT, tokenize.ENCODING):
+                continue
+            lines.setdefault(tok.start[0], []).append(tok.string)
+    except tokenize.TokenError:
+        # fall back to raw lines; better a false positive than a skip
+        for i, line in enumerate(src.decode("utf-8", "replace").split("\n")):
+            lines.setdefault(i + 1, []).append(line)
+    for no in sorted(lines):
+        yield no, " ".join(lines[no])
+
+
+def file_covers(path: str, name: str) -> bool:
+    """True when some code line in `path` both quotes the decision path
+    AND asserts on it (the name on a non-assert line — e.g. an input
+    table — does not count)."""
+    quoted = (f'"{name}"', f"'{name}'")
+    for _no, code in _code_lines(path):
+        if "assert" in code and any(q in code for q in quoted):
+            return True
+    return False
+
+
+def uncovered_paths(cluster_path: str, tests_dir: str) -> list:
+    names = decision_paths(cluster_path)
+    test_files = []
+    for dirpath, _dirs, files in os.walk(tests_dir):
+        for fname in sorted(files):
+            if fname.startswith("test_") and fname.endswith(".py"):
+                test_files.append(os.path.join(dirpath, fname))
+    return [n for n in names
+            if not any(file_covers(p, n) for p in test_files)]
+
+
+def main(argv) -> int:
+    root = argv[1] if len(argv) > 1 else os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__)))
+    cluster_path = os.path.join(root, "kubeml_tpu", "control", "cluster.py")
+    tests_dir = os.path.join(root, "tests")
+    names = decision_paths(cluster_path)
+    if not names:
+        print(f"{cluster_path}: no DECISION_PATHS entries found — "
+              "lint is miswired", file=sys.stderr)
+        return 1
+    missing = uncovered_paths(cluster_path, tests_dir)
+    for n in missing:
+        print(f"decision path {n!r} has no named test: no tests/ file "
+              f"asserts on the quoted name", file=sys.stderr)
+    if missing:
+        print(f"\n{len(missing)} unverified decision path"
+              f"{'' if len(missing) == 1 else 's'}: every invariant in "
+              "kubeml_tpu/control/cluster.py DECISION_PATHS needs a "
+              "test asserting its quoted name", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv))
