@@ -173,6 +173,18 @@ class TrainOptions:
     # round's compute; 0 keeps the monolithic per-leaf merge. Bucketing
     # is bit-identical to the monolithic merge (tests/test_merge.py).
     merge_bucket_mb: float = 0.0
+    # net-new continual-training plane: continual=True makes the job
+    # sliding-window — `epochs` becomes a per-pass cap and the job loops
+    # passes forever (until stopped/preempted), re-polling the dataset
+    # registry for new generations between passes. window_generations
+    # caps how many newest generations the pass trains over (0 = all
+    # retained). publish_every_rounds > 0 publishes a stamped checkpoint
+    # every N sync rounds so the serving plane can hot-swap mid-stream
+    # (kavg only, forces rounds_per_dispatch=1 like
+    # checkpoint_every_rounds).
+    continual: bool = False
+    window_generations: int = 0
+    publish_every_rounds: int = 0
 
     def to_dict(self) -> dict:
         return {
@@ -206,6 +218,9 @@ class TrainOptions:
             "merge_dtype": self.merge_dtype,
             "merge_compress": self.merge_compress,
             "merge_bucket_mb": self.merge_bucket_mb,
+            "continual": self.continual,
+            "window_generations": self.window_generations,
+            "publish_every_rounds": self.publish_every_rounds,
         }
 
     @classmethod
@@ -242,6 +257,9 @@ class TrainOptions:
             merge_dtype=d.get("merge_dtype", ""),
             merge_compress=d.get("merge_compress", "none"),
             merge_bucket_mb=float(d.get("merge_bucket_mb", 0.0)),
+            continual=bool(d.get("continual", False)),
+            window_generations=int(d.get("window_generations", 0)),
+            publish_every_rounds=int(d.get("publish_every_rounds", 0)),
         )
 
 
@@ -467,6 +485,11 @@ class MetricUpdate:
     hbm_in_use_bytes: int = 0
     # tracer events dropped at the ring cap so far (utils/trace.py)
     trace_events_dropped: int = 0
+    # continual-plane freshness (optional on the wire; only continual
+    # jobs publish them): the dataset generation this pass trained over,
+    # and how many generations the registry is ahead of it
+    dataset_generation: int = 0
+    data_lag_generations: int = -1
 
     def to_dict(self) -> dict:
         return _asdict(self)
@@ -493,7 +516,10 @@ class MetricUpdate:
                    hbm_peak_bytes=int(d.get("hbm_peak_bytes", 0)),
                    hbm_in_use_bytes=int(d.get("hbm_in_use_bytes", 0)),
                    trace_events_dropped=int(d.get("trace_events_dropped",
-                                                  0)))
+                                                  0)),
+                   dataset_generation=int(d.get("dataset_generation", 0)),
+                   data_lag_generations=int(d.get("data_lag_generations",
+                                                  -1)))
 
 
 @dataclass

@@ -48,8 +48,20 @@ def _fail(msg: str, code: int = 1):
 def cmd_train(args):
     if args.batch <= 0 or args.batch > MAX_BATCH_SIZE:
         _fail(f"batch size must be in (0, {MAX_BATCH_SIZE}]")
-    if args.epochs <= 0:
-        _fail("epochs must be positive")
+    if args.epochs <= 0 and not args.continual:
+        _fail("epochs must be positive (continual jobs may pass "
+              "--epochs 0 for an unbounded sliding-window loop)")
+    if args.window_generations < 0:
+        _fail("--window-generations must be >= 0")
+    if args.publish_every_rounds < 0:
+        _fail("--publish-every-rounds must be >= 0")
+    if (args.window_generations or args.publish_every_rounds) \
+            and not args.continual:
+        _fail("--window-generations/--publish-every-rounds require "
+              "--continual")
+    if args.publish_every_rounds and args.engine != "kavg":
+        _fail("--publish-every-rounds requires --engine kavg (the "
+              "publish save reuses the round-granular checkpoint path)")
     if args.tensor_parallel < 1 or args.seq_parallel < 1 \
             or args.expert_parallel < 1 or args.pipeline_parallel < 1:
         _fail("--tensor-parallel/--seq-parallel/--expert-parallel/"
@@ -122,7 +134,10 @@ def cmd_train(args):
             max_restarts=args.max_restarts,
             checkpoint_every_rounds=args.checkpoint_every_rounds,
             quarantine_after=args.quarantine_after,
-            reassign_on_quarantine=args.reassign_on_quarantine))
+            reassign_on_quarantine=args.reassign_on_quarantine,
+            continual=args.continual,
+            window_generations=args.window_generations,
+            publish_every_rounds=args.publish_every_rounds))
     job_id = client.v1().networks().train(req)
     print(job_id)
 
@@ -146,6 +161,15 @@ def cmd_dataset_create(args):
         args.testlabels)
     print(f"created dataset {s.name} "
           f"(train={s.train_set_size}, test={s.test_set_size})")
+
+
+def cmd_dataset_append(args):
+    out = _client(args).v1().datasets().append(
+        args.name, args.traindata, args.trainlabels,
+        generation=args.generation, retention=args.retention)
+    print(f"appended to dataset {args.name} "
+          f"(generation={out.get('generation')}, "
+          f"train={out.get('train_set_size')})")
 
 
 def cmd_dataset_delete(args):
@@ -353,6 +377,19 @@ def _render_top(doc: dict) -> str:
             f"prefill backlog "
             f"{latest.get('serve_prefill_backlog_tokens', 0):g}  "
             f"prefix hit {latest.get('serve_prefix_hit_pct', 0):g}%")
+    if latest.get("data_lag_generations") is not None \
+            and float(latest.get("data_lag_generations", -1)) >= 0:
+        # continual pane: dataset freshness — the generation the job last
+        # trained vs how far the registry has moved past it; the serve
+        # plane's live weight generation rides along when published
+        lag = float(latest.get("data_lag_generations", 0))
+        line = (f"continual: trained gen "
+                f"{latest.get('dataset_generation', 0):g}  "
+                f"registry lag {lag:g} gen{'s' if lag != 1 else ''}")
+        if latest.get("serve_weight_generation") is not None:
+            line += (f"  served gen "
+                     f"{latest.get('serve_weight_generation', 0):g}")
+        lines.append(line)
     if latest.get("cluster_pool_lanes") is not None:
         # cluster pane: the `cluster` pseudo job publishes the allocator
         # snapshot — pool utilization, per-tenant share vs quota, queue
@@ -655,6 +692,25 @@ def build_parser() -> argparse.ArgumentParser:
                    help="cluster-allocator tenant for quota and "
                         "weighted-fair-share accounting (default: the "
                         "shared 'default' tenant)")
+    t.add_argument("--continual", action="store_true",
+                   help="continual training: poll the dataset registry "
+                        "at every epoch boundary and slide onto freshly "
+                        "appended generations without restarting "
+                        "(--epochs 0 = unbounded loop, stop via "
+                        "`kubeml task stop`; --epochs N still caps the "
+                        "total)")
+    t.add_argument("--window-generations", type=int, default=0,
+                   metavar="W",
+                   help="train only the newest W append generations "
+                        "(sliding window; 0 = the whole retained "
+                        "dataset); requires --continual")
+    t.add_argument("--publish-every-rounds", type=int, default=0,
+                   metavar="P",
+                   help="publish serving weights every P sync rounds "
+                        "via the round-granular checkpoint path, so a "
+                        "co-deployed serve plane hot-swaps mid-epoch "
+                        "(kavg engine; requires --continual; 0 = "
+                        "publish at checkpoint cadence only)")
     t.add_argument("--reassign-on-quarantine", action="store_true",
                    help="elastic degraded mode: when a worker is "
                         "quarantined mid-epoch, re-deal its unconsumed "
@@ -676,6 +732,21 @@ def build_parser() -> argparse.ArgumentParser:
     dc.add_argument("--testdata", required=True)
     dc.add_argument("--testlabels", required=True)
     dc.set_defaults(fn=cmd_dataset_create)
+    da = d.add_parser("append",
+                      help="append a generation-tagged train chunk "
+                           "(streaming ingest; continual jobs pick the "
+                           "new window up at their next epoch boundary)")
+    da.add_argument("-n", "--name", required=True)
+    da.add_argument("--traindata", required=True)
+    da.add_argument("--trainlabels", required=True)
+    da.add_argument("--generation", type=int, default=None, metavar="G",
+                    help="expected next generation (optimistic "
+                         "concurrency: a stale/duplicate producer tag "
+                         "is a 400; default = whatever is next)")
+    da.add_argument("--retention", type=int, default=0, metavar="W",
+                    help="drop whole append windows beyond the newest W "
+                         "(0 = keep everything)")
+    da.set_defaults(fn=cmd_dataset_append)
     dd = d.add_parser("delete")
     dd.add_argument("-n", "--name", required=True)
     dd.set_defaults(fn=cmd_dataset_delete)

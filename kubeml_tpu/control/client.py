@@ -120,6 +120,28 @@ class DatasetsClient:
                         content_type=ctype, timeout=600)
         return DatasetSummary.from_dict(out)
 
+    def append(self, name: str, train_data: str, train_labels: str,
+               generation: Optional[int] = None,
+               retention: int = 0) -> dict:
+        """Generation-tagged train append (two files). Returns the
+        post-commit summary dict including the new `generation`."""
+        files = {}
+        for field, path in (("x-train", train_data),
+                            ("y-train", train_labels)):
+            with open(path, "rb") as f:
+                files[field] = (os.path.basename(path), f.read())
+        body, ctype = _multipart_body(files)
+        qs = []
+        if generation is not None:
+            qs.append(f"generation={int(generation)}")
+        if retention:
+            qs.append(f"retention={int(retention)}")
+        url = f"{self.base}/dataset/{name}/append"
+        if qs:
+            url += "?" + "&".join(qs)
+        return _request("POST", url, raw_body=body,
+                        content_type=ctype, timeout=600)
+
     def delete(self, name: str) -> None:
         _request("DELETE", f"{self.base}/dataset/{name}")
 

@@ -51,6 +51,7 @@ class Controller(JsonService):
         self.route("GET", "/dataset", self._h_dataset_list)
         self.route("GET", "/dataset/{name}", self._h_dataset_get)
         self.route("POST", "/dataset/{name}", self._h_dataset_create)
+        self.route("POST", "/dataset/{name}/append", self._h_dataset_append)
         self.route("DELETE", "/dataset/{name}", self._h_dataset_delete)
         self.route("GET", "/tasks", self._h_tasks)
         self.route("DELETE", "/tasks/{jobId}", self._h_task_stop)
@@ -101,6 +102,18 @@ class Controller(JsonService):
         (storageApi.go:35-67)."""
         url = f"{self._need(self.storage_url, 'storage service')}" \
               f"/dataset/{req.params['name']}"
+        return http_json("POST", url, raw_body=req.raw,
+                         content_type=req.headers.get("Content-Type", ""),
+                         timeout=600)
+
+    def _h_dataset_append(self, req: Request):
+        """Reverse-proxy a generation-tagged append, preserving the
+        ?generation=/?retention= query the storage service validates."""
+        from urllib.parse import urlencode
+        url = f"{self._need(self.storage_url, 'storage service')}" \
+              f"/dataset/{req.params['name']}/append"
+        if req.query:
+            url += "?" + urlencode(req.query)
         return http_json("POST", url, raw_body=req.raw,
                          content_type=req.headers.get("Content-Type", ""),
                          timeout=600)

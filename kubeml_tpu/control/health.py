@@ -188,6 +188,28 @@ def _make_queue_starvation(wait_limit_s: float):
     return check
 
 
+def _make_data_staleness(lag_limit: int):
+    """Continual plane (train/job.py sliding-window passes): warn when
+    the dataset registry is more than `lag_limit` generations ahead of
+    what the job has trained — appends are outrunning training, so the
+    served model is drifting stale. Non-continual jobs publish
+    data_lag_generations = -1 (the wire default) and older samples omit
+    the field entirely, so this never fires for them."""
+    def check(window: List[dict]) -> Optional[str]:
+        m = _latest(window)
+        lag = m.get("data_lag_generations")
+        if lag is None or int(lag) < 0:
+            return None
+        if int(lag) > lag_limit:
+            return (f"dataset registry is {int(lag)} generation(s) ahead "
+                    f"of the last trained generation "
+                    f"{int(m.get('dataset_generation', 0))} "
+                    f"(limit {lag_limit}) — training is falling behind "
+                    f"appends")
+        return None
+    return check
+
+
 def _make_serve_ttft_slo(slo_s: float):
     def check(window: List[dict]) -> Optional[str]:
         m = _latest(window)
@@ -205,7 +227,8 @@ def default_rules(grad_abs: float = 1e4, grad_rel: float = 50.0,
                   stall_epochs: int = 3, straggler_rel: float = 5.0,
                   straggler_min_rounds: int = 4,
                   serve_ttft_slo_s: float = 2.0,
-                  queue_starvation_s: float = 120.0) -> List[HealthRule]:
+                  queue_starvation_s: float = 120.0,
+                  data_lag_limit: int = 2) -> List[HealthRule]:
     return [
         HealthRule("worker_divergence", "critical",
                    "non-finite guard dropped or quarantined workers",
@@ -231,6 +254,9 @@ def default_rules(grad_abs: float = 1e4, grad_rel: float = 50.0,
         HealthRule("queue_starvation", "warning",
                    "a cluster-parked job has waited past the limit",
                    _make_queue_starvation(queue_starvation_s)),
+        HealthRule("data_staleness", "warning",
+                   "continual job's trained generation lags the registry",
+                   _make_data_staleness(data_lag_limit)),
     ]
 
 
@@ -248,6 +274,10 @@ _SAMPLE_FIELDS = ("train_loss", "validation_loss", "accuracy",
                   "serve_kv_page_utilization", "serve_rejected_total",
                   "serve_ttft_p50", "serve_ttft_p99",
                   "serve_prefill_backlog_tokens", "serve_prefix_hit_pct",
+                  "serve_weight_generation", "serve_active_generations",
+                  # continual-plane freshness (train/job.py sliding
+                  # window); lag -1 = not a continual job
+                  "dataset_generation", "data_lag_generations",
                   # cluster-allocator snapshots (control/cluster.py)
                   # ride the same pipeline under the `cluster` pseudo
                   # job id; `kubeml top --id cluster` renders them

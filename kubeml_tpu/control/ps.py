@@ -721,10 +721,15 @@ class ParameterServer(JsonService):
     # -------------------------------------------------------- serving plane
 
     def _serve_service(self, model_id: str):
-        """The model's continuous-batching decode service, (re)built
-        when its checkpoint stamp changes — a newly published checkpoint
-        hot-swaps the serving weights; streams on the old service finish
-        against the weights they started with."""
+        """The model's continuous-batching decode service. The FIRST
+        request builds it; when the checkpoint stamp later changes (a
+        continual job published on its --publish-every-rounds cadence,
+        or a retrain finished), the new weights are INSTALLED into the
+        live service as a new generation — in-flight streams finish on
+        the weights they attached under, new admissions decode the new
+        generation, and nothing is stopped or shed (the zero-downtime
+        hot-swap; the old build-new-service-and-stop path failed every
+        in-flight stream with 'serving loop stopped')."""
         from kubeml_tpu.serve.engine import DecodeEngine
         from kubeml_tpu.serve.pager import PageGeometry
         from kubeml_tpu.serve.service import ServeService
@@ -732,7 +737,13 @@ class ParameterServer(JsonService):
         stamp = checkpoint_saved_at(model_id)
         with self._serve_lock:
             cur = self._serve.get(model_id)
-            if cur is not None and cur[0] == stamp:
+            if cur is not None:
+                if cur[0] != stamp:
+                    # zero-downtime swap: queue the install for the
+                    # serving-loop thread; requests admitted from here
+                    # on attach to the new generation once it applies
+                    cur[1].install_weights(variables, stamp)
+                    self._serve[model_id] = (stamp, cur[1])
                 return cur[1]
         module = getattr(model, "module", None)
         try:
@@ -756,10 +767,9 @@ class ParameterServer(JsonService):
         old = None
         with self._serve_lock:
             cur = self._serve.get(model_id)
-            if cur is not None and cur[0] == stamp:  # lost the race
+            if cur is not None:  # lost the build race; ours is unused
                 old, svc = svc, cur[1]
             else:
-                old = cur[1] if cur is not None else None
                 self._serve[model_id] = (stamp, svc)
         if old is not None:
             old.stop()

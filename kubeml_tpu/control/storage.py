@@ -18,12 +18,13 @@ from typing import Dict, Optional
 
 from kubeml_tpu.api.errors import InvalidFormatError
 from kubeml_tpu.control.httpd import JsonService, Request
-from kubeml_tpu.data.ingest import ingest_files
+from kubeml_tpu.data.ingest import append_files, ingest_files
 from kubeml_tpu.data.registry import DatasetRegistry
 
 logger = logging.getLogger("kubeml_tpu.storage")
 
 FIELDS = ("x-train", "y-train", "x-test", "y-test")
+APPEND_FIELDS = ("x-train", "y-train")
 
 
 def parse_multipart(content_type: str, raw: bytes) -> Dict[str, tuple]:
@@ -48,6 +49,7 @@ class StorageService(JsonService):
                  registry: Optional[DatasetRegistry] = None):
         super().__init__(port=port)
         self.registry = registry or DatasetRegistry()
+        self.route("POST", "/dataset/{name}/append", self._h_append)
         self.route("POST", "/dataset/{name}", self._h_create)
         self.route("DELETE", "/dataset/{name}", self._h_delete)
         self.route("GET", "/dataset", self._h_list)
@@ -73,6 +75,43 @@ class StorageService(JsonService):
         logger.info("ingested dataset %s (%d train / %d test)", name,
                     handle.train_samples, handle.test_samples)
         return handle.summary().to_dict()
+
+    def _h_append(self, req: Request):
+        """Generation-tagged train append: x-train / y-train multipart
+        files plus optional ?generation= (monotone producer tag) and
+        ?retention= (window size in generations). Validation failures —
+        shape/dtype drift, non-monotonic generation — are 400s raised
+        before anything is committed."""
+        name = req.params["name"]
+        parts = parse_multipart(req.headers.get("Content-Type", ""), req.raw)
+        missing = [f for f in APPEND_FIELDS if f not in parts]
+        if missing:
+            raise InvalidFormatError(f"missing form files: {missing}")
+        try:
+            generation = (int(req.query["generation"])
+                          if "generation" in req.query else None)
+            retention = int(req.query.get("retention", 0))
+        except ValueError:
+            raise InvalidFormatError(
+                "generation/retention must be integers") from None
+        with tempfile.TemporaryDirectory() as tmp:
+            paths = {}
+            for field in APPEND_FIELDS:
+                filename, payload = parts[field]
+                ext = os.path.splitext(filename)[1] or ".npy"
+                p = os.path.join(tmp, field + ext)
+                with open(p, "wb") as f:
+                    f.write(payload)
+                paths[field] = p
+            handle = append_files(name, paths["x-train"], paths["y-train"],
+                                  generation=generation,
+                                  retention_generations=retention,
+                                  registry=self.registry)
+        logger.info("appended to dataset %s -> generation %d (%d train)",
+                    name, handle.generation, handle.train_samples)
+        doc = handle.summary().to_dict()
+        doc["generation"] = handle.generation
+        return doc
 
     def _h_delete(self, req: Request):
         self.registry.delete(req.params["name"])

@@ -70,7 +70,8 @@ class DeviceDatasetCache:
 
     def __init__(self, handle: Optional[DatasetHandle], mesh,
                  layout: str = "sharded",
-                 device_transform: Optional[Callable] = None):
+                 device_transform: Optional[Callable] = None,
+                 incremental: bool = False, grow_quantum: int = 0):
         if layout not in ("sharded", "replicated"):
             raise ValueError(
                 f"layout must be 'sharded' or 'replicated', got {layout!r}")
@@ -89,6 +90,20 @@ class DeviceDatasetCache:
         #: bytes resident per chip after the last upload
         self.device_bytes = 0
         self._plan_key = None
+        #: continual-mode incremental refresh: retain the host slabs of
+        #: the last upload (costs ~one dataset copy of host RAM) so a
+        #: re-layout after a dataset append mmap-reads only the lanes
+        #: whose ABSOLUTE sample range actually moved
+        self.incremental = bool(incremental)
+        #: round each lane's slab width up to this many samples so
+        #: window growth within the quantum keeps the compiled round
+        #: program's shapes (engines key on `signature`) — 0 = exact
+        self.grow_quantum = int(grow_quantum)
+        self._host_slabs: Optional[Dict[str, np.ndarray]] = None
+        self._lane_abs: List[Tuple[int, int]] = []
+        #: cumulative refresh accounting (continual freshness telemetry)
+        self.stats: Dict[str, int] = {
+            "uploads": 0, "lanes_reused": 0, "lanes_refreshed": 0}
 
     # ------------------------------------------------------------- estimates
 
@@ -198,8 +213,14 @@ class DeviceDatasetCache:
         from jax.sharding import NamedSharding, PartitionSpec as P
         from kubeml_tpu.parallel.mesh import DATA_AXIS
         x_mm, y_mm = self.handle.train_arrays()
+        base = int(getattr(self.handle, "train_base", 0))
         if self.layout == "replicated":
-            if self.arrays is not None:
+            # key on the handle's absolute window, not mere existence:
+            # a continual refresh that grew or slid the window must
+            # re-upload (the original upload-once guard silently froze
+            # a continual job on its first generation)
+            key = ("rep", base, int(len(x_mm)))
+            if self.arrays is not None and key == self._plan_key:
                 return False
             rep = NamedSharding(self.mesh, P())
             self.arrays = {
@@ -207,30 +228,86 @@ class DeviceDatasetCache:
                 "y": jax.device_put(np.ascontiguousarray(y_mm), rep),
             }
             self.device_bytes = int(x_mm.nbytes) + int(y_mm.nbytes)
+            self._plan_key = key
+            self.stats["uploads"] += 1
             return True
         if plan is None or W <= 0:
             raise ValueError("sharded layout needs (plan, W) to lay out "
                              "the lane slabs")
         lane_lo, lane_hi = self._lane_ranges(plan, W)
-        key = (tuple(lane_lo), tuple(lane_hi))
+        key = (tuple(lane_lo), tuple(lane_hi), base,
+               int(self.handle.train_samples))
         if key == self._plan_key:
             return False
         L = max(1, max(h - l for l, h in zip(lane_lo, lane_hi)))
+        if self.grow_quantum > 1:
+            L = -(-L // self.grow_quantum) * self.grow_quantum
+        # incremental reuse works on ABSOLUTE sample ranges: appends
+        # never rewrite a retained sample, so the overlap of lane d's
+        # new absolute range with its previous one is bit-identical
+        # host content — copy it from the retained slab and mmap-read
+        # only the samples the lane did not hold before (a grown lane
+        # reads just its tail; an unchanged lane reads nothing; a
+        # slid-window lane reads what slid in)
+        abs_ranges = [(base + lo, base + hi)
+                      for lo, hi in zip(lane_lo, lane_hi)]
+        prev_abs = self._lane_abs if self._host_slabs is not None else []
 
-        def slab(src: np.ndarray) -> np.ndarray:
+        def slab(src: np.ndarray,
+                 prev: Optional[np.ndarray]) -> np.ndarray:
             out = np.zeros((self.n_lanes, L) + src.shape[1:], src.dtype)
             for d, (lo, hi) in enumerate(zip(lane_lo, lane_hi)):
-                out[d, : hi - lo] = src[lo:hi]
+                alo, ahi = abs_ranges[d]
+                olo = ohi = alo  # same-lane overlap with the old slab
+                if prev is not None and d < len(prev_abs):
+                    plo, phi = prev_abs[d]
+                    olo, ohi = max(alo, plo), min(ahi, phi)
+                if olo < ohi:
+                    out[d, olo - alo: ohi - alo] = \
+                        prev[d, olo - plo: ohi - plo]
+                    if alo < olo:
+                        out[d, : olo - alo] = src[lo: lo + (olo - alo)]
+                    if ohi < ahi:
+                        out[d, ohi - alo: hi - lo] = \
+                            src[lo + (ohi - alo): hi]
+                else:
+                    out[d, : hi - lo] = src[lo:hi]
             return out
 
+        prev_slabs = self._host_slabs or {}
+        host = {"x": slab(x_mm, prev_slabs.get("x")),
+                "y": slab(y_mm, prev_slabs.get("y"))}
         sh = NamedSharding(self.mesh, P(DATA_AXIS))
-        self.arrays = {"x": jax.device_put(slab(x_mm), sh),
-                       "y": jax.device_put(slab(y_mm), sh)}
+        self.arrays = {k: jax.device_put(v, sh) for k, v in host.items()}
         self.lane_starts = np.asarray(lane_lo, np.int64)
         self.device_bytes = sum(
             int(a.nbytes) for a in self.arrays.values()) // self.n_lanes
         self._plan_key = key
+        # lane accounting (freshness telemetry): a live lane counts as
+        # reused when its whole range came from the retained slab
+        live = [d for d in range(self.n_lanes)
+                if lane_hi[d] > lane_lo[d]]
+        reused = 0
+        for d in live:
+            alo, ahi = abs_ranges[d]
+            if d < len(prev_abs) and prev_abs[d][0] <= alo \
+                    and prev_abs[d][1] >= ahi:
+                reused += 1
+        self._lane_abs = abs_ranges
+        if self.incremental:
+            self._host_slabs = host
+        self.stats["uploads"] += 1
+        self.stats["lanes_reused"] += reused
+        self.stats["lanes_refreshed"] += len(live) - reused
         return True
+
+    def refresh(self, handle: DatasetHandle) -> None:
+        """Point the cache at a fresh registry handle (continual
+        between-pass refresh). Invalidation is lazy: the next `ensure`
+        compares the new handle's absolute window against `_plan_key`
+        and re-lays-out only what moved (per-lane for sharded slabs,
+        whole-array for replicated)."""
+        self.handle = handle
 
     # ------------------------------------------------------------------ keys
 

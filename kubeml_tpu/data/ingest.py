@@ -47,5 +47,38 @@ def ingest_files(name: str, x_train: str, y_train: str,
         if not os.path.isfile(path):
             raise InvalidFormatError(f"{key} file not found: {path}")
         arrays[key] = load_array_file(path)
+    # length / shape drift is the uploader's fault, not storage's — report
+    # it as a 400 here instead of letting the registry 500 on it
+    if len(arrays["x_train"]) != len(arrays["y_train"]):
+        raise InvalidFormatError(
+            f"train data/labels length mismatch: "
+            f"{len(arrays['x_train'])} vs {len(arrays['y_train'])}")
+    if len(arrays["x_test"]) != len(arrays["y_test"]):
+        raise InvalidFormatError(
+            f"test data/labels length mismatch: "
+            f"{len(arrays['x_test'])} vs {len(arrays['y_test'])}")
+    if arrays["x_train"].shape[1:] != arrays["x_test"].shape[1:]:
+        raise InvalidFormatError(
+            f"train/test sample shape mismatch: "
+            f"{list(arrays['x_train'].shape[1:])} vs "
+            f"{list(arrays['x_test'].shape[1:])}")
     return registry.create(name, arrays["x_train"], arrays["y_train"],
                            arrays["x_test"], arrays["y_test"])
+
+
+def append_files(name: str, x_train: str, y_train: str,
+                 generation: Optional[int] = None,
+                 retention_generations: int = 0,
+                 registry: Optional[DatasetRegistry] = None) -> DatasetHandle:
+    """Append one generation-tagged train chunk (two files) to a live
+    dataset. Shape/dtype drift and non-monotonic generation tags are
+    rejected with 400s by the registry before anything is committed."""
+    registry = registry or DatasetRegistry()
+    arrays = {}
+    for key, path in (("x_train", x_train), ("y_train", y_train)):
+        if not os.path.isfile(path):
+            raise InvalidFormatError(f"{key} file not found: {path}")
+        arrays[key] = load_array_file(path)
+    return registry.append(name, arrays["x_train"], arrays["y_train"],
+                           generation=generation,
+                           retention_generations=retention_generations)

@@ -10,7 +10,7 @@ Here a dataset is a directory of contiguous, memory-mappable .npy arrays:
 
     $KUBEML_TPU_HOME/datasets/<name>/
         manifest.json          {name, subset_size, train_samples, test_samples,
-                                dtypes, shapes, created}
+                                dtypes, shapes, created, generation, windows}
         train_data.npy  train_labels.npy
         test_data.npy   test_labels.npy
 
@@ -18,6 +18,17 @@ Here a dataset is a directory of contiguous, memory-mappable .npy arrays:
 the reference's `_id ∈ [start, end)` range semantics are preserved exactly
 while host-side slicing stays a zero-copy mmap view — which is what the
 infeed pipeline wants on a TPU host.
+
+Streaming appends (continual plane): `append()` adds a generation-tagged
+chunk to the train split. Each append writes NEW versioned array files
+(train_data.v<G>.npy) holding the full retained window, then commits by
+atomically os.replace()-ing manifest.json — the manifest names the data
+files it describes, so a reader holding any committed manifest sees a
+consistent (files, lengths) pair and never a torn append. Generations are
+strictly monotonic per dataset; a retention window (`retention_generations`)
+expires old generations by dropping their samples from the FRONT of the
+contiguous window, which keeps doc addressing and the infeed contracts
+untouched (doc 0 is simply the oldest retained sample).
 """
 
 from __future__ import annotations
@@ -33,7 +44,8 @@ from typing import Dict, List, Optional, Tuple
 import numpy as np
 
 from kubeml_tpu.api.const import STORAGE_SUBSET_SIZE, kubeml_home
-from kubeml_tpu.api.errors import DatasetNotFoundError, StorageError
+from kubeml_tpu.api.errors import (DatasetNotFoundError, InvalidFormatError,
+                                   StorageError)
 from kubeml_tpu.api.types import DatasetSummary
 from kubeml_tpu.utils.names import check_name
 
@@ -44,13 +56,34 @@ def _datasets_root() -> str:
 
 @dataclass
 class DatasetHandle:
-    """Open handle to a registered dataset (mmap-backed)."""
+    """Open handle to a registered dataset (mmap-backed).
+
+    `generation` is the dataset's commit counter: 1 at create, +1 per
+    append (or the producer's explicit monotone tag). `files` maps
+    "<split>_<which>" to the versioned file the manifest committed —
+    a handle is an immutable snapshot of one generation; re-`get()` the
+    registry to observe newer appends.
+
+    Sample addressing under the sliding window: the stored train array
+    holds the RETAINED window; `train_base` is the ABSOLUTE index (in
+    the dataset's append-forever coordinate space) of this handle's
+    sample 0, so two handles agree on a sample's identity even after
+    retention shifted the stored array — the device cache keys its
+    incremental lane reuse on absolute ranges. `train_offset` is the
+    additional front slice a `window_generations` view applies on top
+    of what retention already dropped (doc-aligned, folded into
+    `train_base`).
+    """
 
     name: str
     subset_size: int
     train_samples: int
     test_samples: int
     path: str
+    generation: int = 1
+    files: Optional[Dict[str, str]] = None
+    train_base: int = 0
+    train_offset: int = 0
 
     @property
     def num_train_docs(self) -> int:
@@ -61,8 +94,13 @@ class DatasetHandle:
         return math.ceil(self.test_samples / self.subset_size)
 
     def _load(self, split: str, which: str) -> np.ndarray:
-        return np.load(os.path.join(self.path, f"{split}_{which}.npy"),
-                       mmap_mode="r")
+        default = f"{split}_{which}.npy"
+        fname = (self.files or {}).get(f"{split}_{which}", default)
+        arr = np.load(os.path.join(self.path, fname), mmap_mode="r")
+        if split == "train" and self.train_offset:
+            # window view: slicing an mmap keeps it an mmap view
+            arr = arr[self.train_offset:]
+        return arr
 
     def train_arrays(self) -> Tuple[np.ndarray, np.ndarray]:
         return self._load("train", "data"), self._load("train", "labels")
@@ -138,6 +176,12 @@ class DatasetRegistry:
                 "data_dtype": str(x_train.dtype),
                 "label_dtype": str(y_train.dtype),
                 "created": time.time(),
+                "generation": 1,
+                # per-generation train-sample counts, oldest first — the
+                # retention window drops entries (and their samples) from
+                # the front
+                "windows": [{"generation": 1,
+                             "samples": int(len(x_train))}],
             }
             with open(os.path.join(tmp, "manifest.json"), "w") as f:
                 json.dump(manifest, f)
@@ -147,15 +191,132 @@ class DatasetRegistry:
             raise
         return self.get(name)
 
-    def get(self, name: str) -> DatasetHandle:
+    def append(self, name: str,
+               x_train: np.ndarray, y_train: np.ndarray,
+               generation: Optional[int] = None,
+               retention_generations: int = 0) -> DatasetHandle:
+        """Append a generation-tagged chunk to the train split.
+
+        Validation failures are 400s (InvalidFormatError): per-sample
+        shape or dtype drift would silently corrupt every downstream
+        consumer (the device cache mmaps one contiguous array), and a
+        non-monotonic `generation` means a stale or duplicated producer.
+        The commit is a single atomic os.replace() of manifest.json over
+        freshly written versioned array files, so a concurrent reader
+        sees either the old generation or the new one — never a torn mix.
+        `retention_generations` > 0 keeps only that many newest
+        generations, expiring older samples from the front of the window.
+        """
+        if not self.exists(name):
+            raise DatasetNotFoundError(name)
+        d = self._dir(name)
+        with open(os.path.join(d, "manifest.json")) as f:
+            m = json.load(f)
+        cur_gen = int(m.get("generation", 1))
+        if generation is None:
+            generation = cur_gen + 1
+        generation = int(generation)
+        if generation <= cur_gen:
+            raise InvalidFormatError(
+                f"non-monotonic generation {generation} for dataset "
+                f"{name}: current generation is {cur_gen}")
+        if len(x_train) != len(y_train):
+            raise InvalidFormatError(
+                f"append data/labels length mismatch: "
+                f"{len(x_train)} vs {len(y_train)}")
+        if len(x_train) == 0:
+            raise InvalidFormatError("append chunk is empty")
+        if list(x_train.shape[1:]) != list(m["data_shape"]):
+            raise InvalidFormatError(
+                f"append sample shape {list(x_train.shape[1:])} does not "
+                f"match dataset shape {m['data_shape']}")
+        if str(x_train.dtype) != m["data_dtype"]:
+            raise InvalidFormatError(
+                f"append data dtype {x_train.dtype} does not match "
+                f"dataset dtype {m['data_dtype']}")
+        if str(y_train.dtype) != m["label_dtype"]:
+            raise InvalidFormatError(
+                f"append label dtype {y_train.dtype} does not match "
+                f"dataset label dtype {m['label_dtype']}")
+
+        old_data, old_labels = self.get(name).train_arrays()
+        windows = list(m.get("windows",
+                             [{"generation": cur_gen,
+                               "samples": int(m["train_samples"])}]))
+        windows.append({"generation": generation,
+                        "samples": int(len(x_train))})
+        data = np.concatenate(
+            [np.asarray(old_data), np.ascontiguousarray(x_train)])
+        labels = np.concatenate(
+            [np.asarray(old_labels), np.ascontiguousarray(y_train)])
+        base = int(m.get("base", 0))
+        if retention_generations > 0 and len(windows) > retention_generations:
+            expired = windows[:-retention_generations]
+            windows = windows[-retention_generations:]
+            drop = sum(int(w["samples"]) for w in expired)
+            data, labels = data[drop:], labels[drop:]
+            # absolute coordinate of the retained window's first sample:
+            # monotone across appends, so a reader can tell whether two
+            # manifests' sample i refer to the same logical sample
+            base += drop
+
+        data_file = f"train_data.v{generation}.npy"
+        labels_file = f"train_labels.v{generation}.npy"
+        np.save(os.path.join(d, data_file), np.ascontiguousarray(data))
+        np.save(os.path.join(d, labels_file), np.ascontiguousarray(labels))
+        files = dict(m.get("files") or {})
+        prev = (files.get("train_data"), files.get("train_labels"))
+        files["train_data"] = data_file
+        files["train_labels"] = labels_file
+        m.update(generation=generation, windows=windows, files=files,
+                 train_samples=int(len(data)), base=base,
+                 appended=time.time())
+        tmp = os.path.join(d, "manifest.json.tmp")
+        with open(tmp, "w") as f:
+            json.dump(m, f)
+        os.replace(tmp, os.path.join(d, "manifest.json"))  # atomic commit
+        # keep the immediately-previous version for readers that resolved
+        # their manifest just before the commit; drop anything older
+        for fname in os.listdir(d):
+            if (fname.startswith(("train_data.v", "train_labels.v"))
+                    and fname not in (data_file, labels_file)
+                    and fname not in prev):
+                try:
+                    os.remove(os.path.join(d, fname))
+                except OSError:
+                    pass
+        return self.get(name)
+
+    def get(self, name: str,
+            window_generations: int = 0) -> DatasetHandle:
+        """Open the dataset at its committed generation.
+
+        `window_generations` > 0 returns a view over only the newest W
+        generations even when the on-disk retention keeps more: the
+        view's front offset is rounded DOWN to a doc boundary so doc
+        addressing stays exact (the view may include a partial doc of
+        the (W+1)-th-newest generation rather than split one)."""
         if not self.exists(name):
             raise DatasetNotFoundError(name)
         with open(os.path.join(self._dir(name), "manifest.json")) as f:
             m = json.load(f)
-        return DatasetHandle(name=name, subset_size=m["subset_size"],
-                             train_samples=m["train_samples"],
+        subset = int(m["subset_size"])
+        total = int(m["train_samples"])
+        base = int(m.get("base", 0))
+        offset = 0
+        windows = m.get("windows") or []
+        if window_generations > 0 and windows:
+            keep = sum(int(w["samples"])
+                       for w in windows[-window_generations:])
+            offset = (max(0, total - keep) // subset) * subset
+        return DatasetHandle(name=name, subset_size=subset,
+                             train_samples=total - offset,
                              test_samples=m["test_samples"],
-                             path=self._dir(name))
+                             path=self._dir(name),
+                             generation=int(m.get("generation", 1)),
+                             files=m.get("files"),
+                             train_base=base + offset,
+                             train_offset=offset)
 
     def delete(self, name: str) -> None:
         if not self.exists(name):

@@ -53,6 +53,14 @@ Event kinds:
            explicit worker) — drives the mid-epoch reassignment path
            without NaN poisoning, so it composes with the device cache
            (which NaN plans disable).
+  stale_data
+           suppress the continual-training registry poll after the
+           target epoch (round/worker coordinates are ignored): the job
+           keeps training its current window while the registry moves
+           on, so data_lag_generations grows deterministically and the
+           data_staleness health rule fires without wall-clock races.
+           Continual jobs only — the epoch-boundary refresh is the
+           injection point (TrainJob._continual_refresh).
 
 TrainJob wires the plan in automatically (train/job.py): it becomes the
 job's round hook (dropout/crash/slow/corrupt run post-staging) and wraps
@@ -74,7 +82,7 @@ import numpy as np
 logger = logging.getLogger("kubeml_tpu.faults")
 
 KINDS = ("nan", "dropout", "crash", "corrupt_checkpoint", "slow",
-         "preempt", "quarantine")
+         "preempt", "quarantine", "stale_data")
 
 # distinctive enough that a watchdog test can assert the death was the
 # injected crash, not an import error or OOM kill
@@ -154,6 +162,20 @@ class FaultPlan:
     def _active(self, kind: str, rnd: int):
         return [ev for ev in self.events
                 if ev.kind == kind and ev.matches(self.epoch, rnd)]
+
+    def stale_at(self, epoch: int) -> bool:
+        """True when a stale_data event suppresses the continual
+        registry poll after `epoch` (epoch-granular; round/worker
+        coordinates do not apply — the refresh is an epoch-boundary
+        action, called from the training loop, never the feeder)."""
+        hit = [ev for ev in self.events
+               if ev.kind == "stale_data"
+               and (ev.epoch < 0 or ev.epoch == epoch)]
+        for ev in hit:
+            self.injected["stale_data"] += 1
+            logger.info("fault stale_data: epoch %d — skipping the "
+                        "registry refresh", epoch)
+        return bool(hit)
 
     # ------------------------------------------------------- pre-staging
 

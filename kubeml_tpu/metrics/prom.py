@@ -497,6 +497,22 @@ class MetricsRegistry:
             "kubeml_serve_prefill_backlog_tokens",
             "Prompt tokens admitted but not yet prefilled, by served "
             "model", "model")
+        # continual plane (PR 10): the weight generation new admissions
+        # attach to (advances on every zero-downtime hot-swap), and the
+        # continual job's data freshness — dataset generation trained
+        # vs. how many generations the registry is ahead
+        self.serve_weight_generation = Gauge(
+            "kubeml_serve_weight_generation",
+            "Weight generation new admissions of a served model attach "
+            "to (advances on hot-swap)", "model")
+        self.dataset_generation = Gauge(
+            "kubeml_dataset_generation",
+            "Dataset generation a continual job last trained over",
+            "jobid")
+        self.data_lag_generations = Gauge(
+            "kubeml_data_lag_generations",
+            "Generations the dataset registry is ahead of what a "
+            "continual job has trained", "jobid")
         # checkpoint-LRU (infer cache) instrumentation: entries resident
         # plus hit/miss traffic, labelled by cache in case more
         # deserialization caches grow later
@@ -568,7 +584,9 @@ class MetricsRegistry:
                             self.quarantined_workers, self.restarts,
                             self.reassigned_batches, self.preemptions,
                             self.checkpoint_drops, self.heartbeat_epoch,
-                            self.heartbeat_round, self.loss_spread]
+                            self.heartbeat_round, self.loss_spread,
+                            self.dataset_generation,
+                            self.data_lag_generations]
         self._job_hists = [self.dispatch_seconds, self.data_wait_seconds,
                            self.merge_seconds, self.merge_overlap_seconds]
         self._job_multi = [self.job_health, self.worker_grad_norm,
@@ -580,6 +598,7 @@ class MetricsRegistry:
                               self.serve_queue_depth,
                               self.serve_kv_utilization,
                               self.serve_prefill_backlog,
+                              self.serve_weight_generation,
                               self.infer_cache_entries]
         self._serve_hists = [self.serve_ttft_seconds,
                              self.serve_tpot_seconds,
@@ -650,6 +669,13 @@ class MetricsRegistry:
             if cum > seen.get(m.job_id, 0):
                 counter.inc(m.job_id, cum - seen.get(m.job_id, 0))
                 seen[m.job_id] = cum
+        # continual-plane freshness: lag < 0 marks a non-continual job
+        # (the field's wire default), which publishes neither gauge
+        lag = getattr(m, "data_lag_generations", -1)
+        if lag is not None and lag >= 0:
+            self.dataset_generation.set(
+                m.job_id, getattr(m, "dataset_generation", 0))
+            self.data_lag_generations.set(m.job_id, lag)
 
     def note_restart(self, job_id: str) -> None:
         """One watchdog restart: bump the per-job gauge and the
@@ -706,6 +732,9 @@ class MetricsRegistry:
         self.serve_kv_utilization.set(model, kv_utilization)
         self.serve_prefill_backlog.set(model, prefill_backlog)
 
+    def set_serve_weight_generation(self, model: str, gen: int) -> None:
+        self.serve_weight_generation.set(model, float(gen))
+
     def note_serve_tokens(self, model: str, n: int) -> None:
         self.serve_tokens_total.inc(model, n)
 
@@ -723,7 +752,8 @@ class MetricsRegistry:
 
     def clear_serve(self, model: str) -> None:
         for g in (self.serve_active_slots, self.serve_queue_depth,
-                  self.serve_kv_utilization, self.serve_prefill_backlog):
+                  self.serve_kv_utilization, self.serve_prefill_backlog,
+                  self.serve_weight_generation):
             g.clear(model)
         for h in self._serve_hists:
             h.clear(model)

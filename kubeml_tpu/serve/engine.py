@@ -69,19 +69,35 @@ SERVE_PATH_VARIANTS = (
     "prefix_cow_split",         # write into a shared page copies it first
 )
 
+# Every hot-swap path variant MUST have a quoted-name test in tests/
+# (enforced by tools/check_swap_safety.py, wired like check_serve_parity):
+# a weight swap is a correctness event — streams are PINNED to the
+# generation they attached under, and the prefix cache partitions by
+# generation — so each distinct swap interleaving below needs a test
+# proving zero dropped streams and per-generation bit-identity.
+SWAP_PATH_VARIANTS = (
+    "swap_attach_old",      # stream attached pre-swap finishes on old weights
+    "swap_attach_new",      # stream admitted post-swap runs on new weights
+    "swap_mid_stream",      # swap lands between two decode steps of a stream
+    "swap_cache_partition", # post-swap stream never hits pre-swap KV pages
+    "swap_drain_free",      # old generation frees when its last reader ends
+)
+
 
 class _Slot:
     """Host-side state of one occupied decode slot."""
 
-    __slots__ = ("req", "pos", "prompt", "n_prompt", "seq",
+    __slots__ = ("req", "pos", "prompt", "n_prompt", "seq", "gen",
                  "hash_chain", "hashed_pages", "cached_pages")
 
-    def __init__(self, req: GenerateRequest, prompt: List[int], seq: int):
+    def __init__(self, req: GenerateRequest, prompt: List[int], seq: int,
+                 gen: int = 1):
         self.req = req
         self.prompt = prompt
         self.n_prompt = len(prompt)
         self.pos = 0          # next position to consume
         self.seq = seq        # admission order (newest-stall shedding)
+        self.gen = gen        # weight generation pinned at attach
         self.hash_chain = b""   # rolling digest over hashed_pages pages
         self.hashed_pages = 0   # prompt pages matched or registered so far
         self.cached_pages = 0   # prompt pages attached from the cache
@@ -138,7 +154,15 @@ class DecodeEngine:
             self._prefill = jax.jit(
                 build_paged_prefill_step(module, prefill_chunk),
                 donate_argnums=donate)
-        self._params = jax.device_put(variables["params"])
+        # weight generations: params are per-slot DATA, not program
+        # state — every generation's params pytree has identical
+        # shapes/dtypes, so dispatching different generations reuses the
+        # same two compiled programs (the compile-count pin survives
+        # hot-swaps). New attaches pin to weight_generation; old
+        # generations retire when their last slot releases.
+        self.weight_generation = 1
+        self._params_by_gen: Dict[int, object] = {
+            1: jax.device_put(variables["params"])}
         S, Pmax = self.geom.slots, self.geom.pages_per_slot
         self._tables = np.zeros((S, Pmax), np.int32)
         self._slots: List[Optional[_Slot]] = [None] * S
@@ -152,6 +176,7 @@ class DecodeEngine:
             "prefill_dispatches": 0, "prefill_tokens": 0,
             "prefill_compiles": 0, "decode_tokens": 0,
             "prefix_hits": 0, "prefix_misses": 0, "cow_splits": 0,
+            "weight_swaps": 0, "generations_retired": 0,
         }
 
     # ------------------------------------------------------------- capacity
@@ -174,6 +199,43 @@ class DecodeEngine:
         folds this into Retry-After; exported as a gauge)."""
         return sum(max(0, sl.n_prompt - 1 - sl.pos)
                    for sl in self._slots if sl is not None)
+
+    def active_generations(self) -> List[int]:
+        """Weight generations with resident params: the current one plus
+        any older generations still pinned by in-flight streams."""
+        return sorted(self._params_by_gen)
+
+    # ------------------------------------------------------------- hot-swap
+    def install_weights(self, variables) -> int:
+        """Install a new weight generation. In-flight streams keep
+        decoding on the generation they attached under (their params
+        stay resident); every LATER attach pins to the new generation.
+        Returns the new generation number. Serving-loop thread only,
+        like attach/step — the ServeService marshals installs into the
+        loop via its pending-install hook."""
+        self.weight_generation += 1
+        self._params_by_gen[self.weight_generation] = jax.device_put(
+            variables["params"])
+        self.stats["weight_swaps"] += 1
+        # generations nobody reads anymore free immediately (an idle
+        # engine holds exactly one generation after a swap)
+        for gen in list(self._params_by_gen):
+            self._maybe_retire(gen)
+        return self.weight_generation
+
+    def _maybe_retire(self, gen: int) -> None:
+        """Drop a superseded generation's params and its prefix-cache
+        partition once no slot is pinned to it. The CURRENT generation
+        never retires — new admissions need it."""
+        if gen == self.weight_generation:
+            return
+        if any(sl is not None and sl.gen == gen for sl in self._slots):
+            return
+        if self._params_by_gen.pop(gen, None) is not None:
+            self.pager.drop_generation(gen)
+            self.stats["generations_retired"] += 1
+            logger.info("retired weight generation %d (current %d)",
+                        gen, self.weight_generation)
 
     # ------------------------------------------------------------ lifecycle
     def check_admissible(self, prompt: List[int],
@@ -208,7 +270,8 @@ class DecodeEngine:
         prompt = self.check_admissible(req.prompt, req.max_new_tokens)
         for s, cur in enumerate(self._slots):
             if cur is None:
-                slot = _Slot(req, prompt, self._seq)
+                slot = _Slot(req, prompt, self._seq,
+                             gen=self.weight_generation)
                 self._seq += 1
                 self._slots[s] = slot
                 if self.prefix_cache:
@@ -226,7 +289,7 @@ class DecodeEngine:
         chain = b""
         while (k + 1) * G <= slot.n_prompt and k < self.geom.pages_per_slot:
             digest = chain_hash(chain, slot.prompt[k * G:(k + 1) * G])
-            pid = self.pager.lookup_prefix(digest)
+            pid = self.pager.lookup_prefix(digest, slot.gen)
             if pid is None:
                 self.stats["prefix_misses"] += 1
                 break
@@ -254,7 +317,8 @@ class DecodeEngine:
             pi = slot.hashed_pages
             digest = chain_hash(slot.hash_chain,
                                 slot.prompt[pi * G:(pi + 1) * G])
-            self.pager.register_prefix(int(self._tables[s, pi]), digest)
+            self.pager.register_prefix(int(self._tables[s, pi]), digest,
+                                       slot.gen)
             slot.hash_chain = digest
             slot.hashed_pages += 1
 
@@ -275,6 +339,9 @@ class DecodeEngine:
         self._slots[s] = None
         slot.req.finished_at = self.clock()
         slot.req.finish(outcome, error)
+        # last reader of a superseded weight generation detaching frees
+        # that generation's params and cache partition
+        self._maybe_retire(slot.gen)
 
     def cancel_request(self, req: GenerateRequest) -> bool:
         for s, slot in enumerate(self._slots):
@@ -320,7 +387,8 @@ class DecodeEngine:
         before = self._prefill._cache_size()
         t0 = self.clock()
         self.slab.k, self.slab.v, self.slab.valid = self._prefill(
-            self._params, self.slab.k, self.slab.v, self.slab.valid,
+            self._params_by_gen[slot.gen],
+            self.slab.k, self.slab.v, self.slab.valid,
             jnp.asarray(tokens), jnp.asarray(pos),
             jnp.asarray(self._tables[s]), jnp.asarray(write_pages),
             jnp.asarray(write_offs), jnp.asarray(in_chunk))
@@ -381,16 +449,16 @@ class DecodeEngine:
                     break
 
         # -------------------------------------------------- decode lane
-        tokens = np.zeros(S, np.int32)
-        pos = np.zeros(S, np.int32)
-        write_page = np.zeros(S, np.int32)
-        write_off = np.zeros(S, np.int32)
-        active = np.zeros(S, np.float32)
-        temps = np.zeros(S, np.float32)
-        key_data = np.zeros((S, 2), np.uint32)
-        copy_src = np.zeros(S, np.int32)
-        copy_dst = np.zeros(S, np.int32)
-
+        # per-slot page maintenance first (alloc / copy-on-write), then
+        # ONE decode dispatch PER ACTIVE WEIGHT GENERATION: params are a
+        # same-shape argument, so dispatching old and new generations in
+        # the same round reuses the one compiled decode program — the
+        # swap costs dispatches, never a recompile. A slot's write_page
+        # and copy pair appear only in its own generation's dispatch
+        # (other dispatches see 0 there, landing writes in the null
+        # page), so generations never clobber each other's KV.
+        ready: List[int] = []
+        cow: Dict[int, tuple] = {}
         for s, slot in enumerate(self._slots):
             if slot is None or self._in_prefill(slot):
                 continue
@@ -409,26 +477,13 @@ class DecodeEngine:
                 if dst is None:
                     stalled.append(s)
                     continue
-                copy_src[s] = pid
-                copy_dst[s] = dst
+                cow[s] = (pid, dst)
                 self._tables[s, pi] = dst
                 self.pager.free([pid])  # drop this slot's share
                 self.stats["cow_splits"] += 1
-                pid = dst
-            active[s] = 1.0
-            tokens[s] = slot.prompt[slot.pos] if slot.pos < slot.n_prompt \
-                else slot.req.tokens[-1]
-            pos[s] = slot.pos
-            write_page[s] = pid
-            write_off[s] = slot.pos % G
-            temps[s] = slot.req.temperature
-            # per-(request, position) key: sampling is independent of
-            # co-resident streams — the sampled-path bit-identity hinge
-            key_data[s] = (np.uint32(slot.req.seed & 0xFFFFFFFF),
-                           np.uint32(slot.pos))
+            ready.append(s)
 
-        n_active = int(active.sum())
-        if n_active == 0:
+        if not ready:
             if stalled:
                 self.stats["stalls"] += len(stalled)
                 if not progressed:
@@ -446,41 +501,73 @@ class DecodeEngine:
         if stalled:
             self.stats["stalls"] += len(stalled)
 
-        before = self._step._cache_size()
-        t0 = self.clock()
-        nxt, self.slab.k, self.slab.v, self.slab.valid = self._step(
-            self._params, self.slab.k, self.slab.v, self.slab.valid,
-            jnp.asarray(tokens), jnp.asarray(pos),
-            jnp.asarray(self._tables), jnp.asarray(write_page),
-            jnp.asarray(write_off), jnp.asarray(active),
-            jnp.asarray(temps), jnp.asarray(key_data),
-            jnp.asarray(copy_src), jnp.asarray(copy_dst))
-        compiled = self._step._cache_size() > before
-        self.compile_tracker.note(compiled, self.clock() - t0)
-        self.stats["dispatches"] += 1
-        self.stats["compiles"] += int(compiled)
-        self.stats["occupancy_sum"] += n_active
-        self.stats["decode_tokens"] += n_active
-        nxt_host = np.asarray(nxt)
+        # snapshot each ready slot's generation up front: an earlier
+        # generation's dispatch may finish-and-release its members, and
+        # re-reading self._slots for the next generation would hit None
+        gen_of = {s: self._slots[s].gen for s in ready}
+        for gen in sorted(set(gen_of.values())):
+            members = [s for s in ready if gen_of[s] == gen]
+            tokens = np.zeros(S, np.int32)
+            pos = np.zeros(S, np.int32)
+            write_page = np.zeros(S, np.int32)
+            write_off = np.zeros(S, np.int32)
+            active = np.zeros(S, np.float32)
+            temps = np.zeros(S, np.float32)
+            key_data = np.zeros((S, 2), np.uint32)
+            copy_src = np.zeros(S, np.int32)
+            copy_dst = np.zeros(S, np.int32)
+            for s in members:
+                slot = self._slots[s]
+                active[s] = 1.0
+                tokens[s] = slot.prompt[slot.pos] \
+                    if slot.pos < slot.n_prompt else slot.req.tokens[-1]
+                pos[s] = slot.pos
+                write_page[s] = int(self._tables[s, slot.pos // G])
+                write_off[s] = slot.pos % G
+                temps[s] = slot.req.temperature
+                # per-(request, position) key: sampling is independent of
+                # co-resident streams — the sampled-path bit-identity hinge
+                key_data[s] = (np.uint32(slot.req.seed & 0xFFFFFFFF),
+                               np.uint32(slot.pos))
+                if s in cow:
+                    copy_src[s], copy_dst[s] = cow[s]
 
-        for s, slot in enumerate(self._slots):
-            if slot is None or active[s] == 0.0:
-                continue
-            p = slot.pos
-            slot.pos = p + 1
-            if self.prefix_cache:
-                # a prompt whose length is a page multiple completes its
-                # final page on this very advance — publish it
-                self._register_full_pages(s, slot)
-            if p < slot.n_prompt - 1:
-                continue  # token-by-token prefill: output discarded
-            tok = int(nxt_host[s])
-            if slot.req.first_token_at is None:
-                slot.req.first_token_at = self.clock()
-            slot.req.emit_token(tok)
-            self.stats["generated_tokens"] += 1
-            if (slot.req.eos_id is not None and tok == slot.req.eos_id) \
-                    or len(slot.req.tokens) >= slot.req.max_new_tokens:
-                self.release(s, "ok")
-                finished.append(slot.req)
+            before = self._step._cache_size()
+            t0 = self.clock()
+            nxt, self.slab.k, self.slab.v, self.slab.valid = self._step(
+                self._params_by_gen[gen],
+                self.slab.k, self.slab.v, self.slab.valid,
+                jnp.asarray(tokens), jnp.asarray(pos),
+                jnp.asarray(self._tables), jnp.asarray(write_page),
+                jnp.asarray(write_off), jnp.asarray(active),
+                jnp.asarray(temps), jnp.asarray(key_data),
+                jnp.asarray(copy_src), jnp.asarray(copy_dst))
+            compiled = self._step._cache_size() > before
+            self.compile_tracker.note(compiled, self.clock() - t0)
+            self.stats["dispatches"] += 1
+            self.stats["compiles"] += int(compiled)
+            self.stats["occupancy_sum"] += len(members)
+            self.stats["decode_tokens"] += len(members)
+            nxt_host = np.asarray(nxt)
+
+            for s in members:
+                slot = self._slots[s]
+                p = slot.pos
+                slot.pos = p + 1
+                if self.prefix_cache:
+                    # a prompt whose length is a page multiple completes
+                    # its final page on this very advance — publish it
+                    self._register_full_pages(s, slot)
+                if p < slot.n_prompt - 1:
+                    continue  # token-by-token prefill: output discarded
+                tok = int(nxt_host[s])
+                if slot.req.first_token_at is None:
+                    slot.req.first_token_at = self.clock()
+                slot.req.emit_token(tok)
+                self.stats["generated_tokens"] += 1
+                if (slot.req.eos_id is not None
+                        and tok == slot.req.eos_id) \
+                        or len(slot.req.tokens) >= slot.req.max_new_tokens:
+                    self.release(s, "ok")
+                    finished.append(slot.req)
         return finished

@@ -148,8 +148,13 @@ class PageAllocator:
         # pop() takes from the tail; store descending so ids come out 1, 2, …
         self._free: List[int] = list(range(geom.pages - 1, 0, -1))
         self._refs: Dict[int, int] = {}          # pid -> refcount (>= 1)
-        self._hash_of: Dict[int, bytes] = {}     # registered pid -> chain hash
-        self._by_hash: Dict[bytes, int] = {}     # chain hash -> pid
+        # the prefix cache is PARTITIONED by serving-weight generation:
+        # KV bytes are a function of the weights that produced them, so a
+        # chain-hash match under different weights is NOT the same cache
+        # entry. Registration/lookup key on (generation, chain hash);
+        # a hot-swap retires a whole partition via drop_generation().
+        self._hash_of: Dict[int, tuple] = {}     # pid -> (gen, chain hash)
+        self._by_hash: Dict[tuple, int] = {}     # (gen, chain hash) -> pid
         # refcount-0 registered pages, oldest first (eviction order)
         self._lru: "OrderedDict[int, None]" = OrderedDict()
         self.evictions = 0
@@ -195,29 +200,54 @@ class PageAllocator:
             self._free.sort(reverse=True)
 
     # ---------------------------------------------------------- prefix cache
-    def register_prefix(self, pid: int, digest: bytes) -> bool:
+    def register_prefix(self, pid: int, digest: bytes,
+                        gen: int = 0) -> bool:
         """Publish a referenced, fully-written prompt page under its
-        chain hash so later requests can share it. Returns False (no-op)
-        when the hash is already mapped — first writer wins; the
-        duplicate page stays a private unregistered page."""
+        chain hash, in the partition of the weight generation whose
+        forward pass produced its KV bytes. Returns False (no-op) when
+        the (generation, hash) key is already mapped — first writer
+        wins; the duplicate page stays a private unregistered page."""
         if pid not in self._refs:
             raise ValueError(f"registering unreferenced page {pid}")
-        if digest in self._by_hash or pid in self._hash_of:
+        key = (int(gen), digest)
+        if key in self._by_hash or pid in self._hash_of:
             return False
-        self._hash_of[pid] = digest
-        self._by_hash[digest] = pid
+        self._hash_of[pid] = key
+        self._by_hash[key] = pid
         return True
 
-    def lookup_prefix(self, digest: bytes) -> Optional[int]:
-        """Prefix-cache hit: take one reference on the page registered
-        under `digest`, reviving it from the LRU if it was parked there.
-        Returns None on miss."""
-        pid = self._by_hash.get(digest)
+    def lookup_prefix(self, digest: bytes, gen: int = 0) -> Optional[int]:
+        """Prefix-cache hit WITHIN the given weight generation's
+        partition: take one reference on the page registered under
+        (gen, digest), reviving it from the LRU if it was parked there.
+        Returns None on miss — a page cached under different weights is
+        never a hit, no matter the token match."""
+        pid = self._by_hash.get((int(gen), digest))
         if pid is None:
             return None
         self._lru.pop(pid, None)
         self._refs[pid] = self._refs.get(pid, 0) + 1
         return pid
+
+    def drop_generation(self, gen: int) -> int:
+        """Retire a weight generation's whole cache partition (hot-swap
+        cleanup once its last stream detached): unregister every page in
+        the partition; parked (refcount-0) ones go straight back to the
+        free list. Returns the number of pages unregistered."""
+        gen = int(gen)
+        victims = [pid for pid, (g, _) in self._hash_of.items() if g == gen]
+        released = False
+        for pid in victims:
+            self._unregister(pid)
+            if pid in self._refs:
+                continue  # frees normally when its last stream releases
+            if pid in self._lru:
+                del self._lru[pid]
+            self._free.append(pid)
+            released = True
+        if released:
+            self._free.sort(reverse=True)
+        return len(victims)
 
     def writable(self, pid: int) -> bool:
         """True when a slot may scatter into the page in place: exactly

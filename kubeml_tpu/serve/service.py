@@ -62,6 +62,11 @@ class ServeService:
         self._pending: Deque[GenerateRequest] = collections.deque()
         self._inflight = 0          # admitted, not yet terminal
         self._stopped = False
+        # (variables, stamp) awaiting install by the loop thread — the
+        # engine is single-threaded, so weight hot-swaps marshal through
+        # here instead of touching the engine from the HTTP/PS thread
+        self._pending_weights: Optional[tuple] = None
+        self.weight_stamp: Optional[float] = None
         self.rejected_total = 0
         self._counters_seen: dict = {}   # engine stat -> last published
         self._ttfts: Deque[float] = collections.deque(maxlen=TTFT_WINDOW)
@@ -108,6 +113,20 @@ class ServeService:
         with self._cv:
             self._cv.notify()
 
+    def install_weights(self, variables, stamp: Optional[float] = None
+                        ) -> None:
+        """Queue a zero-downtime weight hot-swap. Any thread may call;
+        the serving-loop thread applies it BEFORE its next admissions,
+        so streams already attached finish on the weights they started
+        with while every later admission decodes under the new
+        generation. `stamp` (e.g. checkpoint saved_at) lets the caller
+        dedupe installs — see ps._serve_service."""
+        with self._cv:
+            if self._stopped:
+                return
+            self._pending_weights = (variables, stamp)
+            self._cv.notify()
+
     def stop(self, timeout: float = 10.0) -> None:
         with self._cv:
             self._stopped = True
@@ -120,11 +139,22 @@ class ServeService:
         while True:
             with self._cv:
                 while not self._stopped and not self._pending \
+                        and self._pending_weights is None \
                         and self.engine.active() == 0:
                     self._publish()
                     self._cv.wait()
                 if self._stopped:
                     break
+                if self._pending_weights is not None:
+                    # apply the hot-swap before this round's admissions:
+                    # queued requests attach to the NEW generation,
+                    # already-attached streams stay pinned to theirs
+                    variables, stamp = self._pending_weights
+                    self._pending_weights = None
+                    gen = self.engine.install_weights(variables)
+                    self.weight_stamp = stamp
+                    logger.info("model %s hot-swapped to weight "
+                                "generation %d", self.model_id, gen)
                 while self._pending and self.engine.free_slots() > 0:
                     req = self._pending.popleft()
                     if req.cancelled:
@@ -230,6 +260,12 @@ class ServeService:
             "serve_prefill_backlog_tokens": self._backlog_tokens(),
             "serve_prefix_hit_pct": round(
                 100.0 * hits / max(1, hits + misses), 1),
+            # hot-swap telemetry: the generation new admissions attach
+            # to, plus how many older generations in-flight streams
+            # still pin resident
+            "serve_weight_generation": self.engine.weight_generation,
+            "serve_active_generations": len(
+                self.engine.active_generations()),
         }
 
     def _publish(self) -> None:
@@ -240,6 +276,8 @@ class ServeService:
                 snap["serve_queue_depth"],
                 snap["serve_kv_page_utilization"],
                 snap["serve_prefill_backlog_tokens"])
+            self.metrics.set_serve_weight_generation(
+                self.model_id, snap["serve_weight_generation"])
             # engine stats are cumulative; prometheus counters take
             # deltas (the loop thread is the only publisher)
             for stat, note in (

@@ -399,7 +399,17 @@ class TrainJob:
                           self._start_epoch + 1, epochs, parallelism)
 
             last_ckpt_epoch = -1
-            for epoch in range(self._start_epoch, epochs):
+            continual = self._continual
+            if continual and epochs <= 0:
+                # a continual job "never finishes": epochs <= 0 runs an
+                # unbounded epoch loop (stop/preempt/goal-accuracy are
+                # the only exits); epochs > 0 keeps acting as a total
+                # cap — the deterministic harness tests and bench use
+                import itertools
+                epoch_iter = itertools.count(self._start_epoch)
+            else:
+                epoch_iter = iter(range(self._start_epoch, epochs))
+            for epoch in epoch_iter:
                 t0 = time.time()
                 used_parallelism = parallelism
                 with self.tracer.span("epoch", epoch=epoch,
@@ -418,7 +428,8 @@ class TrainJob:
                 # dynamic parallelism: ask the scheduler between epochs
                 # (job.go:196-215), gated by LIMIT_PARALLELISM like the
                 # reference (job.go:210-213)
-                if not opts.static_parallelism and epoch < epochs - 1:
+                if not opts.static_parallelism and (
+                        continual or epoch < epochs - 1):
                     new_p = self.callbacks.request_parallelism(self.task)
                     if new_p and not limit_parallelism():
                         parallelism = max(1, int(new_p))
@@ -480,7 +491,15 @@ class TrainJob:
                     jit_compiles=self._jit_tracker.compiles,
                     hbm_peak_bytes=self._hbm.peak_bytes,
                     hbm_in_use_bytes=self._hbm.in_use_bytes,
-                    trace_events_dropped=self.tracer.dropped_events))
+                    trace_events_dropped=self.tracer.dropped_events,
+                    # continual freshness pair (-1 lag = not continual;
+                    # prom.py publishes the gauges only when lag >= 0)
+                    dataset_generation=(self._trained_generation
+                                        if continual else 0),
+                    data_lag_generations=(
+                        self._registry_generation
+                        - self._trained_generation
+                        if continual else -1)))
                 self._log("job %s epoch %d/%d loss=%.4f val=%.4f acc=%.2f "
                             "N=%d %.2fs [%s]", job_id, epoch + 1, epochs,
                             train_loss, val_loss, accuracy, used_parallelism,
@@ -533,6 +552,12 @@ class TrainJob:
                     self._log("job %s reached goal accuracy %.2f", job_id,
                                 accuracy)
                     break
+                if continual:
+                    # between "epochs" the continual job polls the
+                    # registry: appended generations slide the training
+                    # window under the SAME loop (the next epoch's plan
+                    # and cache layout pick the fresh handle up)
+                    self._continual_refresh(epoch)
 
             # final validation if the last epoch didn't run one
             # (job.go:250-253)
@@ -649,14 +674,49 @@ class TrainJob:
         return m
 
     def _init_model(self):
-        handle = self.registry.get(self.req.dataset)
-        self._handle = handle
         opts = self.req.options
+        # ---- continual mode (sliding-window training over a streaming
+        # dataset): validate the knobs BEFORE touching the registry so a
+        # misconfigured job 400s without loading data
+        self._continual = bool(getattr(opts, "continual", False))
+        self._window_generations = int(
+            getattr(opts, "window_generations", 0))
+        pub_rounds = int(getattr(opts, "publish_every_rounds", 0))
+        if self._window_generations < 0 or pub_rounds < 0:
+            raise KubeMLException(
+                "window_generations and publish_every_rounds must be "
+                f">= 0 (got {self._window_generations}, {pub_rounds})",
+                400)
+        if not self._continual and (self._window_generations
+                                    or pub_rounds):
+            raise KubeMLException(
+                "window_generations / publish_every_rounds require "
+                "--continual: both describe the sliding-window loop "
+                "(a one-shot job trains its dataset snapshot as-is)",
+                400)
         engine_kind = opts.engine
         if engine_kind not in ("kavg", "syncdp"):
             raise KubeMLException(
                 f"unknown training engine {engine_kind!r}; "
                 f"expected 'kavg' or 'syncdp'", 400)
+        if pub_rounds > 0 and engine_kind != "kavg":
+            raise KubeMLException(
+                "publish_every_rounds requires the kavg engine: the "
+                "round-cadence publish rides the round-granular "
+                "checkpoint machinery (weights + round cursor), which "
+                "syncdp's persistent device optimizer state cannot "
+                "represent", 400)
+        if self._continual and self._window_generations > 0:
+            handle = self.registry.get(
+                self.req.dataset,
+                window_generations=self._window_generations)
+        else:
+            handle = self.registry.get(self.req.dataset)
+        self._handle = handle
+        # trained vs registry generation: the freshness pair behind the
+        # kubeml_data_lag_generations gauge and the data_staleness rule
+        self._trained_generation = int(getattr(handle, "generation", 1))
+        self._registry_generation = self._trained_generation
         if opts.quarantine_after < 0 or opts.abort_after < 0:
             raise KubeMLException(
                 "quarantine_after and abort_after must be >= 0 "
@@ -1145,7 +1205,54 @@ class TrainJob:
             return
         self._device_cache = DeviceDatasetCache(
             handle, self.mesh, layout=layout,
-            device_transform=dev_hook if not identity else None)
+            device_transform=dev_hook if not identity else None,
+            # continual jobs refresh the slabs as the window slides:
+            # retain host slabs for per-lane reuse, and quantize slab
+            # width so growth within the quantum keeps the compiled
+            # round program (engines key on cache.signature)
+            incremental=self._continual,
+            grow_quantum=512 if self._continual else 0)
+
+    def _continual_refresh(self, epoch: int) -> None:
+        """Epoch-boundary registry poll (continual mode): pick up
+        appended generations by swapping a fresh handle into the loader
+        and the device cache, and track the trained-vs-registry
+        generation lag the freshness gauges and the data_staleness rule
+        consume. Runs on the training-loop thread between epochs — the
+        loader and cache are quiescent there, so the swap needs no
+        locking (the next epoch's plan simply reads the new handle)."""
+        try:
+            if self._window_generations > 0:
+                fresh = self.registry.get(
+                    self.req.dataset,
+                    window_generations=self._window_generations)
+            else:
+                fresh = self.registry.get(self.req.dataset)
+        except Exception as e:
+            # transient registry failure: keep training the current
+            # window; the lag gauge keeps reporting the last poll
+            self._log("job %s: continual registry poll failed (%s); "
+                      "keeping generation %d", self.task.job_id, e,
+                      self._trained_generation)
+            return
+        self._registry_generation = int(getattr(fresh, "generation", 1))
+        if self._fault_plan is not None and \
+                self._fault_plan.stale_at(epoch):
+            # injected staleness: observe the registry moving on (the
+            # lag grows deterministically) but do NOT slide the window
+            return
+        if (self._registry_generation == self._trained_generation
+                and fresh.train_samples == self._handle.train_samples):
+            return
+        self._log("job %s: continual refresh — generation %d -> %d "
+                  "(%d train samples)", self.task.job_id,
+                  self._trained_generation, self._registry_generation,
+                  fresh.train_samples)
+        self._handle = fresh
+        self._loader.handle = fresh
+        if self._device_cache is not None:
+            self._device_cache.refresh(fresh)
+        self._trained_generation = self._registry_generation
 
     def _log_cache_payload(self, W: int, S: int, B: int) -> None:
         """One-time log of what the index path saves per round: the
@@ -1234,10 +1341,13 @@ class TrainJob:
                       or self.req.options.quarantine_after > 0
                       or self.req.options.abort_after > 0
                       or getattr(self.req.options,
-                                 "checkpoint_every_rounds", 0) > 0):
+                                 "checkpoint_every_rounds", 0) > 0
+                      or getattr(self.req.options,
+                                 "publish_every_rounds", 0) > 0):
             # quarantine/abort need per-round drop flags and per-round
-            # mask edits, round-granular checkpoints need a per-round
-            # cursor — per-round host control, like hooks
+            # mask edits, round-granular checkpoints and the continual
+            # publish cadence need a per-round cursor — per-round host
+            # control, like hooks
             return 1
         return R
 
@@ -1393,6 +1503,12 @@ class TrainJob:
         self._guard = guard  # routes force_quarantine from the fault hook
         self._epoch_reassigned = 0
         ckpt_rounds = int(getattr(opts, "checkpoint_every_rounds", 0))
+        # continual publish cadence: every P rounds the job publishes a
+        # stamped checkpoint through the SAME async round-granular save
+        # the checkpoint cadence uses — the serving plane hot-swaps on
+        # the checkpoint's saved_at stamp (control/ps._serve_service)
+        pub_rounds = int(getattr(opts, "publish_every_rounds", 0)) \
+            if self._continual else 0
 
         # ---- round-granular resume (elastic degraded mode): continue a
         # crashed/preempted epoch at the stored round cursor. The loader
@@ -1563,8 +1679,9 @@ class TrainJob:
             dispatch_round(rb)
             rounds_done = rb.round_index + 1
             self._progress = (epoch, rounds_done)
-            if (ckpt_rounds and self.checkpoint
-                    and rounds_done % ckpt_rounds == 0):
+            due = ((ckpt_rounds and rounds_done % ckpt_rounds == 0)
+                   or (pub_rounds and rounds_done % pub_rounds == 0))
+            if due and self.checkpoint:
                 # round-cadence cursor snapshot: async like the epoch
                 # saves, but the train_state readback syncs on the
                 # partial loss sums — the cost the cadence opts into

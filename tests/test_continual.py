@@ -1,0 +1,889 @@
+"""Continual-training plane tests (streaming ingest -> sliding-window
+training -> zero-downtime serving hot-swap).
+
+The contracts pinned here:
+
+  * ingest — appends are generation-tagged, atomically committed, and
+    validated before anything touches disk state (shape/dtype drift and
+    non-monotonic producer tags are 400s); retention drops whole windows
+    from the FRONT and advances the absolute `base` coordinate
+  * sliding window — a continual job re-polls the registry between
+    epochs and trains the fresh window under the SAME loop; the device
+    cache refreshes incrementally with slabs bit-identical to a cold
+    layout; an injected `stale_data` fault makes the freshness lag grow
+    deterministically and the data_staleness health rule fire
+  * hot-swap — every SWAP_PATH_VARIANTS entry in serve/engine.py keeps
+    a named test below (tools/check_swap_safety.py lints that): streams
+    pinned at attach decode bit-identically to a solo run on their
+    generation across swaps, the prefix cache never serves a page
+    across generations, and a retired generation's weights and cache
+    partition actually free — with the decode program compiled once
+  * restartability — a continual job preempted mid-window resumes from
+    its round cursor and finishes bit-identical to an uninterrupted run
+    over the same generation sequence
+"""
+
+import json
+import time
+
+import numpy as np
+import pytest
+
+from kubeml_tpu.api.errors import (InvalidFormatError, JobPreemptedError,
+                                   KubeMLException)
+from kubeml_tpu.data.registry import DatasetRegistry
+from kubeml_tpu.models import get_builtin
+from kubeml_tpu.train.checkpoint import load_checkpoint
+from kubeml_tpu.train.history import HistoryStore
+from kubeml_tpu.train.job import JobCallbacks, TrainJob
+
+from tests.test_job import ToyDataset, make_task
+
+pytestmark = pytest.mark.continual
+
+DIM, CLASSES, SUBSET = 8, 4, 16
+
+
+def _split(n, seed):
+    rng = np.random.RandomState(seed)
+    y = rng.randint(0, CLASSES, n).astype(np.int32)
+    x = rng.randn(n, DIM).astype(np.float32) * 2.0
+    x[np.arange(n), y % DIM] += 3.0
+    return x, y
+
+
+def _blobs(reg, n_train=256, n_test=64, seed=0, subset=SUBSET):
+    xtr, ytr = _split(n_train, seed)
+    xte, yte = _split(n_test, seed + 1)
+    return reg.create("blobs", xtr, ytr, xte, yte, subset_size=subset)
+
+
+def _continual_job(reg, mesh, job_id, *, epochs, store=None,
+                   callbacks=None, resume=False, **optkw):
+    task = make_task(job_id=job_id, epochs=epochs, parallelism=2, k=1,
+                     batch=16, goal=200.0)
+    task.parameters.options.continual = True
+    for key, val in optkw.items():
+        setattr(task.parameters.options, key, val)
+    if resume:
+        task.parameters.resume_from = job_id
+    model = get_builtin("mlp")(hidden=16, num_classes=CLASSES)
+    return TrainJob(task, model, ToyDataset(), mesh, registry=reg,
+                    history_store=store, callbacks=callbacks)
+
+
+# ---------------------------------------------------------------- ingest
+
+
+def test_append_advances_generation_and_windowed_view(tmp_home):
+    reg = DatasetRegistry()
+    h = _blobs(reg, n_train=256)
+    assert (h.generation, h.train_base, h.train_offset) == (1, 0, 0)
+
+    xa, ya = _split(128, seed=7)
+    h2 = reg.append("blobs", xa, ya)
+    assert h2.generation == 2
+    assert h2.train_samples == 384 and h2.train_base == 0
+
+    # the committed bytes: old content untouched, the chunk at the tail
+    x_all, y_all = (np.asarray(a) for a in h2.train_arrays())
+    np.testing.assert_array_equal(x_all[256:], xa)
+    np.testing.assert_array_equal(y_all[256:], ya)
+
+    # a windowed view over the newest generation only, doc-aligned
+    hw = reg.get("blobs", window_generations=1)
+    assert hw.train_samples == 128
+    assert hw.train_offset == 256 and hw.train_base == 256
+    xw, yw = (np.asarray(a) for a in hw.train_arrays())
+    np.testing.assert_array_equal(xw, xa)
+    np.testing.assert_array_equal(yw, ya)
+
+    # a window wider than history degrades to the full dataset
+    assert reg.get("blobs", window_generations=9).train_samples == 384
+
+
+def test_append_retention_drops_front_and_advances_base(tmp_home):
+    reg = DatasetRegistry()
+    _blobs(reg, n_train=256)
+    xa, ya = _split(128, seed=7)
+    h2 = reg.append("blobs", xa, ya, retention_generations=2)
+    assert h2.train_samples == 384 and h2.train_base == 0  # 2 windows kept
+
+    xb, yb = _split(64, seed=8)
+    h3 = reg.append("blobs", xb, yb, retention_generations=2)
+    # generation-1's 256 samples expired from the front
+    assert h3.generation == 3
+    assert h3.train_samples == 192 and h3.train_base == 256
+    x_all, y_all = (np.asarray(a) for a in h3.train_arrays())
+    np.testing.assert_array_equal(x_all, np.concatenate([xa, xb]))
+    np.testing.assert_array_equal(y_all, np.concatenate([ya, yb]))
+
+
+def test_append_validation_400s_commit_nothing(tmp_home):
+    reg = DatasetRegistry()
+    _blobs(reg, n_train=256)
+    x, y = _split(64, seed=7)
+    bad = [
+        (x[:, :4], y),                        # sample shape drift
+        (x.astype(np.float64), y),            # data dtype drift
+        (x, y.astype(np.int64)),              # label dtype drift
+        (x[:0], y[:0]),                       # empty chunk
+        (x, y[:32]),                          # length mismatch
+    ]
+    for xb, yb in bad:
+        with pytest.raises(InvalidFormatError) as ei:
+            reg.append("blobs", xb, yb)
+        assert ei.value.status_code == 400
+    # a stale producer tag (optimistic concurrency) is a 400 too
+    with pytest.raises(InvalidFormatError):
+        reg.append("blobs", x, y, generation=1)
+    # nothing committed: still generation 1, original sample count
+    h = reg.get("blobs")
+    assert (h.generation, h.train_samples) == (1, 256)
+
+
+def test_dataset_append_route_e2e(tmp_path, tmp_home, mesh8):
+    """Client -> controller -> storage over real HTTP: append commits a
+    new generation, validation failures come back as 400 envelopes."""
+    from kubeml_tpu.control.client import KubemlClient
+    from kubeml_tpu.control.deployment import start_deployment
+
+    dep = start_deployment(mesh=mesh8)
+    try:
+        client = KubemlClient(dep.controller_url)
+        paths = {}
+        xtr, ytr = _split(256, seed=0)
+        xte, yte = _split(64, seed=1)
+        xa, ya = _split(128, seed=7)
+        for name, arr in (("xtr", xtr), ("ytr", ytr), ("xte", xte),
+                          ("yte", yte), ("xa", xa), ("ya", ya)):
+            p = tmp_path / f"{name}.npy"
+            np.save(p, arr)
+            paths[name] = str(p)
+        client.v1().datasets().create("blobs", paths["xtr"], paths["ytr"],
+                                      paths["xte"], paths["yte"])
+        out = client.v1().datasets().append(
+            "blobs", paths["xa"], paths["ya"], retention=4)
+        assert out["generation"] == 2
+        assert out["train_set_size"] == 384
+
+        # non-monotonic producer tag -> 400, nothing committed
+        with pytest.raises(KubeMLException) as ei:
+            client.v1().datasets().append("blobs", paths["xa"],
+                                          paths["ya"], generation=1)
+        assert ei.value.status_code == 400
+        # dtype drift -> 400
+        p64 = tmp_path / "x64.npy"
+        np.save(p64, xa.astype(np.float64))
+        with pytest.raises(KubeMLException) as ei:
+            client.v1().datasets().append("blobs", str(p64), paths["ya"])
+        assert ei.value.status_code == 400
+        # unknown dataset -> 404
+        with pytest.raises(KubeMLException) as ei:
+            client.v1().datasets().append("nosuch", paths["xa"],
+                                          paths["ya"])
+        assert ei.value.status_code == 404
+        assert [s.train_set_size
+                for s in client.v1().datasets().list()] == [384]
+    finally:
+        dep.stop()
+
+
+# ----------------------------------------------------------- device cache
+
+
+def test_incremental_cache_bit_identical_on_grow_and_slide(tmp_home, mesh8):
+    """The incremental slab refresh (absolute-range overlap reuse) is
+    bit-identical to a cold layout for both a grown window (append) and
+    a slid window (retention drop), and no-ops on an unchanged one."""
+    from kubeml_tpu.data.device_cache import DeviceDatasetCache
+    from kubeml_tpu.data.sharding import plan_epoch
+
+    reg = DatasetRegistry()
+    _blobs(reg, n_train=256)
+    W = 2
+
+    def plan_for(h):
+        return plan_epoch(h.train_samples, W, 1, 16, h.subset_size)
+
+    def assert_matches_cold(inc, h):
+        cold = DeviceDatasetCache(h, mesh8, layout="sharded",
+                                  grow_quantum=inc.grow_quantum)
+        cold.ensure(plan_for(h), W)
+        for key in ("x", "y"):
+            np.testing.assert_array_equal(np.asarray(inc.arrays[key]),
+                                          np.asarray(cold.arrays[key]))
+
+    h1 = reg.get("blobs")
+    inc = DeviceDatasetCache(h1, mesh8, layout="sharded",
+                             incremental=True, grow_quantum=64)
+    assert inc.ensure(plan_for(h1), W)
+    assert inc.stats["uploads"] == 1
+
+    # grow: an append extends every lane's absolute range
+    reg.append("blobs", *_split(128, seed=7))
+    h2 = reg.get("blobs")
+    inc.refresh(h2)
+    assert inc.ensure(plan_for(h2), W)
+    assert_matches_cold(inc, h2)
+
+    # slide: retention expires the front, base advances
+    reg.append("blobs", *_split(64, seed=8), retention_generations=2)
+    h3 = reg.get("blobs")
+    assert h3.train_base == 256
+    inc.refresh(h3)
+    assert inc.ensure(plan_for(h3), W)
+    assert_matches_cold(inc, h3)
+    assert inc.stats["uploads"] == 3
+
+    # unchanged window: ensure is a no-op
+    inc.refresh(reg.get("blobs"))
+    assert inc.ensure(plan_for(h3), W) is False
+    assert inc.stats["uploads"] == 3
+
+
+def test_replicated_cache_reuploads_after_refresh(tmp_home, mesh8):
+    """The replicated layout keys its upload-once guard on the handle's
+    absolute window — a continual refresh that grew the dataset must
+    re-upload (the old existence-only guard froze generation 1)."""
+    from kubeml_tpu.data.device_cache import DeviceDatasetCache
+
+    reg = DatasetRegistry()
+    _blobs(reg, n_train=256)
+    h1 = reg.get("blobs")
+    cache = DeviceDatasetCache(h1, mesh8, layout="replicated")
+    assert cache.ensure()
+    assert cache.ensure() is False          # unchanged window: no-op
+    reg.append("blobs", *_split(64, seed=7))
+    cache.refresh(reg.get("blobs"))
+    assert cache.ensure()                   # window moved: re-upload
+    x, _ = (np.asarray(a) for a in reg.get("blobs").train_arrays())
+    np.testing.assert_array_equal(np.asarray(cache.arrays["x"]), x)
+
+
+# ------------------------------------------------------- sliding window
+
+
+def test_continual_job_follows_appends(tmp_home, mesh8):
+    """Appends land between epochs; the job's freshness pair tracks the
+    registry with zero lag (epoch N+1 trains the generation committed
+    during epoch N's publish)."""
+    reg = DatasetRegistry()
+    _blobs(reg)
+    store = HistoryStore()
+    seen = []
+
+    def publish(m):
+        seen.append((m.dataset_generation, m.data_lag_generations))
+        if len(seen) <= 2:
+            reg.append("blobs", *_split(64, seed=10 + len(seen)))
+
+    job = _continual_job(reg, mesh8, "ctfollow1", epochs=4, store=store,
+                         callbacks=JobCallbacks(publish_metrics=publish))
+    record = job.train()
+    assert seen == [(1, 0), (2, 0), (3, 0), (3, 0)]
+    assert len(record.data.train_loss) == 4
+    # the job stays checkpointed/inferable like any other
+    variables, manifest = load_checkpoint("ctfollow1")
+    assert manifest["job_id"] == "ctfollow1"
+
+
+def test_continual_refresh_survives_registry_failure(tmp_home, mesh8):
+    """A transient registry failure at the epoch boundary keeps the
+    current window (and the job alive) instead of failing the run."""
+    reg = DatasetRegistry()
+    _blobs(reg)
+    seen = []
+
+    real_get = reg.get
+
+    def flaky_get(name, window_generations=0):
+        if seen and len(seen) == 1:
+            raise OSError("registry briefly unreadable")
+        return real_get(name, window_generations=window_generations)
+
+    def publish(m):
+        seen.append((m.dataset_generation, m.data_lag_generations))
+
+    reg.get = flaky_get
+    job = _continual_job(reg, mesh8, "ctflaky1", epochs=3,
+                         callbacks=JobCallbacks(publish_metrics=publish))
+    job.train()
+    assert job.task.state == "finished"
+    assert seen == [(1, 0), (1, 0), (1, 0)]
+
+
+def test_stale_data_fault_drives_staleness_rule(tmp_home, mesh8):
+    """The `stale_data` fault suppresses the epoch-boundary refresh, so
+    the registry pulls ahead deterministically; the data_staleness
+    health rule fires past the lag limit and stays quiet for
+    non-continual samples."""
+    from kubeml_tpu.control.health import default_rules
+
+    reg = DatasetRegistry()
+    _blobs(reg)
+    seen = []
+
+    def publish(m):
+        seen.append((m.dataset_generation, m.data_lag_generations))
+        if len(seen) <= 3:
+            reg.append("blobs", *_split(64, seed=10 + len(seen)))
+
+    job = _continual_job(
+        reg, mesh8, "ctstale1", epochs=5,
+        callbacks=JobCallbacks(publish_metrics=publish),
+        fault_plan=json.dumps([{"kind": "stale_data"}]))
+    job.train()
+    # trained generation pinned at 1, lag grows with each append
+    assert seen == [(1, 0), (1, 1), (1, 2), (1, 3), (1, 3)]
+    assert job._fault_plan.injected["stale_data"] == 5
+
+    rule = {r.name: r for r in default_rules()}["data_staleness"]
+    detail = rule.check([{"dataset_generation": 1,
+                          "data_lag_generations": 3}])
+    assert detail and "3 generation(s) ahead" in detail
+    assert rule.check([{"data_lag_generations": 2}]) is None  # at limit
+    assert rule.check([{"data_lag_generations": -1}]) is None  # wire default
+    assert rule.check([{}]) is None                # pre-continual samples
+
+
+def test_continual_window_generations_slides_training_window(tmp_home,
+                                                             mesh8):
+    """window_generations caps the trained window: after retention +
+    appends the job's loader sees only the newest generations (doc
+    aligned), not the whole retained set."""
+    reg = DatasetRegistry()
+    _blobs(reg)
+    reg.append("blobs", *_split(128, seed=7))
+
+    job = _continual_job(reg, mesh8, "ctwin1", epochs=1, window_generations=1)
+    job.train()
+    assert job._handle.train_samples == 128
+    assert job._handle.train_offset == 256
+
+
+def test_continual_option_validation_400s(tmp_home, mesh8):
+    """Misconfigured continual options 400 before any data loads."""
+    cases = [
+        (dict(window_generations=-1), "must be >= 0"),
+        (dict(publish_every_rounds=-1), "must be >= 0"),
+        (dict(window_generations=2), "require"),
+        (dict(publish_every_rounds=2), "require"),
+        (dict(continual=True, publish_every_rounds=2, engine="syncdp"),
+         "kavg"),
+    ]
+    reg = DatasetRegistry()
+    _blobs(reg)
+    for optkw, needle in cases:
+        task = make_task(job_id="ctbad1", epochs=2)
+        for key, val in optkw.items():
+            setattr(task.parameters.options, key, val)
+        model = get_builtin("mlp")(hidden=16, num_classes=CLASSES)
+        job = TrainJob(task, model, ToyDataset(), mesh8, registry=reg)
+        with pytest.raises(KubeMLException) as ei:
+            job.train()
+        assert ei.value.status_code == 400
+        assert needle in ei.value.message
+
+
+def test_mid_window_restart_resumes_bit_identical(tmp_path, tmp_home,
+                                                  mesh8):
+    """A continual job preempted mid-window (after a generation slide)
+    resumes from its round cursor and finishes with weights
+    bit-identical to an uninterrupted run over the same generation
+    sequence (each run gets its own registry root so both replay
+    create -> train gen 1 -> append gen 2 -> train gen 2)."""
+    import jax
+
+    def run(tag, interrupt):
+        reg = DatasetRegistry(root=str(tmp_path / f"reg-{tag}"))
+        _blobs(reg)
+        job_id = f"ctres{tag}"
+        optkw = dict(checkpoint_every_rounds=2)
+        if interrupt:
+            optkw["fault_plan"] = json.dumps(
+                [{"kind": "preempt", "epoch": 1, "round": 3}])
+
+        def publish(m):
+            if reg.get("blobs").generation == 1:
+                reg.append("blobs", *_split(64, seed=77))
+
+        cb = JobCallbacks(publish_metrics=publish)
+        job = _continual_job(reg, mesh8, job_id, epochs=2, callbacks=cb,
+                             **optkw)
+        if interrupt:
+            with pytest.raises(JobPreemptedError):
+                job.train()
+            assert job.task.state == "preempted"
+            _, manifest = load_checkpoint(job_id)
+            ts = manifest["train_state"]
+            assert (ts["epoch"], ts["round"]) == (1, 4)
+            resumed = _continual_job(reg, mesh8, job_id, epochs=2,
+                                     callbacks=cb, resume=True, **optkw)
+            resumed.train()
+            assert resumed.task.state == "finished"
+        else:
+            job.train()
+        variables, _ = load_checkpoint(job_id)
+        return [np.asarray(l)
+                for l in jax.tree_util.tree_leaves(variables)]
+
+    clean = run("a", interrupt=False)
+    resumed = run("b", interrupt=True)
+    assert len(clean) == len(resumed)
+    for la, lb in zip(clean, resumed):
+        np.testing.assert_array_equal(la, lb)
+
+
+# ------------------------------------------------------------- hot-swap
+
+
+def _nano(key=0):
+    import jax
+
+    model = get_builtin("gpt-nano")()
+    module = model.module
+    variables = model.init_variables(
+        jax.random.PRNGKey(key),
+        {"x": np.ones((1, module.max_len), np.int32)})
+    return module, variables
+
+
+def _drive(engine, limit=10_000):
+    finished = []
+    while engine.active():
+        finished.extend(engine.step())
+        limit -= 1
+        assert limit > 0, "engine failed to drain"
+    return finished
+
+
+def _step_until(engine, pred, limit=10_000):
+    while not pred():
+        engine.step()
+        limit -= 1
+        assert limit > 0, "engine never reached the awaited state"
+
+
+def _solo_tokens(module, variables, prompt, n_new, **engine_kw):
+    from kubeml_tpu.serve.engine import DecodeEngine
+    from kubeml_tpu.serve.slots import GenerateRequest
+
+    engine = DecodeEngine(module, variables, **engine_kw)
+    req = GenerateRequest(list(prompt), max_new_tokens=n_new)
+    engine.attach(req)
+    _drive(engine)
+    assert req.outcome == "ok"
+    return req.tokens
+
+
+def test_swap_attach_old_and_new_generations_bit_identical():
+    """Streams attached before a swap decode the OLD weights to the
+    end; streams admitted after decode the new ones — both
+    bit-identical to a solo engine on their generation, with the decode
+    program compiled exactly once across the swap."""
+    from kubeml_tpu.serve.engine import SWAP_PATH_VARIANTS, DecodeEngine
+    from kubeml_tpu.serve.slots import GenerateRequest
+
+    assert "swap_attach_old" in SWAP_PATH_VARIANTS
+    assert "swap_attach_new" in SWAP_PATH_VARIANTS
+    module, v1 = _nano(0)
+    _, v2 = _nano(1)
+
+    engine = DecodeEngine(module, v1, slots=4, page=4)
+    old = GenerateRequest([5, 6, 7], max_new_tokens=8)
+    engine.attach(old)
+    _step_until(engine, lambda: len(old.tokens) >= 2)
+
+    assert engine.install_weights(v2) == 2
+    assert engine.active_generations() == [1, 2]
+    new = GenerateRequest([9, 10, 11], max_new_tokens=6)
+    engine.attach(new)
+    _drive(engine)
+
+    assert old.outcome == "ok" and new.outcome == "ok"
+    np.testing.assert_array_equal(
+        old.tokens, _solo_tokens(module, v1, [5, 6, 7], 8,
+                                 slots=4, page=4))
+    np.testing.assert_array_equal(
+        new.tokens, _solo_tokens(module, v2, [9, 10, 11], 6,
+                                 slots=4, page=4))
+    # different inits really decode differently (the swap is observable)
+    assert old.tokens != _solo_tokens(module, v2, [5, 6, 7], 8,
+                                      slots=4, page=4)
+    # compile pinning: the per-generation dispatch reuses the same two
+    # compiled programs — a swap is data, not a new program
+    assert engine.stats["compiles"] == 1
+    assert engine.stats["weight_swaps"] == 1
+
+
+def test_swap_mid_stream_never_changes_inflight_tokens():
+    """A swap landing between two decode steps of one stream does not
+    perturb that stream: its full token sequence (pre- and post-swap
+    steps) equals a solo run on the attach-time weights."""
+    from kubeml_tpu.serve.engine import SWAP_PATH_VARIANTS, DecodeEngine
+    from kubeml_tpu.serve.slots import GenerateRequest
+
+    assert "swap_mid_stream" in SWAP_PATH_VARIANTS
+    module, v1 = _nano(0)
+    _, v2 = _nano(1)
+
+    engine = DecodeEngine(module, v1, slots=2, page=4)
+    req = GenerateRequest([5, 6, 7, 8], max_new_tokens=10)
+    engine.attach(req)
+    _step_until(engine, lambda: len(req.tokens) >= 4)
+    pre_swap = list(req.tokens)
+    engine.install_weights(v2)
+    _drive(engine)
+
+    assert req.outcome == "ok" and len(req.tokens) == 10
+    assert req.tokens[:len(pre_swap)] == pre_swap
+    np.testing.assert_array_equal(
+        req.tokens, _solo_tokens(module, v1, [5, 6, 7, 8], 10,
+                                 slots=2, page=4))
+
+
+def test_swap_cache_partition_no_cross_generation_prefix_hits():
+    """The prefix cache is partitioned by weight generation: KV pages
+    cached under the old weights are NEVER served to a post-swap
+    stream, even for an identical prompt (same-generation sharing keeps
+    working)."""
+    from kubeml_tpu.serve.engine import SWAP_PATH_VARIANTS, DecodeEngine
+    from kubeml_tpu.serve.slots import GenerateRequest
+
+    assert "swap_cache_partition" in SWAP_PATH_VARIANTS
+    module, v1 = _nano(0)
+    _, v2 = _nano(1)
+    prompt = [5, 6, 7, 8, 9, 10, 11, 12]
+
+    engine = DecodeEngine(module, v1, slots=4, page=4, prefill_chunk=4)
+    # a long stream keeps generation 1 pinned across the swap, so its
+    # cache partition stays resident (retirement would also drop it)
+    hold = GenerateRequest([3], max_new_tokens=32)
+    engine.attach(hold)
+    r1 = GenerateRequest(list(prompt), max_new_tokens=2)
+    engine.attach(r1)
+    _step_until(engine, lambda: r1.outcome is not None)
+    assert engine.stats["prefix_hits"] == 0
+
+    # same generation, same prompt: the cached prompt pages ARE shared
+    r2 = GenerateRequest(list(prompt), max_new_tokens=2)
+    engine.attach(r2)
+    _step_until(engine, lambda: r2.outcome is not None)
+    same_gen_hits = engine.stats["prefix_hits"]
+    assert same_gen_hits > 0
+    np.testing.assert_array_equal(r1.tokens, r2.tokens)
+
+    engine.install_weights(v2)
+    assert engine.active_generations() == [1, 2]
+    # post-swap, identical prompt: must MISS the generation-1 pages
+    r3 = GenerateRequest(list(prompt), max_new_tokens=2)
+    engine.attach(r3)
+    _step_until(engine, lambda: r3.outcome is not None)
+    assert engine.stats["prefix_hits"] == same_gen_hits
+    np.testing.assert_array_equal(
+        r3.tokens, _solo_tokens(module, v2, prompt, 2,
+                                slots=4, page=4, prefill_chunk=4))
+    _drive(engine)
+    assert hold.outcome == "ok"
+    assert engine.active_generations() == [2]
+
+
+def test_pager_prefix_partition_and_drop_generation():
+    """Allocator-level regression for the cache partition: the same
+    chain hash resolves per generation, and drop_generation retires
+    exactly its partition — parked pages return to the free list,
+    still-referenced ones free on their stream's release."""
+    from kubeml_tpu.serve.pager import (PageAllocator, PageGeometry,
+                                        chain_hash)
+
+    geom = PageGeometry(slots=2, page=4, pages=8, pages_per_slot=4)
+    pager = PageAllocator(geom)
+    digest = chain_hash(b"", [7, 8, 9, 10])
+
+    p1 = pager.alloc()
+    assert pager.register_prefix(p1, digest, gen=1)
+    p2 = pager.alloc()
+    assert pager.register_prefix(p2, digest, gen=2)  # same hash, new gen
+
+    assert pager.lookup_prefix(digest, gen=1) == p1
+    assert pager.lookup_prefix(digest, gen=2) == p2
+    assert pager.lookup_prefix(digest, gen=3) is None
+    pager.free([p1])          # drop the lookup ref
+    pager.free([p2])
+
+    pager.free([p1])          # last ref: parks in the LRU (registered)
+    assert pager.evictable_pages == 1
+    free_before = pager.free_pages
+    assert pager.drop_generation(1) == 1
+    # the parked generation-1 page went straight back to the free list
+    assert pager.free_pages == free_before + 1
+    assert pager.evictable_pages == 0
+    assert pager.lookup_prefix(digest, gen=1) is None
+    # generation 2's partition is untouched
+    assert pager.lookup_prefix(digest, gen=2) == p2
+    pager.free([p2])
+
+    # a still-referenced page survives the drop and frees on release
+    assert pager.drop_generation(2) == 1
+    assert pager.refcount(p2) == 1      # the stream still holds it
+    free_before = pager.free_pages
+    pager.free([p2])
+    assert pager.free_pages == free_before + 1
+
+
+def test_swap_drain_free_retires_old_generation():
+    """When the last stream pinned to an old generation releases, the
+    generation's params drop and its cache partition frees — the pool
+    returns to fully-free and only the live generation stays resident;
+    an idle engine holds exactly one generation after a swap."""
+    from kubeml_tpu.serve.engine import SWAP_PATH_VARIANTS, DecodeEngine
+    from kubeml_tpu.serve.slots import GenerateRequest
+
+    assert "swap_drain_free" in SWAP_PATH_VARIANTS
+    module, v1 = _nano(0)
+    _, v2 = _nano(1)
+    _, v3 = _nano(2)
+
+    engine = DecodeEngine(module, v1, slots=2, page=4)
+    req = GenerateRequest([5, 6, 7, 8], max_new_tokens=6)
+    engine.attach(req)
+    _step_until(engine, lambda: len(req.tokens) >= 1)
+    engine.install_weights(v2)
+    assert engine.active_generations() == [1, 2]
+    assert engine.stats["generations_retired"] == 0
+
+    _drive(engine)
+    assert req.outcome == "ok"
+    # last generation-1 reader detached: params + cache partition freed
+    assert engine.active_generations() == [2]
+    assert engine.stats["generations_retired"] == 1
+    assert engine.pager.evictable_pages == 0
+    assert engine.pager.in_use == 0
+    assert engine.pager.free_pages == engine.geom.pages - 1
+
+    # idle swap: the superseded generation retires immediately
+    engine.install_weights(v3)
+    assert engine.active_generations() == [3]
+    assert engine.stats["generations_retired"] == 2
+
+
+def test_service_hot_swap_e2e_zero_shed():
+    """The serving loop across TWO hot-swaps: every stream finishes ok
+    (zero shed, zero errors), each decodes bit-identically to a solo
+    engine on the generation it was admitted under, and the snapshot's
+    weight-generation telemetry lands on the final generation."""
+    from kubeml_tpu.serve.engine import DecodeEngine
+    from kubeml_tpu.serve.service import ServeService
+
+    module, v1 = _nano(0)
+    _, v2 = _nano(1)
+    _, v3 = _nano(2)
+    engine = DecodeEngine(module, v1, slots=4, page=4)
+    svc = ServeService("m1", engine, max_queue=8).start()
+    try:
+        a = svc.submit([5, 6, 7], max_new_tokens=48)
+        deadline = time.time() + 60
+        while a.first_token_at is None and time.time() < deadline:
+            time.sleep(0.005)
+        assert a.first_token_at is not None
+
+        svc.install_weights(v2, stamp=2.0)
+        b = svc.submit([9, 10, 11], max_new_tokens=8)
+        assert b.wait(60) and b.outcome == "ok"
+
+        svc.install_weights(v3, stamp=3.0)
+        c = svc.submit([4, 5], max_new_tokens=8)
+        assert c.wait(60) and c.outcome == "ok"
+        assert a.wait(60) and a.outcome == "ok"
+
+        assert svc.rejected_total == 0          # nothing shed
+        np.testing.assert_array_equal(
+            a.tokens, _solo_tokens(module, v1, [5, 6, 7], 48,
+                                   slots=4, page=4))
+        np.testing.assert_array_equal(
+            b.tokens, _solo_tokens(module, v2, [9, 10, 11], 8,
+                                   slots=4, page=4))
+        np.testing.assert_array_equal(
+            c.tokens, _solo_tokens(module, v3, [4, 5], 8,
+                                   slots=4, page=4))
+
+        assert engine.stats["weight_swaps"] == 2
+        assert engine.stats["compiles"] == 1    # swaps are data
+        assert svc.weight_stamp == 3.0
+        snap = svc.snapshot()
+        assert snap["serve_weight_generation"] == 3
+        assert snap["serve_active_generations"] == 1
+        assert engine.active_generations() == [3]
+    finally:
+        svc.stop()
+
+
+def test_ps_checkpoint_stamp_triggers_hot_swap(tmp_home, mesh8):
+    """control/ps._serve_service: a changed checkpoint saved_at stamp
+    installs the new weights into the LIVE service (generation bumps,
+    same engine object) instead of rebuilding it."""
+    import jax
+
+    from kubeml_tpu.control.ps import ParameterServer
+    from kubeml_tpu.train.checkpoint import save_checkpoint
+
+    model = get_builtin("gpt-nano")()
+    module = model.module
+    v1 = model.init_variables(
+        jax.random.PRNGKey(0),
+        {"x": np.ones((1, module.max_len), np.int32)})
+    v2 = model.init_variables(
+        jax.random.PRNGKey(1),
+        {"x": np.ones((1, module.max_len), np.int32)})
+    manifest = {"model": "gpt-nano", "function": "gpt-nano", "epoch": 1}
+
+    ps = ParameterServer(mesh=mesh8, port=0)
+    try:
+        save_checkpoint("swapjob1", v1, dict(manifest))
+        svc1 = ps._serve_service("swapjob1")
+        assert svc1.engine.weight_generation == 1
+        # same stamp: same service, no swap
+        assert ps._serve_service("swapjob1") is svc1
+        assert svc1.engine.stats["weight_swaps"] == 0
+
+        time.sleep(0.01)  # saved_at stamps must differ
+        save_checkpoint("swapjob1", v2, dict(manifest))
+        svc2 = ps._serve_service("swapjob1")
+        assert svc2 is svc1                      # live service reused
+        deadline = time.time() + 30
+        while svc1.engine.stats["weight_swaps"] < 1 \
+                and time.time() < deadline:
+            time.sleep(0.01)
+        assert svc1.engine.stats["weight_swaps"] == 1
+        assert svc1.engine.active_generations() == [2]
+    finally:
+        ps.stop()
+
+
+# -------------------------------------------------- publish cadence
+
+
+def test_publish_every_rounds_saves_mid_epoch(tmp_home, mesh8,
+                                              monkeypatch):
+    """publish_every_rounds emits round-granular checkpoint saves on
+    its own cadence (serving picks them up by stamp), independent of
+    checkpoint_every_rounds."""
+    import kubeml_tpu.train.job as job_mod
+
+    reg = DatasetRegistry()
+    _blobs(reg)  # 256 samples / 16 subset / W=2, k=1, b=16 -> 8 rounds
+    saves = []
+
+    job = _continual_job(reg, mesh8, "ctpub1", epochs=1, publish_every_rounds=2)
+    real_save = job._checkpointer.save
+
+    def spy(job_id, variables, manifest):
+        saves.append(manifest.get("train_state", {}).get("round"))
+        return real_save(job_id, variables, manifest)
+
+    monkeypatch.setattr(job._checkpointer, "save", spy)
+    job.train()
+    # rounds 2/4/6/8 hit the publish cadence (mid-epoch, round cursor
+    # in the manifest so a crash also resumes there)
+    assert [r for r in saves if r is not None] == [2, 4, 6, 8]
+
+
+# ------------------------------------------------------------ lint + CLI
+
+
+def test_check_swap_safety_lint_passes_on_repo():
+    """The lint itself, over the real tree: every swap path variant is
+    covered by this file's tests."""
+    import os
+
+    from kubeml_tpu.serve.engine import SWAP_PATH_VARIANTS
+    from tools.check_swap_safety import main, path_variants
+
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    engine_path = os.path.join(root, "kubeml_tpu", "serve", "engine.py")
+    assert tuple(path_variants(engine_path)) == SWAP_PATH_VARIANTS
+    assert main(["check_swap_safety.py", root]) == 0
+
+
+def test_check_swap_safety_lint_selftest(tmp_path):
+    """The lint catches an uncovered variant, ignores comment-only
+    mentions, and fails loudly when the registry is missing."""
+    from tools.check_swap_safety import main, uncovered_variants
+
+    eng_dir = tmp_path / "kubeml_tpu" / "serve"
+    eng_dir.mkdir(parents=True)
+    tests_dir = tmp_path / "tests"
+    tests_dir.mkdir()
+    engine = eng_dir / "engine.py"
+    engine.write_text(
+        'SWAP_PATH_VARIANTS = (\n    "covered_swap",\n'
+        '    "naked_swap",\n)\n')
+    (tests_dir / "test_ok.py").write_text(
+        'import numpy as np\n'
+        'def test_covered():\n'
+        '    # naked_swap mentioned in a comment only: does not count\n'
+        '    variant = "covered_swap"\n'
+        '    np.testing.assert_array_equal([1], [1])\n')
+    assert uncovered_variants(str(engine), str(tests_dir)) == ["naked_swap"]
+    assert main(["lint", str(tmp_path)]) == 1
+    (tests_dir / "test_fix.py").write_text(
+        'def test_naked(engine):\n'
+        '    assert "naked_swap"\n'
+        '    assert engine.pager.drop_generation(1) == 0\n')
+    assert main(["lint", str(tmp_path)]) == 0
+    engine.write_text("SWAP_PATH_VARIANTS = ()\n")
+    assert main(["lint", str(tmp_path)]) == 1
+
+
+def test_top_renders_continual_pane():
+    from kubeml_tpu.cli.main import _render_top
+
+    doc = {"id": "job1", "state": "healthy", "reasons": [],
+           "latest": {"train_loss": 0.5, "dataset_generation": 4,
+                      "data_lag_generations": 1,
+                      "serve_weight_generation": 3}}
+    out = _render_top(doc)
+    assert "continual: trained gen 4" in out
+    assert "registry lag 1 gen" in out
+    assert "served gen 3" in out
+    # non-continual samples (wire default -1, or absent) have no pane
+    for latest in ({"train_loss": 0.5},
+                   {"train_loss": 0.5, "data_lag_generations": -1}):
+        plain = _render_top({"id": "job1", "state": "healthy",
+                             "reasons": [], "latest": latest})
+        assert "continual:" not in plain
+
+
+def test_cli_train_continual_flag_validation(tmp_home):
+    """The CLI gate: --epochs 0 needs --continual; the continual knobs
+    need --continual; --publish-every-rounds needs the kavg engine.
+    Every failure exits before any network call."""
+    from kubeml_tpu.cli.main import build_parser, cmd_train
+
+    parser = build_parser()
+    base = ["--controller", "http://127.0.0.1:1", "train", "-f", "m",
+            "-d", "ds", "--lr", "0.1"]
+    bad = [
+        ["-e", "0"],
+        ["-e", "2", "--window-generations", "2"],
+        ["-e", "2", "--publish-every-rounds", "4"],
+        ["-e", "2", "--continual", "--window-generations", "-1"],
+        ["-e", "0", "--continual", "--publish-every-rounds", "4",
+         "--engine", "syncdp"],
+    ]
+    for extra in bad:
+        with pytest.raises(SystemExit) as ei:
+            cmd_train(parser.parse_args(base + extra))
+        assert ei.value.code == 1
+
+
+def test_cli_dataset_append_subcommand_parses():
+    from kubeml_tpu.cli.main import build_parser, cmd_dataset_append
+
+    args = build_parser().parse_args(
+        ["dataset", "append", "-n", "blobs", "--traindata", "x.npy",
+         "--trainlabels", "y.npy", "--generation", "5",
+         "--retention", "3"])
+    assert args.fn is cmd_dataset_append
+    assert (args.name, args.generation, args.retention) == ("blobs", 5, 3)

@@ -1,0 +1,123 @@
+#!/usr/bin/env python3
+"""Lint: every weight-hot-swap path variant must have a safety test.
+
+A zero-downtime weight swap (kubeml_tpu/serve/engine.py
+install_weights) makes one promise with several failure surfaces:
+streams attached before the swap finish on their pinned generation,
+streams admitted after decode under the new one, a mid-stream swap
+never changes an in-flight stream's tokens, the prefix cache never
+serves a page across generations, and a retired generation's weights
+and cache partition actually free. Each surface is a SWAP_PATH_VARIANTS
+entry; this lint fails unless each name appears (quoted, in executable
+code) in some tests/ file that also makes an exactness or liveness
+assertion about swap behavior.
+
+Run directly (exit 1 on violation) or via tests/test_continual.py,
+which keeps the lint itself in the tier-1 suite:
+
+    python tools/check_swap_safety.py [repo_root]
+"""
+
+from __future__ import annotations
+
+import io
+import os
+import re
+import sys
+import tokenize
+
+# an assertion that backs a swap-safety claim: bit-identity of decoded
+# tokens, or an exact-equality claim over generations/pages/frees
+SAFETY_TOKENS = (
+    "assert_array_equal",
+    "assert_allclose",
+    "active_generations",
+    "drop_generation",
+)
+
+_VARIANTS_RE = re.compile(
+    r"SWAP_PATH_VARIANTS\s*=\s*\(([^)]*)\)", re.DOTALL)
+_NAME_RE = re.compile(r"['\"]([A-Za-z0-9_]+)['\"]")
+
+
+def path_variants(engine_path: str) -> list:
+    """Variant names declared in engine.py's SWAP_PATH_VARIANTS."""
+    with open(engine_path, encoding="utf-8") as f:
+        m = _VARIANTS_RE.search(f.read())
+    if m is None:
+        return []
+    return _NAME_RE.findall(m.group(1))
+
+
+def _code_lines(path: str):
+    """Yield (lineno, source) for non-comment code lines. STRING tokens
+    are KEPT (variant names appear as string literals in tests);
+    comments are dropped so a mention in prose doesn't count."""
+    with open(path, "rb") as f:
+        src = f.read()
+    lines = {}
+    try:
+        for tok in tokenize.tokenize(io.BytesIO(src).readline):
+            if tok.type in (tokenize.COMMENT, tokenize.ENCODING):
+                continue
+            lines.setdefault(tok.start[0], []).append(tok.string)
+    except tokenize.TokenError:
+        # fall back to raw lines; better a false positive than a skip
+        for i, line in enumerate(src.decode("utf-8", "replace").split("\n")):
+            lines.setdefault(i + 1, []).append(line)
+    for no in sorted(lines):
+        yield no, "".join(lines[no])
+
+
+def file_covers(path: str, name: str) -> bool:
+    """True when `path` names the variant (quoted, in code) AND makes a
+    swap-safety assertion somewhere in its code."""
+    quoted = (f'"{name}"', f"'{name}'")
+    named = has_safety = False
+    for _no, code in _code_lines(path):
+        if not named and any(q in code for q in quoted):
+            named = True
+        if not has_safety and any(t in code for t in SAFETY_TOKENS):
+            has_safety = True
+        if named and has_safety:
+            return True
+    return False
+
+
+def uncovered_variants(engine_path: str, tests_dir: str) -> list:
+    names = path_variants(engine_path)
+    test_files = []
+    for dirpath, _dirs, files in os.walk(tests_dir):
+        for fname in sorted(files):
+            if fname.startswith("test_") and fname.endswith(".py"):
+                test_files.append(os.path.join(dirpath, fname))
+    return [n for n in names
+            if not any(file_covers(p, n) for p in test_files)]
+
+
+def main(argv) -> int:
+    root = argv[1] if len(argv) > 1 else os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__)))
+    engine_path = os.path.join(root, "kubeml_tpu", "serve", "engine.py")
+    tests_dir = os.path.join(root, "tests")
+    names = path_variants(engine_path)
+    if not names:
+        print(f"{engine_path}: no SWAP_PATH_VARIANTS found — lint is "
+              "miswired", file=sys.stderr)
+        return 1
+    missing = uncovered_variants(engine_path, tests_dir)
+    for n in missing:
+        print(f"swap path variant {n!r} has no safety test: no tests/ "
+              f"file both names it and asserts swap safety "
+              f"({' / '.join(SAFETY_TOKENS)})", file=sys.stderr)
+    if missing:
+        print(f"\n{len(missing)} unverified swap path"
+              f"{'' if len(missing) == 1 else 's'}: every variant in "
+              "kubeml_tpu/serve/engine.py SWAP_PATH_VARIANTS needs a "
+              "quoted-name swap-safety test", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv))
