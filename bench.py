@@ -767,6 +767,43 @@ def _measure_serving_arm() -> dict:
     for req in burst:
         req.wait(timeout=60.0)
     svc.stop()
+
+    # -- recorder-overhead pin: the flight recorder + tracer must not
+    # perturb the engine — same compiles, same dispatch count, and
+    # bit-identical tokens with instrumentation on vs off. Requests run
+    # serially so the batching schedule is deterministic either way.
+    from kubeml_tpu.utils.trace import Tracer
+
+    PIN_REQUESTS = 4
+
+    def pin_run(flight_steps, tracer):
+        eng = DecodeEngine(module, variables, slots=SLOTS,
+                           flight_steps=flight_steps, tracer=tracer)
+        s = ServeService("bench-pin", eng, max_queue=QUEUE,
+                         tracer=tracer).start()
+        toks = [list(drain(s.submit(prompt(i),
+                                    max_new_tokens=NEW_TOKENS)).tokens)
+                for i in range(PIN_REQUESTS)]
+        s.stop()
+        return dict(eng.stats), toks
+
+    on_stats, on_toks = pin_run(256, Tracer(clock=time.perf_counter))
+    off_stats, off_toks = pin_run(0, None)
+    assert on_toks == off_toks, \
+        "recorder/tracer changed decoded tokens"
+    assert on_stats["compiles"] == off_stats["compiles"], \
+        (on_stats["compiles"], off_stats["compiles"])
+    assert on_stats["dispatches"] == off_stats["dispatches"], \
+        (on_stats["dispatches"], off_stats["dispatches"])
+    recorder_overhead = {
+        "requests": PIN_REQUESTS,
+        "decode_compiles_on": int(on_stats["compiles"]),
+        "decode_compiles_off": int(off_stats["compiles"]),
+        "dispatches_on": int(on_stats["dispatches"]),
+        "dispatches_off": int(off_stats["dispatches"]),
+        "tokens_bit_identical": True,
+    }
+
     return {
         "model": "gpt-nano", "slots": SLOTS, "queue": QUEUE,
         "prompt_tokens": PROMPT_LEN, "new_tokens": NEW_TOKENS,
@@ -774,6 +811,7 @@ def _measure_serving_arm() -> dict:
         "closed_loop": [arm_c1, arm_cn],
         "burst_submitted": 3 * SLOTS,
         "burst_shed_429": shed,
+        "recorder_overhead": recorder_overhead,
     }
 
 
@@ -926,6 +964,46 @@ def _measure_prefill_arm() -> dict:
         "ttft_warm_p50_s": pct(ttfts_warm, 0.50),
         "ttft_warm_p99_s": pct(ttfts_warm, 0.99),
     }
+
+    # -- recorder-overhead pin: chunked prefill under the flight
+    # recorder + tracer must dispatch the same programs the same number
+    # of times and decode the same tokens as the bare engine. Serial
+    # requests on fresh engines keep both runs deterministic.
+    from kubeml_tpu.utils.trace import Tracer
+
+    PIN_REQUESTS = 2
+
+    def pin_run(flight_steps, tracer):
+        eng = DecodeEngine(module, variables, slots=SLOTS, page=CHUNK,
+                           prefill_chunk=CHUNK, flight_steps=flight_steps,
+                           tracer=tracer)
+        s = ServeService("bench-prefill-pin", eng, max_queue=SLOTS,
+                         tracer=tracer).start()
+        toks = [list(drain(s.submit(prompt(5000 + i),
+                                    max_new_tokens=NEW_TOKENS)).tokens)
+                for i in range(PIN_REQUESTS)]
+        s.stop()
+        return dict(eng.stats), toks
+
+    on_stats, on_toks = pin_run(256, Tracer(clock=time.perf_counter))
+    off_stats, off_toks = pin_run(0, None)
+    assert on_toks == off_toks, \
+        "recorder/tracer changed decoded tokens"
+    for key in ("compiles", "prefill_compiles", "dispatches",
+                "prefill_dispatches"):
+        assert on_stats[key] == off_stats[key], \
+            (key, on_stats[key], off_stats[key])
+    recorder_overhead = {
+        "requests": PIN_REQUESTS,
+        "decode_compiles_on": int(on_stats["compiles"]),
+        "decode_compiles_off": int(off_stats["compiles"]),
+        "prefill_compiles_on": int(on_stats["prefill_compiles"]),
+        "prefill_compiles_off": int(off_stats["prefill_compiles"]),
+        "prefill_dispatches_on": int(on_stats["prefill_dispatches"]),
+        "prefill_dispatches_off": int(off_stats["prefill_dispatches"]),
+        "tokens_bit_identical": True,
+    }
+
     return {
         "model": "gpt-longctx-bench",
         "slots": SLOTS,
@@ -936,6 +1014,7 @@ def _measure_prefill_arm() -> dict:
         "decode_compiles": decode_compiles,
         "concurrent": concurrent,
         "prefix_mix": prefix_mix,
+        "recorder_overhead": recorder_overhead,
     }
 
 

@@ -377,6 +377,15 @@ def _render_top(doc: dict) -> str:
             f"prefill backlog "
             f"{latest.get('serve_prefill_backlog_tokens', 0):g}  "
             f"prefix hit {latest.get('serve_prefix_hit_pct', 0):g}%")
+        if latest.get("serve_ttft_queue_s") is not None:
+            # TTFT attribution (recent-window means): where the first
+            # token's latency went — admission queueing, prefill
+            # compute, or interleave delay behind co-resident decode
+            lines.append(
+                f"ttft breakdown: queue "
+                f"{_ms(latest.get('serve_ttft_queue_s'))}  prefill "
+                f"{_ms(latest.get('serve_ttft_prefill_s'))}  interleave "
+                f"{_ms(latest.get('serve_ttft_interleave_s'))}")
     if latest.get("data_lag_generations") is not None \
             and float(latest.get("data_lag_generations", -1)) >= 0:
         # continual pane: dataset freshness — the generation the job last

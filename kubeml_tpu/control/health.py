@@ -273,6 +273,10 @@ _SAMPLE_FIELDS = ("train_loss", "validation_loss", "accuracy",
                   "serve_queue_depth", "serve_queue_cap",
                   "serve_kv_page_utilization", "serve_rejected_total",
                   "serve_ttft_p50", "serve_ttft_p99",
+                  # additive TTFT attribution (queue + prefill +
+                  # interleave == TTFT) — `kubeml top` breakdown line
+                  "serve_ttft_queue_s", "serve_ttft_prefill_s",
+                  "serve_ttft_interleave_s",
                   "serve_prefill_backlog_tokens", "serve_prefix_hit_pct",
                   "serve_weight_generation", "serve_active_generations",
                   # continual-plane freshness (train/job.py sliding

@@ -36,6 +36,7 @@ ml/cmd/ml/main.go:115-133):
 from __future__ import annotations
 
 import collections
+import json
 import logging
 import os
 import shutil
@@ -64,8 +65,9 @@ from kubeml_tpu.train.checkpoint import (checkpoint_saved_at,
 from kubeml_tpu.train.functionlib import FunctionRegistry
 from kubeml_tpu.train.history import HistoryStore
 from kubeml_tpu.train.job import JobCallbacks, TrainJob
-from kubeml_tpu.utils.trace import (TraceSink, Tracer, get_trace_context,
-                                    make_trace_id, merge_job_trace)
+from kubeml_tpu.utils.trace import (TRACE_HEADER, TraceSink, Tracer,
+                                    get_trace_context, make_trace_id,
+                                    merge_job_trace)
 
 logger = logging.getLogger("kubeml_tpu.ps")
 
@@ -375,6 +377,7 @@ class ParameterServer(JsonService):
         self.route("GET", "/tasks", self._h_tasks)
         self.route("GET", "/metrics", self._h_prom)
         self.route("GET", "/trace", self._h_trace)
+        self.route("GET", "/flight", self._h_flight)
         # replaces the base liveness route: without ?id= it still
         # answers {"ok": true}, with ?id=<jobId> it serves the job's
         # health verdict
@@ -495,8 +498,31 @@ class ParameterServer(JsonService):
             logger.warning("job %s health alert [%s/%s]: %s", job_id,
                            reason["severity"], reason["rule"],
                            reason["detail"])
+            if job_id.startswith("serve:"):
+                # SLO-breach onset on the serving plane: freeze the
+                # evidence — dump the engine flight ring into the trace
+                self._serve_flight_snapshot(job_id[len("serve:"):],
+                                            reason["rule"])
         self.metrics.set_health(
             job_id, self.health.verdict(job_id)["state"])
+
+    def _serve_flight_snapshot(self, model_id: str, rule: str) -> None:
+        """Auto-snapshot the model's flight recorder into its serve
+        trace on a health-rule onset. Reads the serve registry WITHOUT
+        _serve_lock: this runs on the serving-loop thread (health_cb),
+        which can hold the service condition variable — taking
+        _serve_lock here would invert against _serve_service's
+        install_weights (service cv acquired under _serve_lock) and
+        deadlock. A bare dict read is safe in CPython and staleness is
+        harmless (a just-swapped service simply snapshots nothing)."""
+        cur = self._serve.get(model_id)
+        if cur is None:
+            return
+        try:
+            cur[1].flight_snapshot(f"health:{rule}")
+        except Exception:
+            logger.exception("flight snapshot failed for serve:%s",
+                             model_id)
 
     def _h_health(self, req: Request):
         """Bare GET /health keeps the liveness contract every service
@@ -625,6 +651,30 @@ class ParameterServer(JsonService):
             return merge_job_trace(job_id)
         except FileNotFoundError:
             raise JobNotFoundError(f"{job_id} (no trace recorded)")
+
+    def _h_flight(self, req: Request):
+        """Drain the serving engine's flight recorder
+        (?id=serve:<model> or bare ?id=<model>): the last N loop-step
+        records, oldest first — the always-on black box the trace
+        auto-snapshots are cut from. Live state, not a file: shows what
+        the loop was doing RIGHT NOW even with no incident yet."""
+        job_id = req.query.get("id", "")
+        if not job_id:
+            raise InvalidArgsError("id query parameter required")
+        model_id = (job_id[len("serve:"):]
+                    if job_id.startswith("serve:") else job_id)
+        with self._serve_lock:
+            cur = self._serve.get(model_id)
+        if cur is None:
+            raise JobNotFoundError(
+                f"serve:{model_id} (no serving service running)")
+        fl = getattr(cur[1].engine, "flight", None)
+        if fl is None:
+            return {"id": f"serve:{model_id}", "model": model_id,
+                    "capacity": 0, "total_steps": 0, "records": []}
+        return {"id": f"serve:{model_id}", "model": model_id,
+                "capacity": fl.capacity, "total_steps": fl.total,
+                "records": fl.snapshot()}
 
     def _h_infer(self, req: Request):
         model_id = req.body.get("model_id")
@@ -760,10 +810,19 @@ class ParameterServer(JsonService):
             raise InvalidArgsError(
                 f"model {model_id} does not support streaming decode "
                 f"with the configured serve knobs: {e}") from e
+        # serving observability is always on in the product path: the
+        # tracer shares the service clock (perf_counter) so request
+        # spans and engine dispatch spans sit on one timebase, and the
+        # sink files under the serve:<model> pseudo-job id so
+        # GET /trace?id=serve:<model> and `kubeml trace` render the
+        # serving plane exactly like a training job
         svc = ServeService(model_id, engine,
                            max_queue=self.serve_queue_depth,
                            metrics=self.metrics,
-                           health_cb=self._observe_health).start()
+                           health_cb=self._observe_health,
+                           tracer=Tracer(clock=time.perf_counter),
+                           trace_sink=TraceSink(f"serve:{model_id}",
+                                                "serve")).start()
         old = None
         with self._serve_lock:
             cur = self._serve.get(model_id)
@@ -797,40 +856,58 @@ class ParameterServer(JsonService):
             raise InvalidArgsError(
                 f"prompt must be a list of token ids: {e}") from e
         svc = self._serve_service(model_id)
+        # distributed tracing: adopt the client's X-KubeML-Trace-Id
+        # (bound to this thread by the httpd middleware) or mint one.
+        # Every response path echoes it back as a header — body shapes
+        # are part of the streaming contract and stay untouched — so
+        # the client can pull GET /trace?id=serve:<model> and find its
+        # own span tree by trace_id
+        trace_id = get_trace_context() or make_trace_id()
+        hdrs = {TRACE_HEADER: trace_id}
         try:
             r = svc.submit(
                 prompt,
                 max_new_tokens=int(body.get("max_new_tokens", 32)),
                 temperature=float(body.get("temperature", 0.0)),
                 seed=int(body.get("seed", 0)),
-                eos_id=body.get("eos_id"))
+                eos_id=body.get("eos_id"),
+                trace_id=trace_id)
         except InferenceInputError as e:
             raise InvalidArgsError(str(e)) from e
         except ServeSaturated as e:
             retry = max(1, int(round(e.retry_after_s)))
             return Raw(e.to_json().encode(), "application/json",
                        status=e.status_code,
-                       headers={"Retry-After": str(retry)})
+                       headers={"Retry-After": str(retry), **hdrs})
         if body.get("stream", True):
-            return Stream(self._generate_chunks(svc, r))
+            return Stream(self._generate_chunks(svc, r), headers=hdrs)
         if not r.wait(timeout=600.0):
             svc.cancel(r)
             raise KubeMLException("generation timed out", 504)
         if r.outcome == "ok":
-            return {"tokens": r.tokens}
+            return Raw(json.dumps({"tokens": r.tokens}).encode(),
+                       "application/json", headers=hdrs)
         raise KubeMLException(r.error or f"generation {r.outcome}", 500)
 
     def _generate_chunks(self, svc, r):
         """ndjson producer for one stream; generator close() (client
         disconnect — httpd Stream contract) cancels the request so its
         slot and KV pages free immediately."""
-        import json as _json
         try:
             for ev in r.events_iter():
-                yield (_json.dumps(ev) + "\n").encode()
+                yield (json.dumps(ev) + "\n").encode()
         finally:
             if not r.done:
                 svc.cancel(r)
+            # producer-side stream lifetime (submit -> generator close),
+            # including cancelled streams. The HTTP duration histogram
+            # is NOT redundant with this: the middleware observes after
+            # the full chunked body is written to the socket, so it
+            # times the server-side write path — docs/observability.md
+            # spells out which covers what
+            if r.submitted_at is not None:
+                self.metrics.observe_serve_stream(
+                    svc.model_id, svc.clock() - r.submitted_at)
 
     # ------------------------------------------------------------- job mgmt
 

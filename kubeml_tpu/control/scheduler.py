@@ -106,6 +106,12 @@ class Scheduler(JsonService):
         self._parked: Dict[str, TrainTask] = {}
         self._granted: Dict[str, int] = {}
         self._cluster_lock = threading.Lock()
+        # persistent per-job tracers: TraceSink rewrites the whole file
+        # per flush, so every event for a job over its scheduler
+        # lifetime (enqueue span + allocator decision instants) must
+        # accumulate in ONE tracer or each flush would clobber the last
+        self._job_tracers: Dict[str, Tracer] = {}
+        self._tracer_lock = threading.Lock()
         self._stop = threading.Event()
         self._loop_thread: Optional[threading.Thread] = None
 
@@ -146,13 +152,10 @@ class Scheduler(JsonService):
                          trace_id=get_trace_context() or make_trace_id(),
                          priority=train_req.priority,
                          tenant=train_req.tenant)
-        tracer = Tracer(trace_id=task.trace_id)
+        tracer = self._job_tracer(task.job_id, trace_id=task.trace_id)
         with tracer.span("scheduler.enqueue", job_id=task.job_id):
             self.queue.push(task)
-        try:
-            TraceSink(task.job_id, "scheduler").write(tracer)
-        except OSError:
-            logger.exception("trace flush failed for %s", task.job_id)
+        self._flush_job_trace(task.job_id)
         logger.info("queued train task %s (%s on %s)", task.job_id,
                     train_req.model_type, train_req.dataset)
         return {"id": task.job_id}
@@ -188,6 +191,10 @@ class Scheduler(JsonService):
             # freed lanes may grant parked work
             self._apply_decisions(self.allocator.release(task_id))
             self._push_cluster_state()
+        # release the finished job's tracer (its decision instants are
+        # already flushed to the sink file)
+        with self._tracer_lock:
+            self._job_tracers.pop(task_id, None)
         return {"ok": True}
 
     def _h_requeue(self, req: Request):
@@ -355,13 +362,44 @@ class Scheduler(JsonService):
             lanes=ask))
         self._push_cluster_state()
 
+    def _job_tracer(self, job_id: str, trace_id: str = None) -> Tracer:
+        with self._tracer_lock:
+            t = self._job_tracers.get(job_id)
+            if t is None:
+                t = self._job_tracers[job_id] = Tracer(trace_id=trace_id)
+            return t
+
+    def _flush_job_trace(self, job_id: str) -> None:
+        with self._tracer_lock:
+            t = self._job_tracers.get(job_id)
+        if t is None:
+            return
+        try:
+            TraceSink(job_id, "scheduler").write(t)
+        except OSError:
+            logger.exception("trace flush failed for %s", job_id)
+
+    def _cluster_instant(self, d: Decision) -> None:
+        """Allocator decisions land on the decided job's own timeline as
+        instant events (cluster_place / cluster_queue / cluster_preempt
+        / cluster_resize), so a merged trace answers WHY a job sat
+        between its enqueue span and first epoch — parked behind quota,
+        waiting on a preemption, or clamped on resize."""
+        args = {"lanes": d.lanes, "path": d.path, "detail": d.detail}
+        if d.victim:
+            args["victim"] = d.victim
+        self._job_tracer(d.job_id).instant(f"cluster_{d.action}", **args)
+        self._flush_job_trace(d.job_id)
+
     def _apply_decisions(self, decisions: List[Decision]):
         """Apply allocator decisions: 'place' re-pushes the parked task
         through the queue with its granted lanes; 'preempt' asks the PS
         to SIGTERM the victim (it drains, checkpoints, and requeues
         through POST /requeue without consuming max_restarts); 'queue'
-        and 'resize' need no action here."""
+        and 'resize' need no dispatch action, but every decision is
+        recorded on the job's trace timeline (_cluster_instant)."""
         for d in decisions:
+            self._cluster_instant(d)
             if d.action == "place":
                 with self._cluster_lock:
                     task = self._parked.pop(d.job_id, None)

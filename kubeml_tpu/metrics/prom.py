@@ -455,6 +455,27 @@ class MetricsRegistry:
             "kubeml_serve_e2e_seconds",
             "End-to-end latency of a /generate request, by served model",
             "model")
+        # TTFT attribution (PR 11): the same TTFT decomposed into
+        # additive components — queue (submit -> slot attach), prefill
+        # (wall time of the dispatches that computed the prompt), and
+        # interleave (scheduler delay between them; the remainder, so
+        # the three sum to TTFT per request)
+        self.serve_ttft_breakdown_seconds = Histogram(
+            "kubeml_serve_ttft_breakdown_seconds",
+            "Additive TTFT components of a /generate request "
+            "(queue|prefill|interleave; they sum to the TTFT), by "
+            "served model", ("model", "component"))
+        # producer-side stream lifetime, recorded when the ndjson
+        # generator CLOSES (incl. client disconnects that cancel the
+        # request). kubeml_http_request_duration_seconds already covers
+        # the full server-side write — the middleware observes after the
+        # chunked body is written — but it only sees streams whose
+        # connection the server finished with; this one is per-model
+        # and counts cancelled/abandoned streams' real lifetimes too.
+        self.serve_stream_duration_seconds = Histogram(
+            "kubeml_serve_stream_duration_seconds",
+            "Lifetime of a streaming /generate response from submit to "
+            "producer close, by served model", "model")
         self.serve_active_slots = Gauge(
             "kubeml_serve_active_slots",
             "Decode slots occupied by in-flight streams of a served "
@@ -602,7 +623,11 @@ class MetricsRegistry:
                               self.infer_cache_entries]
         self._serve_hists = [self.serve_ttft_seconds,
                              self.serve_tpot_seconds,
-                             self.serve_e2e_seconds]
+                             self.serve_e2e_seconds,
+                             self.serve_stream_duration_seconds]
+        # (model, component)-labelled: cleared per component, so it
+        # stays out of the single-label _serve_hists clear loop
+        self._serve_multi_hists = [self.serve_ttft_breakdown_seconds]
         self._serve_counters = [self.serve_requests_total,
                                 self.serve_tokens_total,
                                 self.serve_prefill_tokens_total,
@@ -750,6 +775,30 @@ class MetricsRegistry:
     def note_serve_prefix_misses(self, model: str, n: int) -> None:
         self.serve_prefix_misses_total.inc(model, n)
 
+    def observe_serve_ttft_breakdown(self, model: str, queue: float,
+                                     prefill: float,
+                                     interleave: float) -> None:
+        self.serve_ttft_breakdown_seconds.observe((model, "queue"), queue)
+        self.serve_ttft_breakdown_seconds.observe((model, "prefill"),
+                                                  prefill)
+        self.serve_ttft_breakdown_seconds.observe((model, "interleave"),
+                                                  interleave)
+
+    def observe_serve_stream(self, model: str, seconds: float) -> None:
+        self.serve_stream_duration_seconds.observe(model, seconds)
+
+    def note_serve_trace_dropped(self, model: str, cum: int) -> None:
+        """Advance kubeml_trace_events_dropped_total for a serving
+        sink's drops, under the serve:<model> pseudo-job id — the value
+        is cumulative over the service's life (Tracer.dropped_events),
+        the counter advances by delta like the training-plane path in
+        update_job."""
+        job_id = f"serve:{model}"
+        seen = self._trace_seen.get(job_id, 0)
+        if cum > seen:
+            self.trace_dropped_total.inc(job_id, cum - seen)
+            self._trace_seen[job_id] = cum
+
     def clear_serve(self, model: str) -> None:
         for g in (self.serve_active_slots, self.serve_queue_depth,
                   self.serve_kv_utilization, self.serve_prefill_backlog,
@@ -757,12 +806,16 @@ class MetricsRegistry:
             g.clear(model)
         for h in self._serve_hists:
             h.clear(model)
+        for comp in ("queue", "prefill", "interleave"):
+            self.serve_ttft_breakdown_seconds.clear((model, comp))
         for c in (self.serve_requests_total, self.serve_tokens_total,
                   self.serve_prefill_tokens_total,
                   self.serve_decode_tokens_total,
                   self.serve_prefix_hits_total,
                   self.serve_prefix_misses_total):
             c.clear_prefix(model)
+        self.trace_dropped_total.clear_prefix(f"serve:{model}")
+        self._trace_seen.pop(f"serve:{model}", None)
 
     # ---------------------------------------------------- cluster allocator
 
@@ -842,6 +895,6 @@ class MetricsRegistry:
                                         self.trace_dropped_total]
                     + self._job_multi + self._job_hists
                     + self._serve_gauges + self._serve_counters
-                    + self._serve_hists
+                    + self._serve_hists + self._serve_multi_hists
                     + self._cluster_gauges + self._cluster_counters)
         return "\n".join(f.collect() for f in families) + "\n"

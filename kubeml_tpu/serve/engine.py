@@ -50,6 +50,7 @@ from kubeml_tpu.metrics.runtime import JitCompileTracker
 from kubeml_tpu.models.base import InferenceInputError
 from kubeml_tpu.models.gpt import (PAD_ID, build_paged_decode_step,
                                    build_paged_prefill_step)
+from kubeml_tpu.serve.flight import FlightRecorder
 from kubeml_tpu.serve.pager import (KVPageSlab, PageAllocator, PageGeometry,
                                     chain_hash)
 from kubeml_tpu.serve.slots import GenerateRequest
@@ -83,12 +84,31 @@ SWAP_PATH_VARIANTS = (
     "swap_drain_free",      # old generation frees when its last reader ends
 )
 
+# Every span/event kind the serving plane emits into the serve:<model>
+# trace MUST have a quoted-name assertion in tests/ (enforced by
+# tools/check_serve_spans.py, wired like check_serve_parity.py): the
+# span tree is an API — dashboards, `kubeml trace`, and the TTFT
+# attribution all parse these names, so an unasserted kind is a
+# rename-silently-breaks-consumers hazard.
+SERVE_SPAN_KINDS = (
+    "generate",        # root span: submit -> terminal, one per request
+    "queue_wait",      # submit -> slot attach (admission queue time)
+    "admit",           # the attach itself (prefix-cache match, slot claim)
+    "prefill_chunk",   # one chunked-prefill dispatch feeding this request
+    "first_token",     # instant: first generated token (carries breakdown)
+    "decode",          # sampled decode dispatch spans after first token
+    "finish",          # terminal instant: EOS / token budget / error
+    "shed",            # terminal instant: load-shed (429 or KV exhaustion)
+    "cancel",          # terminal instant: client cancel / disconnect
+    "flight_snapshot", # instant: flight-recorder ring dumped on incident
+)
+
 
 class _Slot:
     """Host-side state of one occupied decode slot."""
 
     __slots__ = ("req", "pos", "prompt", "n_prompt", "seq", "gen",
-                 "hash_chain", "hashed_pages", "cached_pages")
+                 "hash_chain", "hashed_pages", "cached_pages", "prefill_s")
 
     def __init__(self, req: GenerateRequest, prompt: List[int], seq: int,
                  gen: int = 1):
@@ -101,6 +121,10 @@ class _Slot:
         self.hash_chain = b""   # rolling digest over hashed_pages pages
         self.hashed_pages = 0   # prompt pages matched or registered so far
         self.cached_pages = 0   # prompt pages attached from the cache
+        # wall seconds of dispatches that computed this request's prompt
+        # (prefill chunks + decode dispatches up to the first token) —
+        # the "prefill-compute" term of the TTFT breakdown
+        self.prefill_s = 0.0
 
 
 class DecodeEngine:
@@ -123,7 +147,9 @@ class DecodeEngine:
                  slots: int = 8, page: int = 16,
                  clock=time.perf_counter, prefill_chunk: int = 16,
                  prefix_cache: bool = True,
-                 prefill_budget: Optional[int] = None):
+                 prefill_budget: Optional[int] = None,
+                 tracer=None, flight_steps: int = 256,
+                 decode_span_every: int = 16):
         prefill_chunk = int(prefill_chunk)
         if prefill_chunk < 0:
             raise ValueError(
@@ -168,6 +194,23 @@ class DecodeEngine:
         self._slots: List[Optional[_Slot]] = [None] * S
         self._seq = 0
         self.compile_tracker = JitCompileTracker()
+        # observability plane: spans go to an (optional, injectable)
+        # Tracer with explicit timestamps from this engine's clock; the
+        # flight recorder is ALWAYS on by default (flight_steps=0
+        # disables it, which exists for the bench overhead pin). Both
+        # are host-side only — the bit-identity tests pin that decode
+        # output does not depend on either being enabled.
+        self.tracer = tracer
+        flight_steps = int(flight_steps)
+        if flight_steps < 0:
+            raise ValueError(
+                f"flight_steps must be >= 0 (0 disables the recorder), "
+                f"got {flight_steps}")
+        self.flight = FlightRecorder(flight_steps) if flight_steps else None
+        self.decode_span_every = max(1, int(decode_span_every))
+        self._step_count = 0
+        self._dispatch_wall_s = 0.0   # cumulative prefill+decode wall time
+        self._shed_count = 0          # KV-exhaustion sheds (flight 'kind')
         # "dispatches"/"compiles" are DECODE-only (the PR-6 meaning the
         # bench and pinning tests rely on); prefill has its own lane
         self.stats: Dict[str, float] = {
@@ -237,6 +280,29 @@ class DecodeEngine:
             logger.info("retired weight generation %d (current %d)",
                         gen, self.weight_generation)
 
+    # -------------------------------------------------------------- tracing
+    def _span(self, name: str, start: float, end: float,
+              req: GenerateRequest, **args) -> None:
+        """One request-tree span. Parent is always the request's root
+        ``generate`` span (the tree is two levels deep by design — flat
+        enough to query, nested enough to group); per-request trace_id
+        rides in args so merge_job_trace collects it into metadata."""
+        if self.tracer is None:
+            return
+        if req.trace_id:
+            args["trace_id"] = req.trace_id
+        self.tracer.add_span(name, start, end, parent="generate",
+                             rid=req.rid, **args)
+
+    def _instant(self, name: str, ts: float, req: GenerateRequest,
+                 **args) -> None:
+        if self.tracer is None:
+            return
+        if req.trace_id:
+            args["trace_id"] = req.trace_id
+        self.tracer.instant(name, ts=ts, parent="generate", rid=req.rid,
+                            **args)
+
     # ------------------------------------------------------------ lifecycle
     def check_admissible(self, prompt: List[int],
                          max_new_tokens: int) -> List[int]:
@@ -270,12 +336,21 @@ class DecodeEngine:
         prompt = self.check_admissible(req.prompt, req.max_new_tokens)
         for s, cur in enumerate(self._slots):
             if cur is None:
+                t0 = self.clock()
                 slot = _Slot(req, prompt, self._seq,
                              gen=self.weight_generation)
                 self._seq += 1
                 self._slots[s] = slot
                 if self.prefix_cache:
                     self._match_prefix(s, slot)
+                t1 = self.clock()
+                req.admitted_at = t1
+                if req.submitted_at is not None:
+                    self._span("queue_wait", req.submitted_at, t0, req)
+                self._span("admit", t0, t1, req, slot=s,
+                           prompt_tokens=slot.n_prompt,
+                           prefix_hit_pages=slot.cached_pages,
+                           generation=slot.gen)
                 return s
         raise RuntimeError("attach() with no free slot — admission "
                            "accounting is broken")
@@ -338,6 +413,18 @@ class DecodeEngine:
         self._tables[s] = 0
         self._slots[s] = None
         slot.req.finished_at = self.clock()
+        # terminal instant: finish (ok or error), shed (KV exhaustion —
+        # the only engine-side shed), or cancel. The service emits the
+        # same kinds for requests that never reached a slot.
+        if outcome == "cancelled":
+            kind = "cancel"
+        elif outcome == "error" and error and "shed" in error:
+            kind = "shed"
+        else:
+            kind = "finish"
+        self._instant(kind, slot.req.finished_at, slot.req,
+                      outcome=outcome, tokens=len(slot.req.tokens),
+                      **({"error": error} if error else {}))
         slot.req.finish(outcome, error)
         # last reader of a superseded weight generation detaching frees
         # that generation's params and cache partition
@@ -360,6 +447,7 @@ class DecodeEngine:
         C = self.prefill_chunk
         start = slot.pos
         end = min(start + C, slot.n_prompt - 1)
+        granted = 0
         for pi in range(start // G, (end - 1) // G + 1):
             if self._tables[s, pi] == 0:
                 pid = self.pager.alloc()
@@ -369,6 +457,7 @@ class DecodeEngine:
                     end = min(end, pi * G)
                     break
                 self._tables[s, pi] = pid
+                granted += 1
         n = end - start
         if n <= 0:
             return 0
@@ -393,10 +482,16 @@ class DecodeEngine:
             jnp.asarray(self._tables[s]), jnp.asarray(write_pages),
             jnp.asarray(write_offs), jnp.asarray(in_chunk))
         compiled = self._prefill._cache_size() > before
-        self.compile_tracker.note(compiled, self.clock() - t0)
+        t1 = self.clock()
+        self.compile_tracker.note(compiled, t1 - t0)
         self.stats["prefill_dispatches"] += 1
         self.stats["prefill_compiles"] += int(compiled)
         self.stats["prefill_tokens"] += n
+        slot.prefill_s += t1 - t0
+        self._dispatch_wall_s += t1 - t0
+        self._span("prefill_chunk", t0, t1, slot.req, tokens=n,
+                   pages_granted=granted, start_pos=start,
+                   compiled=int(compiled))
         slot.pos = end
         if self.prefix_cache:
             self._register_full_pages(s, slot)
@@ -413,7 +508,70 @@ class DecodeEngine:
         """One scheduler round: up to prefill_budget prompt tokens of
         prefill chunks (FIFO), then one decode dispatch advancing every
         decode-phase slot by one token. Returns requests that reached a
-        terminal state this round."""
+        terminal state this round.
+
+        Every step — including idle and stalled ones — leaves one record
+        in the flight recorder; the mark/record pair brackets the whole
+        round so the deltas cover every return path."""
+        self._step_count += 1
+        mark = None if self.flight is None else (
+            self.stats["prefill_dispatches"], self.stats["dispatches"],
+            self.stats["generated_tokens"], self.stats["cow_splits"],
+            self._dispatch_wall_s, self._shed_count)
+        try:
+            return self._step_inner()
+        finally:
+            if mark is not None:
+                self._record_flight(mark)
+
+    def _record_flight(self, mark) -> None:
+        pf0, d0, g0, c0, w0, sh0 = mark
+        pf = int(self.stats["prefill_dispatches"] - pf0)
+        de = int(self.stats["dispatches"] - d0)
+        if self._shed_count > sh0:
+            kind = "shed"
+        elif pf and de:
+            kind = "mixed"
+        elif pf:
+            kind = "prefill"
+        elif de:
+            kind = "decode"
+        else:
+            kind = "idle"
+        self.flight.record({
+            "step": self._step_count,
+            "ts": self.clock(),
+            "kind": kind,
+            "active_slots": self.active(),
+            "prefill_backlog": self.prefill_backlog_tokens(),
+            "kv_pages": self.pager.in_use,
+            "cow_splits": int(self.stats["cow_splits"] - c0),
+            "dispatches": pf + de,
+            "dispatch_s": round(self._dispatch_wall_s - w0, 9),
+            "tokens": int(self.stats["generated_tokens"] - g0),
+            "weight_generation": self.weight_generation,
+            "generations": len(self._params_by_gen),
+        })
+
+    def _note_first_token(self, slot: _Slot, t1: float) -> None:
+        """First generated token: fill the additive TTFT breakdown
+        (queue + prefill + interleave == TTFT, exactly — interleave is
+        the remainder: scheduler delay between this request's admission
+        and its dispatches) and drop the instant on the timeline."""
+        req = slot.req
+        args = {}
+        if req.submitted_at is not None:
+            ttft = t1 - req.submitted_at
+            queue = (req.admitted_at if req.admitted_at is not None
+                     else req.submitted_at) - req.submitted_at
+            prefill = slot.prefill_s
+            req.ttft_breakdown = {
+                "queue": queue, "prefill": prefill,
+                "interleave": ttft - queue - prefill}
+            args = dict(ttft=ttft, **req.ttft_breakdown)
+        self._instant("first_token", t1, req, **args)
+
+    def _step_inner(self) -> List[GenerateRequest]:
         S = self.geom.slots
         G = self.geom.page
         stalled: List[int] = []
@@ -494,6 +652,7 @@ class DecodeEngine:
                     req = self._slots[victim].req
                     logger.warning("KV slab exhausted with all slots "
                                    "stalled; shedding newest stream")
+                    self._shed_count += 1
                     self.release(victim, "error",
                                  "KV cache pages exhausted; request shed")
                     finished.append(req)
@@ -543,7 +702,9 @@ class DecodeEngine:
                 jnp.asarray(temps), jnp.asarray(key_data),
                 jnp.asarray(copy_src), jnp.asarray(copy_dst))
             compiled = self._step._cache_size() > before
-            self.compile_tracker.note(compiled, self.clock() - t0)
+            t1 = self.clock()
+            self.compile_tracker.note(compiled, t1 - t0)
+            self._dispatch_wall_s += t1 - t0
             self.stats["dispatches"] += 1
             self.stats["compiles"] += int(compiled)
             self.stats["occupancy_sum"] += len(members)
@@ -554,6 +715,11 @@ class DecodeEngine:
                 slot = self._slots[s]
                 p = slot.pos
                 slot.pos = p + 1
+                if p <= slot.n_prompt - 1:
+                    # this dispatch computed prompt context for the slot
+                    # (token-by-token prefill, or the first-token step)
+                    # — it belongs to the TTFT prefill-compute term
+                    slot.prefill_s += t1 - t0
                 if self.prefix_cache:
                     # a prompt whose length is a page multiple completes
                     # its final page on this very advance — publish it
@@ -562,9 +728,18 @@ class DecodeEngine:
                     continue  # token-by-token prefill: output discarded
                 tok = int(nxt_host[s])
                 if slot.req.first_token_at is None:
-                    slot.req.first_token_at = self.clock()
+                    slot.req.first_token_at = t1
+                    self._note_first_token(slot, t1)
                 slot.req.emit_token(tok)
                 self.stats["generated_tokens"] += 1
+                n_out = len(slot.req.tokens)
+                if self.tracer is not None and n_out > 1 \
+                        and n_out % self.decode_span_every == 0:
+                    # sampled: one decode span every Nth output token
+                    # (the first token has its own instant) — enough to
+                    # see cadence without drowning the timeline
+                    self._span("decode", t0, t1, slot.req, pos=p,
+                               token_index=n_out, cow=int(s in cow))
                 if (slot.req.eos_id is not None
                         and tok == slot.req.eos_id) \
                         or len(slot.req.tokens) >= slot.req.max_new_tokens:

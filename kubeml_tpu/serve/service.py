@@ -51,13 +51,34 @@ class ServeService:
     def __init__(self, model_id: str, engine: DecodeEngine,
                  max_queue: int = 16, metrics=None,
                  health_cb: Optional[Callable[[dict], None]] = None,
-                 clock=time.perf_counter):
+                 clock=time.perf_counter,
+                 tracer=None, trace_sink=None):
         self.model_id = model_id
         self.engine = engine
         self.max_queue = int(max_queue)
         self.metrics = metrics
         self.health_cb = health_cb
         self.clock = clock
+        # per-request tracing: the tracer records on THIS service's
+        # clock (engine and service share it by default, so span
+        # timestamps are one timebase) with trace_id=None — each
+        # request's own trace_id rides in span args instead, so one
+        # serve trace carries many client trace ids and merge_job_trace
+        # lists them all. The sink writes under the serve:<model>
+        # pseudo-job id; the PS wires both in, direct constructions
+        # (unit tests, bench) stay disk-silent unless they pass them.
+        self.tracer = tracer
+        self.trace_sink = trace_sink
+        if tracer is not None and getattr(engine, "tracer", None) is None:
+            engine.tracer = tracer
+        self._events_flushed = 0
+        self._trace_dirty = False
+        # shed-onset detection for the flight auto-snapshot: the FIRST
+        # shed after a clean publish pass snapshots the ring; sustained
+        # shedding does not re-snapshot every request
+        self._shed_total = 0
+        self._shed_seen = 0
+        self._shed_episode = False
         self._cv = threading.Condition()
         self._pending: Deque[GenerateRequest] = collections.deque()
         self._inflight = 0          # admitted, not yet terminal
@@ -70,6 +91,8 @@ class ServeService:
         self.rejected_total = 0
         self._counters_seen: dict = {}   # engine stat -> last published
         self._ttfts: Deque[float] = collections.deque(maxlen=TTFT_WINDOW)
+        self._breakdowns: Deque[dict] = collections.deque(
+            maxlen=TTFT_WINDOW)
         self._thread = threading.Thread(
             target=self._loop, name=f"serve-{model_id}", daemon=True)
 
@@ -80,12 +103,13 @@ class ServeService:
 
     def submit(self, prompt, max_new_tokens: int = 32,
                temperature: float = 0.0, seed: int = 0,
-               eos_id: Optional[int] = None) -> GenerateRequest:
+               eos_id: Optional[int] = None,
+               trace_id: Optional[str] = None) -> GenerateRequest:
         """Admit a request or shed it. Raises InferenceInputError (400)
         on a bad prompt, ServeSaturated (429) at capacity."""
         req = GenerateRequest(prompt, max_new_tokens=max_new_tokens,
                               temperature=temperature, seed=seed,
-                              eos_id=eos_id)
+                              eos_id=eos_id, trace_id=trace_id)
         # validate on the HTTP thread: bad input must 400 before it
         # costs a slot (also strips trailing pads)
         req.prompt = self.engine.check_admissible(req.prompt,
@@ -96,6 +120,15 @@ class ServeService:
             if self._inflight >= self.engine.slot_count + self.max_queue:
                 self.rejected_total += 1
                 self._note_outcome("rejected")
+                # an admission shed never reaches a slot, so the engine
+                # cannot emit its terminal instant — do it here, and let
+                # the onset detector dump the flight ring
+                if self.tracer is not None:
+                    args = {"reason": "saturated", "rid": req.rid}
+                    if req.trace_id:
+                        args["trace_id"] = req.trace_id
+                    self.tracer.instant("shed", ts=self.clock(), **args)
+                self._note_shed()
                 # Retry-After accounts the prefill backlog: prompt
                 # tokens already owed to admitted streams are work the
                 # retrying client queues behind
@@ -203,8 +236,77 @@ class ServeService:
             if req.finished_at is None:
                 req.finished_at = self.clock()
             req.finish(outcome, error)
+            # the engine never saw this request (cancelled / errored in
+            # the admission queue), so emit its terminal instant here —
+            # the engine emits them for requests it released itself
+            self._request_instant(req, outcome, error)
         self._inflight = max(0, self._inflight - 1)
+        if req.outcome == "error" and req.error and "shed" in req.error:
+            self._note_shed()   # engine-side KV-exhaustion shed
+        if self.tracer is not None and req.submitted_at is not None \
+                and req.finished_at is not None:
+            # root span of the request tree: every other span/instant
+            # links to it via parent="generate"
+            args = {"rid": req.rid, "outcome": req.outcome or "error",
+                    "tokens": len(req.tokens)}
+            if req.trace_id:
+                args["trace_id"] = req.trace_id
+            self.tracer.add_span("generate", req.submitted_at,
+                                 req.finished_at, **args)
+        self._trace_dirty = True
         self._observe(req)
+
+    def _request_instant(self, req: GenerateRequest, outcome: str,
+                         error: Optional[str]) -> None:
+        if self.tracer is None:
+            return
+        kind = "cancel" if outcome == "cancelled" else "finish"
+        args = {"rid": req.rid, "outcome": outcome,
+                "tokens": len(req.tokens)}
+        if error:
+            args["error"] = error
+        if req.trace_id:
+            args["trace_id"] = req.trace_id
+        self.tracer.instant(kind, ts=req.finished_at or self.clock(),
+                            parent="generate", **args)
+
+    # -------------------------------------------------- incident black box
+    def _note_shed(self) -> None:
+        """One request shed (admission 429 or engine KV exhaustion).
+        The FIRST shed after a shed-free publish pass is an ONSET:
+        snapshot the flight ring into the trace. Sustained shedding does
+        not re-snapshot per request — the episode re-arms only after a
+        publish pass with no new sheds."""
+        self._shed_total += 1
+        if not self._shed_episode:
+            self._shed_episode = True
+            self.flight_snapshot("shed_onset")
+
+    def flight_snapshot(self, reason: str) -> None:
+        """Dump the engine flight-recorder ring into the serve trace as
+        one instant event, then flush the sink — called on shed onset
+        here, and on serve SLO health-rule onsets by the PS
+        (control/ps.py _observe_health)."""
+        fl = getattr(self.engine, "flight", None)
+        if self.tracer is None or fl is None:
+            return
+        self.tracer.instant("flight_snapshot", ts=self.clock(),
+                            reason=reason, total_steps=fl.total,
+                            records=fl.snapshot())
+        self._flush_trace(force=True)
+
+    def _flush_trace(self, force: bool = False) -> None:
+        if self.trace_sink is None or self.tracer is None:
+            return
+        n = self.tracer.event_count()
+        if not force and n == self._events_flushed:
+            return
+        try:
+            self.trace_sink.write(self.tracer)
+            self._events_flushed = n
+        except OSError:
+            logger.exception("serve trace flush failed for %s",
+                             self.model_id)
 
     # ------------------------------------------------------------ telemetry
     def _note_outcome(self, outcome: str) -> None:
@@ -215,10 +317,15 @@ class ServeService:
         self._note_outcome(req.outcome or "error")
         if req.first_token_at is not None and req.submitted_at is not None:
             self._ttfts.append(req.first_token_at - req.submitted_at)
+            if req.ttft_breakdown:
+                self._breakdowns.append(dict(req.ttft_breakdown))
         if self.metrics is None:
             return
         if req.tokens:
             self.metrics.note_serve_tokens(self.model_id, len(req.tokens))
+        if req.ttft_breakdown:
+            self.metrics.observe_serve_ttft_breakdown(
+                self.model_id, **req.ttft_breakdown)
         if req.outcome == "ok" and req.submitted_at is not None \
                 and req.first_token_at is not None \
                 and req.finished_at is not None:
@@ -241,9 +348,18 @@ class ServeService:
         return self.engine.prefill_backlog_tokens() + sum(
             max(0, len(r.prompt) - 1) for r in self._pending)
 
+    def ttft_breakdown_means(self) -> dict:
+        """Recent-window mean of each additive TTFT component (same
+        window as the percentiles) — the `kubeml top` breakdown line."""
+        bd = list(self._breakdowns)
+        k = max(1, len(bd))
+        return {c: sum(b[c] for b in bd) / k
+                for c in ("queue", "prefill", "interleave")}
+
     def snapshot(self) -> dict:
         """Health-pipeline sample for the serve:<model> pseudo job."""
         p = self.ttft_percentiles()
+        bd = self.ttft_breakdown_means()
         st = self.engine.stats
         hits, misses = st["prefix_hits"], st["prefix_misses"]
         return {
@@ -257,6 +373,11 @@ class ServeService:
             "serve_rejected_total": self.rejected_total,
             "serve_ttft_p50": round(p["p50"], 6),
             "serve_ttft_p99": round(p["p99"], 6),
+            # additive TTFT attribution (recent-window means): queue +
+            # prefill + interleave == TTFT per request by construction
+            "serve_ttft_queue_s": round(bd["queue"], 6),
+            "serve_ttft_prefill_s": round(bd["prefill"], 6),
+            "serve_ttft_interleave_s": round(bd["interleave"], 6),
             "serve_prefill_backlog_tokens": self._backlog_tokens(),
             "serve_prefix_hit_pct": round(
                 100.0 * hits / max(1, hits + misses), 1),
@@ -291,6 +412,21 @@ class ServeService:
                 if delta > 0:
                     note(self.model_id, delta)
                     self._counters_seen[stat] = cur
+            if self.tracer is not None:
+                # serving sink drops land in the same
+                # kubeml_trace_events_dropped_total family as training
+                # jobs, under the serve:<model> pseudo-job id
+                self.metrics.note_serve_trace_dropped(
+                    self.model_id, self.tracer.dropped_events)
+        # shed-episode bookkeeping + trace flush ride the publish
+        # cadence: a pass with no new sheds re-arms the onset snapshot,
+        # a pass after terminal events rewrites the sink file
+        if self._shed_total == self._shed_seen:
+            self._shed_episode = False
+        self._shed_seen = self._shed_total
+        if self._trace_dirty:
+            self._trace_dirty = False
+            self._flush_trace()
         if self.health_cb is not None:
             try:
                 self.health_cb(snap)

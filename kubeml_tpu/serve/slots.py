@@ -11,7 +11,8 @@ from __future__ import annotations
 
 import queue
 import threading
-from typing import List, Optional
+import uuid
+from typing import Dict, List, Optional
 
 from kubeml_tpu.api.errors import KubeMLException
 
@@ -41,19 +42,30 @@ class GenerateRequest:
 
     def __init__(self, prompt: List[int], max_new_tokens: int = 32,
                  temperature: float = 0.0, seed: int = 0,
-                 eos_id: Optional[int] = None):
+                 eos_id: Optional[int] = None,
+                 trace_id: Optional[str] = None):
         self.prompt = [int(t) for t in prompt]
         self.max_new_tokens = int(max_new_tokens)
         self.temperature = float(temperature)
         self.seed = int(seed)
         self.eos_id = None if eos_id is None else int(eos_id)
+        # distributed-trace correlation: trace_id rides from the client
+        # header through every span of this request's tree; rid is a
+        # short per-request id so co-resident requests sharing one
+        # trace_id still separate on the timeline
+        self.trace_id = trace_id or None
+        self.rid = uuid.uuid4().hex[:8]
         self.tokens: List[int] = []          # generated ids, in order
         self.events: "queue.Queue[dict]" = queue.Queue()
         self.outcome: Optional[str] = None   # ok|cancelled|error (terminal)
         self.error: Optional[str] = None
         self.submitted_at: Optional[float] = None
+        self.admitted_at: Optional[float] = None  # attach() = slot claimed
         self.first_token_at: Optional[float] = None
         self.finished_at: Optional[float] = None
+        # additive TTFT decomposition, filled at first token:
+        # queue + prefill + interleave == first_token_at - submitted_at
+        self.ttft_breakdown: Optional[Dict[str, float]] = None
         self._cancel = threading.Event()
         self._done = threading.Event()
 

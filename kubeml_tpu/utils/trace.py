@@ -154,6 +154,48 @@ class Tracer:
         parent = stack[-1] if stack else None
         self._record(name, end - seconds, seconds, parent, args)
 
+    def add_span(self, name: str, start: float, end: float,
+                 parent: Optional[str] = None, **args):
+        """Record a span with explicit timestamps and an explicit parent
+        link. The serving plane needs this: one request's spans straddle
+        many engine loop iterations, so the per-thread nesting stack
+        (which models call nesting, not request lifetimes) cannot
+        supply the parent."""
+        self._record(name, start, max(0.0, end - start), parent, args)
+
+    def instant(self, name: str, ts: Optional[float] = None,
+                parent: Optional[str] = None, **args):
+        """Record a Chrome instant event (``ph: "i"``) — a point on the
+        timeline (first token, terminal outcome, allocator decision)
+        rather than an interval. Subject to the same max_events cap and
+        drop accounting as spans."""
+        if ts is None:
+            ts = self._clock()
+        with self._lock:
+            if len(self._events) >= self.max_events:
+                self.dropped_events += 1
+                return
+            ev_args = dict(args)
+            if self.trace_id:
+                ev_args["trace_id"] = self.trace_id
+            if parent:
+                ev_args["parent"] = parent
+            self._events.append({
+                "name": name,
+                "ph": "i",
+                "s": "t",
+                "ts": round(ts * 1e6),
+                "pid": os.getpid(),
+                "tid": threading.get_ident() % (1 << 31),
+                "args": ev_args,
+            })
+
+    def event_count(self) -> int:
+        """Events currently buffered (cheap dirty check for sinks that
+        flush only when something new arrived)."""
+        with self._lock:
+            return len(self._events)
+
     def summary(self) -> Dict[str, Dict[str, float]]:
         with self._lock:
             return {

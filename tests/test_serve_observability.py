@@ -1,0 +1,531 @@
+"""Serving-plane observability tests (tracing + flight recorder + TTFT).
+
+The contracts pinned here:
+
+  * span-tree exactness — one /generate request yields queue_wait ->
+    admit -> prefill_chunk per chunk -> a first_token instant ->
+    sampled decode spans -> one terminal instant, all parented to the
+    request's root span, timestamped on the engine clock (fake-clock
+    verified to the tick)
+  * TTFT attribution — queue + prefill + interleave == TTFT exactly
+    (interleave is the remainder by construction), both in the trace
+    args and in the kubeml_serve_ttft_breakdown_seconds histograms
+  * flight recorder — always-on fixed-size ring, O(1) per step, decode
+    output bit-identical with it (and tracing) on or off; wraparound
+    keeps the newest records oldest-first; auto-snapshot on shed onset
+  * trace plumbing — client X-KubeML-Trace-Id rides every span of its
+    request through the merged GET /trace?id=serve:<model> document;
+    serving-sink drops land in kubeml_trace_events_dropped_total under
+    the serve pseudo-job id and in the merge metadata
+  * lint — tools/check_serve_spans.py holds every SERVE_SPAN_KINDS name
+    to a quoted assertion in tests/ (this file carries them)
+"""
+
+import itertools
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+pytestmark = pytest.mark.serving
+
+
+def _nano():
+    import jax
+
+    from kubeml_tpu.models import get_builtin
+    model = get_builtin("gpt-nano")()
+    module = model.module
+    variables = model.init_variables(
+        jax.random.PRNGKey(0),
+        {"x": np.ones((1, module.max_len), np.int32)})
+    return model, module, variables
+
+
+def _drive(engine, limit=10_000):
+    finished = []
+    while engine.active():
+        finished.extend(engine.step())
+        limit -= 1
+        assert limit > 0, "engine failed to drain"
+    return finished
+
+
+def _fake_clock():
+    """Deterministic clock: each call is one second after the last, so
+    every span endpoint is an exact integer and the additive-breakdown
+    arithmetic has no float slop to hide behind."""
+    counter = itertools.count(1)
+    return lambda: float(next(counter))
+
+
+def _by_name(events, name):
+    return [e for e in events if e["name"] == name]
+
+
+# ------------------------------------------------------------ flight ring
+
+def test_flight_ring_wraparound_keeps_newest_oldest_first():
+    from kubeml_tpu.serve.flight import FlightRecorder
+
+    fl = FlightRecorder(capacity=4)
+    assert len(fl) == 0 and fl.total == 0 and fl.snapshot() == []
+    for i in range(10):
+        fl.record({"step": i})
+    assert fl.total == 10
+    assert len(fl) == 4
+    assert [r["step"] for r in fl.snapshot()] == [6, 7, 8, 9]
+    # snapshot returns copies: mutating them never corrupts the ring
+    fl.snapshot()[0]["step"] = -1
+    assert [r["step"] for r in fl.snapshot()] == [6, 7, 8, 9]
+    with pytest.raises(ValueError):
+        FlightRecorder(capacity=0)
+
+
+def test_flight_records_schema_and_kinds():
+    """Every engine step — prefill, decode, idle — leaves exactly one
+    record with the documented field set."""
+    from kubeml_tpu.serve.engine import DecodeEngine
+    from kubeml_tpu.serve.flight import FLIGHT_FIELDS
+    from kubeml_tpu.serve.slots import GenerateRequest
+
+    _model, module, variables = _nano()
+    engine = DecodeEngine(module, variables, slots=2, page=8,
+                          prefill_chunk=16)
+    req = GenerateRequest(list(range(2, 36)), max_new_tokens=4)
+    engine.attach(req)
+    _drive(engine)
+    engine.step()  # idle step records too
+    records = engine.flight.snapshot()
+    assert len(records) == engine.flight.total
+    for rec in records:
+        assert set(rec) == set(FLIGHT_FIELDS)
+    kinds = {r["kind"] for r in records}
+    assert "prefill" in kinds and "decode" in kinds and "idle" in kinds
+    steps = [r["step"] for r in records]
+    assert steps == sorted(steps)  # oldest first, monotone
+
+
+def test_decode_bit_identical_with_recorder_and_tracer_on_or_off():
+    """The observability plane is host-side only: identical tokens with
+    the flight recorder + tracer enabled and with both disabled."""
+    from kubeml_tpu.serve.engine import DecodeEngine
+    from kubeml_tpu.serve.slots import GenerateRequest
+    from kubeml_tpu.utils.trace import Tracer
+
+    _model, module, variables = _nano()
+    specs = [([5, 6, 7], 6, 0.0, 0),
+             ([9, 10, 11, 12], 8, 0.7, 1)]
+
+    def run(**kw):
+        engine = DecodeEngine(module, variables, slots=4, page=4, **kw)
+        reqs = [GenerateRequest(list(p), max_new_tokens=n, temperature=t,
+                                seed=s) for p, n, t, s in specs]
+        for r in reqs:
+            engine.attach(r)
+        _drive(engine)
+        return [r.tokens for r in reqs]
+
+    instrumented = run(tracer=Tracer(), flight_steps=8,
+                       decode_span_every=1)
+    bare = run(tracer=None, flight_steps=0)
+    np.testing.assert_array_equal(
+        np.concatenate([np.asarray(t) for t in instrumented]),
+        np.concatenate([np.asarray(t) for t in bare]))
+
+
+# -------------------------------------------------------- span-tree shape
+
+def test_request_span_tree_exact_under_fake_clock():
+    """One chunked-prefill request's full tree, to the tick: the fake
+    clock advances 1s per reading, so every duration and the additive
+    TTFT identity are exact."""
+    from kubeml_tpu.serve.engine import DecodeEngine
+    from kubeml_tpu.serve.slots import GenerateRequest
+    from kubeml_tpu.utils.trace import Tracer
+
+    _model, module, variables = _nano()
+    clk = _fake_clock()
+    tracer = Tracer(clock=clk)
+    engine = DecodeEngine(module, variables, slots=2, page=8, clock=clk,
+                          prefill_chunk=16, tracer=tracer,
+                          decode_span_every=2)
+    prompt = list(range(2, 42))  # 40 tokens -> chunks of 16, 16, 7
+    req = GenerateRequest(prompt, max_new_tokens=6,
+                          trace_id="cafecafe00000001")
+    req.submitted_at = clk()  # what ServeService.submit records
+    engine.attach(req)
+    _drive(engine)
+    assert req.outcome == "ok" and len(req.tokens) == 6
+    events = tracer.events()
+
+    # every span/instant of the tree carries the request's trace_id and
+    # parents to the root "generate" span
+    for ev in events:
+        assert ev["args"]["trace_id"] == "cafecafe00000001"
+        assert ev["args"]["parent"] == "generate"
+        assert ev["args"]["rid"] == req.rid
+
+    (qw,) = _by_name(events, "queue_wait")
+    assert qw["ph"] == "X"
+    assert qw["ts"] == round(req.submitted_at * 1e6)
+    (admit,) = _by_name(events, "admit")
+    assert admit["args"]["prompt_tokens"] == 40
+    assert admit["ts"] == qw["ts"] + qw["dur"]  # queue ends where admit starts
+    chunks = _by_name(events, "prefill_chunk")
+    assert [c["args"]["tokens"] for c in chunks] == [16, 16, 7]
+    assert all(c["dur"] > 0 for c in chunks)
+    (ft,) = _by_name(events, "first_token")
+    assert ft["ph"] == "i"
+    decodes = _by_name(events, "decode")
+    assert [d["args"]["token_index"] for d in decodes] == [2, 4, 6]
+    (fin,) = _by_name(events, "finish")
+    assert fin["args"]["outcome"] == "ok" and fin["args"]["tokens"] == 6
+    assert fin["ts"] == round(req.finished_at * 1e6)
+
+    # additive TTFT attribution: queue + prefill + interleave == TTFT,
+    # and the components match the timeline they claim to decompose
+    bd = req.ttft_breakdown
+    ttft = ft["args"]["ttft"]
+    assert ttft == req.first_token_at - req.submitted_at
+    assert bd["queue"] == req.admitted_at - req.submitted_at
+    # prefill-compute = the three chunk dispatches + the first-token
+    # decode dispatch (it consumes the last prompt position); under
+    # this clock every dispatch is exactly one tick, so any decode
+    # span's dur stands in for the first-token dispatch's
+    assert bd["prefill"] * 1e6 == pytest.approx(
+        sum(c["dur"] for c in chunks) + decodes[0]["dur"], abs=1)
+    assert bd["queue"] + bd["prefill"] + bd["interleave"] == \
+        pytest.approx(ttft, abs=1e-9)
+    assert ft["args"]["queue"] == bd["queue"]
+
+
+def test_engine_cancel_and_shed_emit_terminal_instants():
+    """'cancel' on mid-stream cancellation; 'shed' (not 'finish') when
+    KV exhaustion sheds the newest stream."""
+    from kubeml_tpu.serve.engine import DecodeEngine
+    from kubeml_tpu.serve.slots import GenerateRequest
+    from kubeml_tpu.utils.trace import Tracer
+
+    _model, module, variables = _nano()
+    tracer = Tracer()
+    engine = DecodeEngine(module, variables, slots=2, page=8,
+                          prefill_chunk=0, tracer=tracer)
+    req = GenerateRequest([5, 6, 7], max_new_tokens=30)
+    engine.attach(req)
+    engine.step()
+    req.cancel()
+    engine.step()
+    assert req.outcome == "cancelled"
+    (c,) = _by_name(tracer.events(), "cancel")
+    assert c["args"]["outcome"] == "cancelled"
+
+    # 2 usable pages of 4 tokens, each request needing 2: the newest
+    # stream stalls on page exhaustion and sheds
+    from kubeml_tpu.serve.pager import PageGeometry
+    tracer2 = Tracer()
+    tight = DecodeEngine(module, variables,
+                         geom=PageGeometry(slots=2, page=4, pages=3,
+                                           pages_per_slot=2),
+                         tracer=tracer2)
+    old = GenerateRequest([5, 6, 7, 8], max_new_tokens=4)
+    new = GenerateRequest([9, 10, 11, 12], max_new_tokens=4)
+    tight.attach(old)
+    tight.attach(new)
+    _drive(tight)
+    assert new.outcome == "error" and "shed" in new.error
+    sheds = _by_name(tracer2.events(), "shed")
+    assert len(sheds) == 1 and sheds[0]["args"]["rid"] == new.rid
+    flight_kinds = [r["kind"] for r in tight.flight.snapshot()]
+    assert "shed" in flight_kinds
+
+
+# ----------------------------------------------- service-level incidents
+
+def test_shed_onset_snapshots_flight_ring_once_per_episode():
+    """Admission saturation: the FIRST shed dumps the flight ring into
+    the trace; sustained shedding does not re-snapshot until a publish
+    pass with no sheds re-arms the episode."""
+    from kubeml_tpu.serve.engine import DecodeEngine
+    from kubeml_tpu.serve.service import ServeService
+    from kubeml_tpu.serve.slots import ServeSaturated
+    from kubeml_tpu.utils.trace import Tracer
+
+    _model, module, variables = _nano()
+    engine = DecodeEngine(module, variables, slots=2, page=8)
+    tracer = Tracer()
+    svc = ServeService("m", engine, max_queue=0, tracer=tracer)
+    # loop thread NOT started: submissions sit pending, so capacity
+    # (slots 2 + queue 0) saturates deterministically
+    svc.submit([5, 6, 7], max_new_tokens=2)
+    svc.submit([8, 9], max_new_tokens=2)
+    for _ in range(3):
+        with pytest.raises(ServeSaturated):
+            svc.submit([1, 2], max_new_tokens=2)
+    events = tracer.events()
+    assert len(_by_name(events, "shed")) == 3
+    snaps = _by_name(events, "flight_snapshot")
+    assert len(snaps) == 1  # onset only, not per shed
+    assert snaps[0]["args"]["reason"] == "shed_onset"
+    assert snaps[0]["args"]["total_steps"] == engine.flight.total
+
+    svc._publish()  # sheds happened since last pass: episode stays hot
+    with pytest.raises(ServeSaturated):
+        svc.submit([1, 2], max_new_tokens=2)
+    assert len(_by_name(tracer.events(), "flight_snapshot")) == 1
+    svc._publish()  # shed-free pass? no — one shed above keeps it hot
+    svc._publish()  # now a clean pass re-arms
+    with pytest.raises(ServeSaturated):
+        svc.submit([1, 2], max_new_tokens=2)
+    assert len(_by_name(tracer.events(), "flight_snapshot")) == 2
+
+
+def test_serve_trace_drops_counted_and_merged(tmp_home):
+    """Serving-sink drops reach kubeml_trace_events_dropped_total under
+    the serve pseudo-job id, and the merged trace metadata reports the
+    timeline as partial."""
+    from kubeml_tpu.metrics.prom import MetricsRegistry
+    from kubeml_tpu.serve.engine import DecodeEngine
+    from kubeml_tpu.serve.service import ServeService
+    from kubeml_tpu.serve.slots import ServeSaturated
+    from kubeml_tpu.utils.trace import TraceSink, Tracer, merge_job_trace
+
+    _model, module, variables = _nano()
+    engine = DecodeEngine(module, variables, slots=1, page=8)
+    reg = MetricsRegistry()
+    tracer = Tracer(max_events=2)
+    svc = ServeService("m", engine, max_queue=0, metrics=reg,
+                       tracer=tracer,
+                       trace_sink=TraceSink("serve:m", "serve"))
+    svc.submit([5, 6], max_new_tokens=2)
+    for _ in range(4):  # shed + snapshot fill the 2-event cap; rest drop
+        with pytest.raises(ServeSaturated):
+            svc.submit([1, 2], max_new_tokens=2)
+    assert tracer.dropped_events > 0
+    svc._publish()
+    text = reg.exposition()
+    assert (f'kubeml_trace_events_dropped_total{{jobid="serve:m"}} '
+            f"{float(tracer.dropped_events)}") in text
+    svc._flush_trace(force=True)
+    merged = merge_job_trace("serve:m")
+    assert merged["metadata"]["dropped_events"] == tracer.dropped_events
+
+
+# ------------------------------------------------------------ end to end
+
+@pytest.fixture()
+def serve_ps(tmp_home):
+    from kubeml_tpu.control.ps import ParameterServer
+    from kubeml_tpu.train.checkpoint import save_checkpoint
+
+    model, _module, variables = _nano()
+    save_checkpoint("obsnano", variables,
+                    {"model": "gpt-nano", "function": "gpt-nano",
+                     "parallelism": 1, "epoch": 0})
+    ps = ParameterServer(serve_slots=2, serve_queue_depth=1)
+    ps.start()
+    yield ps, model, variables
+    ps.stop()
+
+
+def _post(url, body, timeout=60.0, headers=None):
+    req = urllib.request.Request(
+        url, data=json.dumps(body).encode(), method="POST",
+        headers={"Content-Type": "application/json", **(headers or {})})
+    return urllib.request.urlopen(req, timeout=timeout)
+
+
+def _get_json(url, timeout=30.0):
+    return json.loads(urllib.request.urlopen(url, timeout=timeout).read())
+
+
+def test_trace_id_propagates_to_merged_serve_trace(serve_ps):
+    """A chunked-prefill request over real HTTP with a client-minted
+    trace id: the response echoes the id, and the merged serve trace
+    carries the full span tree under it, with the TTFT breakdown
+    summing to the TTFT."""
+    from kubeml_tpu.utils.trace import TRACE_HEADER
+
+    ps, _model, _variables = serve_ps
+    tid = "feedbeef00000042"
+    prompt = list(range(2, 42))  # 40 tokens -> 3 chunks at chunk=16
+    resp = _post(f"{ps.url}/generate",
+                 {"model_id": "obsnano", "prompt": prompt,
+                  "max_new_tokens": 4},
+                 headers={TRACE_HEADER: tid})
+    assert resp.headers.get(TRACE_HEADER) == tid
+    events = [json.loads(line) for line in resp.read().splitlines()]
+    assert "done" in events[-1]
+
+    # the serve loop flushes the sink on its publish cadence: poll the
+    # merged document until this request's spans land
+    deadline = time.time() + 15
+    mine = []
+    while time.time() < deadline:
+        try:
+            doc = _get_json(f"{ps.url}/trace?id=serve:obsnano")
+        except urllib.error.HTTPError:
+            time.sleep(0.05)
+            continue
+        mine = [e for e in doc["traceEvents"]
+                if e.get("args", {}).get("trace_id") == tid]
+        if any(e["name"] == "generate" for e in mine):
+            break
+        time.sleep(0.05)
+    assert tid in doc["metadata"]["trace_ids"]
+    names = [e["name"] for e in mine]
+    assert names.count("generate") == 1
+    assert "queue_wait" in names and "admit" in names
+    assert names.count("prefill_chunk") >= 2
+    assert "first_token" in names and "finish" in names
+    (ft,) = [e for e in mine if e["name"] == "first_token"]
+    bd_sum = (ft["args"]["queue"] + ft["args"]["prefill"]
+              + ft["args"]["interleave"])
+    assert bd_sum == pytest.approx(ft["args"]["ttft"], abs=1e-6)
+    # the root brackets the whole request
+    (root,) = [e for e in mine if e["name"] == "generate"]
+    assert root["ts"] <= ft["ts"] <= root["ts"] + root["dur"]
+
+    # a second client id lands in the SAME merged doc alongside
+    resp = _post(f"{ps.url}/generate",
+                 {"model_id": "obsnano", "prompt": [5, 6, 7],
+                  "max_new_tokens": 2, "stream": False},
+                 headers={TRACE_HEADER: "feedbeef00000043"})
+    assert resp.headers.get(TRACE_HEADER) == "feedbeef00000043"
+    assert json.loads(resp.read())["tokens"]
+    deadline = time.time() + 15
+    while time.time() < deadline:
+        doc = _get_json(f"{ps.url}/trace?id=serve:obsnano")
+        if "feedbeef00000043" in doc["metadata"]["trace_ids"]:
+            break
+        time.sleep(0.05)
+    assert set(doc["metadata"]["trace_ids"]) >= {tid, "feedbeef00000043"}
+
+
+def test_flight_endpoint_and_breakdown_exposition(serve_ps):
+    """GET /flight drains the live ring; the TTFT-breakdown and
+    stream-duration histogram families pass the exposition lint."""
+    from tools.check_metrics import validate_exposition
+
+    from kubeml_tpu.serve.flight import FLIGHT_FIELDS
+
+    ps, _model, _variables = serve_ps
+    _post(f"{ps.url}/generate",
+          {"model_id": "obsnano", "prompt": [5, 6, 7, 8],
+           "max_new_tokens": 4}).read()
+    doc = _get_json(f"{ps.url}/flight?id=serve:obsnano")
+    assert doc["id"] == "serve:obsnano" and doc["model"] == "obsnano"
+    assert doc["capacity"] > 0
+    assert doc["total_steps"] >= 1 and doc["records"]
+    for rec in doc["records"]:
+        assert set(rec) == set(FLIGHT_FIELDS)
+    # bare model id resolves too
+    assert _get_json(f"{ps.url}/flight?id=obsnano")["id"] == \
+        "serve:obsnano"
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        urllib.request.urlopen(f"{ps.url}/flight?id=serve:nosuch")
+    assert ei.value.code == 404
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        urllib.request.urlopen(f"{ps.url}/flight")
+    assert ei.value.code == 400
+
+    wanted = ("kubeml_serve_ttft_breakdown_seconds",
+              "kubeml_serve_stream_duration_seconds")
+    # the families expose immediately; the breakdown SAMPLES land when
+    # the serve loop observes the finished request — poll for those
+    deadline = time.time() + 10
+    while time.time() < deadline:
+        text = urllib.request.urlopen(f"{ps.url}/metrics").read().decode()
+        if 'component="queue"' in text:
+            break
+        time.sleep(0.05)
+    for family in wanted:
+        assert f"# TYPE {family}" in text, family
+    assert 'component="queue"' in text
+    assert 'component="prefill"' in text
+    assert 'component="interleave"' in text
+    assert validate_exposition(text) == []
+
+    # health snapshot carries the breakdown means for `kubeml top`
+    deadline = time.time() + 10
+    while time.time() < deadline:
+        doc = _get_json(f"{ps.url}/health?id=serve:obsnano")
+        if doc.get("latest", {}).get("serve_ttft_queue_s") is not None:
+            break
+        time.sleep(0.05)
+    latest = doc["latest"]
+    for field in ("serve_ttft_queue_s", "serve_ttft_prefill_s",
+                  "serve_ttft_interleave_s"):
+        assert field in latest
+    assert latest["serve_ttft_queue_s"] + latest["serve_ttft_prefill_s"] \
+        + latest["serve_ttft_interleave_s"] == \
+        pytest.approx(latest["serve_ttft_p50"], rel=0.5, abs=0.05)
+
+
+def test_top_renders_ttft_breakdown_line():
+    from kubeml_tpu.cli.main import _render_top
+
+    out = _render_top({
+        "id": "serve:m", "state": "healthy", "reasons": [],
+        "latest": {"serve_active_slots": 1, "serve_slot_cap": 2,
+                   "serve_queue_depth": 0, "serve_queue_cap": 4,
+                   "serve_kv_page_utilization": 0.25,
+                   "serve_ttft_p50": 0.030, "serve_ttft_p99": 0.090,
+                   "serve_rejected_total": 0,
+                   "serve_prefill_backlog_tokens": 0,
+                   "serve_prefix_hit_pct": 50.0,
+                   "serve_ttft_queue_s": 0.010,
+                   "serve_ttft_prefill_s": 0.015,
+                   "serve_ttft_interleave_s": 0.005}})
+    assert "ttft breakdown: queue 10ms  prefill 15ms  interleave 5ms" \
+        in out
+    # without breakdown fields the serve pane renders without the line
+    out = _render_top({"id": "serve:m", "state": "healthy", "reasons": [],
+                       "latest": {"serve_slot_cap": 2}})
+    assert "ttft breakdown" not in out
+
+
+# ------------------------------------------------------------------- lint
+
+def test_serve_span_lint_passes_on_this_repo():
+    import tools.check_serve_spans as lint
+    assert lint.main(["check_serve_spans.py"]) == 0
+
+
+def test_serve_span_lint_self_test(tmp_path):
+    """The lint catches an unasserted kind, accepts a quoted assert
+    line, and ignores names that only appear in comments."""
+    import tools.check_serve_spans as lint
+
+    root = tmp_path
+    (root / "kubeml_tpu" / "serve").mkdir(parents=True)
+    (root / "tests").mkdir()
+    eng = root / "kubeml_tpu" / "serve" / "engine.py"
+    eng.write_text('SERVE_SPAN_KINDS = ("zz_alpha", "zz_beta")\n')
+
+    # nothing asserted -> both missing, exit 1
+    assert lint.main(["x", str(root)]) == 1
+    assert lint.unasserted_kinds(str(eng), str(root / "tests")) == \
+        ["zz_alpha", "zz_beta"]
+
+    # a comment mention and a non-assert use do NOT count
+    t = root / "tests" / "test_spans.py"
+    t.write_text('# zz_alpha is great\nkinds = ["zz_alpha"]\n'
+                 'assert "zz_beta" in kinds\n')
+    assert lint.unasserted_kinds(str(eng), str(root / "tests")) == \
+        ["zz_alpha"]
+    assert lint.main(["x", str(root)]) == 1
+
+    # a quoted name on an assert line satisfies the lint
+    t.write_text('kinds = ["zz_alpha", "zz_beta"]\n'
+                 'assert "zz_alpha" in kinds\n'
+                 'assert "zz_beta" in kinds\n')
+    assert lint.main(["x", str(root)]) == 0
+
+    # a miswired tuple (engine refactor) fails loudly, not silently
+    eng.write_text("RENAMED = ()\n")
+    assert lint.main(["x", str(root)]) == 1
