@@ -414,6 +414,7 @@ def main():
 
     serving = _measure_serving_arm()
     serving_prefill = _measure_prefill_arm()
+    serving_faulted = _measure_serving_faulted_arm()
     cluster = _measure_cluster_arm()
     continual = _measure_continual_arm()
 
@@ -550,6 +551,14 @@ def main():
         # dispatch. Values are exact on the CPU tier (greedy, unique
         # prompts concurrent, repeats serial).
         "serving_prefill": serving_prefill,
+        # serving fault-tolerance arm (PR 12): a deterministic
+        # serve_step_crash fires mid-burst, rid-sticky on one stream;
+        # the service's step-exception bisection quarantines exactly
+        # that request while every survivor's tokens stay bit-identical
+        # to the clean run — with NO engine rebuild, so the program
+        # inventory pin (one decode compile, one prefill compile)
+        # survives the fault. Self-asserted inside the arm.
+        "serving_faulted": serving_faulted,
         # cluster-allocator arm (control/cluster.py): a deterministic
         # fake-clock saturation replay — three wide priority-0 batch
         # gangs fill the pool, four narrow priority-1 prod jobs burst
@@ -812,6 +821,102 @@ def _measure_serving_arm() -> dict:
         "burst_submitted": 3 * SLOTS,
         "burst_shed_429": shed,
         "recorder_overhead": recorder_overhead,
+    }
+
+
+def _measure_serving_faulted_arm() -> dict:
+    """Serving fault-tolerance arm: a rid-sticky serve_step_crash
+    (faults.ServeFaultPlan) poisons one stream of a concurrent burst.
+    The service's step-exception bisection must quarantine exactly the
+    poisoning request; every survivor decodes tokens BIT-IDENTICAL to
+    the clean run (per-(seed, position) sampling keys make decode
+    independent of co-residency and of the retry schedule), and the
+    isolation must cost zero recompiles and zero engine rebuilds. The
+    arm asserts all of that itself and reports the recovery overhead."""
+    import jax
+    import numpy as np
+
+    from kubeml_tpu.faults import ServeFaultPlan
+    from kubeml_tpu.models import get_builtin
+    from kubeml_tpu.serve.engine import DecodeEngine
+    from kubeml_tpu.serve.service import ServeService
+
+    PROMPT_LEN, NEW_TOKENS, SLOTS, K = 8, 16, 8, 6
+
+    model = get_builtin("gpt-nano")()
+    module = model.module
+    variables = model.init_variables(
+        jax.random.PRNGKey(0),
+        {"x": np.ones((1, module.max_len), np.int32)})
+
+    def prompt(i):
+        return [(i * 11 + j) % (module.vocab_size - 1) + 1
+                for j in range(PROMPT_LEN)]
+
+    def drain(req):
+        for _ in req.events_iter(timeout=120.0):
+            pass
+        return req
+
+    def run_burst(fault_plan):
+        eng = DecodeEngine(module, variables, slots=SLOTS)
+        # supervise=False: this arm pins the BISECTION path — the
+        # watchdog must not race a recovery in on slow machines
+        svc = ServeService("bench-fault", eng, supervise=False).start()
+        drain(svc.submit(prompt(99), max_new_tokens=NEW_TOKENS))  # warmup
+        if fault_plan is not None:
+            # attach AFTER warmup: the wildcard-step event binds to
+            # whichever request next occupies slot 0 — request 0 of the
+            # burst (slots fill lowest-first in admission order)
+            eng.fault_plan = fault_plan
+        t0 = time.perf_counter()
+        reqs = [svc.submit(prompt(i), max_new_tokens=NEW_TOKENS, seed=i)
+                for i in range(K)]
+        for r in reqs:
+            drain(r)
+        elapsed = time.perf_counter() - t0
+        svc.stop()
+        return svc, eng, reqs, elapsed
+
+    _, clean_eng, clean, clean_s = run_burst(None)
+    assert all(r.outcome == "ok" for r in clean), \
+        [(r.outcome, r.error) for r in clean]
+
+    plan = ServeFaultPlan.parse(
+        [{"kind": "serve_step_crash", "slot": 0}])
+    svc, eng, faulted, faulted_s = run_burst(plan)
+
+    # exactly the bound stream is quarantined; the crash names itself
+    assert faulted[0].outcome == "error" \
+        and "serve_step_crash" in (faulted[0].error or ""), \
+        (faulted[0].outcome, faulted[0].error)
+    # every survivor is bit-identical to the clean run
+    for i in range(1, K):
+        assert faulted[i].outcome == "ok", \
+            (i, faulted[i].outcome, faulted[i].error)
+        assert faulted[i].tokens == clean[i].tokens, i
+    # isolation is free of rebuilds and recompiles: the program
+    # inventory pin survives the fault
+    assert svc.restarts_total == 0, svc.restarts_total
+    assert svc.poisoned_total == 1, svc.poisoned_total
+    assert int(eng.stats["compiles"]) == int(clean_eng.stats["compiles"]), \
+        (eng.stats["compiles"], clean_eng.stats["compiles"])
+    assert int(eng.stats["prefill_compiles"]) == \
+        int(clean_eng.stats["prefill_compiles"])
+
+    return {
+        "model": "gpt-nano", "slots": SLOTS, "requests": K,
+        "new_tokens": NEW_TOKENS,
+        "fault": "serve_step_crash (rid-sticky, slot 0)",
+        "quarantined": 1,
+        "survivors_bit_identical": True,
+        "decode_compiles": int(eng.stats["compiles"]),
+        "prefill_compiles": int(eng.stats["prefill_compiles"]),
+        "engine_restarts": int(svc.restarts_total),
+        "crash_raises": int(plan.injected["serve_step_crash"]),
+        "clean_burst_s": round(clean_s, 4),
+        "faulted_burst_s": round(faulted_s, 4),
+        "recovery_overhead_s": round(max(0.0, faulted_s - clean_s), 4),
     }
 
 

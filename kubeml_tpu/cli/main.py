@@ -386,6 +386,14 @@ def _render_top(doc: dict) -> str:
                 f"{_ms(latest.get('serve_ttft_queue_s'))}  prefill "
                 f"{_ms(latest.get('serve_ttft_prefill_s'))}  interleave "
                 f"{_ms(latest.get('serve_ttft_interleave_s'))}")
+        if latest.get("serve_engine_restarts") is not None:
+            # fault pane: supervisor restarts, quarantined poisoners,
+            # deadline expiries — all zero on a healthy replica
+            lines.append(
+                f"serve faults: restarts "
+                f"{latest.get('serve_engine_restarts', 0):g}  poisoned "
+                f"{latest.get('serve_poisoned_total', 0):g}  deadline "
+                f"{latest.get('serve_deadline_total', 0):g}")
     if latest.get("data_lag_generations") is not None \
             and float(latest.get("data_lag_generations", -1)) >= 0:
         # continual pane: dataset freshness — the generation the job last
@@ -530,6 +538,7 @@ def cmd_serve(args):
                                serve_queue_depth=args.serve_queue_depth,
                                serve_prefill_chunk=args.serve_prefill_chunk,
                                serve_prefix_cache=_prefix_cache_opt(args),
+                               serve_drain_grace_s=args.serve_drain_grace_s,
                                cluster_lanes=args.cluster_lanes,
                                cluster_tenants=args.cluster_tenant,
                                cluster_aging_s=args.cluster_aging_s)
@@ -560,7 +569,8 @@ def cmd_serve(args):
                               serve_slots=args.serve_slots,
                               serve_queue_depth=args.serve_queue_depth,
                               serve_prefill_chunk=args.serve_prefill_chunk,
-                              serve_prefix_cache=_prefix_cache_opt(args))
+                              serve_prefix_cache=_prefix_cache_opt(args),
+                              serve_drain_grace_s=args.serve_drain_grace_s)
     else:  # storage
         from kubeml_tpu.control.storage import StorageService
         svc = StorageService(port=args.port or const.STORAGE_PORT)
@@ -879,6 +889,12 @@ def build_parser() -> argparse.ArgumentParser:
                         "requests by content hash, with copy-on-write "
                         "on divergence "
                         "(KUBEML_SERVE_PREFIX_CACHE, default on)")
+    s.add_argument("--serve-drain-grace-s", type=float, default=None,
+                   metavar="S",
+                   help="graceful-drain budget on shutdown: admission "
+                        "answers 503 + Retry-After while in-flight "
+                        "streams get S seconds to finish; 0 stops hard "
+                        "(KUBEML_SERVE_DRAIN_GRACE_S, default 0)")
     s.add_argument("--cluster-lanes", type=int, default=None, metavar="N",
                    help="turn on the cluster allocator over N shared "
                         "worker lanes: gang placement, priority "

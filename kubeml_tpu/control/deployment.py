@@ -73,6 +73,7 @@ def start_deployment(mesh=None, controller_port: int = 0,
                      serve_queue_depth: Optional[int] = None,
                      serve_prefill_chunk: Optional[int] = None,
                      serve_prefix_cache: Optional[bool] = None,
+                     serve_drain_grace_s: Optional[float] = None,
                      cluster_lanes: Optional[int] = None,
                      cluster_tenants=None,
                      cluster_aging_s: Optional[float] = None) -> Deployment:
@@ -104,7 +105,8 @@ def start_deployment(mesh=None, controller_port: int = 0,
                          serve_slots=serve_slots,
                          serve_queue_depth=serve_queue_depth,
                          serve_prefill_chunk=serve_prefill_chunk,
-                         serve_prefix_cache=serve_prefix_cache)
+                         serve_prefix_cache=serve_prefix_cache,
+                         serve_drain_grace_s=serve_drain_grace_s)
     ps.start()
 
     scheduler = Scheduler(ps_url=ps.url, port=scheduler_port,

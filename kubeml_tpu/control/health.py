@@ -210,6 +210,29 @@ def _make_data_staleness(lag_limit: int):
     return check
 
 
+def _make_serve_crash_loop(restart_limit: int = 2):
+    """Serving plane: critical when the supervisor rebuilt the engine
+    `restart_limit`+ times within the sample window — one restart is
+    recovery working, repeated restarts are a crash loop (a fault the
+    supervisor keeps resurrecting into). Delta across the window, like
+    serve_saturation's 429 accounting, so old restarts age out."""
+    def check(window: List[dict]) -> Optional[str]:
+        m = _latest(window)
+        latest = m.get("serve_engine_restarts")
+        if latest is None:
+            return None
+        first = next((s.get("serve_engine_restarts") for s in window
+                      if s.get("serve_engine_restarts") is not None),
+                     None)
+        delta = float(latest) - float(first if first is not None else 0)
+        if delta >= restart_limit:
+            return (f"serving engine restarted {delta:g} time(s) within "
+                    f"the sample window (limit {restart_limit}) — the "
+                    f"supervisor is crash-looping")
+        return None
+    return check
+
+
 def _make_serve_ttft_slo(slo_s: float):
     def check(window: List[dict]) -> Optional[str]:
         m = _latest(window)
@@ -251,6 +274,9 @@ def default_rules(grad_abs: float = 1e4, grad_rel: float = 50.0,
         HealthRule("serve_ttft_slo", "warning",
                    "serving p99 time-to-first-token above the SLO",
                    _make_serve_ttft_slo(serve_ttft_slo_s)),
+        HealthRule("serve_crash_loop", "critical",
+                   "serving engine restarted repeatedly in the window",
+                   _make_serve_crash_loop()),
         HealthRule("queue_starvation", "warning",
                    "a cluster-parked job has waited past the limit",
                    _make_queue_starvation(queue_starvation_s)),
@@ -279,6 +305,10 @@ _SAMPLE_FIELDS = ("train_loss", "validation_loss", "accuracy",
                   "serve_ttft_interleave_s",
                   "serve_prefill_backlog_tokens", "serve_prefix_hit_pct",
                   "serve_weight_generation", "serve_active_generations",
+                  # fault-tolerance telemetry (PR 12): restarts feed the
+                  # serve_crash_loop rule, the rest the top faults line
+                  "serve_engine_restarts", "serve_poisoned_total",
+                  "serve_deadline_total",
                   # continual-plane freshness (train/job.py sliding
                   # window); lag -1 = not a continual job
                   "dataset_generation", "data_lag_generations",

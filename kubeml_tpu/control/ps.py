@@ -281,7 +281,8 @@ class ParameterServer(JsonService):
                  serve_page_tokens: Optional[int] = None,
                  serve_hbm_budget_mb: Optional[float] = None,
                  serve_prefill_chunk: Optional[int] = None,
-                 serve_prefix_cache: Optional[bool] = None):
+                 serve_prefix_cache: Optional[bool] = None,
+                 serve_drain_grace_s: Optional[float] = None):
         super().__init__(port=port)
         # Lazy mesh: in standalone mode the PARENT must not initialize the
         # accelerator backend (on TPU, libtpu is single-process-exclusive —
@@ -344,6 +345,12 @@ class ParameterServer(JsonService):
                 "KUBEML_SERVE_PREFIX_CACHE", "on").lower() \
                 not in ("0", "off", "false", "no")
         self.serve_prefix_cache = bool(serve_prefix_cache)
+        # graceful drain budget on stop(): 0 = hard stop (the default
+        # keeps test teardown instant); >0 closes admission with 503s
+        # and lets in-flight streams finish for that many seconds
+        self.serve_drain_grace_s = float(
+            serve_drain_grace_s if serve_drain_grace_s is not None
+            else os.environ.get("KUBEML_SERVE_DRAIN_GRACE_S", "0"))
         self._serve: Dict[str, tuple] = {}   # model_id -> (stamp, service)
         self._serve_lock = threading.Lock()
         self._infer_batcher = InferBatcher() if InferBatcher.enabled() \
@@ -803,7 +810,12 @@ class ParameterServer(JsonService):
                     slots=self.serve_slots, page=self.serve_page_tokens,
                     max_len=module.max_len),
                 prefill_chunk=self.serve_prefill_chunk,
-                prefix_cache=self.serve_prefix_cache)
+                prefix_cache=self.serve_prefix_cache,
+                # production posture: a pager invariant violation is
+                # logged and counted (kubeml_serve_page_leaks_total),
+                # never an AssertionError that kills the serving loop
+                # mid-stream — tests run strict
+                strict_pager=False)
         except (ValueError, TypeError, AttributeError) as e:
             # non-GPT modules (no paged decode step) and invalid serve
             # knobs (e.g. a negative prefill chunk) are client errors
@@ -837,12 +849,15 @@ class ParameterServer(JsonService):
     def _h_generate(self, req: Request):
         """Streaming continuous-batching generation. Body:
         {model_id, prompt: [token ids], max_new_tokens, temperature,
-        seed, eos_id, stream} — stream=true (default) answers ndjson
-        chunks ({"token": id} per token, then {"done": ..., "tokens":
-        [...]}) as the decode loop produces them; stream=false blocks
-        and answers one JSON document. Saturation answers 429 with
-        Retry-After (admission control, never unbounded queueing)."""
-        from kubeml_tpu.serve.slots import ServeSaturated
+        seed, eos_id, deadline_ms, stream} — stream=true (default)
+        answers ndjson chunks ({"token": id} per token, then
+        {"done": ..., "tokens": [...]}) as the decode loop produces
+        them; stream=false blocks and answers one JSON document.
+        Saturation answers 429 with Retry-After (admission control,
+        never unbounded queueing); an infeasible deadline_ms also 429s
+        at admission; a draining service answers 503 + Retry-After so
+        the client's retry lands on another replica."""
+        from kubeml_tpu.serve.slots import ServeDraining, ServeSaturated
         body = req.body if isinstance(req.body, dict) else {}
         model_id = body.get("model_id")
         if not model_id:
@@ -871,10 +886,11 @@ class ParameterServer(JsonService):
                 temperature=float(body.get("temperature", 0.0)),
                 seed=int(body.get("seed", 0)),
                 eos_id=body.get("eos_id"),
-                trace_id=trace_id)
+                trace_id=trace_id,
+                deadline_ms=body.get("deadline_ms"))
         except InferenceInputError as e:
             raise InvalidArgsError(str(e)) from e
-        except ServeSaturated as e:
+        except (ServeSaturated, ServeDraining) as e:
             retry = max(1, int(round(e.retry_after_s)))
             return Raw(e.to_json().encode(), "application/json",
                        status=e.status_code,
@@ -1367,12 +1383,14 @@ class ParameterServer(JsonService):
         self._reaper_stop.set()
         # stop the serving loops first: they fail their in-flight
         # streams with terminal events, so blocked /generate threads
-        # unwind instead of waiting out their stream timeout
+        # unwind instead of waiting out their stream timeout. With a
+        # drain grace budget, admission 503s first and in-flight
+        # streams get that long to finish cleanly before the hard stop
         with self._serve_lock:
             serves = [svc for _, svc in self._serve.values()]
             self._serve.clear()
         for svc in serves:
-            svc.stop()
+            svc.stop(grace_s=self.serve_drain_grace_s)
         with self._jobs_lock:
             self._stopping = True  # no further spawns or crash-restarts
             recs = list(self.jobs.values())

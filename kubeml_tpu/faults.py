@@ -66,6 +66,35 @@ TrainJob wires the plan in automatically (train/job.py): it becomes the
 job's round hook (dropout/crash/slow/corrupt run post-staging) and wraps
 the staging transform (nan runs pre-staging — batch leaves are still
 host numpy there; post-staging they are immutable device arrays).
+
+The SERVING plane has its own plan class (ServeFaultPlan) with its own
+coordinate system — (engine step, decode slot) instead of (epoch,
+round, worker) — because the decode loop has no epochs and its unit of
+blast radius is one slot. Serve event kinds:
+
+  serve_nan_logits
+           raise the poison lane for the target slot's decode dispatch
+           at the step, driving the on-device non-finite logit guard
+           (models/gpt.py build_paged_decode_step): only that slot's
+           request terminates (`error`, "poisoned"), concurrent streams
+           stay bit-identical, and the program inventory stays at two
+           compiles. Fires once per event.
+  serve_step_crash
+           raise RuntimeError from the engine step BEFORE any page
+           mutation. STICKY BY REQUEST: the event binds to the rid
+           occupying its slot at first fire and keeps crashing any step
+           that schedules that rid — which is exactly what the
+           ServeService bisection needs to converge on the poisoning
+           request (retries with the rid's lane masked succeed; the
+           quarantined request terminates and the crash stops).
+  serve_slow_step
+           time.sleep(duration_s) at the step — an artificially slow
+           engine round (keep duration_s small in tier-1 tests).
+  serve_loop_wedge
+           spin inside the serving loop (after the step completes)
+           until the supervisor abandons the engine — drives the
+           watchdog's wedge detection + recovery path without killing
+           the process. Fires once per event.
 """
 
 from __future__ import annotations
@@ -75,7 +104,7 @@ import json
 import logging
 import os
 import time
-from typing import Any, List, Optional
+from typing import Any, Dict, List, Optional
 
 import numpy as np
 
@@ -83,6 +112,13 @@ logger = logging.getLogger("kubeml_tpu.faults")
 
 KINDS = ("nan", "dropout", "crash", "corrupt_checkpoint", "slow",
          "preempt", "quarantine", "stale_data")
+
+# serving-plane fault kinds (ServeFaultPlan below); every name here
+# must appear QUOTED on an assert line in some tests/ file —
+# tools/check_fault_tests.py enforces the coverage like
+# check_serve_spans.py does for span kinds
+SERVE_KINDS = ("serve_nan_logits", "serve_step_crash", "serve_slow_step",
+               "serve_loop_wedge")
 
 # distinctive enough that a watchdog test can assert the death was the
 # injected crash, not an import error or OOM kill
@@ -279,3 +315,144 @@ class FaultPlan:
                        self.epoch, rnd, CRASH_EXIT_CODE)
         logging.shutdown()
         os._exit(CRASH_EXIT_CODE)
+
+
+@dataclasses.dataclass
+class ServeFaultEvent:
+    """One serving-plane injection at (engine step, slot); -1 = wildcard
+    (any step / whichever eligible slot comes first)."""
+
+    kind: str
+    step: int = -1
+    slot: int = -1
+    duration_s: float = 0.0   # serve_slow_step only
+
+    def at_step(self, step: int) -> bool:
+        return self.step < 0 or self.step == step
+
+
+class ServeFaultPlan:
+    """Coordinate-driven fault schedule for the decode engine + serving
+    loop (module docstring for kind semantics). No wall-clock
+    randomness: every hook either fires at its coordinates or it does
+    not, so every serve recovery path replays bit-for-bit in tier-1.
+
+    The engine calls `nan_hits` / `check_crash` / `sleep` from inside
+    its step; the ServeService calls `maybe_wedge` between steps. A
+    recovered engine (DecodeEngine.spawn_recovered) adopts the same
+    plan instance, so once-only and rid-sticky state survives restarts
+    — an injected crash does not re-fire into a crash loop.
+    """
+
+    def __init__(self, events: List[ServeFaultEvent]):
+        self.events = events
+        self.injected = {k: 0 for k in SERVE_KINDS}
+        self._fired: set = set()          # event index -> fired (once-only)
+        self._crash_rid: Dict[int, str] = {}   # event index -> bound rid
+
+    @classmethod
+    def parse(cls, spec: Any) -> "ServeFaultPlan":
+        """Parse a JSON string / dict / list of serve event dicts."""
+        if isinstance(spec, ServeFaultPlan):
+            return spec
+        if isinstance(spec, str):
+            spec = json.loads(spec)
+        if isinstance(spec, dict):
+            spec = spec.get("events", [])
+        if not isinstance(spec, list):
+            raise ValueError("serve fault_plan must be a list of events "
+                             "or {'events': [...]}")
+        events = []
+        for e in spec:
+            kind = e.get("kind")
+            if kind not in SERVE_KINDS:
+                raise ValueError(f"unknown serve fault kind {kind!r}; "
+                                 f"expected one of {SERVE_KINDS}")
+            events.append(ServeFaultEvent(
+                kind=kind,
+                step=int(e.get("step", -1)),
+                slot=int(e.get("slot", -1)),
+                duration_s=float(e.get("duration_s", 0.0)),
+            ))
+        return cls(events)
+
+    def has(self, kind: str) -> bool:
+        return any(ev.kind == kind for ev in self.events)
+
+    def nan_hits(self, step: int, member_slots) -> set:
+        """Slots whose decode dispatch at `step` gets the poison lane
+        raised (non-finite logits on device). Once per event: the event
+        is consumed by the first dispatch that actually contains its
+        target slot, so a wildcard-step event poisons exactly one
+        dispatch, not every one."""
+        hits: set = set()
+        for i, ev in enumerate(self.events):
+            if ev.kind != "serve_nan_logits" or i in self._fired:
+                continue
+            if not ev.at_step(step):
+                continue
+            targets = [s for s in member_slots
+                       if ev.slot < 0 or ev.slot == s]
+            if not targets:
+                continue
+            self._fired.add(i)
+            self.injected["serve_nan_logits"] += 1
+            hits.update(targets)
+            logger.warning("fault serve_nan_logits: step %d slot(s) %s",
+                           step, targets)
+        return hits
+
+    def check_crash(self, step: int, occupants) -> None:
+        """Raise RuntimeError when a serve_step_crash event is live for
+        this step. `occupants` is [(slot, rid)] of the streams the step
+        is about to schedule (excluded lanes omitted). Rid-sticky: at
+        first fire the event binds to the rid in its slot, then crashes
+        every step that includes that rid until the request terminates
+        — the exact failure model ServeService's bisection isolates."""
+        for i, ev in enumerate(self.events):
+            if ev.kind != "serve_step_crash":
+                continue
+            rid = self._crash_rid.get(i)
+            if rid is None:
+                if not ev.at_step(step):
+                    continue
+                rid = next((r for s, r in occupants
+                            if ev.slot < 0 or ev.slot == s), None)
+                if rid is None:
+                    continue
+                self._crash_rid[i] = rid
+            if any(r == rid for _, r in occupants):
+                self.injected["serve_step_crash"] += 1
+                logger.warning("fault serve_step_crash: step %d rid %s",
+                               step, rid)
+                raise RuntimeError(
+                    f"injected serve_step_crash: stream {rid} poisons "
+                    f"the decode step")
+
+    def sleep(self, step: int) -> None:
+        for ev in self.events:
+            if ev.kind == "serve_slow_step" and ev.at_step(step):
+                self.injected["serve_slow_step"] += 1
+                logger.info("fault serve_slow_step: step %d sleeping "
+                            "%.3fs", step, ev.duration_s)
+                time.sleep(ev.duration_s)
+
+    def maybe_wedge(self, engine) -> bool:
+        """Spin until the supervisor abandons `engine` when a
+        serve_loop_wedge event is live for its current step. Called by
+        the serving loop AFTER terminal accounting for the step, so the
+        wedge freezes the loop between rounds, never mid-bookkeeping.
+        Once per event."""
+        for i, ev in enumerate(self.events):
+            if ev.kind != "serve_loop_wedge" or i in self._fired:
+                continue
+            if not ev.at_step(engine._step_count):
+                continue
+            self._fired.add(i)
+            self.injected["serve_loop_wedge"] += 1
+            logger.warning("fault serve_loop_wedge: step %d — serving "
+                           "loop wedged until abandon", engine._step_count)
+            while not engine._abandoned:
+                time.sleep(0.005)
+            return True
+        return False

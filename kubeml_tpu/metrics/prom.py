@@ -490,7 +490,7 @@ class MetricsRegistry:
         self.serve_requests_total = Counter(
             "kubeml_serve_requests_total",
             "Finished /generate requests by served model and outcome "
-            "(ok|rejected|cancelled|error)", ("model", "outcome"))
+            "(ok|rejected|cancelled|deadline|error)", ("model", "outcome"))
         self.serve_tokens_total = Counter(
             "kubeml_serve_tokens_total",
             "Tokens generated by a served model", "model")
@@ -518,6 +518,23 @@ class MetricsRegistry:
             "kubeml_serve_prefill_backlog_tokens",
             "Prompt tokens admitted but not yet prefilled, by served "
             "model", "model")
+        # fault tolerance (PR 12): supervisor rebuilds, poisoned-stream
+        # quarantines, and KV pager invariant violations (production
+        # engines log-and-count instead of crashing; any nonzero value
+        # is a bug to chase)
+        self.serve_engine_restarts_total = Counter(
+            "kubeml_serve_engine_restarts_total",
+            "Supervisor engine rebuilds after a dead or wedged serving "
+            "loop, by served model", "model")
+        self.serve_poisoned_total = Counter(
+            "kubeml_serve_poisoned_requests_total",
+            "Requests quarantined for poisoning the decode step "
+            "(non-finite logits or step exceptions isolated by "
+            "bisection), by served model", "model")
+        self.serve_page_leaks_total = Counter(
+            "kubeml_serve_page_leaks_total",
+            "KV pager invariant violations detected on release or "
+            "recovery, by served model", "model")
         # continual plane (PR 10): the weight generation new admissions
         # attach to (advances on every zero-downtime hot-swap), and the
         # continual job's data freshness — dataset generation trained
@@ -634,6 +651,9 @@ class MetricsRegistry:
                                 self.serve_decode_tokens_total,
                                 self.serve_prefix_hits_total,
                                 self.serve_prefix_misses_total,
+                                self.serve_engine_restarts_total,
+                                self.serve_poisoned_total,
+                                self.serve_page_leaks_total,
                                 self.infer_cache_hits_total,
                                 self.infer_cache_misses_total]
         self._cluster_gauges = [self.cluster_pool_lanes,
@@ -775,6 +795,15 @@ class MetricsRegistry:
     def note_serve_prefix_misses(self, model: str, n: int) -> None:
         self.serve_prefix_misses_total.inc(model, n)
 
+    def note_serve_engine_restart(self, model: str) -> None:
+        self.serve_engine_restarts_total.inc(model)
+
+    def note_serve_poisoned(self, model: str) -> None:
+        self.serve_poisoned_total.inc(model)
+
+    def note_serve_page_leaks(self, model: str, n: int) -> None:
+        self.serve_page_leaks_total.inc(model, n)
+
     def observe_serve_ttft_breakdown(self, model: str, queue: float,
                                      prefill: float,
                                      interleave: float) -> None:
@@ -812,7 +841,10 @@ class MetricsRegistry:
                   self.serve_prefill_tokens_total,
                   self.serve_decode_tokens_total,
                   self.serve_prefix_hits_total,
-                  self.serve_prefix_misses_total):
+                  self.serve_prefix_misses_total,
+                  self.serve_engine_restarts_total,
+                  self.serve_poisoned_total,
+                  self.serve_page_leaks_total):
             c.clear_prefix(model)
         self.trace_dropped_total.clear_prefix(f"serve:{model}")
         self._trace_seen.pop(f"serve:{model}", None)

@@ -440,8 +440,8 @@ def build_paged_decode_step(module: GPTModule):
       step(params, k_pages, v_pages, valid_pages,
            tokens[S], pos[S], page_tables[S, Pmax],
            write_page[S], write_off[S], active[S], temps[S],
-           key_data[S, 2], copy_src[S], copy_dst[S])
-        -> (next_tokens[S], k_pages, v_pages, valid_pages)
+           key_data[S, 2], copy_src[S], copy_dst[S], poison[S])
+        -> (next_tokens[S], bad[S], k_pages, v_pages, valid_pages)
 
     Every per-request quantity is DATA (the kavg worker-mask trick), so
     slot membership changes never recompile. Inactive slots compute
@@ -463,6 +463,17 @@ def build_paged_decode_step(module: GPTModule):
     programs and the compile count stays pinned at two (prefill +
     decode). Slots with nothing to split pass 0 -> 0, a no-op through
     the null page.
+
+    bad[S] is the ON-DEVICE NON-FINITE GUARD (the kavg merge guard's
+    serving twin): 1.0 for an active row whose logits went non-finite
+    this step. The check runs BEFORE the never-emit-PAD mask (which
+    puts a legitimate -inf in every row) and flagged rows are
+    where-selected to zeros before sampling — per LANE, so one
+    poisoned stream never perturbs its neighbours' math and the host
+    can terminate just that slot. poison[S] is the fault-injection
+    lane driving it deterministically (faults.py serve_nan_logits): a
+    raised lane forces that row non-finite on device, through the same
+    guard a genuinely poisoned checkpoint would trip.
 
     Slots are rows: no cross-slot reduction exists anywhere in the
     step, which is what makes concurrent decode bit-identical to
@@ -487,7 +498,7 @@ def build_paged_decode_step(module: GPTModule):
 
     def step(params, k_pages, v_pages, valid_pages, tokens, pos,
              page_tables, write_page, write_off, active, temps, key_data,
-             copy_src, copy_dst):
+             copy_src, copy_dst, poison):
         S = tokens.shape[0]
         G = valid_pages.shape[1]
         C = page_tables.shape[1] * G
@@ -534,6 +545,18 @@ def build_paged_decode_step(module: GPTModule):
         logits = tok_embed.apply(
             {"params": params["tok_embed"]}, h.astype(dtype),
             method=tok_embed.attend).astype(jnp.float32)[:, 0]
+        # fault lane: a raised poison row goes non-finite here, BEFORE
+        # the guard — injection and genuine weight poison trip the same
+        # path (where-select, never 0*NaN: that would stay NaN)
+        logits = jnp.where(poison[:, None] > 0, jnp.nan, logits)
+        # non-finite guard, per lane. Must run BEFORE the PAD mask
+        # below writes a legitimate -inf into every row; flagged rows
+        # are sanitized to zeros so argmax/categorical stay well-defined
+        # (their pick is discarded by the host and forced to 0 anyway).
+        bad = active * (1.0 - jnp.all(
+            jnp.isfinite(logits), axis=-1).astype(jnp.float32))
+        logits = jnp.where(bad[:, None] > 0,
+                           jnp.zeros_like(logits), logits)
         logits = logits.at[:, PAD_ID].set(-jnp.inf)  # never emit PAD
 
         def pick_one(kd, lg, t):
@@ -544,7 +567,8 @@ def build_paged_decode_step(module: GPTModule):
             return jnp.where(t > 0, sampled, greedy)
 
         nxt = jax.vmap(pick_one)(key_data, logits, temps)
-        return nxt, k_pages, v_pages, valid_pages
+        nxt = jnp.where(bad > 0, 0, nxt)
+        return nxt, bad, k_pages, v_pages, valid_pages
 
     return step
 

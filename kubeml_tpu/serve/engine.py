@@ -97,10 +97,14 @@ SERVE_SPAN_KINDS = (
     "prefill_chunk",   # one chunked-prefill dispatch feeding this request
     "first_token",     # instant: first generated token (carries breakdown)
     "decode",          # sampled decode dispatch spans after first token
-    "finish",          # terminal instant: EOS / token budget / error
+    "finish",          # terminal instant: EOS / token budget / error /
+                       # deadline expiry (outcome arg tells them apart)
     "shed",            # terminal instant: load-shed (429 or KV exhaustion)
     "cancel",          # terminal instant: client cancel / disconnect
     "flight_snapshot", # instant: flight-recorder ring dumped on incident
+    "engine_restart",  # instant: supervisor rebuilt the engine (carries
+                       # the reason and how many streams resumed)
+    "drain",           # instant: graceful-drain onset (admission -> 503)
 )
 
 
@@ -149,7 +153,8 @@ class DecodeEngine:
                  prefix_cache: bool = True,
                  prefill_budget: Optional[int] = None,
                  tracer=None, flight_steps: int = 256,
-                 decode_span_every: int = 16):
+                 decode_span_every: int = 16,
+                 fault_plan=None, strict_pager: bool = True):
         prefill_chunk = int(prefill_chunk)
         if prefill_chunk < 0:
             raise ValueError(
@@ -208,6 +213,18 @@ class DecodeEngine:
                 f"got {flight_steps}")
         self.flight = FlightRecorder(flight_steps) if flight_steps else None
         self.decode_span_every = max(1, int(decode_span_every))
+        # deterministic serve fault injection (faults.ServeFaultPlan):
+        # nan_hits raises the decode program's poison lane, check_crash
+        # raises from the step, sleep stalls it — all at named (step,
+        # slot) coordinates. strict_pager: pager invariant violations
+        # raise (tests/bench) instead of counting page_leaks (the
+        # production posture control/ps.py wires)
+        self.fault_plan = fault_plan
+        self.strict_pager = bool(strict_pager)
+        # supervisor recovery flag: an abandoned engine's step() is a
+        # no-op, so a wedged loop thread that wakes after the swap can
+        # never double-emit tokens the replacement engine re-decodes
+        self._abandoned = False
         self._step_count = 0
         self._dispatch_wall_s = 0.0   # cumulative prefill+decode wall time
         self._shed_count = 0          # KV-exhaustion sheds (flight 'kind')
@@ -220,6 +237,7 @@ class DecodeEngine:
             "prefill_compiles": 0, "decode_tokens": 0,
             "prefix_hits": 0, "prefix_misses": 0, "cow_splits": 0,
             "weight_swaps": 0, "generations_retired": 0,
+            "poisoned": 0, "deadline_expired": 0, "page_leaks": 0,
         }
 
     # ------------------------------------------------------------- capacity
@@ -332,13 +350,35 @@ class DecodeEngine:
         """Claim a free slot for a validated request; returns the slot.
         With the prefix cache on, the prompt's full pages are matched
         against the content-hash index and every hit is shared into the
-        slot's table — the prefill cursor starts past the matched run."""
-        prompt = self.check_admissible(req.prompt, req.max_new_tokens)
+        slot's table — the prefill cursor starts past the matched run.
+
+        A request that already EMITTED tokens (supervisor recovery,
+        service.py _recover) is RESUMED: prompt + emitted tokens become
+        one combined context to re-prefill, and the per-(seed,
+        position) sampling keys make the continuation bit-identical to
+        the uninterrupted stream — the dispatch at the combined
+        context's last position samples with exactly the key the
+        pre-crash run would have used for the next token, and the emit
+        path skips every position before it, so nothing re-emits. The
+        stream re-pins its original weight generation (resume_gen) when
+        its params are still resident."""
+        ctx = list(req.prompt)
+        budget = req.max_new_tokens
+        if req.tokens:
+            ctx = ctx + [int(t) for t in req.tokens]
+            # emitted tokens already spent budget: validating the
+            # combined context against the REMAINING budget keeps the
+            # context-limit check identical to the original admission
+            budget = max(1, req.max_new_tokens - len(req.tokens))
+        prompt = self.check_admissible(ctx, budget)
+        gen = self.weight_generation
+        if req.resume_gen is not None \
+                and req.resume_gen in self._params_by_gen:
+            gen = req.resume_gen
         for s, cur in enumerate(self._slots):
             if cur is None:
                 t0 = self.clock()
-                slot = _Slot(req, prompt, self._seq,
-                             gen=self.weight_generation)
+                slot = _Slot(req, prompt, self._seq, gen=gen)
                 self._seq += 1
                 self._slots[s] = slot
                 if self.prefix_cache:
@@ -413,9 +453,10 @@ class DecodeEngine:
         self._tables[s] = 0
         self._slots[s] = None
         slot.req.finished_at = self.clock()
-        # terminal instant: finish (ok or error), shed (KV exhaustion —
-        # the only engine-side shed), or cancel. The service emits the
-        # same kinds for requests that never reached a slot.
+        # terminal instant: finish (ok, error, or deadline expiry —
+        # outcome rides in args), shed (KV exhaustion — the only
+        # engine-side shed), or cancel. The service emits the same
+        # kinds for requests that never reached a slot.
         if outcome == "cancelled":
             kind = "cancel"
         elif outcome == "error" and error and "shed" in error:
@@ -429,6 +470,25 @@ class DecodeEngine:
         # last reader of a superseded weight generation detaching frees
         # that generation's params and cache partition
         self._maybe_retire(slot.gen)
+        # every release path audits page conservation: a leak caught at
+        # the releasing request is attributable; one caught at restart
+        # is archaeology
+        self.check_pager()
+
+    def check_pager(self) -> None:
+        """Run the allocator's invariant audit (pager.check_invariants).
+        Violations raise in strict mode; in production they count into
+        stats["page_leaks"] (published as
+        kubeml_serve_page_leaks_total) and serving continues — a leak
+        degrades capacity, it does not justify failing live streams."""
+        problems = self.pager.check_invariants()
+        if not problems:
+            return
+        self.stats["page_leaks"] += 1
+        msg = "KV pager invariants violated: " + "; ".join(problems)
+        if self.strict_pager:
+            raise AssertionError(msg)
+        logger.error(msg)
 
     def cancel_request(self, req: GenerateRequest) -> bool:
         for s, slot in enumerate(self._slots):
@@ -503,29 +563,72 @@ class DecodeEngine:
         decode, so no slot is ever 'in prefill'."""
         return self._prefill is not None and slot.pos < slot.n_prompt - 1
 
+    # ------------------------------------------------------------ supervisor
+    def abandon(self) -> None:
+        """Mark this engine dead: the supervisor (service.py _recover)
+        swapped a replacement in. Step becomes a no-op, so the old loop
+        thread — possibly still wedged inside a fault hook — can wake
+        at any time without double-emitting tokens the new engine is
+        re-decoding; it also unblocks ServeFaultPlan.maybe_wedge."""
+        self._abandoned = True
+
+    def spawn_recovered(self) -> "DecodeEngine":
+        """Build this engine's replacement after a crash or wedge:
+        fresh slab, pager, page tables, slots and jitted programs (the
+        recompile is the recovery cost), same knobs and fault plan. The
+        replacement ADOPTS every resident weight generation, so resumed
+        streams re-attach pinned to the params they started under; the
+        prefix cache starts cold (its KV bytes lived in the dead slab)
+        and re-fills as resumed prompts re-prefill."""
+        eng = DecodeEngine(
+            self.module,
+            {"params": self._params_by_gen[self.weight_generation]},
+            geom=self.geom, clock=self.clock,
+            prefill_chunk=self.prefill_chunk,
+            prefix_cache=self.prefix_cache,
+            prefill_budget=self.prefill_budget,
+            tracer=self.tracer,
+            flight_steps=self.flight.capacity if self.flight else 0,
+            decode_span_every=self.decode_span_every,
+            fault_plan=self.fault_plan,
+            strict_pager=self.strict_pager)
+        eng.weight_generation = self.weight_generation
+        eng._params_by_gen = dict(self._params_by_gen)
+        eng.check_pager()
+        return eng
+
     # ----------------------------------------------------------------- step
-    def step(self) -> List[GenerateRequest]:
+    def step(self, exclude: frozenset = frozenset()
+             ) -> List[GenerateRequest]:
         """One scheduler round: up to prefill_budget prompt tokens of
         prefill chunks (FIFO), then one decode dispatch advancing every
         decode-phase slot by one token. Returns requests that reached a
         terminal state this round.
 
+        `exclude` masks streams by rid for this round only — they skip
+        prefill and decode and do not advance (the service's
+        step-exception bisection retries a failed step with suspect
+        lanes masked to isolate the poisoning request).
+
         Every step — including idle and stalled ones — leaves one record
         in the flight recorder; the mark/record pair brackets the whole
         round so the deltas cover every return path."""
+        if self._abandoned:
+            return []
         self._step_count += 1
         mark = None if self.flight is None else (
             self.stats["prefill_dispatches"], self.stats["dispatches"],
             self.stats["generated_tokens"], self.stats["cow_splits"],
-            self._dispatch_wall_s, self._shed_count)
+            self._dispatch_wall_s, self._shed_count,
+            self.stats["deadline_expired"])
         try:
-            return self._step_inner()
+            return self._step_inner(exclude)
         finally:
             if mark is not None:
                 self._record_flight(mark)
 
     def _record_flight(self, mark) -> None:
-        pf0, d0, g0, c0, w0, sh0 = mark
+        pf0, d0, g0, c0, w0, sh0, dl0 = mark
         pf = int(self.stats["prefill_dispatches"] - pf0)
         de = int(self.stats["dispatches"] - d0)
         if self._shed_count > sh0:
@@ -551,6 +654,7 @@ class DecodeEngine:
             "tokens": int(self.stats["generated_tokens"] - g0),
             "weight_generation": self.weight_generation,
             "generations": len(self._params_by_gen),
+            "deadlines": int(self.stats["deadline_expired"] - dl0),
         })
 
     def _note_first_token(self, slot: _Slot, t1: float) -> None:
@@ -571,7 +675,8 @@ class DecodeEngine:
             args = dict(ttft=ttft, **req.ttft_breakdown)
         self._instant("first_token", t1, req, **args)
 
-    def _step_inner(self) -> List[GenerateRequest]:
+    def _step_inner(self, exclude: frozenset = frozenset()
+                    ) -> List[GenerateRequest]:
         S = self.geom.slots
         G = self.geom.page
         stalled: List[int] = []
@@ -586,13 +691,41 @@ class DecodeEngine:
                 self.release(s, "cancelled")
                 finished.append(req)
 
+        # deadline reaper: expired streams release with the terminal
+        # `deadline` outcome — slot, pages, and prefix refs restore
+        # exactly like any other release, whatever phase the stream was
+        # in (queued requests are swept by the service before attach)
+        now = self.clock()
+        for s, slot in enumerate(self._slots):
+            if slot is None or slot.req.deadline_at is None \
+                    or now < slot.req.deadline_at:
+                continue
+            req = slot.req
+            self.stats["deadline_expired"] += 1
+            self.release(s, "deadline",
+                         f"deadline of {req.deadline_ms:g}ms exceeded "
+                         f"after {len(req.tokens)} token(s)")
+            finished.append(req)
+
+        # deterministic fault hooks, BEFORE any page maintenance: an
+        # injected crash leaves this step free of side effects, so the
+        # service's bisection can retry it with lanes masked and every
+        # successful retry starts from untouched tables
+        if self.fault_plan is not None:
+            occupants = [(s, sl.req.rid)
+                         for s, sl in enumerate(self._slots)
+                         if sl is not None and sl.req.rid not in exclude]
+            self.fault_plan.check_crash(self._step_count, occupants)
+            self.fault_plan.sleep(self._step_count)
+
         # ------------------------------------------------- prefill lane
         progressed = False
         if self._prefill is not None:
             budget = self.prefill_budget
             order = sorted(
                 (s for s, sl in enumerate(self._slots)
-                 if sl is not None and self._in_prefill(sl)),
+                 if sl is not None and self._in_prefill(sl)
+                 and sl.req.rid not in exclude),
                 key=lambda s: self._slots[s].seq)
             for s in order:
                 slot = self._slots[s]
@@ -618,7 +751,8 @@ class DecodeEngine:
         ready: List[int] = []
         cow: Dict[int, tuple] = {}
         for s, slot in enumerate(self._slots):
-            if slot is None or self._in_prefill(slot):
+            if slot is None or self._in_prefill(slot) \
+                    or slot.req.rid in exclude:
                 continue
             pi = slot.pos // G
             pid = int(self._tables[s, pi])
@@ -675,6 +809,11 @@ class DecodeEngine:
             key_data = np.zeros((S, 2), np.uint32)
             copy_src = np.zeros(S, np.int32)
             copy_dst = np.zeros(S, np.int32)
+            poison = np.zeros(S, np.float32)
+            if self.fault_plan is not None:
+                for s in self.fault_plan.nan_hits(self._step_count,
+                                                  members):
+                    poison[s] = 1.0
             for s in members:
                 slot = self._slots[s]
                 active[s] = 1.0
@@ -693,14 +832,16 @@ class DecodeEngine:
 
             before = self._step._cache_size()
             t0 = self.clock()
-            nxt, self.slab.k, self.slab.v, self.slab.valid = self._step(
-                self._params_by_gen[gen],
-                self.slab.k, self.slab.v, self.slab.valid,
-                jnp.asarray(tokens), jnp.asarray(pos),
-                jnp.asarray(self._tables), jnp.asarray(write_page),
-                jnp.asarray(write_off), jnp.asarray(active),
-                jnp.asarray(temps), jnp.asarray(key_data),
-                jnp.asarray(copy_src), jnp.asarray(copy_dst))
+            nxt, bad, self.slab.k, self.slab.v, self.slab.valid = \
+                self._step(
+                    self._params_by_gen[gen],
+                    self.slab.k, self.slab.v, self.slab.valid,
+                    jnp.asarray(tokens), jnp.asarray(pos),
+                    jnp.asarray(self._tables), jnp.asarray(write_page),
+                    jnp.asarray(write_off), jnp.asarray(active),
+                    jnp.asarray(temps), jnp.asarray(key_data),
+                    jnp.asarray(copy_src), jnp.asarray(copy_dst),
+                    jnp.asarray(poison))
             compiled = self._step._cache_size() > before
             t1 = self.clock()
             self.compile_tracker.note(compiled, t1 - t0)
@@ -710,11 +851,24 @@ class DecodeEngine:
             self.stats["occupancy_sum"] += len(members)
             self.stats["decode_tokens"] += len(members)
             nxt_host = np.asarray(nxt)
+            bad_host = np.asarray(bad)
 
             for s in members:
                 slot = self._slots[s]
                 p = slot.pos
                 slot.pos = p + 1
+                if bad_host[s] > 0:
+                    # on-device non-finite guard fired for this lane:
+                    # terminate ONLY this stream. Checked before the
+                    # prefix-cache registration below so a poisoned
+                    # stream never publishes its (suspect) KV pages.
+                    req = slot.req
+                    self.stats["poisoned"] += 1
+                    self.release(s, "error",
+                                 "non-finite logits at position "
+                                 f"{p}; request poisoned and isolated")
+                    finished.append(req)
+                    continue
                 if p <= slot.n_prompt - 1:
                     # this dispatch computed prompt context for the slot
                     # (token-by-token prefill, or the first-token step)

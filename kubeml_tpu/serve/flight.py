@@ -36,6 +36,7 @@ FLIGHT_FIELDS = (
     "tokens",             # generated tokens emitted this step
     "weight_generation",  # generation new admissions attach to
     "generations",        # weight generations resident (swap drain depth)
+    "deadlines",          # requests reaped by deadline expiry this step
 )
 
 

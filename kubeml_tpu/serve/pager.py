@@ -265,6 +265,55 @@ class PageAllocator:
             self._by_hash.pop(digest, None)
 
     # ------------------------------------------------------------ accounting
+    def check_invariants(self) -> List[str]:
+        """Audit the three-state pool; returns human-readable violation
+        strings (empty = healthy). The load-bearing identity is page
+        conservation — null + free + referenced + parked-LRU == every
+        page — which is exactly what a leaked release path breaks
+        (a page referenced by nobody yet on no list is gone until
+        restart). The engine runs this on every release and after
+        supervisor recovery: raises in strict mode (tests, bench),
+        counts kubeml_serve_page_leaks_total in production
+        (strict_pager=False, wired by control/ps.py)."""
+        problems: List[str] = []
+        free, refd = set(self._free), set(self._refs)
+        parked = set(self._lru)
+        if len(free) != len(self._free):
+            problems.append("free list holds duplicate page ids")
+        for name, ids in (("free", free), ("referenced", refd),
+                          ("parked", parked)):
+            bad = [p for p in ids if not 0 < p < self.geom.pages]
+            if bad:
+                problems.append(f"{name} pages outside slab: {bad}")
+        for a, b in (("free", "referenced"), ("free", "parked"),
+                     ("referenced", "parked")):
+            inter = {"free": free, "referenced": refd,
+                     "parked": parked}[a] & \
+                    {"free": free, "referenced": refd, "parked": parked}[b]
+            if inter:
+                problems.append(f"pages both {a} and {b}: {sorted(inter)}")
+        accounted = 1 + len(free) + len(refd) + len(parked)
+        if accounted != self.geom.pages:
+            problems.append(
+                f"page conservation broken: null(1) + free({len(free)}) "
+                f"+ referenced({len(refd)}) + parked({len(parked)}) "
+                f"= {accounted}, slab has {self.geom.pages}")
+        if any(c < 1 for c in self._refs.values()):
+            problems.append("refcount below 1 retained in _refs")
+        # hash index must be a bijection, and every parked page must be
+        # registered (an unregistered refcount-0 page belongs on the
+        # free list, not the LRU)
+        if len(self._by_hash) != len(self._hash_of):
+            problems.append("prefix-hash index is not a bijection")
+        for pid, key in self._hash_of.items():
+            if self._by_hash.get(key) != pid:
+                problems.append(
+                    f"hash index mismatch for page {pid}")
+        unreg = parked - set(self._hash_of)
+        if unreg:
+            problems.append(f"parked pages not registered: {sorted(unreg)}")
+        return problems
+
     @property
     def free_pages(self) -> int:
         return len(self._free)

@@ -23,10 +23,18 @@ import threading
 import time
 from typing import Callable, Deque, List, Optional
 
+from kubeml_tpu.models.base import InferenceInputError
 from kubeml_tpu.serve.engine import DecodeEngine
-from kubeml_tpu.serve.slots import GenerateRequest, ServeSaturated
+from kubeml_tpu.serve.slots import (GenerateRequest, ServeDraining,
+                                    ServeSaturated)
 
 logger = logging.getLogger("kubeml_tpu.serve.service")
+
+# step-exception bisection: how many suspect lanes a failed step is
+# retried against before giving up and failing every active stream
+# (each failed retry is cheap — the engine re-raises before touching
+# page state — but a pathological exception could fail every retry)
+BISECT_MAX_SUSPECTS = 8
 
 # recent-TTFT window for the host-side p50/p99 the health rules consume
 TTFT_WINDOW = 128
@@ -52,7 +60,10 @@ class ServeService:
                  max_queue: int = 16, metrics=None,
                  health_cb: Optional[Callable[[dict], None]] = None,
                  clock=time.perf_counter,
-                 tracer=None, trace_sink=None):
+                 tracer=None, trace_sink=None,
+                 wedge_timeout_s: float = 30.0,
+                 watchdog_interval_s: float = 0.25,
+                 supervise: bool = True):
         self.model_id = model_id
         self.engine = engine
         self.max_queue = int(max_queue)
@@ -83,6 +94,26 @@ class ServeService:
         self._pending: Deque[GenerateRequest] = collections.deque()
         self._inflight = 0          # admitted, not yet terminal
         self._stopped = False
+        self._draining = False      # admission -> 503, streams drain
+        # supervisor (PR-4 heartbeat style, one process): the loop
+        # thread beats at the top of every round; the watchdog declares
+        # a wedge when the beat goes stale WITH work in flight (an idle
+        # loop parks in cv.wait without beating — that is rest, not
+        # death) or the loop thread died, then rebuilds the engine and
+        # resumes in-flight streams (_recover)
+        self.wedge_timeout_s = float(wedge_timeout_s)
+        self.watchdog_interval_s = float(watchdog_interval_s)
+        self.supervise = bool(supervise)
+        self._beat = self.clock()
+        # True while the loop thread is inside engine.step() (or the
+        # bisection retries): the step is XLA-bound, and a multi-second
+        # compile there is indistinguishable from a hang — so wedge
+        # detection exempts it and supervises the loop's host-side
+        # control flow, where the wedge fault model lives
+        self._stepping = False
+        self.restarts_total = 0
+        self.poisoned_total = 0
+        self.deadline_total = 0
         # (variables, stamp) awaiting install by the loop thread — the
         # engine is single-threaded, so weight hot-swaps marshal through
         # here instead of touching the engine from the HTTP/PS thread
@@ -95,21 +126,44 @@ class ServeService:
             maxlen=TTFT_WINDOW)
         self._thread = threading.Thread(
             target=self._loop, name=f"serve-{model_id}", daemon=True)
+        self._watchdog_thread = threading.Thread(
+            target=self._watchdog, name=f"serve-watchdog-{model_id}",
+            daemon=True)
+        self._started = False
 
     # -------------------------------------------------------------- clients
     def start(self) -> "ServeService":
+        self._started = True
         self._thread.start()
+        if self.supervise:
+            self._watchdog_thread.start()
         return self
 
     def submit(self, prompt, max_new_tokens: int = 32,
                temperature: float = 0.0, seed: int = 0,
                eos_id: Optional[int] = None,
-               trace_id: Optional[str] = None) -> GenerateRequest:
+               trace_id: Optional[str] = None,
+               deadline_ms: Optional[float] = None) -> GenerateRequest:
         """Admit a request or shed it. Raises InferenceInputError (400)
-        on a bad prompt, ServeSaturated (429) at capacity."""
+        on a bad prompt or deadline, ServeSaturated (429) at capacity
+        or when the deadline is infeasible against the current backlog,
+        ServeDraining (503) while draining for shutdown."""
+        if deadline_ms is not None:
+            try:
+                deadline_ms = float(deadline_ms)
+            except (TypeError, ValueError) as e:
+                raise InferenceInputError(
+                    f"deadline_ms must be a number of milliseconds: "
+                    f"{e}") from e
+            if not deadline_ms > 0 or deadline_ms != deadline_ms \
+                    or deadline_ms == float("inf"):
+                raise InferenceInputError(
+                    f"deadline_ms must be a positive finite number of "
+                    f"milliseconds, got {deadline_ms!r}")
         req = GenerateRequest(prompt, max_new_tokens=max_new_tokens,
                               temperature=temperature, seed=seed,
-                              eos_id=eos_id, trace_id=trace_id)
+                              eos_id=eos_id, trace_id=trace_id,
+                              deadline_ms=deadline_ms)
         # validate on the HTTP thread: bad input must 400 before it
         # costs a slot (also strips trailing pads)
         req.prompt = self.engine.check_admissible(req.prompt,
@@ -117,6 +171,27 @@ class ServeService:
         with self._cv:
             if self._stopped:
                 raise ServeSaturated(message="serving loop stopped")
+            if self._draining:
+                # graceful drain: new work belongs on another replica;
+                # Retry-After sized by the backlog this replica still
+                # owes, like the 429 path
+                backlog = self._backlog_tokens()
+                raise ServeDraining(retry_after_s=1.0 + (
+                    backlog / PREFILL_DRAIN_TOKENS_PER_S))
+            if req.deadline_ms is not None:
+                # infeasible at admission: the queued prompt work alone
+                # outlasts the deadline, so admitting the request would
+                # only burn a slot to produce a guaranteed expiry — shed
+                # it now, with the honest Retry-After
+                wait_s = self._backlog_tokens() / PREFILL_DRAIN_TOKENS_PER_S
+                if req.deadline_ms / 1000.0 <= wait_s:
+                    self.rejected_total += 1
+                    self._note_outcome("rejected")
+                    raise ServeSaturated(
+                        retry_after_s=1.0 + wait_s,
+                        message=f"deadline_ms={req.deadline_ms:g} is "
+                                f"infeasible: ~{wait_s:.2f}s of prompt "
+                                f"backlog is queued ahead of admission")
             if self._inflight >= self.engine.slot_count + self.max_queue:
                 self.rejected_total += 1
                 self._note_outcome("rejected")
@@ -137,6 +212,10 @@ class ServeService:
                     backlog / PREFILL_DRAIN_TOKENS_PER_S))
             self._inflight += 1
             req.submitted_at = self.clock()
+            if req.deadline_ms is not None:
+                # stamp on the service clock so the engine reaper and
+                # the queue sweep compare against one timebase
+                req.deadline_at = req.submitted_at + req.deadline_ms / 1000.0
             self._pending.append(req)
             self._cv.notify()
         return req
@@ -160,22 +239,70 @@ class ServeService:
             self._pending_weights = (variables, stamp)
             self._cv.notify()
 
-    def stop(self, timeout: float = 10.0) -> None:
+    def drain(self, grace_s: float) -> bool:
+        """Graceful drain: flip admission to 503 (ServeDraining), then
+        wait up to `grace_s` for every in-flight stream to reach a
+        terminal state. Returns True when the service drained fully
+        within the budget; False means the caller should proceed to a
+        hard stop (which force-releases the survivors). Safe to call
+        from any thread — the loop keeps decoding throughout."""
+        with self._cv:
+            if self._stopped:
+                return self._inflight == 0
+            if not self._draining:
+                self._draining = True
+                if self.tracer is not None:
+                    self.tracer.instant("drain", ts=self.clock(),
+                                        grace_s=float(grace_s))
+                    self._trace_dirty = True
+                logger.info("model %s draining: admission closed, "
+                            "grace budget %.1fs", self.model_id,
+                            float(grace_s))
+            self._cv.notify_all()
+        deadline = self.clock() + float(grace_s)
+        while self.clock() < deadline:
+            with self._cv:
+                if self._inflight == 0:
+                    return True
+            time.sleep(0.005)
+        with self._cv:
+            return self._inflight == 0
+
+    def stop(self, timeout: float = 10.0, grace_s: float = 0.0) -> None:
+        """Stop the loop. With `grace_s > 0` this is a graceful
+        shutdown: drain first (admission 503s immediately, in-flight
+        streams keep decoding), then the stop-tail force-releases
+        whatever outlived the budget."""
+        if grace_s > 0:
+            self.drain(grace_s)
         with self._cv:
             self._stopped = True
-            self._cv.notify()
+            self._cv.notify_all()
         if self._thread.is_alive():
             self._thread.join(timeout)
 
     # ----------------------------------------------------------------- loop
     def _loop(self) -> None:
+        # pin the engine this thread owns: after a supervisor recovery
+        # self.engine is a REPLACEMENT and a new loop thread drives it —
+        # if this (wedged-then-unstuck) thread ever resumes, it must
+        # exit instead of double-driving abandoned slot state
+        engine = self.engine
         while True:
             with self._cv:
+                if self.engine is not engine:
+                    self._cv.notify_all()
+                    return
+                self._beat = self.clock()
                 while not self._stopped and not self._pending \
                         and self._pending_weights is None \
-                        and self.engine.active() == 0:
+                        and engine.active() == 0:
                     self._publish()
                     self._cv.wait()
+                    self._beat = self.clock()
+                    if self.engine is not engine:
+                        self._cv.notify_all()
+                        return
                 if self._stopped:
                     break
                 if self._pending_weights is not None:
@@ -184,49 +311,195 @@ class ServeService:
                     # already-attached streams stay pinned to theirs
                     variables, stamp = self._pending_weights
                     self._pending_weights = None
-                    gen = self.engine.install_weights(variables)
+                    gen = engine.install_weights(variables)
                     self.weight_stamp = stamp
                     logger.info("model %s hot-swapped to weight "
                                 "generation %d", self.model_id, gen)
-                while self._pending and self.engine.free_slots() > 0:
+                # queued requests can expire before a slot frees: reap
+                # them here so a deadline never waits on capacity
+                if any(r.deadline_at is not None for r in self._pending):
+                    now = self.clock()
+                    keep: Deque[GenerateRequest] = collections.deque()
+                    while self._pending:
+                        r = self._pending.popleft()
+                        if r.deadline_at is not None and now >= r.deadline_at:
+                            self._terminal(
+                                r, "deadline",
+                                f"deadline of {r.deadline_ms:g}ms exceeded "
+                                f"before a slot was free")
+                        else:
+                            keep.append(r)
+                    self._pending = keep
+                while self._pending and engine.free_slots() > 0:
                     req = self._pending.popleft()
                     if req.cancelled:
                         self._terminal(req, "cancelled")
                         continue
                     try:
-                        self.engine.attach(req)
+                        engine.attach(req)
                     except Exception as e:  # geometry raced a config change
                         self._terminal(req, "error", str(e))
+                self._stepping = True
             try:
-                finished = self.engine.step()
+                finished = engine.step()
             except Exception as e:
-                logger.exception("decode step failed; failing active "
-                                 "streams")
-                with self._cv:
-                    for s in range(self.engine.slot_count):
-                        slot = self.engine._slots[s]
-                        if slot is not None:
-                            req = slot.req
-                            self.engine.release(s, "error",
-                                                f"decode step failed: {e}")
-                            self._terminal(req, None)
-                continue
+                finished = self._bisect_step_failure(engine, e)
             with self._cv:
+                self._stepping = False
+                if self.engine is not engine:
+                    # recovery swapped the engine mid-step: the finished
+                    # list (if any) belongs to abandoned state the
+                    # supervisor already requeued — drop it
+                    self._cv.notify_all()
+                    return
                 for req in finished:
                     self._terminal(req, None)
             self._publish()
-        # drained on stop: fail whatever is left so no client hangs
+            # deterministic wedge injection rides AFTER the publish so
+            # the step's effects are observable, then spins until the
+            # supervisor abandons this engine
+            plan = getattr(engine, "fault_plan", None)
+            if plan is not None and plan.maybe_wedge(engine):
+                continue
+        # drained on stop: fail whatever is left so no client hangs.
+        # After a graceful drain the survivors are streams that outlived
+        # the grace budget — say so, rather than the generic message.
+        msg = "drained: grace budget exhausted" if self._draining \
+            else "serving loop stopped"
         with self._cv:
             while self._pending:
-                self._terminal(self._pending.popleft(), "error",
-                               "serving loop stopped")
-            for s in range(self.engine.slot_count):
-                slot = self.engine._slots[s]
+                self._terminal(self._pending.popleft(), "error", msg)
+            for s in range(engine.slot_count):
+                slot = engine._slots[s]
                 if slot is not None:
                     req = slot.req
-                    self.engine.release(s, "error", "serving loop stopped")
+                    engine.release(s, "error", msg)
                     self._terminal(req, None)
         self._publish()
+
+    def _bisect_step_failure(self, engine: DecodeEngine,
+                             exc: Exception) -> List[GenerateRequest]:
+        """A decode step raised. Before failing every active stream,
+        retry the step with one suspect lane excluded at a time
+        (newest admission first — a fresh request is the likeliest
+        poisoner). If a retry succeeds, the excluded request is the
+        poison: quarantine it (terminal error) and return the retry's
+        finished list; the other streams never notice. The engine's
+        fault hooks run before any page mutation, so each retry starts
+        from the same state. Falls back to the fail-everyone path."""
+        suspects = []
+        with self._cv:
+            for s in range(engine.slot_count):
+                slot = engine._slots[s]
+                if slot is not None:
+                    suspects.append((slot.seq, s, slot.req))
+        suspects.sort(reverse=True)          # newest admissions first
+        for _, _, req in suspects[:BISECT_MAX_SUSPECTS]:
+            try:
+                finished = engine.step(exclude=frozenset([req.rid]))
+            except Exception:
+                continue
+            with self._cv:
+                for s in range(engine.slot_count):
+                    slot = engine._slots[s]
+                    if slot is not None and slot.req is req:
+                        engine.release(
+                            s, "error",
+                            f"request poisoned the decode step and was "
+                            f"quarantined: {exc}")
+                        break
+            logger.warning("model %s: step exception isolated to "
+                           "request %s; quarantined (%s)", self.model_id,
+                           req.rid, exc)
+            finished.append(req)
+            return finished
+        logger.exception("decode step failed and no single stream "
+                         "explains it; failing active streams")
+        with self._cv:
+            for s in range(engine.slot_count):
+                slot = engine._slots[s]
+                if slot is not None:
+                    req = slot.req
+                    engine.release(s, "error",
+                                   f"decode step failed: {exc}")
+                    self._terminal(req, None)
+        return []
+
+    # ------------------------------------------------------------ supervisor
+    def _watchdog(self) -> None:
+        """Supervision thread: detect a dead or wedged serving loop and
+        recover. A loop is DEAD when its thread exited with work still
+        in flight; WEDGED when the beat goes stale past wedge_timeout_s
+        with work in flight (an idle loop parks in cv.wait without
+        beating — rest, not death) while the loop is OUTSIDE
+        engine.step() (inside it, a fresh engine's first dispatch is a
+        multi-second XLA compile, indistinguishable from a hang — a
+        stale beat there must not restart-storm the recovery itself)."""
+        while True:
+            time.sleep(self.watchdog_interval_s)
+            with self._cv:
+                if self._stopped:
+                    return
+                thread_dead = not self._thread.is_alive()
+                stale = self._inflight > 0 and not self._stepping and \
+                    (self.clock() - self._beat) > self.wedge_timeout_s
+                if not thread_dead and not stale:
+                    continue
+                self._recover("loop thread died" if thread_dead
+                              else "loop wedged past timeout")
+
+    def _recover(self, reason: str) -> None:
+        """Rebuild the engine and resume in-flight streams (cv held).
+
+        The old engine is abandoned (its step() becomes a no-op, so a
+        wedged thread that un-sticks cannot double-drive), its
+        non-terminal slots are requeued in admission order with
+        resume_gen pinned to the generation they decoded under, and a
+        fresh engine + loop thread take over. Resumption re-prefills
+        prompt + already-emitted tokens, so continuation is
+        bit-identical to the uninterrupted run (per-position sampling
+        keys) and nothing re-emits."""
+        if self._stopped:
+            return
+        old = self.engine
+        old.abandon()
+        # black box FIRST: the ring shows what the engine was doing
+        # when it died, and recovery resets the step counter
+        self.flight_snapshot(f"engine_restart:{reason}")
+        resumed = []
+        for s in range(old.slot_count):
+            slot = old._slots[s]
+            if slot is not None and slot.req.outcome is None:
+                slot.req.resume_gen = slot.gen
+                resumed.append((slot.seq, slot.req))
+        resumed.sort()
+        # requeue at the FRONT in admission order so recovered streams
+        # re-attach before anything that queued behind them
+        for _, req in reversed(resumed):
+            self._pending.appendleft(req)
+        # inflight recount: requests the dead loop finished but never
+        # accounted would otherwise leak the counter forever
+        self._inflight = len(self._pending)
+        self.engine = old.spawn_recovered()
+        self._counters_seen = {}
+        self.restarts_total += 1
+        if self.metrics is not None:
+            self.metrics.note_serve_engine_restart(self.model_id)
+        if self.tracer is not None:
+            self.tracer.instant("engine_restart", ts=self.clock(),
+                                reason=reason, resumed=len(resumed))
+            self._trace_dirty = True
+        logger.error("model %s: serving engine restarted (%s); "
+                     "resuming %d stream(s)", self.model_id, reason,
+                     len(resumed))
+        self._beat = self.clock()
+        # a loop that died mid-step left the flag set; the new thread
+        # starts outside any step
+        self._stepping = False
+        self._thread = threading.Thread(
+            target=self._loop, name=f"serve-{self.model_id}", daemon=True)
+        self._thread.start()
+        self._cv.notify_all()
 
     def _terminal(self, req: GenerateRequest, outcome: Optional[str],
                   error: Optional[str] = None) -> None:
@@ -243,6 +516,16 @@ class ServeService:
         self._inflight = max(0, self._inflight - 1)
         if req.outcome == "error" and req.error and "shed" in req.error:
             self._note_shed()   # engine-side KV-exhaustion shed
+        if req.outcome == "deadline":
+            self.deadline_total += 1
+        if req.outcome == "error" and req.error \
+                and "poisoned" in req.error:
+            # both poison paths funnel here: the on-device non-finite
+            # guard ("poisoned and isolated") and the step-exception
+            # bisection ("poisoned the decode step")
+            self.poisoned_total += 1
+            if self.metrics is not None:
+                self.metrics.note_serve_poisoned(self.model_id)
         if self.tracer is not None and req.submitted_at is not None \
                 and req.finished_at is not None:
             # root span of the request tree: every other span/instant
@@ -387,6 +670,11 @@ class ServeService:
             "serve_weight_generation": self.engine.weight_generation,
             "serve_active_generations": len(
                 self.engine.active_generations()),
+            # fault-tolerance telemetry: restart count feeds the
+            # serve_crash_loop rule; poisoned/deadline feed `kubeml top`
+            "serve_engine_restarts": self.restarts_total,
+            "serve_poisoned_total": self.poisoned_total,
+            "serve_deadline_total": self.deadline_total,
         }
 
     def _publish(self) -> None:
@@ -406,7 +694,8 @@ class ServeService:
                     ("decode_tokens", self.metrics.note_serve_decode),
                     ("prefix_hits", self.metrics.note_serve_prefix_hits),
                     ("prefix_misses",
-                     self.metrics.note_serve_prefix_misses)):
+                     self.metrics.note_serve_prefix_misses),
+                    ("page_leaks", self.metrics.note_serve_page_leaks)):
                 cur = int(self.engine.stats[stat])
                 delta = cur - self._counters_seen.get(stat, 0)
                 if delta > 0:

@@ -29,6 +29,20 @@ class ServeSaturated(KubeMLException):
         self.retry_after_s = retry_after_s
 
 
+class ServeDraining(KubeMLException):
+    """Admission refused: the service is draining for shutdown (SIGTERM
+    / stop with a grace budget). Maps to 503 + backlog-aware
+    Retry-After — in a fleet the client's retry lands on a replica that
+    is not going away; in-flight streams here keep decoding until the
+    grace budget expires."""
+
+    def __init__(self, retry_after_s: float = 1.0,
+                 message: str = "serving is draining for shutdown; "
+                                "retry against another replica"):
+        super().__init__(message, 503)
+        self.retry_after_s = retry_after_s
+
+
 class GenerateRequest:
     """One generation stream, from admission to EOS/cancel/shed.
 
@@ -43,12 +57,22 @@ class GenerateRequest:
     def __init__(self, prompt: List[int], max_new_tokens: int = 32,
                  temperature: float = 0.0, seed: int = 0,
                  eos_id: Optional[int] = None,
-                 trace_id: Optional[str] = None):
+                 trace_id: Optional[str] = None,
+                 deadline_ms: Optional[float] = None):
         self.prompt = [int(t) for t in prompt]
         self.max_new_tokens = int(max_new_tokens)
         self.temperature = float(temperature)
         self.seed = int(seed)
         self.eos_id = None if eos_id is None else int(eos_id)
+        # per-request deadline: deadline_at (service clock) is stamped
+        # at admission; the engine's reaper releases the slot with the
+        # terminal `deadline` outcome once the clock passes it
+        self.deadline_ms = None if deadline_ms is None \
+            else float(deadline_ms)
+        self.deadline_at: Optional[float] = None
+        # supervisor recovery: the weight generation this stream was
+        # pinned to, so a resumed attach decodes the same params
+        self.resume_gen: Optional[int] = None
         # distributed-trace correlation: trace_id rides from the client
         # header through every span of this request's tree; rid is a
         # short per-request id so co-resident requests sharing one
@@ -57,7 +81,8 @@ class GenerateRequest:
         self.rid = uuid.uuid4().hex[:8]
         self.tokens: List[int] = []          # generated ids, in order
         self.events: "queue.Queue[dict]" = queue.Queue()
-        self.outcome: Optional[str] = None   # ok|cancelled|error (terminal)
+        # terminal: ok | cancelled | deadline | error
+        self.outcome: Optional[str] = None
         self.error: Optional[str] = None
         self.submitted_at: Optional[float] = None
         self.admitted_at: Optional[float] = None  # attach() = slot claimed
@@ -88,11 +113,16 @@ class GenerateRequest:
         """Yield event dicts ({"token": id} per token, then one
         {"done"/"error": ...}) until the stream ends. The timeout guards
         against a dead serving loop — a stalled stream ends with an
-        error event rather than hanging its HTTP thread forever."""
+        error event rather than hanging its HTTP thread forever, AND
+        cancels the request: without the cancel the abandoned stream
+        kept its slot decoding to EOS with nobody reading, leaking its
+        KV pages for the duration (the serving loop reaps the cancel
+        and restores the free list)."""
         while True:
             try:
                 ev = self.events.get(timeout=timeout)
             except queue.Empty:
+                self.cancel()
                 yield {"error": f"stream stalled for {timeout:g}s"}
                 return
             yield ev
@@ -115,6 +145,12 @@ class GenerateRequest:
             self.events.put({"done": True, "tokens": list(self.tokens)})
         elif outcome == "cancelled":
             self.events.put({"done": True, "cancelled": True,
+                             "tokens": list(self.tokens)})
+        elif outcome == "deadline":
+            # deadline expiry carries the partial tokens: the client
+            # paid for them and may well use a truncated completion
+            self.events.put({"error": error or "deadline exceeded",
+                             "deadline": True,
                              "tokens": list(self.tokens)})
         else:
             self.events.put({"error": error or outcome})

@@ -8,6 +8,13 @@ with wall-clock or unseeded randomness silently gives that up — so any
 test file that references FaultPlan is scanned for the tokens below and
 the build fails if one appears outside a comment.
 
+A second contract rides along (PR 12): every SERVING fault kind
+declared in kubeml_tpu/faults.py SERVE_KINDS must be exercised by name
+in at least one tier-1 test — the quoted kind string must appear on an
+assert line somewhere under tests/ (same quoted-name discipline as
+tools/check_serve_spans.py). A serve fault kind nobody asserts on is
+recovery machinery nobody would notice breaking.
+
 Run directly (exit 1 on violation) or via tests/test_faults.py, which
 keeps the lint itself in the tier-1 suite:
 
@@ -18,6 +25,7 @@ from __future__ import annotations
 
 import io
 import os
+import re
 import sys
 import tokenize
 
@@ -77,6 +85,45 @@ def check_file(path: str) -> list:
     return violations
 
 
+def serve_kinds(faults_path: str) -> list:
+    """The declared serving fault kinds, parsed from the SERVE_KINDS
+    tuple literal (same declaration-site parse as check_serve_spans.py
+    — adding a kind without a test is a lint failure, not a doc TODO)."""
+    with open(faults_path, encoding="utf-8") as f:
+        src = f.read()
+    m = re.search(r"SERVE_KINDS\s*=\s*\(([^)]*)\)", src)
+    if not m:
+        raise SystemExit(f"{faults_path}: SERVE_KINDS tuple not found")
+    return re.findall(r"[\"']([^\"']+)[\"']", m.group(1))
+
+
+def file_asserts_kind(path: str, kind: str) -> bool:
+    """True when the file asserts on the QUOTED kind name. Unlike
+    _code_lines this keeps STRING tokens — the kind appears as a string
+    literal — and requires an `assert` on the same physical line, so a
+    mere mention in a fault-plan spec does not count as coverage."""
+    with open(path, encoding="utf-8", errors="replace") as f:
+        for line in f:
+            if "assert" in line and (f'"{kind}"' in line
+                                     or f"'{kind}'" in line):
+                return True
+    return False
+
+
+def unasserted_serve_kinds(faults_path: str, tests_dir: str) -> list:
+    kinds = serve_kinds(faults_path)
+    missing = []
+    for kind in kinds:
+        for dirpath, _dirs, files in os.walk(tests_dir):
+            if any(file_asserts_kind(os.path.join(dirpath, name), kind)
+                   for name in sorted(files)
+                   if name.startswith("test_") and name.endswith(".py")):
+                break
+        else:
+            missing.append(kind)
+    return missing
+
+
 def main(argv) -> int:
     root = argv[1] if len(argv) > 1 else os.path.join(
         os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
@@ -94,6 +141,16 @@ def main(argv) -> int:
               "must be coordinate-driven (see kubeml_tpu/faults.py)",
               file=sys.stderr)
         return 1
+    faults_path = os.path.join(os.path.dirname(root), "kubeml_tpu",
+                               "faults.py")
+    if os.path.exists(faults_path):
+        missing = unasserted_serve_kinds(faults_path, root)
+        for kind in missing:
+            print(f"{faults_path}: serve fault kind {kind!r} has no "
+                  f"tier-1 test asserting its quoted name under {root}",
+                  file=sys.stderr)
+        if missing:
+            return 1
     return 0
 
 
