@@ -415,6 +415,7 @@ def main():
     serving = _measure_serving_arm()
     serving_prefill = _measure_prefill_arm()
     serving_faulted = _measure_serving_faulted_arm()
+    serving_fleet = _measure_serving_fleet_arm()
     cluster = _measure_cluster_arm()
     continual = _measure_continual_arm()
 
@@ -559,6 +560,16 @@ def main():
         # inventory pin (one decode compile, one prefill compile)
         # survives the fault. Self-asserted inside the arm.
         "serving_faulted": serving_faulted,
+        # serving-fleet arm (PR 13, serve/fleet.py): thousands of
+        # closed-loop streams over 8 repeated prompt prefixes, routed
+        # through a 4-replica fleet. Prefix-affinity routing vs random
+        # routing vs a single-engine baseline at the same offered
+        # concurrency; self-asserts the per-replica compile pin (two
+        # programs per engine, traffic notwithstanding) and that the
+        # affine fleet's prefix-cache hit rate strictly beats random
+        # routing's (the cache is per-replica — affinity is what makes
+        # it work); reports fleet tail TTFT against the single engine.
+        "serving_fleet": serving_fleet,
         # cluster-allocator arm (control/cluster.py): a deterministic
         # fake-clock saturation replay — three wide priority-0 batch
         # gangs fill the pool, four narrow priority-1 prod jobs burst
@@ -1120,6 +1131,171 @@ def _measure_prefill_arm() -> dict:
         "concurrent": concurrent,
         "prefix_mix": prefix_mix,
         "recorder_overhead": recorder_overhead,
+    }
+
+
+def _measure_serving_fleet_arm() -> dict:
+    """Serving-fleet arm (serve/fleet.py): thousands of closed-loop
+    streams over a handful of repeated prompt prefixes, routed through
+    a 4-replica fleet with consistent-hash prefix affinity vs the same
+    fleet with prompt-blind random routing, vs a single-engine
+    baseline at the same offered concurrency.
+
+    Self-asserted invariants:
+      * per-replica compile pin — every engine in every run compiles
+        exactly TWO programs (prefill + decode), traffic and routing
+        notwithstanding (the fleet is a router, not a compile lever)
+      * affinity pays — the affine fleet's prefix-cache hit rate is
+        STRICTLY above random routing's (the cache is per-replica, so
+        only same-prefix-same-replica routing lets it work)
+    Reported: hit rates, goodput, and tail TTFT of the 4-replica fleet
+    against the single-engine baseline.
+
+    KUBEML_BENCH_FLEET_STREAMS scales the stream budget down for quick
+    runs (default 2000)."""
+    import os
+    import threading
+
+    import jax
+    import numpy as np
+
+    from kubeml_tpu.models import get_builtin
+    from kubeml_tpu.serve.engine import DecodeEngine
+    from kubeml_tpu.serve.fleet import ServeFleet
+    from kubeml_tpu.serve.service import ServeService
+    from kubeml_tpu.serve.slots import ServeSaturated
+
+    PROMPT_LEN, NEW_TOKENS, PAGE = 32, 8, 16
+    PREFIX_GROUPS = 8
+    REPLICAS, SLOTS, QUEUE = 4, 8, 8
+    CONCURRENCY = REPLICAS * SLOTS
+    STREAMS = int(os.environ.get("KUBEML_BENCH_FLEET_STREAMS", "2000"))
+
+    model = get_builtin("gpt-nano")()
+    module = model.module
+    variables = model.init_variables(
+        jax.random.PRNGKey(0),
+        {"x": np.ones((1, module.max_len), np.int32)})
+    vocab = module.vocab_size - 1
+
+    def prompt(i):
+        # PREFIX_GROUPS distinct first pages (PAGE tokens, the routing
+        # key AND the cacheable unit), unique per-request suffixes
+        g = i % PREFIX_GROUPS
+        head = [(g * 13 + j) % vocab + 1 for j in range(PAGE)]
+        tail = [(i * 7 + j) % vocab + 1
+                for j in range(PROMPT_LEN - PAGE)]
+        return head + tail
+
+    def drain(req):
+        for _ in req.events_iter(timeout=300.0):
+            pass
+        return req
+
+    def pct(vals, q):
+        if not vals:
+            return 0.0
+        return round(vals[min(len(vals) - 1,
+                              int(q * (len(vals) - 1) + 0.5))], 6)
+
+    def fleet_run(routing, replicas, streams):
+        def factory(index):
+            eng = DecodeEngine(module, variables, slots=SLOTS,
+                               page=PAGE)
+            return ServeService("bench-fleet", eng, max_queue=QUEUE,
+                                supervise=False)
+        fleet = ServeFleet("bench-fleet", factory,
+                           replicas_min=replicas,
+                           replicas_max=replicas,
+                           autoscale_interval_s=0.0,
+                           page_tokens=PAGE, routing=routing)
+        fleet.start()
+        # warm every replica DIRECTLY (bypassing the router) so each
+        # engine's two compiles land outside the timed window
+        for svc in fleet.replicas():
+            drain(svc.submit(prompt(0), max_new_tokens=NEW_TOKENS))
+        before = {i: dict(eng.stats) for i, eng in fleet.engines()}
+
+        done = []
+        lock = threading.Lock()
+        budget = [streams]
+
+        def client(cid):
+            while True:
+                with lock:
+                    if budget[0] <= 0:
+                        return
+                    budget[0] -= 1
+                    i = budget[0]
+                try:
+                    req = fleet.submit(prompt(i),
+                                       max_new_tokens=NEW_TOKENS)
+                except ServeSaturated as e:
+                    with lock:
+                        budget[0] += 1      # give the stream back
+                    time.sleep(min(1.0, e.retry_after_s))
+                    continue
+                drain(req)
+                with lock:
+                    done.append(req)
+
+        t0 = time.perf_counter()
+        threads = [threading.Thread(target=client, args=(c,))
+                   for c in range(CONCURRENCY)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        elapsed = time.perf_counter() - t0
+
+        hits = misses = toks = 0
+        for i, eng in fleet.engines():
+            d = {k: eng.stats[k] - before[i][k] for k in before[i]}
+            hits += int(d["prefix_hits"])
+            misses += int(d["prefix_misses"])
+            toks += int(d["generated_tokens"])
+            # per-replica compile pin: exactly two programs, full stop
+            assert eng.stats["compiles"] == 1, \
+                (routing, i, eng.stats["compiles"])
+            assert eng.stats["prefill_compiles"] == 1, \
+                (routing, i, eng.stats["prefill_compiles"])
+        ttfts = sorted(r.first_token_at - r.submitted_at for r in done
+                       if r.first_token_at and r.submitted_at)
+        spills = fleet.spills_total
+        fleet.stop(grace_s=0.0)
+        return {
+            "routing": routing,
+            "replicas": replicas,
+            "requests": len(done),
+            "prefix_hit_pct": round(
+                100.0 * hits / max(1, hits + misses), 2),
+            "goodput_tok_s": round(toks / elapsed, 1),
+            "spills": int(spills),
+            "ttft_p50_s": pct(ttfts, 0.50),
+            "ttft_p99_s": pct(ttfts, 0.99),
+        }
+
+    affine = fleet_run("affine", REPLICAS, STREAMS)
+    rand = fleet_run("random", REPLICAS, STREAMS)
+    solo = fleet_run("affine", 1, max(CONCURRENCY, STREAMS // 4))
+
+    # the headline claim: prefix affinity is what makes the fleet's
+    # per-replica caches work — random routing must measurably lose
+    assert affine["prefix_hit_pct"] > rand["prefix_hit_pct"], \
+        (affine["prefix_hit_pct"], rand["prefix_hit_pct"])
+
+    return {
+        "model": "gpt-nano",
+        "replicas": REPLICAS, "slots": SLOTS, "queue": QUEUE,
+        "prompt_tokens": PROMPT_LEN, "new_tokens": NEW_TOKENS,
+        "page_tokens": PAGE, "prefix_groups": PREFIX_GROUPS,
+        "streams": STREAMS, "concurrency": CONCURRENCY,
+        "affine": affine, "random": rand,
+        "single_engine_baseline": solo,
+        "per_replica_compiles": [1, 1],   # prefill + decode, pinned
+        "affinity_hit_rate_beats_random": True,
+        "fleet_ttft_p99_vs_single_s": [affine["ttft_p99_s"],
+                                       solo["ttft_p99_s"]],
     }
 
 

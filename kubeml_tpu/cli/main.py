@@ -394,6 +394,22 @@ def _render_top(doc: dict) -> str:
                 f"{latest.get('serve_engine_restarts', 0):g}  poisoned "
                 f"{latest.get('serve_poisoned_total', 0):g}  deadline "
                 f"{latest.get('serve_deadline_total', 0):g}")
+        if latest.get("fleet_replicas") is not None:
+            # fleet pane: replica count against the autoscaler bounds,
+            # the router's spill/retry activity, and the lifetime
+            # scale-event counters (cold starts include scale-from-zero)
+            lines.append(
+                f"fleet: replicas {latest.get('fleet_replicas', 0):g} "
+                f"[{latest.get('fleet_replicas_min', 0):g}"
+                f"..{latest.get('fleet_replicas_max', 0):g}]  "
+                f"draining {latest.get('fleet_draining', 0):g}  "
+                f"spills {latest.get('fleet_spills_total', 0):g}  "
+                f"retries {latest.get('fleet_router_retries_total', 0):g}  "
+                f"cold starts {latest.get('fleet_cold_starts_total', 0):g}  "
+                f"grow/shrink/zero "
+                f"{latest.get('fleet_grows_total', 0):g}/"
+                f"{latest.get('fleet_shrinks_total', 0):g}/"
+                f"{latest.get('fleet_scale_to_zero_total', 0):g}")
     if latest.get("data_lag_generations") is not None \
             and float(latest.get("data_lag_generations", -1)) >= 0:
         # continual pane: dataset freshness — the generation the job last
@@ -539,6 +555,9 @@ def cmd_serve(args):
                                serve_prefill_chunk=args.serve_prefill_chunk,
                                serve_prefix_cache=_prefix_cache_opt(args),
                                serve_drain_grace_s=args.serve_drain_grace_s,
+                               serve_replicas_min=args.serve_replicas_min,
+                               serve_replicas_max=args.serve_replicas_max,
+                               serve_scale_to_zero_s=args.serve_scale_to_zero_s,
                                cluster_lanes=args.cluster_lanes,
                                cluster_tenants=args.cluster_tenant,
                                cluster_aging_s=args.cluster_aging_s)
@@ -570,7 +589,10 @@ def cmd_serve(args):
                               serve_queue_depth=args.serve_queue_depth,
                               serve_prefill_chunk=args.serve_prefill_chunk,
                               serve_prefix_cache=_prefix_cache_opt(args),
-                              serve_drain_grace_s=args.serve_drain_grace_s)
+                              serve_drain_grace_s=args.serve_drain_grace_s,
+                              serve_replicas_min=args.serve_replicas_min,
+                              serve_replicas_max=args.serve_replicas_max,
+                              serve_scale_to_zero_s=args.serve_scale_to_zero_s)
     else:  # storage
         from kubeml_tpu.control.storage import StorageService
         svc = StorageService(port=args.port or const.STORAGE_PORT)
@@ -895,6 +917,26 @@ def build_parser() -> argparse.ArgumentParser:
                         "answers 503 + Retry-After while in-flight "
                         "streams get S seconds to finish; 0 stops hard "
                         "(KUBEML_SERVE_DRAIN_GRACE_S, default 0)")
+    s.add_argument("--serve-replicas-min", type=int, default=None,
+                   metavar="N",
+                   help="floor of the serving fleet: each served model "
+                        "fronts at least N decode replicas behind the "
+                        "prefix-affinity router; 0 lets the autoscaler "
+                        "park the model entirely "
+                        "(KUBEML_SERVE_REPLICAS_MIN, default 1)")
+    s.add_argument("--serve-replicas-max", type=int, default=None,
+                   metavar="N",
+                   help="ceiling of the serving fleet: the autoscaler "
+                        "grows toward N replicas under shed/queue/TTFT "
+                        "pressure and shrinks back when idle "
+                        "(KUBEML_SERVE_REPLICAS_MAX, default 1)")
+    s.add_argument("--serve-scale-to-zero-s", type=float, default=None,
+                   metavar="S",
+                   help="retire every replica after S seconds with no "
+                        "traffic; the next /generate cold-starts one "
+                        "synchronously (peers get 429 + warm-up "
+                        "Retry-After meanwhile); 0 disables "
+                        "(KUBEML_SERVE_SCALE_TO_ZERO_S, default 0)")
     s.add_argument("--cluster-lanes", type=int, default=None, metavar="N",
                    help="turn on the cluster allocator over N shared "
                         "worker lanes: gang placement, priority "

@@ -56,6 +56,8 @@ DECISION_PATHS = {
                    "under-quota tenant is held back",
     "preempt-cheapest": "a higher-priority arrival displaces the "
                         "cheapest-to-displace lower-priority victims",
+    "serve-elastic": "a serving-replica gang (kind='serving') grows or "
+                     "shrinks elastically through the shared pool",
 }
 
 DEFAULT_TENANT = "default"
@@ -88,6 +90,7 @@ class _Pending:
     priority: int
     lanes: int          # requested gang size (clamped to the pool)
     enqueued_at: float
+    kind: str = "train"  # 'train' (worker gang) | 'serving' (replicas)
 
 
 @dataclasses.dataclass
@@ -98,6 +101,7 @@ class _Running:
     lanes: int
     placed_at: float
     preempting: bool = False  # victim selected; lanes free on release
+    kind: str = "train"
 
 
 def parse_tenant_spec(spec: str) -> Tuple[str, float, Optional[int]]:
@@ -216,7 +220,8 @@ class ClusterAllocator:
                     break  # size-blocked head holds the line: no backfill
                 self._pending.remove(p)
                 self._running[p.job_id] = _Running(
-                    p.job_id, p.tenant, p.priority, lanes, placed_at=now)
+                    p.job_id, p.tenant, p.priority, lanes, placed_at=now,
+                    kind=p.kind)
                 self.gang_placements += 1
                 aged = self._eff_priority(p, now) > p.priority
                 clamped = lanes < p.lanes
@@ -294,10 +299,14 @@ class ClusterAllocator:
     # -------------------------------------------------------------- surface
 
     def submit(self, job_id: str, tenant: str = DEFAULT_TENANT,
-               priority: int = 0, lanes: int = 1) -> List[Decision]:
+               priority: int = 0, lanes: int = 1,
+               kind: str = "train") -> List[Decision]:
         """Admit one job's gang request. Returns the decisions to apply:
         an immediate atomic 'place', or 'queue' (possibly alongside
-        'preempt' decisions naming the victims making room)."""
+        'preempt' decisions naming the victims making room). `kind` is
+        the gang kind: 'train' worker gangs and 'serving' replica gangs
+        (serve/fleet.py via the scheduler's /serve/resize) share the
+        one pool and the same placement/preemption machinery."""
         with self._lock:
             now = self.clock()
             lanes = max(1, min(int(lanes), self.pool_lanes))
@@ -306,7 +315,7 @@ class ClusterAllocator:
                     or any(p.job_id == job_id for p in self._pending):
                 raise ValueError(f"job {job_id} already admitted")
             p = _Pending(job_id, tenant, int(priority), lanes,
-                         enqueued_at=now)
+                         enqueued_at=now, kind=str(kind))
             self._pending.append(p)
             decisions = self._grants(now)
             if any(p.job_id == job_id for p in self._pending):
@@ -367,6 +376,13 @@ class ClusterAllocator:
                 detail = (f"advisor asked {requested}, tenant "
                           f"{rec.tenant} quota {self._quota(rec.tenant)} "
                           f"lane(s) allows {allowed}")
+            if rec.kind == "serving" and not path:
+                # the second gang kind's signature decision: a serving
+                # fleet's replica count flexes through the shared pool
+                path = "serve-elastic"
+                if not detail:
+                    detail = (f"serving gang resized {rec.lanes}->"
+                              f"{allowed} lane(s) elastically")
             decisions = [Decision("resize", job_id, lanes=allowed,
                                   path=path, detail=detail)]
             if allowed != rec.lanes:
@@ -376,6 +392,14 @@ class ClusterAllocator:
                     self._accrue_deficit(freed)
                     decisions += self._grants(now)
             return decisions
+
+    def running_lanes(self, job_id: str) -> Optional[int]:
+        """Lanes currently held by `job_id`, or None when it is not a
+        running pool member (the scheduler's /serve/resize uses this to
+        pick submit-vs-resize for a serving gang)."""
+        with self._lock:
+            rec = self._running.get(job_id)
+            return None if rec is None else rec.lanes
 
     # ------------------------------------------------------------ telemetry
 
@@ -411,6 +435,12 @@ class ClusterAllocator:
                     t: self._quota(t) for t in tenants},
                 "cluster_tenant_weight": {
                     t: self._weight(t) for t in tenants},
+                "cluster_serving_jobs": sum(
+                    1 for r in self._running.values()
+                    if r.kind == "serving"),
+                "cluster_serving_lanes": sum(
+                    r.lanes for r in self._running.values()
+                    if r.kind == "serving"),
                 "cluster_gang_placements_total": self.gang_placements,
                 "cluster_preemptions_total": self.preemptions,
                 "cluster_aged_grants_total": self.aged_grants,

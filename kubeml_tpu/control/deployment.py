@@ -74,6 +74,9 @@ def start_deployment(mesh=None, controller_port: int = 0,
                      serve_prefill_chunk: Optional[int] = None,
                      serve_prefix_cache: Optional[bool] = None,
                      serve_drain_grace_s: Optional[float] = None,
+                     serve_replicas_min: Optional[int] = None,
+                     serve_replicas_max: Optional[int] = None,
+                     serve_scale_to_zero_s: Optional[float] = None,
                      cluster_lanes: Optional[int] = None,
                      cluster_tenants=None,
                      cluster_aging_s: Optional[float] = None) -> Deployment:
@@ -106,7 +109,10 @@ def start_deployment(mesh=None, controller_port: int = 0,
                          serve_queue_depth=serve_queue_depth,
                          serve_prefill_chunk=serve_prefill_chunk,
                          serve_prefix_cache=serve_prefix_cache,
-                         serve_drain_grace_s=serve_drain_grace_s)
+                         serve_drain_grace_s=serve_drain_grace_s,
+                         serve_replicas_min=serve_replicas_min,
+                         serve_replicas_max=serve_replicas_max,
+                         serve_scale_to_zero_s=serve_scale_to_zero_s)
     ps.start()
 
     scheduler = Scheduler(ps_url=ps.url, port=scheduler_port,

@@ -309,6 +309,18 @@ _SAMPLE_FIELDS = ("train_loss", "validation_loss", "accuracy",
                   # serve_crash_loop rule, the rest the top faults line
                   "serve_engine_restarts", "serve_poisoned_total",
                   "serve_deadline_total",
+                  # serving-fleet telemetry (serve/fleet.py): replica
+                  # count + router/autoscaler counters ride the merged
+                  # serve:<model> sample; the per-replica prefix
+                  # hit/miss deltas make routing-quality regressions
+                  # visible per replica (the cache LRU is per-replica)
+                  "fleet_replicas", "fleet_replicas_min",
+                  "fleet_replicas_max", "fleet_draining",
+                  "fleet_cold_starts_total", "fleet_spills_total",
+                  "fleet_router_retries_total", "fleet_grows_total",
+                  "fleet_shrinks_total", "fleet_scale_to_zero_total",
+                  "fleet_replica_prefix_hits",
+                  "fleet_replica_prefix_misses",
                   # continual-plane freshness (train/job.py sliding
                   # window); lag -1 = not a continual job
                   "dataset_generation", "data_lag_generations",
@@ -316,7 +328,8 @@ _SAMPLE_FIELDS = ("train_loss", "validation_loss", "accuracy",
                   # ride the same pipeline under the `cluster` pseudo
                   # job id; `kubeml top --id cluster` renders them
                   "cluster_pool_lanes", "cluster_lanes_in_use",
-                  "cluster_running_jobs", "cluster_queue_depth",
+                  "cluster_running_jobs", "cluster_serving_jobs",
+                  "cluster_serving_lanes", "cluster_queue_depth",
                   "cluster_queue_by_priority", "cluster_oldest_wait_s",
                   "cluster_tenant_lanes", "cluster_tenant_quota",
                   "cluster_tenant_weight",

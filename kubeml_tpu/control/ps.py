@@ -282,7 +282,10 @@ class ParameterServer(JsonService):
                  serve_hbm_budget_mb: Optional[float] = None,
                  serve_prefill_chunk: Optional[int] = None,
                  serve_prefix_cache: Optional[bool] = None,
-                 serve_drain_grace_s: Optional[float] = None):
+                 serve_drain_grace_s: Optional[float] = None,
+                 serve_replicas_min: Optional[int] = None,
+                 serve_replicas_max: Optional[int] = None,
+                 serve_scale_to_zero_s: Optional[float] = None):
         super().__init__(port=port)
         # Lazy mesh: in standalone mode the PARENT must not initialize the
         # accelerator backend (on TPU, libtpu is single-process-exclusive —
@@ -351,7 +354,19 @@ class ParameterServer(JsonService):
         self.serve_drain_grace_s = float(
             serve_drain_grace_s if serve_drain_grace_s is not None
             else os.environ.get("KUBEML_SERVE_DRAIN_GRACE_S", "0"))
-        self._serve: Dict[str, tuple] = {}   # model_id -> (stamp, service)
+        # fleet knobs (serve/fleet.py): replica floor/ceiling per model
+        # and the idle budget before the fleet scales to zero (0 =
+        # never). Defaults keep the single-replica behavior exactly.
+        self.serve_replicas_min = int(
+            serve_replicas_min if serve_replicas_min is not None
+            else os.environ.get("KUBEML_SERVE_REPLICAS_MIN", "1"))
+        self.serve_replicas_max = int(
+            serve_replicas_max if serve_replicas_max is not None
+            else os.environ.get("KUBEML_SERVE_REPLICAS_MAX", "1"))
+        self.serve_scale_to_zero_s = float(
+            serve_scale_to_zero_s if serve_scale_to_zero_s is not None
+            else os.environ.get("KUBEML_SERVE_SCALE_TO_ZERO_S", "0"))
+        self._serve: Dict[str, tuple] = {}   # model_id -> (stamp, fleet)
         self._serve_lock = threading.Lock()
         self._infer_batcher = InferBatcher() if InferBatcher.enabled() \
             else None
@@ -580,8 +595,23 @@ class ParameterServer(JsonService):
         then the watchdog hands its task BACK to the scheduler queue
         (requeue_on_exit) instead of respawning in place, so the freed
         lanes go to the higher-priority arrival. No restart budget is
-        consumed anywhere on this path."""
+        consumed anywhere on this path.
+
+        A ``serve:<model>`` victim is the second gang kind: its fleet
+        drains to zero (in-flight streams get the grace budget, then
+        the replicas stop) and the model cold-starts again on its next
+        request — the serverless analogue of drain + requeue."""
         job_id = req.params["jobId"]
+        if job_id.startswith("serve:"):
+            model_id = job_id[len("serve:"):]
+            with self._serve_lock:
+                cur = self._serve.get(model_id)
+            if cur is None:
+                raise JobNotFoundError(job_id)
+            logger.warning("serving fleet %s: allocator preemption — "
+                           "draining to zero", model_id)
+            cur[1].scale_to_zero("allocator preemption")
+            return {"ok": True}
         with self._jobs_lock:
             rec = self.jobs.get(job_id)
             if rec is None:
@@ -675,13 +705,26 @@ class ParameterServer(JsonService):
         if cur is None:
             raise JobNotFoundError(
                 f"serve:{model_id} (no serving service running)")
-        fl = getattr(cur[1].engine, "flight", None)
-        if fl is None:
-            return {"id": f"serve:{model_id}", "model": model_id,
-                    "capacity": 0, "total_steps": 0, "records": []}
+        # fleet mode: one merged document over every replica's ring,
+        # each record stamped with the replica index it came from
+        capacity = total = 0
+        records: list = []
+        replicas: list = []
+        for idx, engine in cur[1].engines():
+            fl = getattr(engine, "flight", None)
+            if fl is None:
+                continue
+            replicas.append(idx)
+            capacity += fl.capacity
+            total += fl.total
+            for rec in fl.snapshot():
+                if isinstance(rec, dict):
+                    rec = dict(rec)
+                    rec["replica"] = idx
+                records.append(rec)
         return {"id": f"serve:{model_id}", "model": model_id,
-                "capacity": fl.capacity, "total_steps": fl.total,
-                "records": fl.snapshot()}
+                "capacity": capacity, "total_steps": total,
+                "replicas": replicas, "records": records}
 
     def _h_infer(self, req: Request):
         model_id = req.body.get("model_id")
@@ -772,79 +815,129 @@ class ParameterServer(JsonService):
 
     def _serve_hbm_bytes(self) -> int:
         with self._serve_lock:
-            return sum(svc.engine.slab.device_bytes
-                       for _, svc in self._serve.values())
+            return sum(fleet.hbm_bytes
+                       for _, fleet in self._serve.values())
 
     # -------------------------------------------------------- serving plane
 
+    def _serve_replica_factory(self, model_id: str):
+        """Replica builder for the model's fleet (serve/fleet.py): one
+        call builds one UNSTARTED ServeService over a fresh DecodeEngine
+        — exactly two jitted programs per replica. Called at fleet
+        start, on autoscaler grows, and on cold starts from zero, so it
+        re-reads the checkpoint cache each time (a replica born after a
+        hot-swap starts on the newest weights)."""
+        from kubeml_tpu.serve.engine import DecodeEngine
+        from kubeml_tpu.serve.pager import PageGeometry
+        from kubeml_tpu.serve.service import ServeService
+
+        def factory(index: int) -> ServeService:
+            model, variables = self._load_for_infer(model_id)
+            module = getattr(model, "module", None)
+            try:
+                engine = DecodeEngine(
+                    module, variables,
+                    geom=PageGeometry.for_module(
+                        slots=self.serve_slots,
+                        page=self.serve_page_tokens,
+                        max_len=module.max_len),
+                    prefill_chunk=self.serve_prefill_chunk,
+                    prefix_cache=self.serve_prefix_cache,
+                    # production posture: a pager invariant violation
+                    # is logged and counted
+                    # (kubeml_serve_page_leaks_total), never an
+                    # AssertionError that kills the serving loop
+                    # mid-stream — tests run strict
+                    strict_pager=False)
+            except (ValueError, TypeError, AttributeError) as e:
+                # non-GPT modules (no paged decode step) and invalid
+                # serve knobs (e.g. a negative prefill chunk) are
+                # client errors
+                raise InvalidArgsError(
+                    f"model {model_id} does not support streaming "
+                    f"decode with the configured serve knobs: {e}") \
+                    from e
+            # serving observability is always on in the product path:
+            # the tracer shares the service clock (perf_counter), and
+            # each replica sinks under the serve:<model> pseudo-job id
+            # with its own process name so GET /trace?id=serve:<model>
+            # renders the whole fleet on one timeline
+            return ServeService(model_id, engine,
+                                max_queue=self.serve_queue_depth,
+                                metrics=self.metrics,
+                                tracer=Tracer(clock=time.perf_counter),
+                                trace_sink=TraceSink(
+                                    f"serve:{model_id}",
+                                    f"serve-r{index}"))
+        return factory
+
+    def _serve_resize_cb(self, model_id: str):
+        """The fleet's bridge to the cluster pool: every autoscale
+        decision is offered to the scheduler (POST /serve/resize →
+        ClusterAllocator, gang kind 'serving') so replicas and training
+        lanes contend for one pool. Fails OPEN — a standalone PS or an
+        unreachable scheduler must not stall serving elasticity."""
+        def resize_cb(replicas: int) -> int:
+            if not self.scheduler_url:
+                return replicas
+            try:
+                resp = http_json(
+                    "POST", f"{self.scheduler_url}/serve/resize",
+                    {"model_id": model_id, "replicas": int(replicas)})
+                return int(resp.get("granted", replicas))
+            except Exception:
+                logger.exception("serve resize offer failed for %s; "
+                                 "failing open", model_id)
+                return replicas
+        return resize_cb
+
     def _serve_service(self, model_id: str):
-        """The model's continuous-batching decode service. The FIRST
+        """The model's serving FLEET (serve/fleet.py): N continuous-
+        batching replicas behind the prefix-affinity router. The FIRST
         request builds it; when the checkpoint stamp later changes (a
         continual job published on its --publish-every-rounds cadence,
-        or a retrain finished), the new weights are INSTALLED into the
-        live service as a new generation — in-flight streams finish on
+        or a retrain finished), the new weights are INSTALLED into every
+        live replica as a new generation — in-flight streams finish on
         the weights they attached under, new admissions decode the new
         generation, and nothing is stopped or shed (the zero-downtime
         hot-swap; the old build-new-service-and-stop path failed every
         in-flight stream with 'serving loop stopped')."""
-        from kubeml_tpu.serve.engine import DecodeEngine
-        from kubeml_tpu.serve.pager import PageGeometry
-        from kubeml_tpu.serve.service import ServeService
+        from kubeml_tpu.serve.fleet import ServeFleet
         model, variables = self._load_for_infer(model_id)
         stamp = checkpoint_saved_at(model_id)
         with self._serve_lock:
             cur = self._serve.get(model_id)
             if cur is not None:
                 if cur[0] != stamp:
-                    # zero-downtime swap: queue the install for the
-                    # serving-loop thread; requests admitted from here
-                    # on attach to the new generation once it applies
+                    # zero-downtime swap: queue the install for every
+                    # replica's serving-loop thread; requests admitted
+                    # from here on attach to the new generation
                     cur[1].install_weights(variables, stamp)
                     self._serve[model_id] = (stamp, cur[1])
                 return cur[1]
-        module = getattr(model, "module", None)
-        try:
-            engine = DecodeEngine(
-                module, variables,
-                geom=PageGeometry.for_module(
-                    slots=self.serve_slots, page=self.serve_page_tokens,
-                    max_len=module.max_len),
-                prefill_chunk=self.serve_prefill_chunk,
-                prefix_cache=self.serve_prefix_cache,
-                # production posture: a pager invariant violation is
-                # logged and counted (kubeml_serve_page_leaks_total),
-                # never an AssertionError that kills the serving loop
-                # mid-stream — tests run strict
-                strict_pager=False)
-        except (ValueError, TypeError, AttributeError) as e:
-            # non-GPT modules (no paged decode step) and invalid serve
-            # knobs (e.g. a negative prefill chunk) are client errors
-            raise InvalidArgsError(
-                f"model {model_id} does not support streaming decode "
-                f"with the configured serve knobs: {e}") from e
-        # serving observability is always on in the product path: the
-        # tracer shares the service clock (perf_counter) so request
-        # spans and engine dispatch spans sit on one timebase, and the
-        # sink files under the serve:<model> pseudo-job id so
-        # GET /trace?id=serve:<model> and `kubeml trace` render the
-        # serving plane exactly like a training job
-        svc = ServeService(model_id, engine,
-                           max_queue=self.serve_queue_depth,
-                           metrics=self.metrics,
-                           health_cb=self._observe_health,
-                           tracer=Tracer(clock=time.perf_counter),
-                           trace_sink=TraceSink(f"serve:{model_id}",
-                                                "serve")).start()
+        fleet = ServeFleet(
+            model_id, self._serve_replica_factory(model_id),
+            replicas_min=self.serve_replicas_min,
+            replicas_max=self.serve_replicas_max,
+            scale_to_zero_s=self.serve_scale_to_zero_s,
+            # the shrink/scale-to-zero grace: the stop() knob defaults
+            # to 0 for instant teardown, but an autoscaler retire must
+            # always give in-flight streams a real budget
+            drain_grace_s=self.serve_drain_grace_s or 5.0,
+            page_tokens=self.serve_page_tokens,
+            metrics=self.metrics,
+            health_cb=self._observe_health,
+            resize_cb=self._serve_resize_cb(model_id)).start()
         old = None
         with self._serve_lock:
             cur = self._serve.get(model_id)
             if cur is not None:  # lost the build race; ours is unused
-                old, svc = svc, cur[1]
+                old, fleet = fleet, cur[1]
             else:
-                self._serve[model_id] = (stamp, svc)
+                self._serve[model_id] = (stamp, fleet)
         if old is not None:
             old.stop()
-        return svc
+        return fleet
 
     def _h_generate(self, req: Request):
         """Streaming continuous-batching generation. Body:
@@ -887,7 +980,8 @@ class ParameterServer(JsonService):
                 seed=int(body.get("seed", 0)),
                 eos_id=body.get("eos_id"),
                 trace_id=trace_id,
-                deadline_ms=body.get("deadline_ms"))
+                deadline_ms=body.get("deadline_ms"),
+                session=body.get("session"))
         except InferenceInputError as e:
             raise InvalidArgsError(str(e)) from e
         except (ServeSaturated, ServeDraining) as e:

@@ -120,6 +120,7 @@ class Scheduler(JsonService):
         self.route("POST", "/infer", self._h_infer)
         self.route("POST", "/requeue", self._h_requeue)
         self.route("GET", "/cluster", self._h_cluster)
+        self.route("POST", "/serve/resize", self._h_serve_resize)
         self.route("DELETE", "/finish/{taskId}", self._h_finish)
 
     # ------------------------------------------------------------ lifecycle
@@ -222,6 +223,68 @@ class Scheduler(JsonService):
         if self.allocator is None:
             raise KubeMLException("cluster allocator not configured", 503)
         return self.allocator.snapshot()
+
+    def _h_serve_resize(self, req: Request):
+        """A serving fleet (serve/fleet.py resize_cb, via the PS) offers
+        a replica-count change to the shared pool. Serving gangs are the
+        allocator's SECOND gang kind ('serving'): placed, resized, and
+        preempted through the same Decision machinery as training gangs,
+        so replicas and worker lanes contend for one device pool.
+        Answers {"granted": n replicas}.
+
+        Policy: serving gangs never park. A fleet that cannot grow NOW
+        is granted 0 and retries on its next autoscale tick (its SLO
+        pressure re-asks every second), so a 'queue' decision is
+        released immediately instead of holding the line against
+        training arrivals."""
+        body = req.body or {}
+        model_id = body.get("model_id") or body.get("model")
+        if not model_id:
+            raise InvalidArgsError("model_id required")
+        try:
+            replicas = int(body.get("replicas", 1))
+            lanes_per = max(1, int(body.get("lanes_per_replica", 1)))
+            priority = int(body.get("priority", 0))
+        except (TypeError, ValueError) as e:
+            raise InvalidArgsError(f"bad serve resize request: {e}")
+        tenant = body.get("tenant") or ""
+        if self.allocator is None:
+            # standalone scheduler: no pool to arbitrate — fail open so
+            # serving elasticity never stalls on deployment shape
+            return {"granted": max(0, replicas)}
+        job_id = f"serve:{model_id}"
+        if replicas <= 0:
+            # fleet drained to zero (idle budget / preemption): its
+            # lanes free now and may grant parked training work
+            self._apply_decisions(self.allocator.release(job_id))
+            self._push_cluster_state()
+            return {"granted": 0}
+        lanes = replicas * lanes_per
+        cur = self.allocator.running_lanes(job_id)
+        if cur is None:
+            decisions = self.allocator.submit(
+                job_id, tenant=tenant, priority=priority, lanes=lanes,
+                kind="serving")
+            placed = next((d.lanes for d in decisions
+                           if d.action == "place"
+                           and d.job_id == job_id), 0)
+            # the serve gang's own place/queue need no task dispatch;
+            # everything else (preempts it triggered, grants unlocked
+            # elsewhere) applies normally
+            self._apply_decisions(
+                [d for d in decisions
+                 if not (d.job_id == job_id
+                         and d.action in ("place", "queue"))])
+            if placed == 0:
+                # never park: give the reservation back right away
+                self._apply_decisions(self.allocator.release(job_id))
+            self._push_cluster_state()
+            return {"granted": placed // lanes_per}
+        decisions = self.allocator.resize(job_id, lanes)
+        granted = decisions[0].lanes
+        self._apply_decisions(decisions)
+        self._push_cluster_state()
+        return {"granted": granted // lanes_per}
 
     # ----------------------------------------------------------------- loop
 
@@ -417,16 +480,23 @@ class Scheduler(JsonService):
             elif d.action == "preempt":
                 logger.warning("allocator preempting %s for %s [%s] %s",
                                d.victim, d.job_id, d.path, d.detail)
-                if self.ps_url is None:
-                    continue
-                try:
-                    http_json("POST",
-                              f"{self.ps_url}/preempt/{d.victim}")
-                except KubeMLException as e:
-                    # victim already gone (finish raced the decision):
-                    # its release path frees the lanes either way
-                    logger.warning("preempt of %s failed: %s", d.victim,
-                                   e.message)
+                if self.ps_url is not None:
+                    try:
+                        http_json("POST",
+                                  f"{self.ps_url}/preempt/{d.victim}")
+                    except KubeMLException as e:
+                        # victim already gone (finish raced the
+                        # decision): its release path frees the lanes
+                        # either way
+                        logger.warning("preempt of %s failed: %s",
+                                       d.victim, e.message)
+                if d.victim.startswith("serve:"):
+                    # serving victims have no /requeue round-trip: the
+                    # PS scaled the fleet to zero synchronously (it
+                    # cold-starts again on its next request), so the
+                    # lanes free here, not on a process exit
+                    self._apply_decisions(
+                        self.allocator.release(d.victim))
 
     def _push_cluster_state(self):
         """Feed the allocator snapshot to the PS: Prometheus gauges

@@ -566,6 +566,38 @@ class MetricsRegistry:
             "kubeml_infer_cache_misses_total",
             "Inference-cache lookups that deserialized a checkpoint",
             "cache")
+        # serving fleet (serve/fleet.py), fed by the fleet's merged
+        # snapshot (update_fleet): live replica count, router traffic
+        # (spills off the affine replica, shed retries, cold starts),
+        # autoscaler decisions by action, and per-replica prefix-cache
+        # traffic — per-replica series ride a `replica` LABEL, never
+        # family-name suffixes (the check_metrics.py cardinality rule)
+        self.serve_fleet_replicas = Gauge(
+            "kubeml_serve_fleet_replicas",
+            "Live decode replicas behind the model's fleet router",
+            "model")
+        self.serve_fleet_spills_total = Counter(
+            "kubeml_serve_fleet_spills_total",
+            "Requests routed off their affine replica to a peer",
+            "model")
+        self.serve_fleet_router_retries_total = Counter(
+            "kubeml_serve_fleet_router_retries_total",
+            "Replica sheds the router retried against a peer", "model")
+        self.serve_fleet_cold_starts_total = Counter(
+            "kubeml_serve_fleet_cold_starts_total",
+            "Replicas built from zero by a first request", "model")
+        self.serve_fleet_scale_events_total = Counter(
+            "kubeml_serve_fleet_scale_events_total",
+            "Fleet autoscaler decisions applied, by action",
+            ("model", "action"))
+        self.serve_fleet_replica_prefix_hits_total = Counter(
+            "kubeml_serve_fleet_replica_prefix_hits_total",
+            "Prefix-cache hits per decode replica",
+            ("model", "replica"))
+        self.serve_fleet_replica_prefix_misses_total = Counter(
+            "kubeml_serve_fleet_replica_prefix_misses_total",
+            "Prefix-cache misses per decode replica",
+            ("model", "replica"))
         # cluster allocator (control/cluster.py), fed by the scheduler's
         # snapshot pushes (POST /cluster): pool occupancy, queue depth
         # by priority, per-tenant lanes vs quota/weighted share, and
@@ -637,6 +669,7 @@ class MetricsRegistry:
                               self.serve_kv_utilization,
                               self.serve_prefill_backlog,
                               self.serve_weight_generation,
+                              self.serve_fleet_replicas,
                               self.infer_cache_entries]
         self._serve_hists = [self.serve_ttft_seconds,
                              self.serve_tpot_seconds,
@@ -654,6 +687,12 @@ class MetricsRegistry:
                                 self.serve_engine_restarts_total,
                                 self.serve_poisoned_total,
                                 self.serve_page_leaks_total,
+                                self.serve_fleet_spills_total,
+                                self.serve_fleet_router_retries_total,
+                                self.serve_fleet_cold_starts_total,
+                                self.serve_fleet_scale_events_total,
+                                self.serve_fleet_replica_prefix_hits_total,
+                                self.serve_fleet_replica_prefix_misses_total,
                                 self.infer_cache_hits_total,
                                 self.infer_cache_misses_total]
         self._cluster_gauges = [self.cluster_pool_lanes,
@@ -671,6 +710,8 @@ class MetricsRegistry:
         # cumulative counter values seen per snapshot field, for the
         # delta advance in update_cluster
         self._cluster_seen: Dict[str, float] = {}
+        # (model, field) -> cumulative seen, for update_fleet's deltas
+        self._fleet_seen: Dict[tuple, float] = {}
 
     def update_job(self, m) -> None:
         """Apply a MetricUpdate (ml/pkg/ps/metrics.go:90-99)."""
@@ -828,10 +869,50 @@ class MetricsRegistry:
             self.trace_dropped_total.inc(job_id, cum - seen)
             self._trace_seen[job_id] = cum
 
+    def update_fleet(self, model: str, snap: dict) -> None:
+        """Apply one merged fleet snapshot (serve/fleet.py). The gauge
+        mirrors the live replica count; lifetime counters advance by
+        delta against the snapshot's cumulative values (the
+        update_cluster discipline, so republished snapshots stay
+        monotone); the per-replica prefix hit/miss fields are already
+        deltas and feed their counters directly."""
+        self.serve_fleet_replicas.set(
+            model, float(snap.get("fleet_replicas", 0)))
+        for field, counter in (
+                ("fleet_spills_total", self.serve_fleet_spills_total),
+                ("fleet_router_retries_total",
+                 self.serve_fleet_router_retries_total),
+                ("fleet_cold_starts_total",
+                 self.serve_fleet_cold_starts_total)):
+            cum = float(snap.get(field, 0))
+            seen = self._fleet_seen.get((model, field), 0.0)
+            if cum > seen:
+                counter.inc(model, cum - seen)
+                self._fleet_seen[(model, field)] = cum
+        for field, action in (("fleet_grows_total", "grow"),
+                              ("fleet_shrinks_total", "shrink"),
+                              ("fleet_scale_to_zero_total",
+                               "scale_to_zero")):
+            cum = float(snap.get(field, 0))
+            seen = self._fleet_seen.get((model, field), 0.0)
+            if cum > seen:
+                self.serve_fleet_scale_events_total.inc(
+                    (model, action), cum - seen)
+                self._fleet_seen[(model, field)] = cum
+        for counter, field in (
+                (self.serve_fleet_replica_prefix_hits_total,
+                 "fleet_replica_prefix_hits"),
+                (self.serve_fleet_replica_prefix_misses_total,
+                 "fleet_replica_prefix_misses")):
+            for replica, n in (snap.get(field) or {}).items():
+                if n > 0:
+                    counter.inc((model, str(replica)), float(n))
+
     def clear_serve(self, model: str) -> None:
         for g in (self.serve_active_slots, self.serve_queue_depth,
                   self.serve_kv_utilization, self.serve_prefill_backlog,
-                  self.serve_weight_generation):
+                  self.serve_weight_generation,
+                  self.serve_fleet_replicas):
             g.clear(model)
         for h in self._serve_hists:
             h.clear(model)
@@ -844,10 +925,18 @@ class MetricsRegistry:
                   self.serve_prefix_misses_total,
                   self.serve_engine_restarts_total,
                   self.serve_poisoned_total,
-                  self.serve_page_leaks_total):
+                  self.serve_page_leaks_total,
+                  self.serve_fleet_spills_total,
+                  self.serve_fleet_router_retries_total,
+                  self.serve_fleet_cold_starts_total,
+                  self.serve_fleet_scale_events_total,
+                  self.serve_fleet_replica_prefix_hits_total,
+                  self.serve_fleet_replica_prefix_misses_total):
             c.clear_prefix(model)
         self.trace_dropped_total.clear_prefix(f"serve:{model}")
         self._trace_seen.pop(f"serve:{model}", None)
+        for key in [k for k in self._fleet_seen if k[0] == model]:
+            del self._fleet_seen[key]
 
     # ---------------------------------------------------- cluster allocator
 

@@ -16,14 +16,18 @@ Modules:
   engine.py   the jitted one-token-per-slot decode step + slot state
   slots.py    request objects, event streams, admission errors
   service.py  the background serving loop the PS mounts at POST /generate
+  fleet.py    multi-replica router + lifecycle + autoscaler (one model)
 """
 
 from kubeml_tpu.serve.engine import DecodeEngine
-from kubeml_tpu.serve.pager import KVPageSlab, PageAllocator, PageGeometry
+from kubeml_tpu.serve.fleet import FLEET_PATH_VARIANTS, ServeFleet
+from kubeml_tpu.serve.pager import (KVPageSlab, PageAllocator,
+                                    PageGeometry, routing_digest)
 from kubeml_tpu.serve.service import ServeService
 from kubeml_tpu.serve.slots import GenerateRequest, ServeSaturated
 
 __all__ = [
-    "DecodeEngine", "GenerateRequest", "KVPageSlab", "PageAllocator",
-    "PageGeometry", "ServeSaturated", "ServeService",
+    "DecodeEngine", "FLEET_PATH_VARIANTS", "GenerateRequest",
+    "KVPageSlab", "PageAllocator", "PageGeometry", "ServeFleet",
+    "ServeSaturated", "ServeService", "routing_digest",
 ]

@@ -1,0 +1,729 @@
+"""The serving fleet: N decode replicas behind one router, scaled to load.
+
+One ``ServeFleet`` fronts N ``ServeService`` replicas for a single
+model. Three responsibilities live here, deliberately in one place so
+they can share one lock and one view of the replica set:
+
+* **Routing** — consistent-hash prefix affinity. The routing key is the
+  PR-8 ``chain_hash`` digest of the first full prompt page
+  (``pager.routing_digest``): two prompts that share a first page route
+  to the same replica, which is exactly the condition under which the
+  content-hash prefix cache can serve one's pages to the other. The
+  cache is per-replica (so is its LRU eviction order), so affinity is
+  what makes the fleet's hit rate approach the solo engine's. Sessions
+  pin sticky (same ``session`` id → same replica while it lives), and a
+  saturated owner spills to the least-loaded admitting peer rather than
+  shedding work the fleet still has room for.
+
+* **Lifecycle** — replicas are built by a caller-supplied factory
+  (index → unstarted ``ServeService``), retired through the PR-12
+  ``drain(grace_s)`` grace path (admission flips to 503 on the victim,
+  in-flight streams finish, THEN the replica stops — shrink loses zero
+  streams), and cold-started from zero on the first request (the
+  builder thread serves that request; concurrent arrivals shed 429 with
+  a warm-up Retry-After).
+
+* **Autoscaling** — a policy tick reads the same SLO snapshot the
+  health rules consume (shed deltas, queue fraction, TTFT p99) and
+  grows toward ``replicas_max``; sustained idleness shrinks toward
+  ``replicas_min``; ``scale_to_zero_s`` of no admissions drains the
+  whole fleet away. Every resize is offered to the cluster allocator
+  first via ``resize_cb`` (control/scheduler.py ``/serve/resize`` →
+  cluster.py "serve-elastic" decisions) so training and serving share
+  one device pool.
+
+Lock discipline (load-bearing): replica loop threads call back into
+the fleet (``_on_replica_publish``) while holding their own ``_cv``, so
+the only legal lock order is **replica _cv → fleet lock**. Inside the
+fleet lock only lock-free replica reads are allowed (``snapshot()``,
+``would_admit()``, ``inflight`` — see service.py "fleet router hooks");
+anything that takes a replica's ``_cv`` (submit/drain/stop/cancel/
+install_weights) or blocks (factory builds, HTTP resize calls) runs
+OUTSIDE the fleet lock.
+"""
+
+from __future__ import annotations
+
+import bisect
+import collections
+import hashlib
+import logging
+import threading
+import time
+from typing import Callable, Dict, List, Optional, Tuple
+
+from kubeml_tpu.serve.pager import routing_digest
+from kubeml_tpu.serve.service import ServeService
+from kubeml_tpu.serve.slots import (GenerateRequest, ServeDraining,
+                                    ServeSaturated)
+
+logger = logging.getLogger("kubeml_tpu.serve.fleet")
+
+# Router / lifecycle paths a request or scale event can take. Linted by
+# tools/check_fleet_paths.py: every entry needs a tests/ assertion that
+# names it in quotes next to a bit-identity check, so no path exists
+# without a test proving the routed stream decodes exactly like a solo
+# engine's. Keep this a flat tuple of plain strings.
+FLEET_PATH_VARIANTS = (
+    "affine_hit",     # routed to the consistent-hash owner and admitted
+    "spill",          # owner saturated/draining; a peer took the stream
+    "cold_start",     # fleet was at zero; first request built replica 0
+    "shrink_drain",   # autoscaler retired an idle replica via drain
+    "scale_to_zero",  # idle budget expired; the whole fleet drained away
+)
+
+# ring points per replica: enough that removing one replica moves only
+# ~1/N of the keyspace instead of re-homing every prefix
+VNODES = 32
+
+# consecutive idle autoscale ticks before one replica is shrunk — a
+# momentary lull between bursts must not thrash the replica count
+SHRINK_IDLE_TICKS = 3
+
+# Retry-After handed to requests that arrive WHILE replica 0 is cold
+# starting: dominated by the two jitted compiles, so order-seconds
+COLD_START_WARM_ESTIMATE_S = 8.0
+
+# sticky session -> replica LRU capacity
+SESSION_CACHE = 4096
+
+
+def _ring_point(idx: int, vnode: int) -> int:
+    h = hashlib.sha256(f"replica:{idx}:{vnode}".encode()).digest()
+    return int.from_bytes(h[:8], "big")
+
+
+class ServeFleet:
+    """Router + lifecycle manager + autoscaler for one model's replicas.
+
+    ``replica_factory(index)`` returns an UNSTARTED ``ServeService``;
+    the fleet silences its per-model gauges, installs its own health
+    callback, and starts it. ``resize_cb(replicas)`` (optional) offers
+    each resize to the cluster allocator and returns the granted count.
+    """
+
+    def __init__(self, model_id: str,
+                 replica_factory: Callable[[int], ServeService], *,
+                 replicas_min: int = 1, replicas_max: int = 1,
+                 scale_to_zero_s: float = 0.0,
+                 drain_grace_s: float = 5.0,
+                 page_tokens: int = 16,
+                 routing: str = "affine",
+                 metrics=None,
+                 health_cb: Optional[Callable[[dict], None]] = None,
+                 resize_cb: Optional[Callable[[int], int]] = None,
+                 autoscale_interval_s: float = 1.0,
+                 ttft_slo_s: float = 2.0,
+                 clock=time.perf_counter):
+        if routing not in ("affine", "random"):
+            raise ValueError(f"routing must be 'affine' or 'random', "
+                             f"got {routing!r}")
+        self.model_id = model_id
+        self.clock = clock
+        self._factory = replica_factory
+        self.replicas_min = max(0, int(replicas_min))
+        self.replicas_max = max(1, int(replicas_max), self.replicas_min)
+        self.scale_to_zero_s = float(scale_to_zero_s)
+        self.drain_grace_s = float(drain_grace_s)
+        self.page_tokens = max(1, int(page_tokens))
+        self.routing = routing
+        self.metrics = metrics
+        self.health_cb = health_cb
+        self.resize_cb = resize_cb
+        self.autoscale_interval_s = float(autoscale_interval_s)
+        self.ttft_slo_s = float(ttft_slo_s)
+
+        self._lock = threading.Lock()
+        self._replicas: "collections.OrderedDict[int, ServeService]" = \
+            collections.OrderedDict()
+        self._draining: set = set()      # idxs mid-retire (off the ring)
+        self._next_idx = 0
+        self._ring: List[Tuple[int, int]] = []   # sorted (point, idx)
+        self._sessions: "collections.OrderedDict[str, int]" = \
+            collections.OrderedDict()
+        self._stopped = False
+        # cold start: first submit against an empty fleet builds replica
+        # 0 synchronously (that request is served, not shed); concurrent
+        # arrivals shed with the remaining warm estimate
+        self._warming = False
+        self._warm_started = 0.0
+        self._last_submit = clock()
+        self._idle_ticks = 0
+        self._rr = 0                     # routing="random" counter
+        # totals folded in from retired replicas so fleet aggregates
+        # stay monotone across shrink / scale-to-zero
+        self._retired: Dict[str, int] = collections.defaultdict(int)
+        # per-replica prefix hit/miss cursors for the delta fields the
+        # fleet snapshot exposes (satellite: per-replica cache health)
+        self._prefix_seen: Dict[int, Tuple[int, int]] = {}
+        self._rejected_seen = 0          # autoscaler shed-delta cursor
+        self._router_rejected_total = 0  # sheds surfaced BY the router
+        # the testable surface: how many times each FLEET_PATH_VARIANTS
+        # path was taken
+        self.path_counts: Dict[str, int] = {
+            name: 0 for name in FLEET_PATH_VARIANTS}
+        self.cold_starts_total = 0
+        self.spills_total = 0
+        self.router_retries_total = 0
+        self.grows_total = 0
+        self.shrinks_total = 0
+        self.scale_to_zero_total = 0
+        self.decisions: "collections.deque" = collections.deque(maxlen=64)
+        self._stop_event = threading.Event()
+        self._autoscale_thread = threading.Thread(
+            target=self._autoscale_loop,
+            name=f"fleet-autoscale-{model_id}", daemon=True)
+        self._started = False
+
+    # -------------------------------------------------------------- lifecycle
+    def start(self) -> "ServeFleet":
+        """Spawn the floor replica set and the autoscaler thread. With
+        ``replicas_min == 0`` the fleet starts EMPTY and cold-starts on
+        the first request (serverless semantics)."""
+        self._started = True
+        for _ in range(self.replicas_min):
+            self._spawn_one()
+        if self.autoscale_interval_s > 0:
+            self._autoscale_thread.start()
+        return self
+
+    def _spawn_one(self, path: Optional[str] = None) -> int:
+        """Build + start one replica (caller must NOT hold the lock:
+        the factory loads checkpoints and compiles nothing yet, but it
+        is slow and must never serialize the router)."""
+        with self._lock:
+            idx = self._next_idx
+            self._next_idx += 1
+        svc = self._factory(idx)
+        # the fleet owns the per-model gauges (it publishes the MERGED
+        # snapshot); replicas keep their additive counters/histograms
+        svc.publish_state_gauges = False
+        svc.health_cb = (lambda snap, _i=idx:
+                         self._on_replica_publish(_i, snap))
+        svc.start()
+        with self._lock:
+            self._replicas[idx] = svc
+            self._rebuild_ring()
+            if path is not None:
+                self._count_path(path)
+        logger.info("fleet %s: replica %d up (%d live)", self.model_id,
+                    idx, self.replica_count)
+        return idx
+
+    def _retire(self, idx: int, path: str) -> bool:
+        """Drain one replica off the fleet: off the ring first (no new
+        work routes to it), then the PR-12 grace drain (in-flight
+        streams finish), then stop. Returns True when the drain emptied
+        the replica within the grace budget."""
+        with self._lock:
+            svc = self._replicas.get(idx)
+            if svc is None or idx in self._draining:
+                return True
+            self._draining.add(idx)
+            self._rebuild_ring()
+        drained = svc.drain(self.drain_grace_s)
+        svc.stop(grace_s=0.0)
+        with self._lock:
+            self._fold_retired(svc, idx)
+            self._replicas.pop(idx, None)
+            self._draining.discard(idx)
+            self._count_path(path)
+        logger.info("fleet %s: replica %d retired (%s, drained=%s, "
+                    "%d live)", self.model_id, idx, path, drained,
+                    self.replica_count)
+        return drained
+
+    def _fold_retired(self, svc: ServeService, idx: int) -> None:
+        """Accumulate a retiring replica's monotone totals (lock held)
+        so fleet aggregates never go backwards on shrink."""
+        st = svc.engine.stats
+        self._retired["rejected"] += svc.rejected_total
+        self._retired["restarts"] += svc.restarts_total
+        self._retired["poisoned"] += svc.poisoned_total
+        self._retired["deadline"] += svc.deadline_total
+        self._retired["prefix_hits"] += int(st["prefix_hits"])
+        self._retired["prefix_misses"] += int(st["prefix_misses"])
+        self._prefix_seen.pop(idx, None)
+
+    def drain(self, grace_s: float) -> bool:
+        """Graceful fleet drain: every replica flips to 503 at once,
+        then the grace budget is shared across them (they drain
+        concurrently — each polls its own in-flight count)."""
+        with self._lock:
+            self._stopped = True
+            svcs = list(self._replicas.values())
+        ok = True
+        deadline = self.clock() + float(grace_s)
+        for svc in svcs:
+            ok = svc.drain(max(0.0, deadline - self.clock())) and ok
+        return ok
+
+    def stop(self, timeout: float = 10.0, grace_s: float = 0.0) -> None:
+        self._stop_event.set()
+        with self._lock:
+            self._stopped = True
+            svcs = list(self._replicas.values())
+            self._replicas.clear()
+            self._ring = []
+        for svc in svcs:
+            svc.stop(timeout=timeout, grace_s=grace_s)
+        if self._autoscale_thread.is_alive():
+            self._autoscale_thread.join(timeout)
+
+    def scale_to_zero(self, reason: str = "requested") -> None:
+        """Drain every live replica away (preemption / idle budget).
+        The fleet stays routable: the next submit cold-starts."""
+        with self._lock:
+            idxs = [i for i in self._replicas if i not in self._draining]
+        if not idxs:
+            return
+        self._resize_grant(0)
+        for idx in idxs[:-1]:
+            self._retire(idx, "shrink_drain")
+        self._retire(idxs[-1], "scale_to_zero")
+        with self._lock:
+            self.scale_to_zero_total += 1
+            self.shrinks_total += len(idxs) - 1
+            self._note_decision("scale_to_zero", reason)
+        self._publish_merged()
+
+    # -------------------------------------------------------------- routing
+    def _live_idxs(self) -> List[int]:
+        """Replicas new work may route to (lock held)."""
+        return [i for i in self._replicas if i not in self._draining]
+
+    def _rebuild_ring(self) -> None:
+        """(lock held) VNODES sha256 points per live replica."""
+        self._ring = sorted(
+            (_ring_point(i, v), i)
+            for i in self._replicas if i not in self._draining
+            for v in range(VNODES))
+
+    def _ring_owner(self, digest: bytes) -> Optional[int]:
+        """(lock held) first ring point at/after the key, wrapping."""
+        if not self._ring:
+            return None
+        key = int.from_bytes(digest[:8], "big")
+        pos = bisect.bisect_left(self._ring, (key, -1))
+        if pos == len(self._ring):
+            pos = 0
+        return self._ring[pos][1]
+
+    def _least_loaded(self, live: List[int],
+                      exclude: set) -> Optional[int]:
+        """(lock held) spill target: fewest in-flight among admitting
+        candidates; falls back to fewest in-flight overall."""
+        cands = [i for i in live if i not in exclude]
+        if not cands:
+            return None
+        admitting = [i for i in cands if self._replicas[i].would_admit()]
+        pool = admitting or cands
+        return min(pool, key=lambda i: (self._replicas[i].inflight, i))
+
+    def _pick(self, digest: bytes, session: Optional[str],
+              attempted: set) -> Tuple[Optional[int], Optional[str]]:
+        """(lock held) choose the next replica to try and the path name
+        that a SUCCESSFUL admission there should count."""
+        live = self._live_idxs()
+        cands = [i for i in live if i not in attempted]
+        if not cands:
+            return None, None
+        if attempted:
+            # the retry after a shed: least-loaded peer, counts as spill
+            return self._least_loaded(live, attempted), "spill"
+        if self.routing == "random":
+            # bench control arm: deterministic hash-of-counter choice,
+            # deliberately blind to the prompt
+            h = hashlib.sha256(str(self._rr).encode()).digest()
+            self._rr += 1
+            return cands[int.from_bytes(h[:8], "big") % len(cands)], None
+        if session is not None:
+            owner = self._sessions.get(session)
+            if owner is not None and owner in cands:
+                self._sessions.move_to_end(session)
+                return owner, "affine_hit"
+        owner = self._ring_owner(digest)
+        if owner is None or owner not in cands:
+            return self._least_loaded(live, attempted), "spill"
+        if not self._replicas[owner].would_admit():
+            # proactive spill: the owner would shed, a peer would not —
+            # route around the 429 instead of collecting it
+            peer = self._least_loaded(live, attempted | {owner})
+            if peer is not None and self._replicas[peer].would_admit():
+                return peer, "spill"
+        return owner, "affine_hit"
+
+    def _ensure_capacity(self) -> None:
+        """Cold start from zero: the first thread against an empty
+        fleet builds replica 0 synchronously and then SERVES its
+        request; concurrent arrivals shed 429 with the remaining warm
+        estimate so clients back off instead of dogpiling the build."""
+        build = False
+        with self._lock:
+            self._last_submit = self.clock()
+            if self._stopped:
+                raise ServeSaturated(message="serving fleet stopped")
+            if self._live_idxs():
+                return
+            if self._warming:
+                remaining = max(
+                    0.5, self._warm_started + COLD_START_WARM_ESTIMATE_S
+                    - self.clock())
+                raise ServeSaturated(
+                    retry_after_s=remaining,
+                    message="cold start in progress: replica warming "
+                            "from zero")
+            self._warming = True
+            self._warm_started = self.clock()
+            build = True
+        if not build:
+            return
+        try:
+            # offer the gang to the allocator, but proceed even on a
+            # zero grant: a model with live traffic holds a serving
+            # floor of one replica — the allocator can preempt it later
+            # through /preempt (which scales the fleet back to zero)
+            self._resize_grant(1)
+            self._spawn_one(path="cold_start")
+            with self._lock:
+                self.cold_starts_total += 1
+                self.grows_total += 1
+                self._idle_ticks = 0
+                self._note_decision("cold_start", "first request after "
+                                                  "scale-to-zero")
+        finally:
+            with self._lock:
+                self._warming = False
+
+    def submit(self, prompt, max_new_tokens: int = 32,
+               temperature: float = 0.0, seed: int = 0,
+               eos_id: Optional[int] = None,
+               trace_id: Optional[str] = None,
+               deadline_ms: Optional[float] = None,
+               session: Optional[str] = None) -> GenerateRequest:
+        """Route one request into the fleet. Same contract as
+        ``ServeService.submit`` plus ``session`` stickiness; a shed on
+        the affine replica is retried ONCE against the least-loaded
+        peer before the fleet surfaces it, and a surfaced shed carries
+        the fleet-minimum Retry-After (not the first replica's)."""
+        self._ensure_capacity()
+        digest = routing_digest(list(prompt), self.page_tokens)
+        attempted: set = set()
+        sheds: List[Exception] = []
+        while True:
+            with self._lock:
+                idx, path = self._pick(digest, session, attempted)
+                svc = self._replicas.get(idx) if idx is not None else None
+            if svc is None:
+                break
+            try:
+                req = svc.submit(prompt, max_new_tokens=max_new_tokens,
+                                 temperature=temperature, seed=seed,
+                                 eos_id=eos_id, trace_id=trace_id,
+                                 deadline_ms=deadline_ms)
+            except (ServeSaturated, ServeDraining) as e:
+                sheds.append(e)
+                attempted.add(idx)
+                with self._lock:
+                    if len(attempted) > 1 or not \
+                            [i for i in self._live_idxs()
+                             if i not in attempted]:
+                        break       # retried once already, or no peer
+                    self.router_retries_total += 1
+                continue
+            req.fleet_replica = idx     # cancel() routes on this
+            with self._lock:
+                if path is not None:
+                    self._count_path(path)
+                    if path == "spill":
+                        self.spills_total += 1
+                if session is not None:
+                    self._sessions[session] = idx
+                    self._sessions.move_to_end(session)
+                    while len(self._sessions) > SESSION_CACHE:
+                        self._sessions.popitem(last=False)
+            return req
+        self._surface_shed(sheds, attempted)
+
+    def _surface_shed(self, sheds: List[Exception],
+                      attempted: set) -> None:
+        """Every routing attempt shed: surface ONE exception carrying
+        the fleet-minimum Retry-After (satellite fix — the first
+        replica's backlog must not set the whole fleet's hint)."""
+        with self._lock:
+            self._router_rejected_total += 1
+            others = [self._replicas[i].estimated_retry_after_s()
+                      for i in self._live_idxs() if i not in attempted]
+        if len(sheds) == 1 and not others:
+            raise sheds[0]          # single replica: verbatim pass-through
+        candidates = [e.retry_after_s for e in sheds] + others
+        retry = min(candidates) if candidates else 1.0
+        if sheds and all(isinstance(e, ServeDraining) for e in sheds):
+            raise ServeDraining(retry_after_s=retry)
+        raise ServeSaturated(
+            retry_after_s=retry,
+            message=f"fleet at capacity: {len(sheds)} replica(s) shed "
+                    f"the request")
+
+    def cancel(self, req: GenerateRequest) -> None:
+        idx = getattr(req, "fleet_replica", None)
+        with self._lock:
+            svc = self._replicas.get(idx) if idx is not None else None
+            fallback = [] if svc is not None \
+                else list(self._replicas.values())
+        if svc is not None:
+            svc.cancel(req)
+            return
+        for s in fallback:
+            s.cancel(req)
+
+    def install_weights(self, variables, stamp: Optional[float] = None
+                        ) -> None:
+        """Queue the hot-swap on every live replica (each applies it
+        before its own next admissions, same zero-downtime contract as
+        the single-service path)."""
+        with self._lock:
+            svcs = list(self._replicas.values())
+        for svc in svcs:
+            svc.install_weights(variables, stamp)
+
+    # ------------------------------------------------------------ autoscaler
+    def _autoscale_loop(self) -> None:
+        while not self._stop_event.wait(self.autoscale_interval_s):
+            try:
+                self.autoscale_once()
+            except Exception:
+                logger.exception("fleet %s autoscale tick failed",
+                                 self.model_id)
+
+    def autoscale_once(self, now: Optional[float] = None) -> Optional[str]:
+        """One policy tick. Reads the per-replica SLO signals (shed
+        delta since the last tick, queue fraction, worst TTFT p99) and
+        returns the action taken: 'grow', 'shrink', 'scale_to_zero' or
+        None. Public and deterministic so tests drive it directly; the
+        background thread just calls it on a cadence."""
+        now = self.clock() if now is None else now
+        with self._lock:
+            if self._stopped or self._warming:
+                return None
+            live = self._live_idxs()
+            n = len(live)
+            snaps = [self._replicas[i].snapshot() for i in live]
+            inflight = sum(self._replicas[i].inflight for i in live)
+            rejected = self._retired["rejected"] + sum(
+                s["serve_rejected_total"] for s in snaps)
+            shed_delta = max(0, rejected - self._rejected_seen)
+            self._rejected_seen = rejected
+            queue = sum(s["serve_queue_depth"] for s in snaps)
+            qcap = sum(s["serve_queue_cap"] for s in snaps)
+            p99 = max((s["serve_ttft_p99"] for s in snaps), default=0.0)
+            idle = inflight == 0 and queue == 0 and shed_delta == 0
+            idle_for = now - self._last_submit
+            # grow needs LIVE pressure: a shed since the last tick, a
+            # half-full admission queue, or an SLO-busting p99 WITH
+            # work in flight — a stale p99 over an idle fleet (e.g.
+            # the one compile-priced request that woke it) must not
+            # grow replicas nobody is waiting on
+            pressured = (shed_delta > 0
+                         or (qcap > 0 and queue / qcap >= 0.5)
+                         or (p99 > self.ttft_slo_s and inflight > 0))
+            grow = pressured and n < self.replicas_max and n > 0
+            to_zero = (idle and n > 0 and self.scale_to_zero_s > 0
+                       and idle_for >= self.scale_to_zero_s)
+            if idle and not to_zero:
+                self._idle_ticks += 1
+            elif not idle:
+                self._idle_ticks = 0
+            shrink = (idle and not to_zero
+                      and self._idle_ticks >= SHRINK_IDLE_TICKS
+                      and n > max(1, self.replicas_min))
+            victim = None
+            if shrink:
+                # least-loaded victim, highest index on ties (retire
+                # the newest replica first — its cache is the coldest)
+                victim = min(live, key=lambda i: (
+                    self._replicas[i].inflight, -i))
+        if to_zero:
+            self.scale_to_zero(
+                f"idle {idle_for:.1f}s >= {self.scale_to_zero_s:g}s")
+            return "scale_to_zero"
+        if grow:
+            granted = self._resize_grant(n + 1)
+            if granted <= n:
+                return None     # allocator said no; try again next tick
+            self._spawn_one()
+            with self._lock:
+                self.grows_total += 1
+                self._idle_ticks = 0
+                self._note_decision(
+                    "grow", f"shed_delta={shed_delta} queue={queue}/"
+                            f"{qcap} p99={p99:.3g}s -> {n + 1}")
+            self._publish_merged()
+            return "grow"
+        if shrink and victim is not None:
+            self._resize_grant(n - 1)
+            self._retire(victim, "shrink_drain")
+            with self._lock:
+                self.shrinks_total += 1
+                self._idle_ticks = 0
+                self._note_decision(
+                    "shrink", f"idle {SHRINK_IDLE_TICKS} ticks "
+                              f"-> {n - 1}")
+            self._publish_merged()
+            return "shrink"
+        return None
+
+    def _resize_grant(self, replicas: int) -> int:
+        """Offer a resize to the cluster allocator. Fails OPEN: with no
+        allocator (standalone PS) or an unreachable one, serving
+        elasticity must not stall, so the desired count is granted."""
+        if self.resize_cb is None:
+            return replicas
+        try:
+            return int(self.resize_cb(replicas))
+        except Exception:
+            logger.exception("fleet %s: resize_cb(%d) failed; "
+                             "failing open", self.model_id, replicas)
+            return replicas
+
+    def _note_decision(self, action: str, detail: str) -> None:
+        """(lock held) ring buffer of scale decisions for top/debug."""
+        self.decisions.append({"ts": self.clock(), "action": action,
+                               "detail": detail,
+                               "replicas": len(self._live_idxs())})
+
+    def _count_path(self, path: str) -> None:
+        """(lock held)"""
+        self.path_counts[path] = self.path_counts.get(path, 0) + 1
+
+    # ------------------------------------------------------------- telemetry
+    @property
+    def replica_count(self) -> int:
+        return len(self._replicas) - len(self._draining)
+
+    def replicas(self) -> List[ServeService]:
+        with self._lock:
+            return list(self._replicas.values())
+
+    def engines(self) -> List[Tuple[int, object]]:
+        with self._lock:
+            return [(i, svc.engine) for i, svc in self._replicas.items()]
+
+    @property
+    def hbm_bytes(self) -> int:
+        with self._lock:
+            return sum(svc.engine.slab.device_bytes
+                       for svc in self._replicas.values())
+
+    def flight_snapshot(self, reason: str) -> None:
+        """Forward the black-box dump to every replica (called on serve
+        health-rule onsets by the PS; replica flight_snapshot never
+        takes _cv, so this is callable from a replica loop thread)."""
+        with self._lock:
+            svcs = list(self._replicas.values())
+        for svc in svcs:
+            svc.flight_snapshot(reason)
+
+    def snapshot(self) -> dict:
+        """The MERGED health-pipeline sample for ``serve:<model>`` —
+        the same serve_* fields a solo service publishes (summed or
+        worst-cased across replicas, retired totals folded in so
+        counters stay monotone) plus the fleet_* routing/scaling
+        fields, including per-replica prefix hit/miss DELTAS since the
+        previous fleet snapshot (cache-health per replica: the LRU is
+        per-replica, so a routing regression shows up here first)."""
+        with self._lock:
+            return self._snapshot_locked()
+
+    def _snapshot_locked(self) -> dict:
+        idxs = list(self._replicas)
+        snaps = {i: self._replicas[i].snapshot() for i in idxs}
+        live = [i for i in idxs if i not in self._draining]
+
+        def tot(field):
+            return sum(snaps[i][field] for i in idxs)
+
+        def worst(field):
+            return max((snaps[i][field] for i in idxs), default=0.0)
+
+        hits = self._retired["prefix_hits"]
+        misses = self._retired["prefix_misses"]
+        hit_deltas, miss_deltas = {}, {}
+        for i in idxs:
+            st = self._replicas[i].engine.stats
+            h, m = int(st["prefix_hits"]), int(st["prefix_misses"])
+            hits += h
+            misses += m
+            ph, pm = self._prefix_seen.get(i, (0, 0))
+            hit_deltas[str(i)] = h - ph
+            miss_deltas[str(i)] = m - pm
+            self._prefix_seen[i] = (h, m)
+        util = [snaps[i]["serve_kv_page_utilization"] for i in idxs]
+        return {
+            "job_id": f"serve:{self.model_id}",
+            "serve_active_slots": tot("serve_active_slots"),
+            "serve_slot_cap": tot("serve_slot_cap"),
+            "serve_queue_depth": tot("serve_queue_depth"),
+            "serve_queue_cap": tot("serve_queue_cap"),
+            "serve_kv_page_utilization": round(
+                sum(util) / len(util), 4) if util else 0.0,
+            "serve_rejected_total": self._retired["rejected"]
+            + self._router_rejected_total
+            + tot("serve_rejected_total"),
+            "serve_ttft_p50": worst("serve_ttft_p50"),
+            "serve_ttft_p99": worst("serve_ttft_p99"),
+            "serve_ttft_queue_s": worst("serve_ttft_queue_s"),
+            "serve_ttft_prefill_s": worst("serve_ttft_prefill_s"),
+            "serve_ttft_interleave_s": worst("serve_ttft_interleave_s"),
+            "serve_prefill_backlog_tokens": tot(
+                "serve_prefill_backlog_tokens"),
+            "serve_prefix_hit_pct": round(
+                100.0 * hits / max(1, hits + misses), 1),
+            "serve_weight_generation": worst("serve_weight_generation"),
+            "serve_active_generations": worst(
+                "serve_active_generations"),
+            "serve_engine_restarts": self._retired["restarts"]
+            + tot("serve_engine_restarts"),
+            "serve_poisoned_total": self._retired["poisoned"]
+            + tot("serve_poisoned_total"),
+            "serve_deadline_total": self._retired["deadline"]
+            + tot("serve_deadline_total"),
+            # fleet routing / scaling surface
+            "fleet_replicas": len(live),
+            "fleet_replicas_min": self.replicas_min,
+            "fleet_replicas_max": self.replicas_max,
+            "fleet_draining": len(self._draining),
+            "fleet_cold_starts_total": self.cold_starts_total,
+            "fleet_spills_total": self.spills_total,
+            "fleet_router_retries_total": self.router_retries_total,
+            "fleet_grows_total": self.grows_total,
+            "fleet_shrinks_total": self.shrinks_total,
+            "fleet_scale_to_zero_total": self.scale_to_zero_total,
+            "fleet_replica_prefix_hits": hit_deltas,
+            "fleet_replica_prefix_misses": miss_deltas,
+        }
+
+    def _on_replica_publish(self, idx: int, snap: dict) -> None:
+        """Replica health callback: runs on replica loop threads,
+        sometimes with that replica's _cv held — which is why every
+        fleet-lock section above reads replicas lock-free only."""
+        self._publish_merged()
+
+    def _publish_merged(self) -> None:
+        merged = self.snapshot()
+        if self.metrics is not None:
+            self.metrics.set_serve_state(
+                self.model_id, merged["serve_active_slots"],
+                merged["serve_queue_depth"],
+                merged["serve_kv_page_utilization"],
+                merged["serve_prefill_backlog_tokens"])
+            self.metrics.set_serve_weight_generation(
+                self.model_id, merged["serve_weight_generation"])
+            update = getattr(self.metrics, "update_fleet", None)
+            if update is not None:
+                update(self.model_id, merged)
+        if self.health_cb is not None:
+            try:
+                self.health_cb(merged)
+            except Exception:
+                logger.exception("fleet health callback failed")

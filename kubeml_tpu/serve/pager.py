@@ -64,6 +64,18 @@ def chain_hash(prefix_digest: bytes, tokens: Sequence[int]) -> bytes:
     return h.digest()
 
 
+def routing_digest(prompt: Sequence[int], page: int) -> bytes:
+    """The fleet router's prefix-affinity key (serve/fleet.py): the
+    chain hash of the FIRST FULL prompt page — exactly the first digest
+    the prefix cache registers, so two prompts route to the same replica
+    precisely when they would share that replica's cached page. Prompts
+    shorter than one page can never register a page; they hash whole,
+    which still keeps identical short prompts together."""
+    page = max(1, int(page))
+    toks = prompt[:page] if len(prompt) >= page else prompt
+    return chain_hash(b"", list(toks))
+
+
 @dataclasses.dataclass(frozen=True)
 class PageGeometry:
     """Static shape of the paged cache — any change here recompiles, so

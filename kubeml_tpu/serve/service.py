@@ -70,6 +70,12 @@ class ServeService:
         self.metrics = metrics
         self.health_cb = health_cb
         self.clock = clock
+        # fleet mode (serve/fleet.py): the fleet aggregates replica
+        # snapshots into ONE per-model serve gauge set, so replica
+        # services must not fight over those gauges — the fleet flips
+        # this off per replica. Per-request counters/histograms keep
+        # publishing either way (they are additive across replicas).
+        self.publish_state_gauges = True
         # per-request tracing: the tracer records on THIS service's
         # clock (engine and service share it by default, so span
         # timestamps are one timebase) with trace_id=None — each
@@ -238,6 +244,41 @@ class ServeService:
                 return
             self._pending_weights = (variables, stamp)
             self._cv.notify()
+
+    # ------------------------------------------------- fleet router hooks
+    # Lock-free reads for the fleet router (serve/fleet.py). They run on
+    # HTTP threads while the FLEET's lock is held, and the only legal
+    # lock order is replica _cv -> fleet lock (the serving loop publishes
+    # health snapshots with _cv held, and the fleet aggregates inside
+    # that callback) — so, like snapshot(), these must never take _cv.
+    # Racy-but-safe: a stale read costs at most one routed request a
+    # spill/retry, never a deadlock or a wrong terminal state.
+    @property
+    def capacity(self) -> int:
+        """Admission capacity: decode slots plus the queue cap."""
+        return self.engine.slot_count + self.max_queue
+
+    @property
+    def inflight(self) -> int:
+        """Requests admitted but not yet terminal (racy read)."""
+        return self._inflight
+
+    def would_admit(self) -> bool:
+        """Whether submit() would (probably) admit right now."""
+        return (not self._stopped and not self._draining
+                and self._inflight < self.capacity)
+
+    def estimated_retry_after_s(self) -> float:
+        """The Retry-After submit() would attach to a shed right now —
+        the fleet surfaces the MINIMUM of these across replicas when
+        every routing attempt sheds."""
+        try:
+            queued = sum(max(0, len(r.prompt) - 1)
+                         for r in list(self._pending))
+        except RuntimeError:        # deque mutated mid-iteration; rare
+            queued = 0
+        backlog = self.engine.prefill_backlog_tokens() + queued
+        return 1.0 + backlog / PREFILL_DRAIN_TOKENS_PER_S
 
     def drain(self, grace_s: float) -> bool:
         """Graceful drain: flip admission to 503 (ServeDraining), then
@@ -680,13 +721,14 @@ class ServeService:
     def _publish(self) -> None:
         snap = self.snapshot()
         if self.metrics is not None:
-            self.metrics.set_serve_state(
-                self.model_id, snap["serve_active_slots"],
-                snap["serve_queue_depth"],
-                snap["serve_kv_page_utilization"],
-                snap["serve_prefill_backlog_tokens"])
-            self.metrics.set_serve_weight_generation(
-                self.model_id, snap["serve_weight_generation"])
+            if self.publish_state_gauges:
+                self.metrics.set_serve_state(
+                    self.model_id, snap["serve_active_slots"],
+                    snap["serve_queue_depth"],
+                    snap["serve_kv_page_utilization"],
+                    snap["serve_prefill_backlog_tokens"])
+                self.metrics.set_serve_weight_generation(
+                    self.model_id, snap["serve_weight_generation"])
             # engine stats are cumulative; prometheus counters take
             # deltas (the loop thread is the only publisher)
             for stat, note in (

@@ -507,7 +507,7 @@ def test_sched_invariants_lint_passes_and_self_checks(tmp_path):
     names = lint.decision_paths("kubeml_tpu/control/cluster.py")
     assert set(names) == set(DECISION_PATHS) == {
         "gang-atomicity", "no-starvation", "quota-clamp",
-        "preempt-cheapest"}
+        "preempt-cheapest", "serve-elastic"}
 
     covered = tmp_path / "test_ok.py"
     covered.write_text("def test_x(d):\n"

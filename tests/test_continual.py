@@ -721,8 +721,8 @@ def test_service_hot_swap_e2e_zero_shed():
 
 def test_ps_checkpoint_stamp_triggers_hot_swap(tmp_home, mesh8):
     """control/ps._serve_service: a changed checkpoint saved_at stamp
-    installs the new weights into the LIVE service (generation bumps,
-    same engine object) instead of rebuilding it."""
+    installs the new weights into the LIVE fleet (generation bumps,
+    same engine object in the same replica) instead of rebuilding."""
     import jax
 
     from kubeml_tpu.control.ps import ParameterServer
@@ -741,22 +741,25 @@ def test_ps_checkpoint_stamp_triggers_hot_swap(tmp_home, mesh8):
     ps = ParameterServer(mesh=mesh8, port=0)
     try:
         save_checkpoint("swapjob1", v1, dict(manifest))
-        svc1 = ps._serve_service("swapjob1")
-        assert svc1.engine.weight_generation == 1
-        # same stamp: same service, no swap
-        assert ps._serve_service("swapjob1") is svc1
-        assert svc1.engine.stats["weight_swaps"] == 0
+        fleet1 = ps._serve_service("swapjob1")
+        (_, engine), = fleet1.engines()          # default: one replica
+        assert engine.weight_generation == 1
+        # same stamp: same fleet, no swap
+        assert ps._serve_service("swapjob1") is fleet1
+        assert engine.stats["weight_swaps"] == 0
 
         time.sleep(0.01)  # saved_at stamps must differ
         save_checkpoint("swapjob1", v2, dict(manifest))
-        svc2 = ps._serve_service("swapjob1")
-        assert svc2 is svc1                      # live service reused
+        fleet2 = ps._serve_service("swapjob1")
+        assert fleet2 is fleet1                  # live fleet reused
+        (_, engine2), = fleet2.engines()
+        assert engine2 is engine                 # installed, not rebuilt
         deadline = time.time() + 30
-        while svc1.engine.stats["weight_swaps"] < 1 \
+        while engine.stats["weight_swaps"] < 1 \
                 and time.time() < deadline:
             time.sleep(0.01)
-        assert svc1.engine.stats["weight_swaps"] == 1
-        assert svc1.engine.active_generations() == [2]
+        assert engine.stats["weight_swaps"] == 1
+        assert engine.active_generations() == [2]
     finally:
         ps.stop()
 

@@ -1,0 +1,663 @@
+"""Serving-fleet tests (kubeml_tpu/serve/fleet.py + the wiring around it).
+
+The contracts pinned here:
+
+  * router identity — a stream routed through the fleet (affinity hit,
+    spill, cold start) decodes TOKEN-FOR-TOKEN identically to the same
+    request on a solo engine; every FLEET_PATH_VARIANTS entry is named
+    next to an exactness assertion (tools/check_fleet_paths.py lints
+    that this stays true)
+  * shed handling — a shed on the affine replica is retried once
+    against a peer; a surfaced shed carries the FLEET-minimum
+    Retry-After; a single-replica fleet passes the replica's shed
+    through verbatim
+  * lifecycle — shrink drains its victim through the grace path and
+    loses zero in-flight streams; scale-to-zero → cold-start → serve
+    round-trips, at the fleet level and e2e through POST /generate
+  * pool sharing — serving gangs ride the cluster allocator's Decision
+    machinery ("serve-elastic" path) via the scheduler's /serve/resize,
+    and never park
+  * telemetry — per-replica prefix hit/miss deltas in the fleet
+    snapshot, the Prometheus fleet families pass the metrics lint, and
+    `kubeml top` renders the fleet pane
+"""
+
+import json
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+pytestmark = pytest.mark.serving
+
+
+class FakeClock:
+    def __init__(self, t: float = 0.0):
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, dt: float) -> None:
+        self.t += dt
+
+
+@pytest.fixture(scope="module")
+def nano():
+    import jax
+
+    from kubeml_tpu.models import get_builtin
+    model = get_builtin("gpt-nano")()
+    module = model.module
+    variables = model.init_variables(
+        jax.random.PRNGKey(0),
+        {"x": np.ones((1, module.max_len), np.int32)})
+    return model, module, variables
+
+
+def _factory(module, variables, *, slots=2, page=4, max_queue=2):
+    """index -> UNSTARTED ServeService, the fleet's replica recipe."""
+    from kubeml_tpu.serve.engine import DecodeEngine
+    from kubeml_tpu.serve.service import ServeService
+
+    def make(index):
+        engine = DecodeEngine(module, variables, slots=slots, page=page)
+        return ServeService("fleet-m", engine, max_queue=max_queue,
+                            supervise=False)
+    return make
+
+
+def _solo_tokens(module, variables, prompt, n_new, *, page=4):
+    """Reference decode: the same request alone on a fresh engine."""
+    from kubeml_tpu.serve.engine import DecodeEngine
+    from kubeml_tpu.serve.slots import GenerateRequest
+
+    engine = DecodeEngine(module, variables, slots=2, page=page)
+    req = GenerateRequest(list(prompt), max_new_tokens=n_new)
+    engine.attach(req)
+    limit = 10_000
+    while engine.active():
+        engine.step()
+        limit -= 1
+        assert limit > 0, "solo engine failed to drain"
+    assert req.outcome == "ok"
+    return req.tokens
+
+
+def _fleet(module, variables, **kw):
+    from kubeml_tpu.serve.fleet import ServeFleet
+    kw.setdefault("autoscale_interval_s", 0.0)   # tests drive ticks
+    kw.setdefault("page_tokens", 4)
+    factory_kw = {k: kw.pop(k) for k in ("slots", "max_queue")
+                  if k in kw}
+    return ServeFleet("fleet-m", _factory(module, variables,
+                                          **factory_kw), **kw)
+
+
+# ----------------------------------------------------------- routing paths
+
+
+def test_affine_routing_is_sticky_and_bit_identical(nano):
+    """Same-prefix requests all land on the consistent-hash owner
+    ("affine_hit") and the routed streams decode exactly like a solo
+    engine's — the fleet is a router, not a different decoder."""
+    _model, module, variables = nano
+    fleet = _fleet(module, variables, replicas_min=2, replicas_max=2)
+    fleet.start()
+    try:
+        # same first page (page_tokens=4) -> same routing digest
+        specs = [([5, 6, 7, 8, 9], 5), ([5, 6, 7, 8, 10, 11], 4),
+                 ([5, 6, 7, 8, 9], 5)]
+        reqs = []
+        for prompt, n in specs:
+            r = fleet.submit(prompt, max_new_tokens=n)
+            assert r.wait(120)
+            reqs.append(r)
+        assert all(r.outcome == "ok" for r in reqs)
+        homes = {r.fleet_replica for r in reqs}
+        assert len(homes) == 1, f"affine prompts split across {homes}"
+        assert fleet.path_counts["affine_hit"] >= 3
+        for (prompt, n), r in zip(specs, reqs):
+            np.testing.assert_array_equal(
+                r.tokens, _solo_tokens(module, variables, prompt, n))
+        # session stickiness overrides the ring: pin s1 to the OTHER
+        # replica and the next submit follows the session, not the hash
+        other = next(i for i, _ in fleet.engines() if i not in homes)
+        fleet._sessions["s1"] = other
+        r = fleet.submit([5, 6, 7, 8, 9], max_new_tokens=5, session="s1")
+        assert r.wait(120) and r.outcome == "ok"
+        assert r.fleet_replica == other
+    finally:
+        fleet.stop(grace_s=0.0)
+
+
+def test_spill_routes_around_saturated_owner(nano):
+    """A saturated ring owner spills to the least-loaded admitting peer
+    ("spill") instead of shedding, and the spilled stream is still
+    bit-identical to the solo engine."""
+    from kubeml_tpu.serve.pager import routing_digest
+
+    _model, module, variables = nano
+    fleet = _fleet(module, variables, replicas_min=2, replicas_max=2,
+                   slots=1, max_queue=0)
+    fleet.start()
+    try:
+        prompt = [5, 6, 7, 8, 9]
+        owner = fleet._ring_owner(routing_digest(prompt, 4))
+        # saturate the owner (capacity 1) with a long-running stream
+        busy = fleet._replicas[owner].submit([9, 10, 11],
+                                             max_new_tokens=48)
+        r = fleet.submit(prompt, max_new_tokens=5)
+        assert r.fleet_replica != owner
+        assert fleet.path_counts["spill"] >= 1
+        assert fleet.spills_total >= 1
+        assert r.wait(120) and r.outcome == "ok"
+        np.testing.assert_array_equal(
+            r.tokens, _solo_tokens(module, variables, prompt, 5))
+        assert busy.wait(120)
+    finally:
+        fleet.stop(grace_s=0.0)
+
+
+def test_random_routing_ignores_the_prompt(nano):
+    """The bench control arm: routing="random" spreads identical
+    prompts across replicas (given enough draws) — the property the
+    affinity arm must beat on prefix-cache hit rate."""
+    _model, module, variables = nano
+    fleet = _fleet(module, variables, replicas_min=2, replicas_max=2,
+                   routing="random")
+    fleet.start()
+    try:
+        homes = set()
+        for _ in range(8):
+            r = fleet.submit([5, 6, 7, 8, 9], max_new_tokens=2)
+            assert r.wait(120) and r.outcome == "ok"
+            homes.add(r.fleet_replica)
+        assert homes == {0, 1}
+    finally:
+        fleet.stop(grace_s=0.0)
+    with pytest.raises(ValueError):
+        _fleet(module, variables, routing="round-robin")
+
+
+# ------------------------------------------------------------ shed handling
+
+
+def test_surfaced_shed_carries_fleet_minimum_retry_after(nano):
+    """Both replicas shed -> the router retried once, and the surfaced
+    Retry-After is the FLEET minimum (the lightly-backlogged replica's
+    hint), not the first replica's heavy estimate."""
+    from kubeml_tpu.serve.slots import ServeSaturated
+
+    _model, module, variables = nano
+    fleet = _fleet(module, variables, replicas_min=2, replicas_max=2,
+                   slots=1, max_queue=1)
+    fleet.start()
+    try:
+        # replica 0: two 40-token prompts -> heavy prefill backlog
+        # (shed hint ~= 1 + 78/256 s); replica 1: two 3-token prompts
+        # -> hint ~= 1.0 s. capacity is 2 each, so the fleet is full.
+        heavy = [fleet._replicas[0].submit(list(range(1, 41)),
+                                           max_new_tokens=8)
+                 for _ in range(2)]
+        light = [fleet._replicas[1].submit([5, 6, 7], max_new_tokens=32)
+                 for _ in range(2)]
+        with pytest.raises(ServeSaturated) as ei:
+            fleet.submit([5, 6, 7, 8, 9], max_new_tokens=4)
+        assert "fleet at capacity" in ei.value.message
+        assert fleet.router_retries_total == 1
+        assert 1.0 <= ei.value.retry_after_s < 1.2   # min, not ~1.3
+        assert heavy and light                       # keep refs alive
+    finally:
+        fleet.stop(grace_s=0.0)
+
+
+def test_single_replica_shed_passes_through_verbatim(nano):
+    """With one replica and no peers there is nothing router-aware to
+    say: the replica's own exception surfaces unwrapped, preserving the
+    exact Retry-After contract the solo-service tests pin."""
+    from kubeml_tpu.serve.slots import ServeSaturated
+
+    _model, module, variables = nano
+    fleet = _fleet(module, variables, replicas_min=1, replicas_max=1,
+                   slots=1, max_queue=0)
+    fleet.start()
+    try:
+        busy = fleet._replicas[0].submit([9, 10, 11], max_new_tokens=48)
+        with pytest.raises(ServeSaturated) as ei:
+            fleet.submit([5, 6, 7, 8, 9], max_new_tokens=4)
+        assert "fleet at capacity" not in (ei.value.message or "")
+        assert fleet.router_retries_total == 0
+        assert busy.wait(120)
+    finally:
+        fleet.stop(grace_s=0.0)
+
+
+# --------------------------------------------------------------- lifecycle
+
+
+def test_shrink_drains_victim_without_losing_streams(nano):
+    """Retiring a replica ("shrink_drain") goes off the ring first,
+    then through the grace drain: the in-flight stream on the victim
+    finishes normally and matches the solo engine bit-for-bit."""
+    _model, module, variables = nano
+    fleet = _fleet(module, variables, replicas_min=1, replicas_max=2,
+                   drain_grace_s=120.0)
+    fleet.start()
+    fleet._spawn_one()
+    try:
+        assert fleet.replica_count == 2
+        prompt = [5, 6, 7, 8, 9]
+        r = fleet.submit(prompt, max_new_tokens=6)
+        victim = r.fleet_replica
+        # retire the replica that is mid-stream: drain must wait it out
+        assert fleet._retire(victim, "shrink_drain") is True
+        assert r.outcome == "ok", "shrink lost an in-flight stream"
+        np.testing.assert_array_equal(
+            r.tokens, _solo_tokens(module, variables, prompt, 6))
+        assert fleet.replica_count == 1
+        assert fleet.path_counts["shrink_drain"] == 1
+    finally:
+        fleet.stop(grace_s=0.0)
+
+
+def test_autoscaler_shrinks_after_sustained_idle(nano):
+    """SHRINK_IDLE_TICKS consecutive idle ticks retire one replica
+    toward the floor; a lone completed request's compile-priced p99
+    must NOT read as pressure on an idle fleet."""
+    from kubeml_tpu.serve.fleet import SHRINK_IDLE_TICKS
+
+    _model, module, variables = nano
+    fleet = _fleet(module, variables, replicas_min=1, replicas_max=2)
+    fleet.start()
+    fleet._spawn_one()
+    try:
+        r = fleet.submit([5, 6, 7, 8], max_new_tokens=2)
+        assert r.wait(120) and r.outcome == "ok"
+        # the in-flight count decrements on the loop thread just after
+        # the request goes terminal: wait for true quiescence
+        deadline = time.time() + 30
+        while any(s.inflight for s in fleet.replicas()) \
+                and time.time() < deadline:
+            time.sleep(0.01)
+        actions = [fleet.autoscale_once()
+                   for _ in range(SHRINK_IDLE_TICKS)]
+        assert actions == [None] * (SHRINK_IDLE_TICKS - 1) + ["shrink"]
+        assert fleet.replica_count == 1
+        assert fleet.shrinks_total == 1
+        # at the floor: more idleness never shrinks below replicas_min
+        for _ in range(SHRINK_IDLE_TICKS + 1):
+            assert fleet.autoscale_once() is None
+        assert fleet.replica_count == 1
+    finally:
+        fleet.stop(grace_s=0.0)
+
+
+def test_autoscaler_grows_on_shed_pressure(nano):
+    """A shed since the last tick grows the fleet (allocator grant
+    permitting) toward replicas_max, and the grant flow records the
+    offered counts."""
+    from kubeml_tpu.serve.slots import ServeSaturated
+
+    _model, module, variables = nano
+    offers = []
+
+    def grant(n):
+        offers.append(n)
+        return n
+
+    fleet = _fleet(module, variables, replicas_min=1, replicas_max=2,
+                   slots=1, max_queue=0, resize_cb=grant)
+    fleet.start()
+    try:
+        busy = fleet._replicas[0].submit([9, 10, 11], max_new_tokens=48)
+        with pytest.raises(ServeSaturated):
+            fleet.submit([5, 6, 7, 8], max_new_tokens=2)
+        assert fleet.autoscale_once() == "grow"
+        assert fleet.replica_count == 2
+        assert fleet.grows_total == 1
+        assert offers[-1] == 2
+        assert busy.wait(120)
+    finally:
+        fleet.stop(grace_s=0.0)
+
+
+def test_autoscaler_respects_denied_grant(nano):
+    """The allocator said no: the fleet stays put and re-asks on the
+    next tick instead of exceeding its grant."""
+    from kubeml_tpu.serve.slots import ServeSaturated
+
+    _model, module, variables = nano
+    fleet = _fleet(module, variables, replicas_min=1, replicas_max=2,
+                   slots=1, max_queue=0, resize_cb=lambda n: 1)
+    fleet.start()
+    try:
+        busy = fleet._replicas[0].submit([9, 10, 11], max_new_tokens=48)
+        with pytest.raises(ServeSaturated):
+            fleet.submit([5, 6, 7, 8], max_new_tokens=2)
+        assert fleet.autoscale_once() is None
+        assert fleet.replica_count == 1
+        assert busy.wait(120)
+    finally:
+        fleet.stop(grace_s=0.0)
+
+
+def test_scale_to_zero_and_cold_start_round_trip(nano):
+    """The serverless loop at fleet level: idle past the budget drains
+    the fleet away ("scale_to_zero"), the next request cold-starts
+    replica 0 synchronously ("cold_start") and is served — with tokens
+    identical to a solo engine's — while concurrent arrivals during the
+    warm-up shed with the remaining estimate."""
+    from kubeml_tpu.serve.slots import ServeSaturated
+
+    _model, module, variables = nano
+    clock = FakeClock()
+    fleet = _fleet(module, variables, replicas_min=0, replicas_max=1,
+                   scale_to_zero_s=5.0, clock=clock)
+    fleet.start()
+    try:
+        assert fleet.replica_count == 0       # min=0 starts EMPTY
+        prompt = [5, 6, 7, 8, 9]
+        r = fleet.submit(prompt, max_new_tokens=5)
+        assert fleet.path_counts["cold_start"] == 1
+        assert r.wait(120) and r.outcome == "ok"
+        np.testing.assert_array_equal(
+            r.tokens, _solo_tokens(module, variables, prompt, 5))
+
+        deadline = time.time() + 30           # loop-thread bookkeeping
+        while any(s.inflight for s in fleet.replicas()) \
+                and time.time() < deadline:
+            time.sleep(0.01)
+        clock.advance(10.0)                   # idle past the budget
+        assert fleet.autoscale_once() == "scale_to_zero"
+        assert fleet.replica_count == 0
+        assert fleet.path_counts["scale_to_zero"] == 1
+
+        # a request that lands WHILE a cold start is mid-build sheds
+        # with the remaining warm estimate instead of dogpiling
+        fleet._warming = True
+        fleet._warm_started = clock()
+        with pytest.raises(ServeSaturated) as ei:
+            fleet.submit(prompt, max_new_tokens=2)
+        assert ei.value.retry_after_s > 0
+        fleet._warming = False
+
+        r2 = fleet.submit(prompt, max_new_tokens=5)
+        assert fleet.cold_starts_total == 2
+        assert r2.wait(120) and r2.outcome == "ok"
+        np.testing.assert_array_equal(r2.tokens, r.tokens)
+    finally:
+        fleet.stop(grace_s=0.0)
+
+
+def test_generate_scale_to_zero_cold_start_round_trip_e2e(tmp_home):
+    """E2e through POST /generate: a fleet with replicas_min=0 scales
+    itself to zero after the idle budget, and the next HTTP request
+    cold-starts and returns the same tokens as before."""
+    import jax
+
+    from kubeml_tpu.control.ps import ParameterServer
+    from kubeml_tpu.models import get_builtin
+    from kubeml_tpu.train.checkpoint import save_checkpoint
+
+    model = get_builtin("gpt-nano")()
+    variables = model.init_variables(
+        jax.random.PRNGKey(0),
+        {"x": np.ones((1, model.module.max_len), np.int32)})
+    save_checkpoint("fleetnano", variables,
+                    {"model": "gpt-nano", "function": "gpt-nano",
+                     "parallelism": 1, "epoch": 0})
+    ps = ParameterServer(serve_slots=2, serve_queue_depth=1,
+                         serve_replicas_min=0, serve_replicas_max=1,
+                         serve_scale_to_zero_s=0.2)
+    ps.start()
+    try:
+        body = {"model_id": "fleetnano", "prompt": [5, 6, 7, 8],
+                "max_new_tokens": 4, "stream": False}
+
+        def generate():
+            req = urllib.request.Request(
+                f"{ps.url}/generate", data=json.dumps(body).encode(),
+                method="POST",
+                headers={"Content-Type": "application/json"})
+            return json.loads(urllib.request.urlopen(
+                req, timeout=120).read())["tokens"]
+
+        first = generate()                    # cold start #1 (min=0)
+        with ps._serve_lock:
+            fleet = ps._serve["fleetnano"][1]
+        assert fleet.path_counts["cold_start"] >= 1
+        deadline = time.time() + 60
+        while fleet.replica_count > 0 and time.time() < deadline:
+            time.sleep(0.05)
+        assert fleet.replica_count == 0, "fleet never scaled to zero"
+        assert fleet.scale_to_zero_total >= 1
+        second = generate()                   # cold start #2
+        np.testing.assert_array_equal(second, first)
+        assert fleet.cold_starts_total >= 2
+    finally:
+        ps.stop()
+
+
+def test_fleet_drain_flips_every_replica(nano):
+    """Fleet drain = PR-12 drain on every replica at once; afterwards
+    admission sheds as stopped."""
+    from kubeml_tpu.serve.slots import ServeSaturated
+
+    _model, module, variables = nano
+    fleet = _fleet(module, variables, replicas_min=2, replicas_max=2)
+    fleet.start()
+    try:
+        assert fleet.drain(5.0) is True
+        with pytest.raises(ServeSaturated):
+            fleet.submit([5, 6, 7, 8], max_new_tokens=2)
+    finally:
+        fleet.stop(grace_s=0.0)
+
+
+# --------------------------------------------------------------- telemetry
+
+
+def test_fleet_snapshot_per_replica_prefix_deltas(nano):
+    """The fleet snapshot exposes per-replica prefix hit/miss DELTAS
+    since the previous snapshot: a repeat of a routed prefix shows up
+    as a hit on the affine replica and zeros elsewhere."""
+    _model, module, variables = nano
+    fleet = _fleet(module, variables, replicas_min=2, replicas_max=2)
+    fleet.start()
+    try:
+        # silence the background replica publishes so only OUR snapshot
+        # calls consume the deltas (deterministic cursors)
+        for svc in fleet.replicas():
+            svc.health_cb = None
+        prompt = [5, 6, 7, 8, 9]
+        r1 = fleet.submit(prompt, max_new_tokens=2)
+        assert r1.wait(120) and r1.outcome == "ok"
+        fleet.snapshot()                      # absorb the first round
+        r2 = fleet.submit(prompt, max_new_tokens=2)
+        assert r2.wait(120) and r2.outcome == "ok"
+        assert r2.fleet_replica == r1.fleet_replica
+        snap = fleet.snapshot()
+        home, other = str(r1.fleet_replica), str(
+            1 - r1.fleet_replica)
+        assert snap["fleet_replica_prefix_hits"][home] >= 1
+        assert snap["fleet_replica_prefix_hits"][other] == 0
+        assert snap["fleet_replica_prefix_misses"][other] == 0
+        assert snap["job_id"] == "serve:fleet-m"
+        assert snap["fleet_replicas"] == 2
+        assert snap["serve_slot_cap"] == 4    # summed across replicas
+    finally:
+        fleet.stop(grace_s=0.0)
+
+
+def test_fleet_metrics_families_pass_lint():
+    """update_fleet mirrors a merged snapshot into the fleet families
+    (per-replica series via the `replica` LABEL, counters by delta) and
+    the exposition passes the metrics lint."""
+    from kubeml_tpu.metrics.prom import MetricsRegistry
+    from tools.check_metrics import validate_exposition
+
+    reg = MetricsRegistry()
+    snap = {"fleet_replicas": 3, "fleet_spills_total": 2,
+            "fleet_router_retries_total": 1, "fleet_cold_starts_total": 1,
+            "fleet_grows_total": 2, "fleet_shrinks_total": 1,
+            "fleet_scale_to_zero_total": 0,
+            "fleet_replica_prefix_hits": {"0": 4, "1": 0},
+            "fleet_replica_prefix_misses": {"0": 1, "1": 2}}
+    reg.update_fleet("m1", snap)
+    # a republish with unchanged cumulative counters and drained deltas
+    # (what a real steady-state snapshot looks like) adds nothing
+    reg.update_fleet("m1", dict(snap, fleet_replica_prefix_hits={},
+                                fleet_replica_prefix_misses={}))
+    text = reg.exposition()
+    assert 'kubeml_serve_fleet_replicas{model="m1"} 3' in text
+    assert 'kubeml_serve_fleet_spills_total{model="m1"} 2' in text
+    assert ('kubeml_serve_fleet_scale_events_total'
+            '{model="m1",action="grow"} 2') in text
+    assert ('kubeml_serve_fleet_replica_prefix_hits_total'
+            '{model="m1",replica="0"} 4') in text
+    assert validate_exposition(text) == []
+    reg.clear_serve("m1")
+    assert 'model="m1"' not in reg.exposition()
+
+
+def test_top_renders_fleet_pane():
+    from kubeml_tpu.cli.main import _render_top
+
+    doc = {"id": "serve:m1", "state": "healthy", "reasons": [],
+           "latest": {"serve_active_slots": 2, "serve_slot_cap": 8,
+                      "serve_queue_depth": 0, "serve_queue_cap": 16,
+                      "serve_kv_page_utilization": 0.25,
+                      "serve_rejected_total": 0,
+                      "serve_ttft_p50": 0.010, "serve_ttft_p99": 0.020,
+                      "fleet_replicas": 2, "fleet_replicas_min": 1,
+                      "fleet_replicas_max": 4, "fleet_draining": 0,
+                      "fleet_spills_total": 3,
+                      "fleet_router_retries_total": 1,
+                      "fleet_cold_starts_total": 2,
+                      "fleet_grows_total": 5, "fleet_shrinks_total": 4,
+                      "fleet_scale_to_zero_total": 1}}
+    out = _render_top(doc)
+    assert "fleet: replicas 2 [1..4]" in out
+    assert "spills 3" in out
+    assert "cold starts 2" in out
+    assert "grow/shrink/zero 5/4/1" in out
+    # a solo-service snapshot (no fleet_replicas) has no fleet line
+    del doc["latest"]["fleet_replicas"]
+    assert "fleet:" not in _render_top(doc)
+
+
+# ----------------------------------------------------- pool sharing (cluster)
+
+
+def test_cluster_serving_gang_kind_and_serve_elastic_path():
+    """Serving replicas are the allocator's second gang kind: they
+    place/resize through the same Decision machinery, resizes are
+    tagged with the "serve-elastic" path, and the snapshot breaks out
+    serving jobs/lanes."""
+    from kubeml_tpu.control.cluster import (DECISION_PATHS,
+                                            ClusterAllocator)
+
+    assert "serve-elastic" in DECISION_PATHS
+    alloc = ClusterAllocator(4, clock=FakeClock())
+    (d,) = alloc.submit("serve:m1", lanes=1, kind="serving")
+    assert d.action == "place" and d.lanes == 1
+    ds = alloc.resize("serve:m1", 2)
+    assert ds[0].action == "resize" and ds[0].lanes == 2
+    assert ds[0].path == "serve-elastic"
+    snap = alloc.snapshot()
+    assert snap["cluster_serving_jobs"] == 1
+    assert snap["cluster_serving_lanes"] == 2
+    assert alloc.running_lanes("serve:m1") == 2
+    # training resizes keep their own paths
+    alloc.submit("train0001", lanes=1)
+    tds = alloc.resize("train0001", 2)
+    assert tds[0].path != "serve-elastic"
+    assert alloc.running_lanes("nope") is None
+
+
+def test_scheduler_serve_resize_grows_shrinks_and_never_parks():
+    """/serve/resize: grow places a serving gang, shrink-to-zero frees
+    its lanes, a full pool answers granted=0 WITHOUT parking (the
+    fleet's next tick re-asks), and a scheduler without an allocator
+    fails open."""
+    from kubeml_tpu.control.cluster import ClusterAllocator
+    from kubeml_tpu.control.httpd import Request
+    from kubeml_tpu.control.scheduler import Scheduler
+
+    def resize(body):
+        return Request(path="/serve/resize", params={}, query={},
+                       body=body, raw=b"")
+
+    alloc = ClusterAllocator(4, clock=FakeClock())
+    sched = Scheduler(ps_url=None, allocator=alloc)  # handlers inline
+    out = sched._h_serve_resize(resize({"model_id": "m1", "replicas": 2}))
+    assert out == {"granted": 2}
+    assert alloc.running_lanes("serve:m1") == 2
+    # grow past the pool clamps to what fits
+    out = sched._h_serve_resize(resize({"model_id": "m1", "replicas": 8}))
+    assert out == {"granted": 4}
+    # scale to zero frees every lane
+    out = sched._h_serve_resize(resize({"model_id": "m1", "replicas": 0}))
+    assert out == {"granted": 0}
+    assert alloc.running_lanes("serve:m1") is None
+    # pool full of training work: the serving gang is granted 0 and
+    # does NOT hold a queue slot against later arrivals
+    alloc.submit("train0001", lanes=4)
+    out = sched._h_serve_resize(resize({"model_id": "m1", "replicas": 1}))
+    assert out == {"granted": 0}
+    assert alloc.running_lanes("serve:m1") is None
+    assert alloc.snapshot()["cluster_queue_depth"] == 0
+    # no allocator: fail open so elasticity never stalls
+    bare = Scheduler(ps_url=None)
+    assert bare._h_serve_resize(
+        resize({"model_id": "m1", "replicas": 3})) == {"granted": 3}
+
+
+# ----------------------------------------------------------------- the lint
+
+
+def test_check_fleet_paths_lint_passes_on_repo():
+    """The lint itself, run over the real tree: every registered fleet
+    path variant is covered by this file's tests."""
+    import os
+
+    from kubeml_tpu.serve.fleet import FLEET_PATH_VARIANTS
+    from tools.check_fleet_paths import main, path_variants
+
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    fleet_path = os.path.join(root, "kubeml_tpu", "serve", "fleet.py")
+    assert tuple(path_variants(fleet_path)) == FLEET_PATH_VARIANTS
+    assert main(["check_fleet_paths.py", root]) == 0
+
+
+def test_check_fleet_paths_lint_selftest(tmp_path):
+    """The lint catches an uncovered variant, ignores comment-only
+    mentions, and fails loudly when the registry is missing."""
+    from tools.check_fleet_paths import main, uncovered_variants
+
+    fleet_dir = tmp_path / "kubeml_tpu" / "serve"
+    fleet_dir.mkdir(parents=True)
+    tests_dir = tmp_path / "tests"
+    tests_dir.mkdir()
+    fleet = fleet_dir / "fleet.py"
+    fleet.write_text(
+        'FLEET_PATH_VARIANTS = (\n    "covered_path",\n'
+        '    "naked_path",\n)\n')
+    (tests_dir / "test_ok.py").write_text(
+        'import numpy as np\n'
+        'def test_covered():\n'
+        '    # naked_path mentioned in a comment only: does not count\n'
+        '    variant = "covered_path"\n'
+        '    np.testing.assert_array_equal([1], [1])\n')
+    assert uncovered_variants(str(fleet), str(tests_dir)) == ["naked_path"]
+    assert main(["lint", str(tmp_path)]) == 1
+    (tests_dir / "test_fix.py").write_text(
+        'import numpy as np\n'
+        'def test_naked():\n'
+        '    assert "naked_path"\n'
+        '    np.testing.assert_array_equal([2], [2])\n')
+    assert main(["lint", str(tmp_path)]) == 0
+    fleet.write_text("FLEET_PATH_VARIANTS = ()\n")
+    assert main(["lint", str(tmp_path)]) == 1

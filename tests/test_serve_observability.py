@@ -421,8 +421,11 @@ def test_flight_endpoint_and_breakdown_exposition(serve_ps):
     assert doc["id"] == "serve:obsnano" and doc["model"] == "obsnano"
     assert doc["capacity"] > 0
     assert doc["total_steps"] >= 1 and doc["records"]
+    # the fleet router stamps each record with the replica it came from
+    assert doc["replicas"] == [0]
     for rec in doc["records"]:
-        assert set(rec) == set(FLIGHT_FIELDS)
+        assert set(rec) == set(FLIGHT_FIELDS) | {"replica"}
+        assert rec["replica"] == 0
     # bare model id resolves too
     assert _get_json(f"{ps.url}/flight?id=obsnano")["id"] == \
         "serve:obsnano"

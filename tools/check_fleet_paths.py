@@ -1,0 +1,121 @@
+#!/usr/bin/env python3
+"""Lint: every fleet routing/lifecycle path must have an identity test.
+
+The serving fleet (kubeml_tpu/serve/fleet.py) routes one logical
+/generate contract over several physical paths: the consistent-hash
+affinity hit, the spill to a least-loaded peer, the cold start from
+zero, the drain of a shrink victim, and scale-to-zero itself. Each
+promises the caller the SAME stream a solo engine would produce — a
+path without a test making that claim is an unverified router branch.
+So this lint walks the FLEET_PATH_VARIANTS tuple in fleet.py and fails
+unless each name appears (quoted, in executable code) in some tests/
+file that also carries an exactness assertion (assert_array_equal /
+assert_allclose).
+
+Run directly (exit 1 on violation) or via tests/test_fleet.py, which
+keeps the lint itself in the tier-1 suite:
+
+    python tools/check_fleet_paths.py [repo_root]
+"""
+
+from __future__ import annotations
+
+import io
+import os
+import re
+import sys
+import tokenize
+
+# an assertion that makes an identity claim: exactness (bit-identity)
+# or closeness (bounded divergence)
+PARITY_TOKENS = (
+    "assert_array_equal",
+    "assert_allclose",
+)
+
+_VARIANTS_RE = re.compile(
+    r"FLEET_PATH_VARIANTS\s*=\s*\(([^)]*)\)", re.DOTALL)
+_NAME_RE = re.compile(r"['\"]([A-Za-z0-9_]+)['\"]")
+
+
+def path_variants(fleet_path: str) -> list:
+    """Variant names declared in fleet.py's FLEET_PATH_VARIANTS."""
+    with open(fleet_path, encoding="utf-8") as f:
+        m = _VARIANTS_RE.search(f.read())
+    if m is None:
+        return []
+    return _NAME_RE.findall(m.group(1))
+
+
+def _code_lines(path: str):
+    """Yield (lineno, source) for non-comment code lines. STRING tokens
+    are KEPT (variant names appear as string literals in tests);
+    comments are dropped so a mention in prose doesn't count."""
+    with open(path, "rb") as f:
+        src = f.read()
+    lines = {}
+    try:
+        for tok in tokenize.tokenize(io.BytesIO(src).readline):
+            if tok.type in (tokenize.COMMENT, tokenize.ENCODING):
+                continue
+            lines.setdefault(tok.start[0], []).append(tok.string)
+    except tokenize.TokenError:
+        # fall back to raw lines; better a false positive than a skip
+        for i, line in enumerate(src.decode("utf-8", "replace").split("\n")):
+            lines.setdefault(i + 1, []).append(line)
+    for no in sorted(lines):
+        yield no, "".join(lines[no])
+
+
+def file_covers(path: str, name: str) -> bool:
+    """True when `path` names the variant (quoted, in code) AND makes a
+    parity assertion somewhere in its code."""
+    quoted = (f'"{name}"', f"'{name}'")
+    named = has_parity = False
+    for _no, code in _code_lines(path):
+        if not named and any(q in code for q in quoted):
+            named = True
+        if not has_parity and any(t in code for t in PARITY_TOKENS):
+            has_parity = True
+        if named and has_parity:
+            return True
+    return False
+
+
+def uncovered_variants(fleet_path: str, tests_dir: str) -> list:
+    names = path_variants(fleet_path)
+    test_files = []
+    for dirpath, _dirs, files in os.walk(tests_dir):
+        for fname in sorted(files):
+            if fname.startswith("test_") and fname.endswith(".py"):
+                test_files.append(os.path.join(dirpath, fname))
+    return [n for n in names
+            if not any(file_covers(p, n) for p in test_files)]
+
+
+def main(argv) -> int:
+    root = argv[1] if len(argv) > 1 else os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__)))
+    fleet_path = os.path.join(root, "kubeml_tpu", "serve", "fleet.py")
+    tests_dir = os.path.join(root, "tests")
+    names = path_variants(fleet_path)
+    if not names:
+        print(f"{fleet_path}: no FLEET_PATH_VARIANTS found — lint is "
+              "miswired", file=sys.stderr)
+        return 1
+    missing = uncovered_variants(fleet_path, tests_dir)
+    for n in missing:
+        print(f"fleet path variant {n!r} has no identity test: no "
+              f"tests/ file both names it and asserts exactness "
+              f"({' / '.join(PARITY_TOKENS)})", file=sys.stderr)
+    if missing:
+        print(f"\n{len(missing)} unverified fleet path"
+              f"{'' if len(missing) == 1 else 's'}: every variant in "
+              "kubeml_tpu/serve/fleet.py FLEET_PATH_VARIANTS needs a "
+              "quoted-name identity test", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv))
