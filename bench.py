@@ -416,6 +416,7 @@ def main():
     serving_prefill = _measure_prefill_arm()
     serving_faulted = _measure_serving_faulted_arm()
     serving_fleet = _measure_serving_fleet_arm()
+    serving_fleet_faulted = _measure_serving_fleet_faulted_arm()
     cluster = _measure_cluster_arm()
     continual = _measure_continual_arm()
 
@@ -570,6 +571,19 @@ def main():
         # routing's (the cache is per-replica — affinity is what makes
         # it work); reports fleet tail TTFT against the single engine.
         "serving_fleet": serving_fleet,
+        # fleet failure-domain arm (PR 14): a deterministic
+        # fleet_replica_crash kills 1 of 4 replicas under ~1k
+        # closed-loop streams. The fleet supervisor ejects the dead
+        # replica from the hash ring, live-migrates its in-flight
+        # streams via the re-prefill path (prompt + emitted tokens
+        # replayed, (seed, pos) sampling keys -> bit-identical
+        # continuation), spawns a probationary replacement, and
+        # graduates it back through half-open probes. Self-asserts:
+        # zero streams lost, migrated streams token-identical to a
+        # solo unfaulted engine, survivor compile pin intact, and
+        # exactly one ejection + one probe-rejoin in the
+        # kubeml_serve_fleet_* counters.
+        "serving_fleet_faulted": serving_fleet_faulted,
         # cluster-allocator arm (control/cluster.py): a deterministic
         # fake-clock saturation replay — three wide priority-0 batch
         # gangs fill the pool, four narrow priority-1 prod jobs burst
@@ -1296,6 +1310,212 @@ def _measure_serving_fleet_arm() -> dict:
         "affinity_hit_rate_beats_random": True,
         "fleet_ttft_p99_vs_single_s": [affine["ttft_p99_s"],
                                        solo["ttft_p99_s"]],
+    }
+
+
+def _measure_serving_fleet_faulted_arm() -> dict:
+    """Fleet failure-domain arm (serve/fleet.py + faults.py): a
+    4-replica fleet under ~1k closed-loop streams takes a deterministic
+    ``fleet_replica_crash`` on replica 0 mid-load. The supervisor must
+    eject the dead replica, live-migrate its in-flight streams onto
+    survivors via the re-prefill path, spawn a probationary
+    replacement, and graduate it back onto the ring through half-open
+    probes — all while the load keeps flowing.
+
+    Self-asserted invariants (the PR's acceptance bar):
+      * zero streams lost — every admitted stream finishes "ok"
+      * bit-identity — each MIGRATED stream's token sequence equals a
+        solo unfaulted engine's for the same prompt (re-prefill replays
+        prompt + emitted tokens; (seed, pos) sampling keys make the
+        continuation exact)
+      * surviving replicas' program inventory stays pinned at two
+        compiles (one prefill + one decode) — failover is routing and
+        KV work, never a recompile
+      * exactly one ejection and one probe-rejoin cycle land in the
+        ``kubeml_serve_fleet_*`` counters
+
+    KUBEML_BENCH_FLEET_FAULT_STREAMS scales the stream budget down for
+    quick runs (default 1000)."""
+    import os
+    import threading
+
+    import jax
+    import numpy as np
+
+    from kubeml_tpu.metrics.prom import MetricsRegistry
+    from kubeml_tpu.models import get_builtin
+    from kubeml_tpu.serve.engine import DecodeEngine
+    from kubeml_tpu.serve.fleet import ServeFleet
+    from kubeml_tpu.serve.service import ServeService
+    from kubeml_tpu.serve.slots import GenerateRequest, ServeSaturated
+
+    PROMPT_LEN, NEW_TOKENS, PAGE = 32, 8, 16
+    PREFIX_GROUPS = 8
+    REPLICAS, SLOTS, QUEUE = 4, 8, 8
+    CONCURRENCY = REPLICAS * SLOTS
+    PROBE_REQUESTS = 2
+    STREAMS = int(os.environ.get(
+        "KUBEML_BENCH_FLEET_FAULT_STREAMS", "1000"))
+
+    model = get_builtin("gpt-nano")()
+    module = model.module
+    variables = model.init_variables(
+        jax.random.PRNGKey(0),
+        {"x": np.ones((1, module.max_len), np.int32)})
+    vocab = module.vocab_size - 1
+
+    def prompt(i):
+        g = i % PREFIX_GROUPS
+        head = [(g * 13 + j) % vocab + 1 for j in range(PAGE)]
+        tail = [(i * 7 + j) % vocab + 1
+                for j in range(PROMPT_LEN - PAGE)]
+        return head + tail
+
+    def drain(req):
+        for _ in req.events_iter(timeout=300.0):
+            pass
+        return req
+
+    def factory(index):
+        eng = DecodeEngine(module, variables, slots=SLOTS, page=PAGE)
+        return ServeService("bench-fleet", eng, max_queue=QUEUE,
+                            supervise=False)
+
+    fleet = ServeFleet(
+        "bench-fleet", factory,
+        replicas_min=REPLICAS, replicas_max=REPLICAS,
+        autoscale_interval_s=0.0, page_tokens=PAGE,
+        probe_requests=PROBE_REQUESTS,
+        fault_plan=[{"kind": "fleet_replica_crash", "replica": 0}])
+    fleet.start()
+    victim = fleet.replicas()[0]
+    for svc in fleet.replicas():
+        drain(svc.submit(prompt(0), max_new_tokens=NEW_TOKENS))
+    before = {i: dict(eng.stats) for i, eng in fleet.engines()}
+
+    done = []
+    lock = threading.Lock()
+    budget = [STREAMS]
+    stop_evt = threading.Event()
+
+    def supervisor():
+        # hold fire until the victim is mid-decode so the crash lands
+        # on live in-flight streams, then tick steadily: the first
+        # tick delivers the kill AND detects/ejects/migrates; later
+        # ticks reap half-open probes until the replacement rejoins
+        while not stop_evt.is_set() and victim.engine.active() < 2:
+            time.sleep(0.002)
+        while not stop_evt.is_set():
+            fleet.supervise_once()
+            time.sleep(0.02)
+
+    def client(cid):
+        while True:
+            with lock:
+                if budget[0] <= 0:
+                    return
+                budget[0] -= 1
+                i = budget[0]
+            try:
+                req = fleet.submit(prompt(i),
+                                   max_new_tokens=NEW_TOKENS)
+            except ServeSaturated as e:
+                with lock:
+                    budget[0] += 1      # give the stream back
+                time.sleep(min(1.0, e.retry_after_s))
+                continue
+            drain(req)
+            with lock:
+                done.append(req)
+
+    sup = threading.Thread(target=supervisor)
+    sup.start()
+    t0 = time.perf_counter()
+    threads = [threading.Thread(target=client, args=(c,))
+               for c in range(CONCURRENCY)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    elapsed = time.perf_counter() - t0
+
+    # safety net: if the load drained before the replacement earned
+    # its probes, feed it single streams until the rejoin lands
+    for extra in range(200):
+        if fleet.path_counts.get("probe_rejoin", 0) >= 1:
+            break
+        try:
+            done.append(drain(fleet.submit(
+                prompt(STREAMS + extra), max_new_tokens=NEW_TOKENS)))
+        except ServeSaturated as e:
+            time.sleep(min(1.0, e.retry_after_s))
+        fleet.supervise_once()
+    stop_evt.set()
+    sup.join()
+
+    snap = fleet.snapshot()
+    # zero streams lost: every admitted stream finished "ok"
+    bad = [(r.outcome, r.error) for r in done if r.outcome != "ok"]
+    assert not bad, bad[:5]
+    migrated = [r for r in done if r.migrations > 0]
+    assert migrated, "crash fired but no stream was live-migrated"
+
+    # bit-identity of every migrated stream vs a solo unfaulted engine
+    ref_eng = DecodeEngine(module, variables, slots=SLOTS, page=PAGE)
+
+    def solo_tokens(p):
+        q = GenerateRequest(list(p), max_new_tokens=NEW_TOKENS)
+        ref_eng.attach(q)
+        while ref_eng.active():
+            ref_eng.step()
+        assert q.outcome == "ok", (q.outcome, q.error)
+        return q.tokens
+
+    for r in migrated:
+        np.testing.assert_array_equal(
+            np.asarray(r.tokens), np.asarray(solo_tokens(r.prompt)))
+
+    # survivors' program inventory stays pinned at two compiles; the
+    # probationary replacement gets at most its own cold two
+    for i, eng in fleet.engines():
+        if i in before:
+            assert eng.stats["compiles"] == 1, (i, eng.stats["compiles"])
+            assert eng.stats["prefill_compiles"] == 1, \
+                (i, eng.stats["prefill_compiles"])
+        else:
+            assert eng.stats["compiles"] <= 1, (i, eng.stats["compiles"])
+
+    # exactly one ejection + one probe-rejoin cycle, counter-visible
+    assert snap["fleet_ejections_total"] == 1, snap
+    assert snap["fleet_failovers_total"] == 1, snap
+    assert snap["fleet_migrated_streams_total"] >= len(migrated), snap
+    assert snap["fleet_probes_total"] >= PROBE_REQUESTS, snap
+    assert fleet.path_counts.get("probe_rejoin", 0) == 1, \
+        fleet.path_counts
+    reg = MetricsRegistry()
+    reg.update_fleet("bench-fleet", snap)
+    assert reg.serve_fleet_ejections_total.value("bench-fleet") == 1.0
+    assert reg.serve_fleet_probes_total.value("bench-fleet") \
+        >= PROBE_REQUESTS
+    toks = sum(len(r.tokens) for r in done)
+    fleet.stop(grace_s=0.0)
+
+    return {
+        "model": "gpt-nano",
+        "replicas": REPLICAS, "slots": SLOTS, "queue": QUEUE,
+        "prompt_tokens": PROMPT_LEN, "new_tokens": NEW_TOKENS,
+        "page_tokens": PAGE, "streams": len(done),
+        "concurrency": CONCURRENCY,
+        "goodput_tok_s": round(toks / elapsed, 1),
+        "streams_lost": 0,
+        "streams_migrated": len(migrated),
+        "migrated_bit_identical": True,
+        "survivor_compiles_pinned": True,
+        "ejections": int(snap["fleet_ejections_total"]),
+        "failovers": int(snap["fleet_failovers_total"]),
+        "probes": int(snap["fleet_probes_total"]),
+        "probe_rejoins": int(fleet.path_counts["probe_rejoin"]),
+        "hedges": int(snap["fleet_hedges_total"]),
     }
 
 

@@ -410,6 +410,18 @@ def _render_top(doc: dict) -> str:
                 f"{latest.get('fleet_grows_total', 0):g}/"
                 f"{latest.get('fleet_shrinks_total', 0):g}/"
                 f"{latest.get('fleet_scale_to_zero_total', 0):g}")
+        if latest.get("fleet_ejections_total") is not None:
+            # fleet fault pane: supervisor ejections / stream failover
+            # activity plus the circuit-breaker state (replicas in
+            # probation earning their vnodes back via probes)
+            lines.append(
+                f"fleet faults: ejections "
+                f"{latest.get('fleet_ejections_total', 0):g}  failovers "
+                f"{latest.get('fleet_failovers_total', 0):g}  migrated "
+                f"{latest.get('fleet_migrated_streams_total', 0):g}  "
+                f"probes {latest.get('fleet_probes_total', 0):g}  hedges "
+                f"{latest.get('fleet_hedges_total', 0):g}  probation "
+                f"{latest.get('fleet_probation', 0):g}")
     if latest.get("data_lag_generations") is not None \
             and float(latest.get("data_lag_generations", -1)) >= 0:
         # continual pane: dataset freshness — the generation the job last
@@ -558,6 +570,10 @@ def cmd_serve(args):
                                serve_replicas_min=args.serve_replicas_min,
                                serve_replicas_max=args.serve_replicas_max,
                                serve_scale_to_zero_s=args.serve_scale_to_zero_s,
+                               serve_replica_restart_budget=(
+                                   args.serve_replica_restart_budget),
+                               serve_probe_requests=args.serve_probe_requests,
+                               serve_hedge_after_s=args.serve_hedge_after_s,
                                cluster_lanes=args.cluster_lanes,
                                cluster_tenants=args.cluster_tenant,
                                cluster_aging_s=args.cluster_aging_s)
@@ -592,7 +608,11 @@ def cmd_serve(args):
                               serve_drain_grace_s=args.serve_drain_grace_s,
                               serve_replicas_min=args.serve_replicas_min,
                               serve_replicas_max=args.serve_replicas_max,
-                              serve_scale_to_zero_s=args.serve_scale_to_zero_s)
+                              serve_scale_to_zero_s=args.serve_scale_to_zero_s,
+                              serve_replica_restart_budget=(
+                                  args.serve_replica_restart_budget),
+                              serve_probe_requests=args.serve_probe_requests,
+                              serve_hedge_after_s=args.serve_hedge_after_s)
     else:  # storage
         from kubeml_tpu.control.storage import StorageService
         svc = StorageService(port=args.port or const.STORAGE_PORT)
@@ -937,6 +957,25 @@ def build_parser() -> argparse.ArgumentParser:
                         "synchronously (peers get 429 + warm-up "
                         "Retry-After meanwhile); 0 disables "
                         "(KUBEML_SERVE_SCALE_TO_ZERO_S, default 0)")
+    s.add_argument("--serve-replica-restart-budget", type=int,
+                   default=None, metavar="N",
+                   help="watchdog restarts one replica may burn before "
+                        "the fleet supervisor calls it crash-looping "
+                        "and ejects it, live-migrating its streams "
+                        "(KUBEML_SERVE_REPLICA_RESTART_BUDGET, default 2)")
+    s.add_argument("--serve-probe-requests", type=int, default=None,
+                   metavar="N",
+                   help="half-open probe requests a probation replica "
+                        "must serve to 'ok' before its vnodes rejoin "
+                        "the routing ring after an ejection "
+                        "(KUBEML_SERVE_PROBE_REQUESTS, default 2)")
+    s.add_argument("--serve-hedge-after-s", type=float, default=None,
+                   metavar="S",
+                   help="hedged retry for gray failures: a stream still "
+                        "queued (no slot) after S seconds on one "
+                        "replica is re-issued on the least-loaded peer; "
+                        "0 disables (KUBEML_SERVE_HEDGE_AFTER_S, "
+                        "default 0)")
     s.add_argument("--cluster-lanes", type=int, default=None, metavar="N",
                    help="turn on the cluster allocator over N shared "
                         "worker lanes: gang placement, priority "

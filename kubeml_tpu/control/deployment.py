@@ -77,6 +77,9 @@ def start_deployment(mesh=None, controller_port: int = 0,
                      serve_replicas_min: Optional[int] = None,
                      serve_replicas_max: Optional[int] = None,
                      serve_scale_to_zero_s: Optional[float] = None,
+                     serve_replica_restart_budget: Optional[int] = None,
+                     serve_probe_requests: Optional[int] = None,
+                     serve_hedge_after_s: Optional[float] = None,
                      cluster_lanes: Optional[int] = None,
                      cluster_tenants=None,
                      cluster_aging_s: Optional[float] = None) -> Deployment:
@@ -112,7 +115,11 @@ def start_deployment(mesh=None, controller_port: int = 0,
                          serve_drain_grace_s=serve_drain_grace_s,
                          serve_replicas_min=serve_replicas_min,
                          serve_replicas_max=serve_replicas_max,
-                         serve_scale_to_zero_s=serve_scale_to_zero_s)
+                         serve_scale_to_zero_s=serve_scale_to_zero_s,
+                         serve_replica_restart_budget=(
+                             serve_replica_restart_budget),
+                         serve_probe_requests=serve_probe_requests,
+                         serve_hedge_after_s=serve_hedge_after_s)
     ps.start()
 
     scheduler = Scheduler(ps_url=ps.url, port=scheduler_port,

@@ -233,6 +233,33 @@ def _make_serve_crash_loop(restart_limit: int = 2):
     return check
 
 
+def _make_fleet_degraded():
+    """Serving fleet: warn when the supervisor ejected a replica within
+    the sample window — the fleet is serving, but degraded: a pool
+    member died or crash-looped, its streams were live-migrated, and a
+    probation replica is earning its vnodes back. Delta across the
+    window like serve_crash_loop, so old ejections age out; solo-serve
+    samples carry no fleet_* fields and never fire this."""
+    def check(window: List[dict]) -> Optional[str]:
+        m = _latest(window)
+        latest = m.get("fleet_ejections_total")
+        if latest is None:
+            return None
+        first = next((s.get("fleet_ejections_total") for s in window
+                      if s.get("fleet_ejections_total") is not None),
+                     None)
+        delta = float(latest) - float(first if first is not None else 0)
+        if delta >= 1:
+            migrated = m.get("fleet_migrated_streams_total", 0)
+            probation = m.get("fleet_probation", 0)
+            return (f"{delta:g} replica(s) ejected within the sample "
+                    f"window ({float(migrated):g} stream(s) "
+                    f"live-migrated, {float(probation):g} replica(s) in "
+                    f"probation) — fleet is degraded")
+        return None
+    return check
+
+
 def _make_serve_ttft_slo(slo_s: float):
     def check(window: List[dict]) -> Optional[str]:
         m = _latest(window)
@@ -277,6 +304,9 @@ def default_rules(grad_abs: float = 1e4, grad_rel: float = 50.0,
         HealthRule("serve_crash_loop", "critical",
                    "serving engine restarted repeatedly in the window",
                    _make_serve_crash_loop()),
+        HealthRule("fleet_degraded", "warning",
+                   "fleet supervisor ejected a replica in the window",
+                   _make_fleet_degraded()),
         HealthRule("queue_starvation", "warning",
                    "a cluster-parked job has waited past the limit",
                    _make_queue_starvation(queue_starvation_s)),
@@ -321,6 +351,13 @@ _SAMPLE_FIELDS = ("train_loss", "validation_loss", "accuracy",
                   "fleet_shrinks_total", "fleet_scale_to_zero_total",
                   "fleet_replica_prefix_hits",
                   "fleet_replica_prefix_misses",
+                  # fleet failure domains (PR 14): ejections feed the
+                  # fleet_degraded rule, the rest the top fleet-faults
+                  # line
+                  "fleet_probation", "fleet_ejections_total",
+                  "fleet_failovers_total",
+                  "fleet_migrated_streams_total",
+                  "fleet_probes_total", "fleet_hedges_total",
                   # continual-plane freshness (train/job.py sliding
                   # window); lag -1 = not a continual job
                   "dataset_generation", "data_lag_generations",

@@ -285,7 +285,10 @@ class ParameterServer(JsonService):
                  serve_drain_grace_s: Optional[float] = None,
                  serve_replicas_min: Optional[int] = None,
                  serve_replicas_max: Optional[int] = None,
-                 serve_scale_to_zero_s: Optional[float] = None):
+                 serve_scale_to_zero_s: Optional[float] = None,
+                 serve_replica_restart_budget: Optional[int] = None,
+                 serve_probe_requests: Optional[int] = None,
+                 serve_hedge_after_s: Optional[float] = None):
         super().__init__(port=port)
         # Lazy mesh: in standalone mode the PARENT must not initialize the
         # accelerator backend (on TPU, libtpu is single-process-exclusive —
@@ -366,6 +369,20 @@ class ParameterServer(JsonService):
         self.serve_scale_to_zero_s = float(
             serve_scale_to_zero_s if serve_scale_to_zero_s is not None
             else os.environ.get("KUBEML_SERVE_SCALE_TO_ZERO_S", "0"))
+        # fleet failure-domain knobs (serve/fleet.py supervise_once):
+        # crash-loop restart budget per replica, half-open probes to
+        # rejoin after ejection, hedge age for gray failures (0 = off)
+        self.serve_replica_restart_budget = int(
+            serve_replica_restart_budget
+            if serve_replica_restart_budget is not None
+            else os.environ.get(
+                "KUBEML_SERVE_REPLICA_RESTART_BUDGET", "2"))
+        self.serve_probe_requests = int(
+            serve_probe_requests if serve_probe_requests is not None
+            else os.environ.get("KUBEML_SERVE_PROBE_REQUESTS", "2"))
+        self.serve_hedge_after_s = float(
+            serve_hedge_after_s if serve_hedge_after_s is not None
+            else os.environ.get("KUBEML_SERVE_HEDGE_AFTER_S", "0"))
         self._serve: Dict[str, tuple] = {}   # model_id -> (stamp, fleet)
         self._serve_lock = threading.Lock()
         self._infer_batcher = InferBatcher() if InferBatcher.enabled() \
@@ -927,7 +944,10 @@ class ParameterServer(JsonService):
             page_tokens=self.serve_page_tokens,
             metrics=self.metrics,
             health_cb=self._observe_health,
-            resize_cb=self._serve_resize_cb(model_id)).start()
+            resize_cb=self._serve_resize_cb(model_id),
+            replica_restart_budget=self.serve_replica_restart_budget,
+            probe_requests=self.serve_probe_requests,
+            hedge_after_s=self.serve_hedge_after_s).start()
         old = None
         with self._serve_lock:
             cur = self._serve.get(model_id)

@@ -95,6 +95,35 @@ blast radius is one slot. Serve event kinds:
            until the supervisor abandons the engine — drives the
            watchdog's wedge detection + recovery path without killing
            the process. Fires once per event.
+
+The FLEET has a third plan class (FleetFaultPlan) whose coordinate
+system is (supervise tick, replica index) — the unit of blast radius at
+fleet level is one whole replica, and the fleet supervisor
+(serve/fleet.py supervise_once) is the deterministic injection point.
+Fleet event kinds:
+
+  fleet_replica_crash
+           abrupt, unrecoverable replica death: the target replica's
+           serving loop exits WITHOUT its drain tail (ServeService.kill)
+           and its own watchdog stands down, leaving in-flight streams
+           stranded in the abandoned engine — exactly the state the
+           fleet supervisor must detect, eject, and live-migrate.
+           Fires once per event.
+  fleet_replica_wedge
+           crash-looping replica: drives the target replica's real
+           supervisor recovery (ServeService.force_restart — each one a
+           genuine engine rebuild + stream requeue) until restarts_total
+           exceeds the fleet's replica_restart_budget, so the
+           restart-budget ejection channel fires deterministically
+           instead of waiting out wall-clock watchdog timeouts. Fires
+           once per event.
+  fleet_replica_slow
+           gray failure: injects a wildcard serve_slow_step of
+           duration_s into the target replica's engine plan, turning it
+           into a persistent straggler — the hedged-retry path
+           (hedge_after_s) then re-issues its over-age queued streams on
+           a healthy peer. Fires once per event (the slow-step event it
+           plants fires every step).
 """
 
 from __future__ import annotations
@@ -119,6 +148,12 @@ KINDS = ("nan", "dropout", "crash", "corrupt_checkpoint", "slow",
 # check_serve_spans.py does for span kinds
 SERVE_KINDS = ("serve_nan_logits", "serve_step_crash", "serve_slow_step",
                "serve_loop_wedge")
+
+# fleet-level fault kinds (FleetFaultPlan below); the same quoted-name
+# coverage rule applies — tools/check_fault_tests.py parses this tuple
+# and fails unless every kind is asserted by name under tests/
+FLEET_KINDS = ("fleet_replica_crash", "fleet_replica_wedge",
+               "fleet_replica_slow")
 
 # distinctive enough that a watchdog test can assert the death was the
 # injected crash, not an import error or OOM kill
@@ -456,3 +491,82 @@ class ServeFaultPlan:
                 time.sleep(0.005)
             return True
         return False
+
+
+@dataclasses.dataclass
+class FleetFaultEvent:
+    """One fleet-plane injection at (supervise tick, replica); -1 =
+    wildcard (first tick the target is live / lowest live replica)."""
+
+    kind: str
+    tick: int = -1
+    replica: int = -1
+    duration_s: float = 0.0   # fleet_replica_slow only
+
+    def at_tick(self, tick: int) -> bool:
+        return self.tick < 0 or self.tick == tick
+
+
+class FleetFaultPlan:
+    """Coordinate-driven fault schedule for the serving FLEET (module
+    docstring for kind semantics). The fleet supervisor tick
+    (serve/fleet.py supervise_once) is the injection point: a public,
+    deterministic method tests and the bench drive directly, so every
+    ejection / migration / hedge path replays without wall-clock
+    randomness. Every event fires once."""
+
+    def __init__(self, events: List[FleetFaultEvent]):
+        self.events = events
+        self.injected = {k: 0 for k in FLEET_KINDS}
+        self._fired: set = set()          # event index -> fired (once-only)
+
+    @classmethod
+    def parse(cls, spec: Any) -> "FleetFaultPlan":
+        """Parse a JSON string / dict / list of fleet event dicts."""
+        if isinstance(spec, FleetFaultPlan):
+            return spec
+        if isinstance(spec, str):
+            spec = json.loads(spec)
+        if isinstance(spec, dict):
+            spec = spec.get("events", [])
+        if not isinstance(spec, list):
+            raise ValueError("fleet fault_plan must be a list of events "
+                             "or {'events': [...]}")
+        events = []
+        for e in spec:
+            kind = e.get("kind")
+            if kind not in FLEET_KINDS:
+                raise ValueError(f"unknown fleet fault kind {kind!r}; "
+                                 f"expected one of {FLEET_KINDS}")
+            events.append(FleetFaultEvent(
+                kind=kind,
+                tick=int(e.get("tick", -1)),
+                replica=int(e.get("replica", -1)),
+                duration_s=float(e.get("duration_s", 0.0)),
+            ))
+        return cls(events)
+
+    def has(self, kind: str) -> bool:
+        return any(ev.kind == kind for ev in self.events)
+
+    def fire(self, tick: int, live_idxs) -> List[tuple]:
+        """Events due at this supervise tick, as (kind, replica, event)
+        with the replica wildcard resolved to the lowest live index.
+        Once per event: an event whose target is not live yet stays
+        armed for a later tick (wildcard-tick events fire at the first
+        tick that has a live target)."""
+        live = sorted(live_idxs)
+        out = []
+        for i, ev in enumerate(self.events):
+            if i in self._fired or not ev.at_tick(tick):
+                continue
+            target = ev.replica if ev.replica >= 0 else \
+                (live[0] if live else -1)
+            if target < 0 or target not in live:
+                continue
+            self._fired.add(i)
+            self.injected[ev.kind] += 1
+            logger.warning("fleet fault %s: tick %d replica %d",
+                           ev.kind, tick, target)
+            out.append((ev.kind, target, ev))
+        return out

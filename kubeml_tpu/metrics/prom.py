@@ -598,6 +598,27 @@ class MetricsRegistry:
             "kubeml_serve_fleet_replica_prefix_misses_total",
             "Prefix-cache misses per decode replica",
             ("model", "replica"))
+        # fleet failure domains (serve/fleet.py supervise_once):
+        # ejections (replica removed from the ring), failovers
+        # (ejections that moved >= 1 in-flight stream), migrated
+        # streams (failover + hedge moves), half-open probes, hedges
+        self.serve_fleet_ejections_total = Counter(
+            "kubeml_serve_fleet_ejections_total",
+            "Replicas ejected from the ring (dead or crash-looping)",
+            "model")
+        self.serve_fleet_failovers_total = Counter(
+            "kubeml_serve_fleet_failovers_total",
+            "Ejections that live-migrated at least one stream", "model")
+        self.serve_fleet_migrated_streams_total = Counter(
+            "kubeml_serve_fleet_migrated_streams_total",
+            "In-flight streams resumed on another replica", "model")
+        self.serve_fleet_probes_total = Counter(
+            "kubeml_serve_fleet_probes_total",
+            "Half-open probe requests routed to probation replicas",
+            "model")
+        self.serve_fleet_hedges_total = Counter(
+            "kubeml_serve_fleet_hedges_total",
+            "Queued streams re-issued off a straggler replica", "model")
         # cluster allocator (control/cluster.py), fed by the scheduler's
         # snapshot pushes (POST /cluster): pool occupancy, queue depth
         # by priority, per-tenant lanes vs quota/weighted share, and
@@ -693,6 +714,11 @@ class MetricsRegistry:
                                 self.serve_fleet_scale_events_total,
                                 self.serve_fleet_replica_prefix_hits_total,
                                 self.serve_fleet_replica_prefix_misses_total,
+                                self.serve_fleet_ejections_total,
+                                self.serve_fleet_failovers_total,
+                                self.serve_fleet_migrated_streams_total,
+                                self.serve_fleet_probes_total,
+                                self.serve_fleet_hedges_total,
                                 self.infer_cache_hits_total,
                                 self.infer_cache_misses_total]
         self._cluster_gauges = [self.cluster_pool_lanes,
@@ -883,7 +909,15 @@ class MetricsRegistry:
                 ("fleet_router_retries_total",
                  self.serve_fleet_router_retries_total),
                 ("fleet_cold_starts_total",
-                 self.serve_fleet_cold_starts_total)):
+                 self.serve_fleet_cold_starts_total),
+                ("fleet_ejections_total",
+                 self.serve_fleet_ejections_total),
+                ("fleet_failovers_total",
+                 self.serve_fleet_failovers_total),
+                ("fleet_migrated_streams_total",
+                 self.serve_fleet_migrated_streams_total),
+                ("fleet_probes_total", self.serve_fleet_probes_total),
+                ("fleet_hedges_total", self.serve_fleet_hedges_total)):
             cum = float(snap.get(field, 0))
             seen = self._fleet_seen.get((model, field), 0.0)
             if cum > seen:
@@ -931,7 +965,12 @@ class MetricsRegistry:
                   self.serve_fleet_cold_starts_total,
                   self.serve_fleet_scale_events_total,
                   self.serve_fleet_replica_prefix_hits_total,
-                  self.serve_fleet_replica_prefix_misses_total):
+                  self.serve_fleet_replica_prefix_misses_total,
+                  self.serve_fleet_ejections_total,
+                  self.serve_fleet_failovers_total,
+                  self.serve_fleet_migrated_streams_total,
+                  self.serve_fleet_probes_total,
+                  self.serve_fleet_hedges_total):
             c.clear_prefix(model)
         self.trace_dropped_total.clear_prefix(f"serve:{model}")
         self._trace_seen.pop(f"serve:{model}", None)

@@ -475,6 +475,27 @@ class DecodeEngine:
         # is archaeology
         self.check_pager()
 
+    def evacuate(self, s: int) -> Optional[GenerateRequest]:
+        """Forced-teardown detach: free slot ``s``'s page references and
+        clear the slot WITHOUT finishing the request — the fleet's live
+        migration path (service.py eject_streams) hands the still-open
+        request to a surviving replica, whose attach() re-prefills
+        prompt + emitted tokens for a bit-identical continuation. The
+        pager audit runs like any release: a refcount that does not
+        balance on forced teardown is a real leak, attributable here
+        rather than archaeology at the next restart."""
+        slot = self._slots[s]
+        if slot is None:
+            return None
+        held = [int(p) for p in self._tables[s] if p]
+        if held:
+            self.pager.free(held)
+        self._tables[s] = 0
+        self._slots[s] = None
+        self._maybe_retire(slot.gen)
+        self.check_pager()
+        return slot.req
+
     def check_pager(self) -> None:
         """Run the allocator's invariant audit (pager.check_invariants).
         Violations raise in strict mode; in production they count into

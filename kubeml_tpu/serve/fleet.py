@@ -32,6 +32,26 @@ they can share one lock and one view of the replica set:
   cluster.py "serve-elastic" decisions) so training and serving share
   one device pool.
 
+* **Failure domains** — a supervision tick (``supervise_once``, same
+  public-and-deterministic shape as ``autoscale_once``) detects a dead
+  replica (loop thread gone, killed by an injected
+  ``fleet_replica_crash``) or a crash-looping one (watchdog restarts
+  past ``replica_restart_budget``) and EJECTS it: off the ring
+  immediately, sticky sessions purged, every in-flight stream harvested
+  (``ServeService.eject_streams`` — KV pages freed under the pager
+  audit, requests left open) and live-migrated to survivors through the
+  PR-12 resume path, so continuation is bit-identical (prompt + emitted
+  tokens re-prefilled, per-(seed, pos) sampling keys, emitted-prefix
+  suppression) and each move is charged against a per-stream
+  ``MIGRATION_BUDGET`` so a replica-killing request cannot ping-pong
+  around the ring forever. The replacement replica enters PROBATION — a
+  half-open circuit: live but off the ring, earning its vnodes back by
+  serving ``probe_requests`` real requests to "ok" — and gray failures
+  (``fleet_replica_slow``) are routed around by hedged retry: a stream
+  queued past ``hedge_after_s`` is withdrawn from the straggler and
+  re-issued on the least-loaded peer (determinism makes the re-issue
+  THE stream — no duplicate race to the client).
+
 Lock discipline (load-bearing): replica loop threads call back into
 the fleet (``_on_replica_publish``) while holding their own ``_cv``, so
 the only legal lock order is **replica _cv → fleet lock**. Inside the
@@ -52,6 +72,8 @@ import threading
 import time
 from typing import Callable, Dict, List, Optional, Tuple
 
+from kubeml_tpu.faults import (FleetFaultPlan, ServeFaultEvent,
+                               ServeFaultPlan)
 from kubeml_tpu.serve.pager import routing_digest
 from kubeml_tpu.serve.service import ServeService
 from kubeml_tpu.serve.slots import (GenerateRequest, ServeDraining,
@@ -70,6 +92,10 @@ FLEET_PATH_VARIANTS = (
     "cold_start",     # fleet was at zero; first request built replica 0
     "shrink_drain",   # autoscaler retired an idle replica via drain
     "scale_to_zero",  # idle budget expired; the whole fleet drained away
+    "eject",          # supervisor removed a dead/crash-looping replica
+    "failover_migrate",  # in-flight stream resumed on a survivor
+    "probe_rejoin",   # probation passed; vnodes rejoined the ring
+    "hedge",          # queued stream re-issued off a straggler replica
 )
 
 # ring points per replica: enough that removing one replica moves only
@@ -86,6 +112,13 @@ COLD_START_WARM_ESTIMATE_S = 8.0
 
 # sticky session -> replica LRU capacity
 SESSION_CACHE = 4096
+
+# Per-stream migration budget: total times one stream may be moved to
+# another replica (ejection failover or hedge) before the fleet fails
+# it with an attributable error. A request whose decode kills every
+# replica it lands on would otherwise tour the ring forever, taking a
+# fresh replica down on each hop.
+MIGRATION_BUDGET = 2
 
 
 def _ring_point(idx: int, vnode: int) -> int:
@@ -114,6 +147,10 @@ class ServeFleet:
                  resize_cb: Optional[Callable[[int], int]] = None,
                  autoscale_interval_s: float = 1.0,
                  ttft_slo_s: float = 2.0,
+                 replica_restart_budget: int = 2,
+                 probe_requests: int = 2,
+                 hedge_after_s: float = 0.0,
+                 fault_plan=None,
                  clock=time.perf_counter):
         if routing not in ("affine", "random"):
             raise ValueError(f"routing must be 'affine' or 'random', "
@@ -132,11 +169,26 @@ class ServeFleet:
         self.resize_cb = resize_cb
         self.autoscale_interval_s = float(autoscale_interval_s)
         self.ttft_slo_s = float(ttft_slo_s)
+        # failure-domain knobs: restarts past the budget = crash loop
+        # (eject); probe_requests successful half-open probes graduate a
+        # probationer back onto the ring; hedge_after_s > 0 arms hedged
+        # retry for streams queued that long on one replica
+        self.replica_restart_budget = max(0, int(replica_restart_budget))
+        self.probe_requests = max(1, int(probe_requests))
+        self.hedge_after_s = float(hedge_after_s)
+        self.fault_plan = None if fault_plan is None \
+            else FleetFaultPlan.parse(fault_plan)
 
         self._lock = threading.Lock()
         self._replicas: "collections.OrderedDict[int, ServeService]" = \
             collections.OrderedDict()
         self._draining: set = set()      # idxs mid-retire (off the ring)
+        # circuit half-open: idx -> {"ok": probes succeeded, "probes":
+        # in-flight probe requests}. Probationers are live processes but
+        # OFF the ring; _pick hands them real traffic up to the probe
+        # quota, and supervise_once graduates or re-arms them.
+        self._probation: Dict[int, dict] = {}
+        self._supervise_ticks = 0
         self._next_idx = 0
         self._ring: List[Tuple[int, int]] = []   # sorted (point, idx)
         self._sessions: "collections.OrderedDict[str, int]" = \
@@ -154,8 +206,11 @@ class ServeFleet:
         # stay monotone across shrink / scale-to-zero
         self._retired: Dict[str, int] = collections.defaultdict(int)
         # per-replica prefix hit/miss cursors for the delta fields the
-        # fleet snapshot exposes (satellite: per-replica cache health)
-        self._prefix_seen: Dict[int, Tuple[int, int]] = {}
+        # fleet snapshot exposes (satellite: per-replica cache health).
+        # Keyed by replica EPOCH (restarts_total) as well: a recovered
+        # engine's cumulative counters restart at zero, so deltas must
+        # re-baseline per epoch or go negative / double-count.
+        self._prefix_seen: Dict[int, Tuple[int, int, int]] = {}
         self._rejected_seen = 0          # autoscaler shed-delta cursor
         self._router_rejected_total = 0  # sheds surfaced BY the router
         # the testable surface: how many times each FLEET_PATH_VARIANTS
@@ -168,6 +223,11 @@ class ServeFleet:
         self.grows_total = 0
         self.shrinks_total = 0
         self.scale_to_zero_total = 0
+        self.ejections_total = 0
+        self.failovers_total = 0         # ejections that moved >= 1 stream
+        self.migrated_streams_total = 0  # streams moved (failover + hedge)
+        self.probes_total = 0            # half-open probe requests routed
+        self.hedges_total = 0
         self.decisions: "collections.deque" = collections.deque(maxlen=64)
         self._stop_event = threading.Event()
         self._autoscale_thread = threading.Thread(
@@ -187,10 +247,13 @@ class ServeFleet:
             self._autoscale_thread.start()
         return self
 
-    def _spawn_one(self, path: Optional[str] = None) -> int:
+    def _spawn_one(self, path: Optional[str] = None,
+                   probation: bool = False) -> int:
         """Build + start one replica (caller must NOT hold the lock:
         the factory loads checkpoints and compiles nothing yet, but it
-        is slow and must never serialize the router)."""
+        is slow and must never serialize the router). With
+        ``probation=True`` the replica comes up in the half-open state:
+        live but OFF the ring until its probe requests succeed."""
         with self._lock:
             idx = self._next_idx
             self._next_idx += 1
@@ -203,11 +266,14 @@ class ServeFleet:
         svc.start()
         with self._lock:
             self._replicas[idx] = svc
+            if probation:
+                self._probation[idx] = {"ok": 0, "probes": []}
             self._rebuild_ring()
             if path is not None:
                 self._count_path(path)
-        logger.info("fleet %s: replica %d up (%d live)", self.model_id,
-                    idx, self.replica_count)
+        logger.info("fleet %s: replica %d up (%d live%s)", self.model_id,
+                    idx, self.replica_count,
+                    ", probation" if probation else "")
         return idx
 
     def _retire(self, idx: int, path: str) -> bool:
@@ -227,6 +293,8 @@ class ServeFleet:
             self._fold_retired(svc, idx)
             self._replicas.pop(idx, None)
             self._draining.discard(idx)
+            self._probation.pop(idx, None)
+            self._purge_sessions(idx)
             self._count_path(path)
         logger.info("fleet %s: replica %d retired (%s, drained=%s, "
                     "%d live)", self.model_id, idx, path, drained,
@@ -264,6 +332,7 @@ class ServeFleet:
             self._stopped = True
             svcs = list(self._replicas.values())
             self._replicas.clear()
+            self._probation.clear()
             self._ring = []
         for svc in svcs:
             svc.stop(timeout=timeout, grace_s=grace_s)
@@ -289,15 +358,25 @@ class ServeFleet:
 
     # -------------------------------------------------------------- routing
     def _live_idxs(self) -> List[int]:
-        """Replicas new work may route to (lock held)."""
-        return [i for i in self._replicas if i not in self._draining]
+        """Replicas new work may route to (lock held). Probationers are
+        excluded — they only receive half-open probe traffic."""
+        return [i for i in self._replicas
+                if i not in self._draining and i not in self._probation]
 
     def _rebuild_ring(self) -> None:
         """(lock held) VNODES sha256 points per live replica."""
         self._ring = sorted(
             (_ring_point(i, v), i)
-            for i in self._replicas if i not in self._draining
+            for i in self._replicas
+            if i not in self._draining and i not in self._probation
             for v in range(VNODES))
+
+    def _purge_sessions(self, idx: int) -> None:
+        """(lock held) drop sticky entries pinned to a departed replica
+        so the next request with that session re-resolves through the
+        ring instead of 500ing on a dead index."""
+        for key in [k for k, v in self._sessions.items() if v == idx]:
+            del self._sessions[key]
 
     def _ring_owner(self, digest: bytes) -> Optional[int]:
         """(lock held) first ring point at/after the key, wrapping."""
@@ -323,8 +402,24 @@ class ServeFleet:
     def _pick(self, digest: bytes, session: Optional[str],
               attempted: set) -> Tuple[Optional[int], Optional[str]]:
         """(lock held) choose the next replica to try and the path name
-        that a SUCCESSFUL admission there should count."""
+        that a SUCCESSFUL admission there should count. The sentinel
+        path "probe" is not a FLEET_PATH_VARIANTS entry — submit()
+        tracks it in the probation ledger instead of path_counts (the
+        countable event is the later "probe_rejoin")."""
         live = self._live_idxs()
+        if not attempted:
+            # half-open circuit: a probationer with remaining probe
+            # quota takes real traffic BEFORE the ring — serving probes
+            # to "ok" is the only way it earns its vnodes back. Retries
+            # after a shed skip probation (a shed probe must not burn
+            # the client's one retry on the same suspect replica).
+            for i, st in self._probation.items():
+                if i not in self._replicas:
+                    continue
+                if st["ok"] + len(st["probes"]) >= self.probe_requests:
+                    continue
+                if self._replicas[i].would_admit():
+                    return i, "probe"
         cands = [i for i in live if i not in attempted]
         if not cands:
             return None, None
@@ -365,6 +460,17 @@ class ServeFleet:
                 raise ServeSaturated(message="serving fleet stopped")
             if self._live_idxs():
                 return
+            if self._probation:
+                # all routable replicas are ejected; half-open probes
+                # are the only admission path until one graduates.
+                # Fail FAST when no probationer can take this request —
+                # the retry-once loop has nothing to retry against.
+                for i, st in self._probation.items():
+                    if (st["ok"] + len(st["probes"]) < self.probe_requests
+                            and i in self._replicas
+                            and self._replicas[i].would_admit()):
+                        return      # _pick routes it as a probe
+                raise self._all_ejected_error()
             if self._warming:
                 remaining = max(
                     0.5, self._warm_started + COLD_START_WARM_ESTIMATE_S
@@ -433,7 +539,12 @@ class ServeFleet:
                 continue
             req.fleet_replica = idx     # cancel() routes on this
             with self._lock:
-                if path is not None:
+                if path == "probe":
+                    st = self._probation.get(idx)
+                    if st is not None:
+                        self.probes_total += 1
+                        st["probes"].append(req)
+                elif path is not None:
                     self._count_path(path)
                     if path == "spill":
                         self.spills_total += 1
@@ -454,6 +565,10 @@ class ServeFleet:
             self._router_rejected_total += 1
             others = [self._replicas[i].estimated_retry_after_s()
                       for i in self._live_idxs() if i not in attempted]
+            if not others and not sheds and self._probation:
+                # an ejection raced this submit past _ensure_capacity:
+                # same fail-fast 503 as the front door
+                raise self._all_ejected_error()
         if len(sheds) == 1 and not others:
             raise sheds[0]          # single replica: verbatim pass-through
         candidates = [e.retry_after_s for e in sheds] + others
@@ -487,10 +602,282 @@ class ServeFleet:
         for svc in svcs:
             svc.install_weights(variables, stamp)
 
+    # ------------------------------------------------------ failure domains
+    def _all_ejected_error(self) -> ServeDraining:
+        """(lock held) the fail-fast 503 for an empty ring with every
+        surviving replica stuck in probation: Retry-After is the best
+        probationer's own estimate (it is warm — its probes just have
+        to land), falling back to the cold-start bound."""
+        retries = [self._replicas[i].estimated_retry_after_s()
+                   for i in self._probation if i in self._replicas]
+        return ServeDraining(
+            retry_after_s=max(1.0, min(retries,
+                                       default=COLD_START_WARM_ESTIMATE_S)),
+            message=f"all replicas ejected: {len(self._probation)} "
+                    f"replica(s) in probation must pass half-open "
+                    f"probes before the ring repopulates")
+
+    def supervise_once(self, now: Optional[float] = None) -> List[str]:
+        """One fleet supervision tick: (1) fire any due fleet fault
+        injections, (2) detect failed replicas — killed / loop thread
+        gone (``ServeService.failed``) or watchdog restarts past
+        ``replica_restart_budget`` (the crash-loop signal the
+        serve_crash_loop health rule keys on) — and eject them with
+        live stream migration, (3) graduate probationers whose probe
+        requests all finished ok back onto the ring, (4) hedge over-age
+        queued streams off stragglers. Public and deterministic, same
+        contract as ``autoscale_once``: the background thread calls it
+        each tick, tests and the bench drive it directly. Returns the
+        list of actions taken (path-variant names)."""
+        now = self.clock() if now is None else now
+        actions: List[str] = []
+        with self._lock:
+            if self._stopped:
+                return actions
+            self._supervise_ticks += 1
+            tick = self._supervise_ticks
+            live = self._live_idxs() + list(self._probation)
+        # fault delivery runs OUTSIDE the fleet lock: kill and
+        # force_restart take the victim replica's _cv
+        if self.fault_plan is not None:
+            for kind, idx, ev in self.fault_plan.fire(tick, live):
+                with self._lock:
+                    svc = self._replicas.get(idx)
+                if svc is None:
+                    continue
+                if kind == "fleet_replica_crash":
+                    svc.kill("injected fleet_replica_crash")
+                elif kind == "fleet_replica_wedge":
+                    # drive REAL recoveries until the budget is blown:
+                    # the ejection below sees exactly the state a
+                    # genuine crash loop leaves behind
+                    for _ in range(self.replica_restart_budget + 1):
+                        svc.force_restart("injected fleet_replica_wedge")
+                elif kind == "fleet_replica_slow":
+                    self._slow_replica(svc, ev.duration_s)
+        with self._lock:
+            candidates = [(i, self._replicas[i]) for i in self._replicas
+                          if i not in self._draining]
+        for idx, svc in candidates:
+            if svc.failed:
+                actions += self._eject(idx, "replica dead: loop thread "
+                                            "gone or killed")
+            elif svc.restarts_total > self.replica_restart_budget:
+                actions += self._eject(
+                    idx, f"crash-looping: {svc.restarts_total} watchdog "
+                         f"restart(s) exceed the budget of "
+                         f"{self.replica_restart_budget}")
+        actions += self._advance_probation()
+        if self.hedge_after_s > 0:
+            actions += self._hedge_stragglers(now)
+        return actions
+
+    def _slow_replica(self, svc: ServeService, duration_s: float) -> None:
+        """Deliver fleet_replica_slow: plant a WILDCARD serve_slow_step
+        into the replica's engine fault plan — every subsequent step
+        sleeps, turning the replica into a persistent straggler whose
+        queued streams age past hedge_after_s and get hedged away."""
+        ev = ServeFaultEvent(kind="serve_slow_step",
+                             duration_s=float(duration_s))
+        plan = getattr(svc.engine, "fault_plan", None)
+        if plan is None:
+            svc.engine.fault_plan = ServeFaultPlan([ev])
+        else:
+            plan.events.append(ev)
+
+    def _eject(self, idx: int, reason: str) -> List[str]:
+        """Eject one replica (circuit OPEN): off the ring immediately,
+        sticky sessions purged, in-flight streams harvested and
+        live-migrated to survivors, the dead service stopped, and —
+        when the fleet would drop below its floor — a replacement
+        spawned into PROBATION (it earns its vnodes back through
+        probes; it does not get them for showing up)."""
+        actions: List[str] = []
+        with self._lock:
+            svc = self._replicas.pop(idx, None)
+            if svc is None:
+                return actions
+            self._draining.discard(idx)
+            self._probation.pop(idx, None)
+            self._purge_sessions(idx)
+            self._rebuild_ring()
+            self.ejections_total += 1
+            self._count_path("eject")
+            self._note_decision("eject", f"replica {idx}: {reason}")
+            need_replacement = (
+                len(self._live_idxs()) + len(self._probation)
+                < max(1, self.replicas_min))
+        logger.error("fleet %s: replica %d ejected (%s)", self.model_id,
+                     idx, reason)
+        actions.append("eject")
+        # harvest OUTSIDE the fleet lock (eject_streams takes the
+        # replica's _cv); the pager audit runs inside the evacuation
+        streams = svc.eject_streams()
+        with self._lock:
+            self._fold_retired(svc, idx)
+        svc.stop(grace_s=0.0)
+        if streams:
+            with self._lock:
+                self.failovers_total += 1
+            moved = self._migrate(streams)
+            actions.append("failover_migrate")
+            logger.warning("fleet %s: %d/%d stream(s) live-migrated off "
+                           "replica %d", self.model_id, moved,
+                           len(streams), idx)
+        if need_replacement and not self._stopped:
+            self._spawn_one(probation=True)
+        self._publish_merged()
+        return actions
+
+    def _migrate(self, streams: List[GenerateRequest]) -> int:
+        """Resume harvested streams on survivors. Routing goes through
+        _pick like a fresh submit — the digest is a pure function of
+        the prompt, so migration preserves prefix affinity on the
+        SHRUNK ring — but unlike submit it tries every survivor before
+        giving up (losing a stream is worse than a cold route). Each
+        move is charged one migration; past MIGRATION_BUDGET the stream
+        fails with an attributable error instead of ping-ponging."""
+        moved = 0
+        for req in streams:
+            req.migrations += 1
+            if req.migrations > MIGRATION_BUDGET:
+                req.finish(
+                    "error",
+                    f"migration budget exhausted: stream moved "
+                    f"{req.migrations - 1} time(s) across replica "
+                    f"failures and will not be resumed again")
+                continue
+            digest = routing_digest(list(req.prompt), self.page_tokens)
+            attempted: set = set()
+            placed = False
+            while True:
+                with self._lock:
+                    idx, path = self._pick(digest, None, attempted)
+                    svc = self._replicas.get(idx) \
+                        if idx is not None else None
+                if svc is None:
+                    break
+                try:
+                    svc.adopt(req)
+                except (ServeSaturated, ServeDraining):
+                    attempted.add(idx)
+                    continue
+                placed = True
+                req.fleet_replica = idx
+                with self._lock:
+                    self.migrated_streams_total += 1
+                    self._count_path("failover_migrate")
+                    if path == "probe":
+                        st = self._probation.get(idx)
+                        if st is not None:
+                            self.probes_total += 1
+                            st["probes"].append(req)
+                moved += 1
+                break
+            if not placed:
+                req.finish("error",
+                           "replica ejected and no surviving replica "
+                           "admitted the migrated stream")
+        return moved
+
+    def _advance_probation(self) -> List[str]:
+        """Reap probe outcomes and graduate passing probationers back
+        onto the ring. A probe that errored re-arms the gate (successes
+        reset to zero — the circuit stays half-open); a cancelled probe
+        neither counts nor resets (the client walked away, that says
+        nothing about the replica)."""
+        actions: List[str] = []
+        rejoined: List[int] = []
+        with self._lock:
+            for idx in list(self._probation):
+                st = self._probation[idx]
+                if idx not in self._replicas:
+                    del self._probation[idx]
+                    continue
+                still = []
+                for req in st["probes"]:
+                    if req.outcome is None:
+                        still.append(req)
+                    elif req.outcome == "ok":
+                        st["ok"] += 1
+                    elif req.outcome != "cancelled":
+                        st["ok"] = 0
+                st["probes"] = still
+                if st["ok"] >= self.probe_requests:
+                    del self._probation[idx]
+                    self._rebuild_ring()
+                    self._count_path("probe_rejoin")
+                    self._note_decision(
+                        "probe_rejoin",
+                        f"replica {idx}: {st['ok']} probe(s) ok; "
+                        f"vnodes rejoined")
+                    rejoined.append(idx)
+                    actions.append("probe_rejoin")
+        for idx in rejoined:
+            logger.info("fleet %s: replica %d passed probation and "
+                        "rejoined the ring", self.model_id, idx)
+            self._publish_merged()
+        return actions
+
+    def _hedge_stragglers(self, now: float) -> List[str]:
+        """Hedged retry for gray failures: a stream still QUEUED (no
+        slot, no first token) past hedge_after_s on one replica is
+        withdrawn (steal_pending) and re-issued on the least-loaded
+        admitting peer. Decode is deterministic per (seed, pos), so the
+        re-issue IS the stream — no duplicate races to the client.
+        Attached streams are out of scope: they are making (slow)
+        progress, and only ejection may touch another replica's slot
+        state. At most one stream moves per tick, so a slow replica
+        drains gradually instead of stampeding its peers."""
+        with self._lock:
+            pairs = [(i, self._replicas[i]) for i in self._live_idxs()]
+        for idx, svc in pairs:
+            for req in list(svc._pending):
+                if req.outcome is not None or req.cancelled:
+                    continue
+                if req.submitted_at is None \
+                        or now - req.submitted_at <= self.hedge_after_s:
+                    continue
+                if req.migrations >= MIGRATION_BUDGET:
+                    continue        # budget spent; leave it queued
+                with self._lock:
+                    peer = self._least_loaded(self._live_idxs(), {idx})
+                    peer_svc = self._replicas.get(peer) \
+                        if peer is not None else None
+                if peer_svc is None or not peer_svc.would_admit():
+                    return []       # nowhere better to put it
+                if not svc.steal_pending(req):
+                    continue        # attached/finished while we looked
+                try:
+                    peer_svc.adopt(req)
+                except (ServeSaturated, ServeDraining):
+                    try:
+                        svc.adopt(req)      # undo: back where it was
+                    except (ServeSaturated, ServeDraining):
+                        req.finish("error", "hedge raced admission on "
+                                            "both replicas")
+                    continue
+                req.migrations += 1
+                req.fleet_replica = peer
+                with self._lock:
+                    self.hedges_total += 1
+                    self.migrated_streams_total += 1
+                    self._count_path("hedge")
+                    self._note_decision(
+                        "hedge",
+                        f"stream {req.rid} queued "
+                        f"{now - req.submitted_at:.2f}s on replica "
+                        f"{idx}; re-issued on {peer}")
+                return ["hedge"]
+        return []
+
     # ------------------------------------------------------------ autoscaler
     def _autoscale_loop(self) -> None:
         while not self._stop_event.wait(self.autoscale_interval_s):
             try:
+                # supervision first: an ejection this tick changes the
+                # live set the scaling policy reads
+                self.supervise_once()
                 self.autoscale_once()
             except Exception:
                 logger.exception("fleet %s autoscale tick failed",
@@ -527,7 +914,11 @@ class ServeFleet:
             pressured = (shed_delta > 0
                          or (qcap > 0 and queue / qcap >= 0.5)
                          or (p99 > self.ttft_slo_s and inflight > 0))
-            grow = pressured and n < self.replicas_max and n > 0
+            # probationers count against the cap: they are live
+            # processes about to rejoin, so pressure while one probes
+            # must not over-provision past replicas_max
+            grow = (pressured and n > 0
+                    and n + len(self._probation) < self.replicas_max)
             to_zero = (idle and n > 0 and self.scale_to_zero_s > 0
                        and idle_for >= self.scale_to_zero_s)
             if idle and not to_zero:
@@ -638,7 +1029,10 @@ class ServeFleet:
     def _snapshot_locked(self) -> dict:
         idxs = list(self._replicas)
         snaps = {i: self._replicas[i].snapshot() for i in idxs}
-        live = [i for i in idxs if i not in self._draining]
+        # routable replicas: probationers are live processes but off
+        # the ring, reported separately as fleet_probation
+        live = [i for i in idxs if i not in self._draining
+                and i not in self._probation]
 
         def tot(field):
             return sum(snaps[i][field] for i in idxs)
@@ -650,14 +1044,29 @@ class ServeFleet:
         misses = self._retired["prefix_misses"]
         hit_deltas, miss_deltas = {}, {}
         for i in idxs:
-            st = self._replicas[i].engine.stats
+            svc = self._replicas[i]
+            st = svc.engine.stats
             h, m = int(st["prefix_hits"]), int(st["prefix_misses"])
+            # replica EPOCH = restarts_total: a watchdog recovery (or
+            # the crash-loop path) rebuilds the engine and its counters
+            # restart at ZERO. A delta against the old epoch's cursor
+            # would go negative (silently dropped by update_fleet's
+            # `> 0` guard, losing hits) and the fleet total would dip.
+            # Re-baseline: fold the dead epoch's last-seen cumulative
+            # into the retired totals and start the cursor from zero.
+            epoch = svc.restarts_total
+            pe, ph, pm = self._prefix_seen.get(i, (epoch, 0, 0))
+            if pe != epoch:
+                self._retired["prefix_hits"] += ph
+                self._retired["prefix_misses"] += pm
+                hits += ph
+                misses += pm
+                ph, pm = 0, 0
             hits += h
             misses += m
-            ph, pm = self._prefix_seen.get(i, (0, 0))
             hit_deltas[str(i)] = h - ph
             miss_deltas[str(i)] = m - pm
-            self._prefix_seen[i] = (h, m)
+            self._prefix_seen[i] = (epoch, h, m)
         util = [snaps[i]["serve_kv_page_utilization"] for i in idxs]
         return {
             "job_id": f"serve:{self.model_id}",
@@ -699,6 +1108,13 @@ class ServeFleet:
             "fleet_grows_total": self.grows_total,
             "fleet_shrinks_total": self.shrinks_total,
             "fleet_scale_to_zero_total": self.scale_to_zero_total,
+            # failure-domain surface
+            "fleet_probation": len(self._probation),
+            "fleet_ejections_total": self.ejections_total,
+            "fleet_failovers_total": self.failovers_total,
+            "fleet_migrated_streams_total": self.migrated_streams_total,
+            "fleet_probes_total": self.probes_total,
+            "fleet_hedges_total": self.hedges_total,
             "fleet_replica_prefix_hits": hit_deltas,
             "fleet_replica_prefix_misses": miss_deltas,
         }

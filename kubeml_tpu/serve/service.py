@@ -101,6 +101,12 @@ class ServeService:
         self._inflight = 0          # admitted, not yet terminal
         self._stopped = False
         self._draining = False      # admission -> 503, streams drain
+        # fleet failure domain: a KILLED replica died abruptly (injected
+        # fleet_replica_crash or ejection teardown) — the loop exits
+        # WITHOUT its drain tail and the watchdog stands down, leaving
+        # in-flight state in place for the fleet supervisor to harvest
+        # (eject_streams) and live-migrate to a surviving replica
+        self._killed = False
         # supervisor (PR-4 heartbeat style, one process): the loop
         # thread beats at the top of every round; the watchdog declares
         # a wedge when the beat goes stale WITH work in flight (an idle
@@ -175,7 +181,7 @@ class ServeService:
         req.prompt = self.engine.check_admissible(req.prompt,
                                                   req.max_new_tokens)
         with self._cv:
-            if self._stopped:
+            if self._stopped or self._killed:
                 raise ServeSaturated(message="serving loop stopped")
             if self._draining:
                 # graceful drain: new work belongs on another replica;
@@ -265,8 +271,23 @@ class ServeService:
 
     def would_admit(self) -> bool:
         """Whether submit() would (probably) admit right now."""
-        return (not self._stopped and not self._draining
+        return (not self._stopped and not self._killed
+                and not self._draining
                 and self._inflight < self.capacity)
+
+    @property
+    def failed(self) -> bool:
+        """Fleet supervisor's replica-death signal (lock-free, like the
+        other router hooks): True when the replica was killed outright,
+        or its loop thread is gone with nothing to resurrect it. A
+        SUPERVISED replica's dead thread is not failure — its own
+        watchdog rebuilds the engine, and the fleet's restart budget
+        catches it if that turns into a crash loop."""
+        if not self._started or self._stopped:
+            return False
+        if self._killed:
+            return True
+        return not self.supervise and not self._thread.is_alive()
 
     def estimated_retry_after_s(self) -> float:
         """The Retry-After submit() would attach to a shed right now —
@@ -288,7 +309,7 @@ class ServeService:
         hard stop (which force-releases the survivors). Safe to call
         from any thread — the loop keeps decoding throughout."""
         with self._cv:
-            if self._stopped:
+            if self._stopped or self._killed:
                 return self._inflight == 0
             if not self._draining:
                 self._draining = True
@@ -322,6 +343,135 @@ class ServeService:
         if self._thread.is_alive():
             self._thread.join(timeout)
 
+    # ------------------------------------------------- fleet failure domain
+    def kill(self, reason: str = "killed") -> None:
+        """Abrupt, unrecoverable replica death (fleet_replica_crash
+        injection, forced teardown). The engine is abandoned, the loop
+        thread exits WITHOUT the drain tail, and the watchdog stands
+        down — in-flight state (queued requests, occupied slots) is
+        deliberately left in place for the fleet supervisor to harvest
+        via eject_streams() and live-migrate. A standalone service
+        should call stop(), which fails survivors so no client hangs;
+        kill() on its own strands streams by design."""
+        with self._cv:
+            if self._stopped or self._killed:
+                return
+            self._killed = True
+            self.engine.abandon()
+            logger.error("model %s: replica killed (%s); in-flight "
+                         "streams await fleet ejection", self.model_id,
+                         reason)
+            self._cv.notify_all()
+
+    def force_restart(self, reason: str) -> int:
+        """Drive one real supervisor recovery from outside the watchdog
+        (fleet_replica_wedge injection, tests): the engine is abandoned
+        and rebuilt, in-flight streams requeue with resume_gen pinned,
+        restarts_total ticks — exactly the state a genuine crash loop
+        leaves behind. Returns the new restarts_total."""
+        with self._cv:
+            if not self._stopped and not self._killed:
+                self._recover(reason)
+            return self.restarts_total
+
+    def eject_streams(self) -> List[GenerateRequest]:
+        """Forced teardown for fleet ejection: abandon the engine,
+        evacuate every non-terminal stream — KV pages freed (the
+        engine's pager audit runs on each evacuation, so a refcount
+        leak in this path fails loudly), the request left UNFINISHED
+        with resume_gen pinned — and mark the service dead. Returns the
+        evacuated requests in admission order (attached slots by seq,
+        then the queue FIFO) so the surviving replica re-admits them in
+        the order clients submitted them."""
+        with self._cv:
+            engine = self.engine
+            engine.abandon()
+            self._killed = True
+            self._cv.notify_all()
+            # the loop thread may be mid-step on this engine's live
+            # state: evacuating KV pages under it would corrupt the
+            # step (and trip the pager audit on a phantom). abandon()
+            # only no-ops FUTURE steps, so wait for the in-flight one
+            # to account itself — the loop exits on _killed right after
+            # — before touching slot state. Bounded: a loop thread that
+            # died mid-step never clears _stepping.
+            deadline = time.monotonic() + 5.0
+            while self._stepping and time.monotonic() < deadline:
+                self._cv.wait(0.05)
+            harvested = []
+            for s in range(engine.slot_count):
+                slot = engine._slots[s]
+                if slot is None:
+                    continue
+                req = slot.req
+                slot.req.resume_gen = slot.gen
+                seq = slot.seq
+                engine.evacuate(s)
+                if req.outcome is None and not req.cancelled:
+                    harvested.append((seq, req))
+                elif req.outcome is None:
+                    # the client walked away mid-stream; nothing to move
+                    req.finish("cancelled")
+            harvested.sort(key=lambda t: t[0])
+            out = [req for _, req in harvested]
+            while self._pending:
+                r = self._pending.popleft()
+                if r.outcome is None and not r.cancelled:
+                    out.append(r)
+                elif r.outcome is None:
+                    r.finish("cancelled")
+            self._inflight = 0
+            self._killed = True      # the loop exits without its drain tail
+            self._stopped = True     # and the watchdog stands down
+            self._cv.notify_all()
+        return out
+
+    def adopt(self, req: GenerateRequest) -> GenerateRequest:
+        """Admit an EXISTING request object — the fleet's migration
+        path. The request was validated at its original admission and
+        may carry emitted tokens; attach() re-prefills prompt + tokens
+        so the continuation is bit-identical (per-(seed, pos) sampling
+        keys, emitted-prefix suppression). Sheds exactly like submit()
+        — the migrating fleet retries a shed against another survivor.
+        submitted_at and deadline_at are preserved: a migration does
+        not reset the client's SLO clock."""
+        with self._cv:
+            if self._stopped or self._killed:
+                raise ServeSaturated(message="serving loop stopped")
+            if self._draining:
+                backlog = self._backlog_tokens()
+                raise ServeDraining(retry_after_s=1.0 + (
+                    backlog / PREFILL_DRAIN_TOKENS_PER_S))
+            if self._inflight >= self.engine.slot_count + self.max_queue:
+                self.rejected_total += 1
+                self._note_outcome("rejected")
+                self._note_shed()
+                backlog = self._backlog_tokens()
+                raise ServeSaturated(retry_after_s=1.0 + (
+                    backlog / PREFILL_DRAIN_TOKENS_PER_S))
+            self._inflight += 1
+            if req.submitted_at is None:
+                req.submitted_at = self.clock()
+            self._pending.append(req)
+            self._cv.notify()
+        return req
+
+    def steal_pending(self, req: GenerateRequest) -> bool:
+        """Withdraw a still-QUEUED request from this replica (fleet
+        hedge path). Only unattached streams are stealable: an attached
+        stream is making (slow) progress, and mutating another
+        replica's slot state from the fleet thread would race its loop
+        — moving attached streams is the ejection path's job. Returns
+        False when the request already attached, finished, or was never
+        here."""
+        with self._cv:
+            try:
+                self._pending.remove(req)
+            except ValueError:
+                return False
+            self._inflight = max(0, self._inflight - 1)
+            return True
+
     # ----------------------------------------------------------------- loop
     def _loop(self) -> None:
         # pin the engine this thread owns: after a supervisor recovery
@@ -334,16 +484,26 @@ class ServeService:
                 if self.engine is not engine:
                     self._cv.notify_all()
                     return
+                if self._killed:
+                    # crashed replica: exit WITHOUT the drain tail —
+                    # queued requests and occupied slots stay in place
+                    # for the fleet's eject_streams() harvest
+                    self._cv.notify_all()
+                    return
                 self._beat = self.clock()
-                while not self._stopped and not self._pending \
+                while not self._stopped and not self._killed \
+                        and not self._pending \
                         and self._pending_weights is None \
                         and engine.active() == 0:
                     self._publish()
                     self._cv.wait()
                     self._beat = self.clock()
-                    if self.engine is not engine:
+                    if self.engine is not engine or self._killed:
                         self._cv.notify_all()
                         return
+                if self._killed:
+                    self._cv.notify_all()
+                    return
                 if self._stopped:
                     break
                 if self._pending_weights is not None:
@@ -395,6 +555,9 @@ class ServeService:
                     return
                 for req in finished:
                     self._terminal(req, None)
+                if self._killed:
+                    self._cv.notify_all()
+                    return
             self._publish()
             # deterministic wedge injection rides AFTER the publish so
             # the step's effects are observable, then spins until the
@@ -479,7 +642,7 @@ class ServeService:
         while True:
             time.sleep(self.watchdog_interval_s)
             with self._cv:
-                if self._stopped:
+                if self._stopped or self._killed:
                     return
                 thread_dead = not self._thread.is_alive()
                 stale = self._inflight > 0 and not self._stepping and \
@@ -500,7 +663,7 @@ class ServeService:
         prompt + already-emitted tokens, so continuation is
         bit-identical to the uninterrupted run (per-position sampling
         keys) and nothing re-emits."""
-        if self._stopped:
+        if self._stopped or self._killed:
             return
         old = self.engine
         old.abandon()

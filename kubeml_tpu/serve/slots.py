@@ -73,6 +73,11 @@ class GenerateRequest:
         # supervisor recovery: the weight generation this stream was
         # pinned to, so a resumed attach decodes the same params
         self.resume_gen: Optional[int] = None
+        # fleet failover: how many times this stream has been moved to
+        # another replica (ejection migration or hedge). Charged against
+        # the fleet's migration budget so a request that poisons every
+        # replica it lands on cannot ping-pong around the ring forever.
+        self.migrations = 0
         # distributed-trace correlation: trace_id rides from the client
         # header through every span of this request's tree; rid is a
         # short per-request id so co-resident requests sharing one

@@ -1,0 +1,551 @@
+"""Fleet failure-domain tests (serve/fleet.py supervise_once + the
+FleetFaultPlan kinds in kubeml_tpu/faults.py).
+
+The contracts pinned here:
+
+  * fault plan — FleetFaultPlan parses the same shapes as
+    ServeFaultPlan (JSON string / dict / list), rejects unknown kinds,
+    fires each event ONCE, resolves wildcard replicas to the lowest
+    live index, and keeps an untargetable event armed
+  * crash failover — a killed replica is ejected from the hash ring
+    and its in-flight streams live-migrate onto survivors via the
+    re-prefill path, finishing TOKEN-FOR-TOKEN identical to a solo
+    unfaulted engine (the (seed, pos) sampling keys make the
+    continuation exact); the replacement replica earns its vnodes back
+    through half-open probes ("probe_rejoin")
+  * wedge — watchdog restarts beyond the budget read as crash-looping
+    and eject; slow — a planted serve_slow_step straggler drives the
+    hedged retry of a QUEUED stream onto a peer ("hedge")
+  * edge cases — all-replicas-ejected fails fast with a 503 whose
+    Retry-After reflects probation (no spin against an empty ring);
+    stale sticky sessions pointing at an ejected replica re-resolve
+    through the ring; the per-stream migration budget turns the N+1th
+    move into a clean terminal error
+  * telemetry — per-replica prefix deltas re-baseline across a replica
+    restart epoch (never negative, totals monotone), the new
+    kubeml_serve_fleet_* counter families pass the metrics lint, the
+    fleet_degraded health rule fires on an in-window ejection, and
+    `kubeml top` renders the fleet-faults line
+  * lint — tools/check_fault_tests.py FLEET_KINDS coverage rule passes
+    on this repo and behaves on synthetic trees (every injection here
+    is coordinate-driven; the lint scans this file too)
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+pytestmark = [pytest.mark.serving, pytest.mark.faults]
+
+
+@pytest.fixture(scope="module")
+def nano():
+    import jax
+
+    from kubeml_tpu.models import get_builtin
+    model = get_builtin("gpt-nano")()
+    module = model.module
+    variables = model.init_variables(
+        jax.random.PRNGKey(0),
+        {"x": np.ones((1, module.max_len), np.int32)})
+    return model, module, variables
+
+
+def _factory(module, variables, *, slots=2, page=4, max_queue=2):
+    from kubeml_tpu.serve.engine import DecodeEngine
+    from kubeml_tpu.serve.service import ServeService
+
+    def make(index):
+        engine = DecodeEngine(module, variables, slots=slots, page=page)
+        return ServeService("fleet-m", engine, max_queue=max_queue,
+                            supervise=False)
+    return make
+
+
+def _fleet(module, variables, **kw):
+    from kubeml_tpu.serve.fleet import ServeFleet
+    kw.setdefault("autoscale_interval_s", 0.0)   # tests drive ticks
+    kw.setdefault("page_tokens", 4)
+    factory_kw = {k: kw.pop(k) for k in ("slots", "max_queue")
+                  if k in kw}
+    return ServeFleet("fleet-m", _factory(module, variables,
+                                          **factory_kw), **kw)
+
+
+def _solo_tokens(module, variables, prompt, n_new, *, page=4):
+    """Reference decode: the same request alone on a fresh engine."""
+    from kubeml_tpu.serve.engine import DecodeEngine
+    from kubeml_tpu.serve.slots import GenerateRequest
+
+    engine = DecodeEngine(module, variables, slots=2, page=page)
+    req = GenerateRequest(list(prompt), max_new_tokens=n_new)
+    engine.attach(req)
+    limit = 10_000
+    while engine.active():
+        engine.step()
+        limit -= 1
+        assert limit > 0, "solo engine failed to drain"
+    assert req.outcome == "ok"
+    return req.tokens
+
+
+def _owned_prompts(fleet, owner, count, n_tokens=5):
+    """Prompts whose routing digest lands on replica `owner`."""
+    from kubeml_tpu.serve.pager import routing_digest
+    out = []
+    for base in range(3, 4000):
+        p = [(base + j) % 97 + 1 for j in range(n_tokens)]
+        with fleet._lock:
+            if fleet._ring_owner(
+                    routing_digest(p, fleet.page_tokens)) == owner:
+                out.append(p)
+        if len(out) == count:
+            return out
+    raise AssertionError(f"no {count} prompts owned by {owner}")
+
+
+def _wait(pred, timeout_s=30.0, tick=0.01):
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(tick)
+    return False
+
+
+# ------------------------------------------------------------ fault plan
+
+def test_fleet_fault_plan_parse_fire_once_and_wildcard():
+    """FleetFaultPlan shares ServeFaultPlan's parse contract; fire() is
+    once-only, wildcard replicas resolve to the lowest live index, and
+    an event with no live target stays armed."""
+    from kubeml_tpu.faults import FLEET_KINDS, FleetFaultPlan
+
+    assert "fleet_replica_crash" in FLEET_KINDS
+    assert "fleet_replica_wedge" in FLEET_KINDS
+    assert "fleet_replica_slow" in FLEET_KINDS
+
+    plan = FleetFaultPlan.parse(
+        '{"events": [{"kind": "fleet_replica_crash", "tick": 2},'
+        ' {"kind": "fleet_replica_slow", "replica": 7,'
+        '  "duration_s": 0.5}]}')
+    assert plan is FleetFaultPlan.parse(plan)       # idempotent
+    # tick 1: nothing due
+    assert plan.fire(1, [0, 1]) == []
+    # tick 2: the crash fires, wildcard replica -> lowest live index
+    fired = plan.fire(2, [3, 1])
+    assert [(k, r) for k, r, _e in fired] == [("fleet_replica_crash", 1)]
+    assert plan.injected["fleet_replica_crash"] == 1
+    # once-only: tick 2 again delivers nothing
+    assert plan.fire(2, [1, 3]) == []
+    # the slow event targets replica 7: stays armed while 7 is absent
+    assert plan.fire(3, [1, 3]) == []
+    assert plan.injected["fleet_replica_slow"] == 0
+    fired = plan.fire(4, [1, 7])
+    assert [(k, r) for k, r, _e in fired] == [("fleet_replica_slow", 7)]
+    assert fired[0][2].duration_s == 0.5
+    assert plan.injected["fleet_replica_slow"] == 1
+    assert plan.injected["fleet_replica_wedge"] == 0
+
+    # list / dict forms parse too; unknown kinds fail loudly
+    assert FleetFaultPlan.parse(
+        [{"kind": "fleet_replica_wedge"}]).has("fleet_replica_wedge")
+    with pytest.raises(ValueError):
+        FleetFaultPlan.parse([{"kind": "replica_crash"}])
+    with pytest.raises(ValueError):
+        FleetFaultPlan.parse('{"events": 3}')
+
+
+# ------------------------------------------- crash -> eject -> migrate
+
+def test_crash_failover_migrates_streams_and_probation_rejoins(nano):
+    """The full failure-domain cycle: a deterministic
+    fleet_replica_crash kills replica 0 mid-decode; supervise_once
+    ejects it, live-migrates its in-flight streams onto the survivor
+    (bit-identical continuation via re-prefill), spawns a probationary
+    replacement, and later graduates it back onto the ring."""
+    _model, module, variables = nano
+    fleet = _fleet(module, variables, replicas_min=2, replicas_max=2,
+                   probe_requests=1, slots=2, max_queue=4,
+                   fault_plan=[{"kind": "fleet_replica_crash",
+                                "replica": 0}])
+    fleet.start()
+    try:
+        victim = fleet._replicas[0]
+        prompts = _owned_prompts(fleet, 0, 3)
+        reqs = [fleet.submit(p, max_new_tokens=8) for p in prompts]
+        assert all(r.fleet_replica == 0 for r in reqs)
+        # let the victim get mid-decode so the kill lands on live work
+        assert _wait(lambda: victim.engine.active() >= 1)
+        actions = fleet.supervise_once()
+        assert "eject" in actions and "failover_migrate" in actions
+        for p, r in zip(prompts, reqs):
+            assert r.wait(120) and r.outcome == "ok", (r.outcome, r.error)
+            assert r.migrations >= 1 and r.fleet_replica != 0
+            np.testing.assert_array_equal(
+                r.tokens, _solo_tokens(module, variables, p, 8))
+        snap = fleet.snapshot()
+        assert snap["fleet_ejections_total"] == 1
+        assert snap["fleet_failovers_total"] == 1
+        assert snap["fleet_migrated_streams_total"] >= 3
+        assert snap["fleet_probation"] == 1      # replacement, half-open
+        assert fleet.path_counts["eject"] == 1
+        assert fleet.path_counts["failover_migrate"] >= 3
+        assert fleet.fault_plan.injected["fleet_replica_crash"] == 1
+
+        # probation: the next submit is routed as a half-open probe;
+        # serving it to "ok" earns the vnodes back on the next tick
+        r = fleet.submit(prompts[0], max_new_tokens=4)
+        assert r.wait(120) and r.outcome == "ok"
+        np.testing.assert_array_equal(
+            r.tokens, _solo_tokens(module, variables, prompts[0], 4))
+        assert snap["fleet_probes_total"] + 1 == \
+            fleet.snapshot()["fleet_probes_total"]
+        actions = fleet.supervise_once()
+        assert "probe_rejoin" in actions
+        assert fleet.path_counts["probe_rejoin"] == 1
+        snap = fleet.snapshot()
+        assert snap["fleet_probation"] == 0
+        assert snap["fleet_replicas"] == 2       # ring repopulated
+    finally:
+        fleet.stop(grace_s=0.0)
+
+
+def test_wedge_blows_restart_budget_and_ejects(nano):
+    """fleet_replica_wedge drives real watchdog-path restarts past the
+    budget; the supervisor reads the replica as crash-looping and
+    ejects it (no migration needed when nothing is in flight)."""
+    _model, module, variables = nano
+    fleet = _fleet(module, variables, replicas_min=2, replicas_max=2,
+                   replica_restart_budget=1, probe_requests=1,
+                   fault_plan=[{"kind": "fleet_replica_wedge",
+                                "replica": 1}])
+    fleet.start()
+    try:
+        r = fleet.submit([5, 6, 7, 8], max_new_tokens=4)
+        assert r.wait(120) and r.outcome == "ok"
+        actions = fleet.supervise_once()
+        assert actions == ["eject"]
+        assert fleet.fault_plan.injected["fleet_replica_wedge"] == 1
+        snap = fleet.snapshot()
+        assert snap["fleet_ejections_total"] == 1
+        assert snap["fleet_failovers_total"] == 0    # nothing in flight
+        assert 1 not in fleet._replicas or 1 in fleet._probation
+    finally:
+        fleet.stop(grace_s=0.0)
+
+
+def test_slow_replica_straggler_is_hedged(nano):
+    """fleet_replica_slow plants serve_slow_step on the victim; a
+    stream stuck QUEUED behind the straggler past hedge_after_s is
+    stolen and re-admitted on a peer ("hedge") and still finishes
+    bit-identical to a solo engine."""
+    _model, module, variables = nano
+    fleet = _fleet(module, variables, replicas_min=2, replicas_max=2,
+                   hedge_after_s=0.05, slots=2, max_queue=4,
+                   fault_plan=[{"kind": "fleet_replica_slow",
+                                "replica": 0, "duration_s": 0.2}])
+    fleet.start()
+    try:
+        fleet.supervise_once()       # delivers the slow-step plant
+        assert fleet.fault_plan.injected["fleet_replica_slow"] == 1
+        prompts = _owned_prompts(fleet, 0, 5)
+        reqs = [fleet.submit(p, max_new_tokens=12) for p in prompts]
+        assert all(r.fleet_replica == 0 for r in reqs)
+        assert _wait(lambda: "hedge" in fleet.supervise_once(),
+                     timeout_s=60.0, tick=0.05), "no hedge fired"
+        assert fleet.path_counts["hedge"] >= 1
+        assert fleet.snapshot()["fleet_hedges_total"] >= 1
+        hedged = 0
+        for p, r in zip(prompts, reqs):
+            assert r.wait(180) and r.outcome == "ok", (r.outcome, r.error)
+            hedged += int(r.fleet_replica != 0)
+            np.testing.assert_array_equal(
+                r.tokens, _solo_tokens(module, variables, p, 12))
+        assert hedged >= 1
+    finally:
+        fleet.stop(grace_s=0.0)
+
+
+# ------------------------------------------------------------ edge cases
+
+def test_all_replicas_ejected_fails_fast_with_probation_retry_after(nano):
+    """Satellite: when the LAST replica is ejected the router must not
+    spin retry-once against an empty ring — submit fails fast 503 with
+    a probation-aware Retry-After once the replacement's probe quota is
+    spoken for, and the ring heals through the normal rejoin path."""
+    from kubeml_tpu.serve.slots import ServeDraining
+
+    _model, module, variables = nano
+    fleet = _fleet(module, variables, replicas_min=1, replicas_max=1,
+                   probe_requests=1,
+                   fault_plan=[{"kind": "fleet_replica_crash"}])
+    fleet.start()
+    try:
+        r = fleet.submit([5, 6, 7], max_new_tokens=3)
+        assert r.wait(120) and r.outcome == "ok"
+        actions = fleet.supervise_once()
+        assert "eject" in actions
+        snap = fleet.snapshot()
+        assert snap["fleet_replicas"] == 0       # ring is empty
+        assert snap["fleet_probation"] == 1      # replacement half-open
+
+        # the replacement's single probe slot takes one stream...
+        probe = fleet.submit([5, 6, 7], max_new_tokens=3)
+        assert probe.wait(120) and probe.outcome == "ok"
+        # ...and with probe quota exhausted, submit fails FAST: 503
+        with pytest.raises(ServeDraining) as exc:
+            fleet.submit([9, 10, 11], max_new_tokens=3)
+        assert "all replicas ejected" in str(exc.value)
+        assert exc.value.retry_after_s >= 1.0
+        # the reaped probe graduates the replacement; service resumes
+        assert "probe_rejoin" in fleet.supervise_once()
+        r = fleet.submit([9, 10, 11], max_new_tokens=3)
+        assert r.wait(120) and r.outcome == "ok"
+    finally:
+        fleet.stop(grace_s=0.0)
+
+
+def test_stale_session_remaps_through_ring_after_ejection(nano):
+    """Satellite: a sticky session pointing at an ejected replica is a
+    stale LRU entry, not an error — the next submit with that session
+    re-resolves through the ring onto a live replica."""
+    _model, module, variables = nano
+    fleet = _fleet(module, variables, replicas_min=2, replicas_max=2,
+                   probe_requests=1,
+                   fault_plan=[{"kind": "fleet_replica_crash",
+                                "replica": 0}])
+    fleet.start()
+    try:
+        prompt = _owned_prompts(fleet, 0, 1)[0]
+        r = fleet.submit(prompt, max_new_tokens=3, session="s1")
+        assert r.wait(120) and r.outcome == "ok"
+        assert r.fleet_replica == 0
+        assert "eject" in fleet.supervise_once()
+        # ejection purges sessions; simulate the worst case anyway: a
+        # stale entry that somehow still names the dead replica
+        with fleet._lock:
+            assert "s1" not in fleet._sessions   # purged on eject
+            fleet._sessions["s1"] = 0
+        r = fleet.submit(prompt, max_new_tokens=3, session="s1")
+        assert r.wait(120) and r.outcome == "ok"
+        assert r.fleet_replica != 0
+        with fleet._lock:
+            assert fleet._sessions["s1"] == r.fleet_replica
+    finally:
+        fleet.stop(grace_s=0.0)
+
+
+def test_migration_budget_exhausts_into_clean_terminal_error(nano):
+    """A stream that has already moved MIGRATION_BUDGET times is NOT
+    re-prefilled again on the next ejection — it finishes with a
+    terminal error naming the budget, instead of ping-ponging KV work
+    across a flapping fleet forever."""
+    from kubeml_tpu.serve.fleet import MIGRATION_BUDGET
+
+    _model, module, variables = nano
+    fleet = _fleet(module, variables, replicas_min=2, replicas_max=2,
+                   probe_requests=1, slots=2, max_queue=4,
+                   fault_plan=[{"kind": "fleet_replica_crash",
+                                "replica": 0}])
+    fleet.start()
+    try:
+        victim = fleet._replicas[0]
+        doomed, fine = _owned_prompts(fleet, 0, 2)
+        r_doomed = fleet.submit(doomed, max_new_tokens=16)
+        r_fine = fleet.submit(fine, max_new_tokens=16)
+        assert _wait(lambda: victim.engine.active() >= 1)
+        r_doomed.migrations = MIGRATION_BUDGET      # already moved N times
+        actions = fleet.supervise_once()
+        assert "eject" in actions
+        assert r_doomed.wait(120) and r_doomed.outcome == "error"
+        assert "migration budget exhausted" in r_doomed.error
+        # its neighbour still migrates and finishes bit-identically
+        assert r_fine.wait(120) and r_fine.outcome == "ok"
+        np.testing.assert_array_equal(
+            r_fine.tokens, _solo_tokens(module, variables, fine, 16))
+    finally:
+        fleet.stop(grace_s=0.0)
+
+
+# ------------------------------------------------------------- telemetry
+
+def test_prefix_deltas_rebaseline_across_replica_restart_epoch(nano):
+    """Satellite: a watchdog-rebuilt engine restarts its prefix
+    counters at zero; the fleet snapshot must re-baseline per replica
+    EPOCH instead of publishing negative deltas or double-counting."""
+    _model, module, variables = nano
+    fleet = _fleet(module, variables, replicas_min=1, replicas_max=1)
+    fleet.start()
+    try:
+        # silence background publishes so only OUR snapshot calls
+        # consume the deltas (deterministic cursors)
+        for svc in fleet.replicas():
+            svc.health_cb = None
+        r = fleet.submit([5, 6, 7, 8, 9], max_new_tokens=3)
+        assert r.wait(120) and r.outcome == "ok"
+        fleet.snapshot()                      # absorb the first round
+        r = fleet.submit([5, 6, 7, 8, 9], max_new_tokens=3)
+        assert r.wait(120) and r.outcome == "ok"
+        snap1 = fleet.snapshot()
+        assert snap1["fleet_replica_prefix_hits"]["0"] >= 1
+        total1 = fleet._retired["prefix_hits"] + sum(
+            int(e.stats["prefix_hits"]) for _i, e in fleet.engines())
+
+        svc = fleet._replicas[0]
+        assert svc.force_restart("test epoch bump") == 1
+        # the rebuilt engine's counters are back at zero: without the
+        # epoch re-baseline this snapshot would publish NEGATIVE deltas
+        r = fleet.submit([5, 6, 7, 8, 9], max_new_tokens=3)
+        assert r.wait(120) and r.outcome == "ok"
+        snap2 = fleet.snapshot()
+        for d in list(snap2["fleet_replica_prefix_hits"].values()) + \
+                list(snap2["fleet_replica_prefix_misses"].values()):
+            assert d >= 0, snap2
+        total2 = fleet._retired["prefix_hits"] + sum(
+            int(e.stats["prefix_hits"]) for _i, e in fleet.engines())
+        assert total2 >= total1      # lifetime totals stay monotone
+    finally:
+        fleet.stop(grace_s=0.0)
+
+
+def test_fleet_fault_counter_families_pass_metrics_lint():
+    """The five new kubeml_serve_fleet_* families advance by delta from
+    the snapshot, survive a republish, render a lint-clean exposition,
+    and clear with the model."""
+    from kubeml_tpu.metrics.prom import MetricsRegistry
+    from tools.check_metrics import validate_exposition
+
+    reg = MetricsRegistry()
+    snap = {"fleet_replicas": 2, "fleet_ejections_total": 1,
+            "fleet_failovers_total": 1,
+            "fleet_migrated_streams_total": 3,
+            "fleet_probes_total": 2, "fleet_hedges_total": 1}
+    reg.update_fleet("m1", snap)
+    reg.update_fleet("m1", snap)      # republish: no double count
+    text = reg.exposition()
+    assert 'kubeml_serve_fleet_ejections_total{model="m1"} 1' in text
+    assert 'kubeml_serve_fleet_failovers_total{model="m1"} 1' in text
+    assert ('kubeml_serve_fleet_migrated_streams_total'
+            '{model="m1"} 3') in text
+    assert 'kubeml_serve_fleet_probes_total{model="m1"} 2' in text
+    assert 'kubeml_serve_fleet_hedges_total{model="m1"} 1' in text
+    assert validate_exposition(text) == []
+    reg.clear_serve("m1")
+    assert 'model="m1"' not in reg.exposition()
+
+
+def test_fleet_degraded_health_rule_fires_on_in_window_ejection():
+    """Warning when fleet_ejections_total grew within the window; a
+    steady republish has no in-window delta; solo-serve samples carry
+    no fleet_* fields and never fire."""
+    from kubeml_tpu.control.health import HealthEvaluator
+
+    ev = HealthEvaluator()
+    assert not [f for f in ev.observe(
+        {"job_id": "serve:m", "fleet_ejections_total": 0})
+        if f["rule"] == "fleet_degraded"]
+    fired = [f for f in ev.observe(
+        {"job_id": "serve:m", "fleet_ejections_total": 1,
+         "fleet_migrated_streams_total": 3, "fleet_probation": 1})
+        if f["rule"] == "fleet_degraded"]
+    assert fired and fired[0]["severity"] == "warning"
+    assert "ejected within the sample window" in fired[0]["detail"]
+    assert "fleet is degraded" in fired[0]["detail"]
+
+    solo = HealthEvaluator()
+    assert not [f for f in solo.observe(
+        {"job_id": "serve:n", "serve_active_slots": 1})
+        if f["rule"] == "fleet_degraded"]
+
+
+def test_top_renders_fleet_faults_line():
+    from kubeml_tpu.cli.main import _render_top
+
+    doc = {"id": "serve:m1", "state": "healthy", "reasons": [],
+           "latest": {"serve_active_slots": 1, "serve_slot_cap": 8,
+                      "serve_queue_depth": 0, "serve_queue_cap": 16,
+                      "serve_kv_page_utilization": 0.25,
+                      "serve_rejected_total": 0,
+                      "fleet_replicas": 3, "fleet_replicas_min": 1,
+                      "fleet_replicas_max": 4, "fleet_draining": 0,
+                      "fleet_spills_total": 0,
+                      "fleet_router_retries_total": 0,
+                      "fleet_cold_starts_total": 0,
+                      "fleet_grows_total": 0, "fleet_shrinks_total": 0,
+                      "fleet_scale_to_zero_total": 0,
+                      "fleet_ejections_total": 1,
+                      "fleet_failovers_total": 1,
+                      "fleet_migrated_streams_total": 4,
+                      "fleet_probes_total": 2, "fleet_hedges_total": 1,
+                      "fleet_probation": 1}}
+    out = _render_top(doc)
+    assert "fleet faults: ejections 1" in out
+    assert "failovers 1" in out and "migrated 4" in out
+    assert "probes 2" in out and "hedges 1" in out
+    assert "probation 1" in out
+    # an old snapshot without the fault fields renders no faults line
+    del doc["latest"]["fleet_ejections_total"]
+    assert "fleet faults:" not in _render_top(doc)
+
+
+# ------------------------------------------------------------------ lint
+
+def test_fault_lint_fleet_kind_coverage_passes_on_this_repo():
+    import tools.check_fault_tests as lint
+    assert lint.main(["check_fault_tests"]) == 0
+
+
+def test_fault_lint_fleet_kind_coverage_self_test(tmp_path):
+    """The FLEET_KINDS coverage rule parses the declaration site,
+    demands the QUOTED kind on an assert line, and fails loudly when
+    the tuple goes missing in a refactor."""
+    import tools.check_fault_tests as lint
+
+    root = tmp_path
+    (root / "kubeml_tpu").mkdir()
+    (root / "tests").mkdir()
+    faults = root / "kubeml_tpu" / "faults.py"
+    faults.write_text('SERVE_KINDS = ()\n'
+                      'FLEET_KINDS = ("zz_boom", "zz_wedge")\n')
+    tests_dir = str(root / "tests")
+
+    assert lint.fleet_kinds(str(faults)) == ["zz_boom", "zz_wedge"]
+    assert lint.unasserted_fleet_kinds(str(faults), tests_dir) == \
+        ["zz_boom", "zz_wedge"]
+    assert lint.main(["x", tests_dir]) == 1
+
+    # a mention in a plan spec (no assert) does NOT count as coverage
+    t = root / "tests" / "test_zz.py"
+    t.write_text('plan = [{"kind": "zz_boom"}]\nkinds = ["zz_wedge"]\n')
+    assert lint.unasserted_fleet_kinds(str(faults), tests_dir) == \
+        ["zz_boom", "zz_wedge"]
+
+    t.write_text('kinds = ["zz_boom", "zz_wedge"]\n'
+                 'assert "zz_boom" in kinds\n'
+                 'assert "zz_wedge" in kinds\n')
+    assert lint.unasserted_fleet_kinds(str(faults), tests_dir) == []
+    assert lint.main(["x", tests_dir]) == 0
+
+    # a miswired tuple (faults.py refactor) fails loudly, not silently
+    faults.write_text('SERVE_KINDS = ()\n')
+    with pytest.raises(SystemExit):
+        lint.fleet_kinds(str(faults))
+
+
+def test_fleet_path_lint_covers_the_fault_paths():
+    """The four failure-domain paths are FLEET_PATH_VARIANTS entries,
+    so tools/check_fleet_paths.py now demands a quoted-name identity
+    test for each — this file is that coverage."""
+    import os
+
+    import tools.check_fleet_paths as lint
+
+    root = os.path.dirname(os.path.dirname(os.path.abspath(
+        lint.__file__)))
+    names = lint.path_variants(
+        os.path.join(root, "kubeml_tpu", "serve", "fleet.py"))
+    assert {"eject", "failover_migrate", "probe_rejoin",
+            "hedge"} <= set(names)
+    assert lint.main(["check_fleet_paths"]) == 0

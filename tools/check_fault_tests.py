@@ -15,6 +15,13 @@ assert line somewhere under tests/ (same quoted-name discipline as
 tools/check_serve_spans.py). A serve fault kind nobody asserts on is
 recovery machinery nobody would notice breaking.
 
+A third contract (PR 14) applies the same rule to the fleet plane:
+every FLEET_KINDS entry (fleet_replica_crash / wedge / slow — the
+fault kinds the fleet supervisor's supervise_once tick delivers) must
+be asserted by quoted name under tests/ too. Replica ejection and live
+stream migration are exactly the machinery that silently rots without
+a named test.
+
 Run directly (exit 1 on violation) or via tests/test_faults.py, which
 keeps the lint itself in the tier-1 suite:
 
@@ -97,6 +104,17 @@ def serve_kinds(faults_path: str) -> list:
     return re.findall(r"[\"']([^\"']+)[\"']", m.group(1))
 
 
+def fleet_kinds(faults_path: str) -> list:
+    """The declared FLEET fault kinds, parsed from the FLEET_KINDS
+    tuple literal (same rule as serve_kinds)."""
+    with open(faults_path, encoding="utf-8") as f:
+        src = f.read()
+    m = re.search(r"FLEET_KINDS\s*=\s*\(([^)]*)\)", src)
+    if not m:
+        raise SystemExit(f"{faults_path}: FLEET_KINDS tuple not found")
+    return re.findall(r"[\"']([^\"']+)[\"']", m.group(1))
+
+
 def file_asserts_kind(path: str, kind: str) -> bool:
     """True when the file asserts on the QUOTED kind name. Unlike
     _code_lines this keeps STRING tokens — the kind appears as a string
@@ -110,8 +128,7 @@ def file_asserts_kind(path: str, kind: str) -> bool:
     return False
 
 
-def unasserted_serve_kinds(faults_path: str, tests_dir: str) -> list:
-    kinds = serve_kinds(faults_path)
+def _unasserted(kinds: list, tests_dir: str) -> list:
     missing = []
     for kind in kinds:
         for dirpath, _dirs, files in os.walk(tests_dir):
@@ -122,6 +139,14 @@ def unasserted_serve_kinds(faults_path: str, tests_dir: str) -> list:
         else:
             missing.append(kind)
     return missing
+
+
+def unasserted_serve_kinds(faults_path: str, tests_dir: str) -> list:
+    return _unasserted(serve_kinds(faults_path), tests_dir)
+
+
+def unasserted_fleet_kinds(faults_path: str, tests_dir: str) -> list:
+    return _unasserted(fleet_kinds(faults_path), tests_dir)
 
 
 def main(argv) -> int:
@@ -147,6 +172,13 @@ def main(argv) -> int:
         missing = unasserted_serve_kinds(faults_path, root)
         for kind in missing:
             print(f"{faults_path}: serve fault kind {kind!r} has no "
+                  f"tier-1 test asserting its quoted name under {root}",
+                  file=sys.stderr)
+        if missing:
+            return 1
+        missing = unasserted_fleet_kinds(faults_path, root)
+        for kind in missing:
+            print(f"{faults_path}: fleet fault kind {kind!r} has no "
                   f"tier-1 test asserting its quoted name under {root}",
                   file=sys.stderr)
         if missing:

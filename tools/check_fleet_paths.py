@@ -4,13 +4,18 @@
 The serving fleet (kubeml_tpu/serve/fleet.py) routes one logical
 /generate contract over several physical paths: the consistent-hash
 affinity hit, the spill to a least-loaded peer, the cold start from
-zero, the drain of a shrink victim, and scale-to-zero itself. Each
-promises the caller the SAME stream a solo engine would produce — a
-path without a test making that claim is an unverified router branch.
-So this lint walks the FLEET_PATH_VARIANTS tuple in fleet.py and fails
-unless each name appears (quoted, in executable code) in some tests/
-file that also carries an exactness assertion (assert_array_equal /
-assert_allclose).
+zero, the drain of a shrink victim, scale-to-zero itself, and — since
+PR 14 — the failure-domain paths: the ejection of a dead replica
+("eject"), the live migration of its in-flight streams
+("failover_migrate"), the probation round trip back onto the ring
+("probe_rejoin"), and the hedged retry off a straggler ("hedge"). Each
+promises the caller the SAME stream a solo engine would produce — the
+fault paths most of all, since migration re-prefills prompt + emitted
+tokens and claims bit-identical continuation. A path without a test
+making that claim is an unverified router branch. So this lint walks
+the FLEET_PATH_VARIANTS tuple in fleet.py and fails unless each name
+appears (quoted, in executable code) in some tests/ file that also
+carries an exactness assertion (assert_array_equal / assert_allclose).
 
 Run directly (exit 1 on violation) or via tests/test_fleet.py, which
 keeps the lint itself in the tier-1 suite:
