@@ -417,6 +417,7 @@ def main():
     serving_faulted = _measure_serving_faulted_arm()
     serving_fleet = _measure_serving_fleet_arm()
     serving_fleet_faulted = _measure_serving_fleet_faulted_arm()
+    serving_decode_bw = _measure_serving_decode_bw_arm()
     cluster = _measure_cluster_arm()
     continual = _measure_continual_arm()
 
@@ -584,6 +585,16 @@ def main():
         # exactly one ejection + one probe-rejoin in the
         # kubeml_serve_fleet_* counters.
         "serving_fleet_faulted": serving_fleet_faulted,
+        # decode-bandwidth arm (ops/pallas/paged_attention.py +
+        # serve/pager.py int8 pages): KV traffic measured with the
+        # deterministic bytes-per-token proxy (page geometry x dtype,
+        # no timers). Self-asserts: pallas paged kernel bit-identical
+        # to the gather programs with the same two-compile inventory,
+        # int8 KV >= 3.5x bytes-per-token reduction with the kv_bytes
+        # stat replaying exactly from dispatch counts, int8 rows
+        # independent (solo == concurrent), and int8-vs-f32 greedy
+        # divergence bounded.
+        "serving_decode_bw": serving_decode_bw,
         # cluster-allocator arm (control/cluster.py): a deterministic
         # fake-clock saturation replay — three wide priority-0 batch
         # gangs fill the pool, four narrow priority-1 prod jobs burst
@@ -1145,6 +1156,133 @@ def _measure_prefill_arm() -> dict:
         "concurrent": concurrent,
         "prefix_mix": prefix_mix,
         "recorder_overhead": recorder_overhead,
+    }
+
+
+def _measure_serving_decode_bw_arm() -> dict:
+    """Decode-bandwidth arm (PR 15): pallas paged attention + int8 KV
+    pages, measured with the DETERMINISTIC bytes-per-token proxy (page
+    geometry x storage dtype — engine.kv_bytes_per_token), never a
+    timer, so every number is exact on the CPU tier. The model runs
+    f32 compute/storage so the int8 leg's reduction reads honestly
+    against 4-byte pages. Self-asserted pins:
+
+    - the paged kernel (interpret mode here) is a pure bandwidth
+      lever: tokens BIT-IDENTICAL to the gather programs, identical
+      dispatch counts, and the same two-compile program inventory;
+    - int8 KV cuts the per-decoded-token KV traffic >= 3.5x, and the
+      cumulative kv_bytes stat replays exactly from dispatch counts;
+    - int8 keeps the row-independence contract (solo == concurrent,
+      bit-identical) and its divergence from the f32 leg is bounded:
+      greedy first tokens agree and the whole-stream token agreement
+      stays high (reported, asserted >= 0.75)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from kubeml_tpu.models.gpt import GPTMini, GPTModule
+    from kubeml_tpu.serve.engine import DecodeEngine
+    from kubeml_tpu.serve.slots import GenerateRequest
+
+    SLOTS, PAGE, NEW_TOKENS = 4, 16, 12
+
+    class F32GPT(GPTMini):
+        """gpt-nano-sized blocks in f32: the registered gpt-nano is
+        bf16, which would halve the baseline and understate int8."""
+
+        def build(self):
+            return GPTModule(vocab_size=512, max_len=128, hidden=32,
+                             layers=2, heads=2, ffn=64, dropout=0.0,
+                             dtype=jnp.float32)
+
+    model = F32GPT()
+    module = model.module
+    variables = model.init_variables(
+        jax.random.PRNGKey(0),
+        {"x": np.ones((1, module.max_len), np.int32)})
+    # mixed prompt lengths: off-page, page-multiple, and multi-chunk
+    prompts = [[(i * 37 + 5 * j) % (module.vocab_size - 1) + 1
+                for j in range(n)]
+               for i, n in enumerate((9, 17, 33, 5))]
+
+    def drive(eng):
+        while eng.active():
+            eng.step()
+
+    def run(concurrent=True, **kw):
+        eng = DecodeEngine(module, variables, slots=SLOTS, page=PAGE,
+                           prefill_chunk=PAGE, **kw)
+        reqs = [GenerateRequest(list(p), max_new_tokens=NEW_TOKENS,
+                                temperature=0.0, seed=i)
+                for i, p in enumerate(prompts)]
+        if concurrent:
+            for r in reqs:
+                eng.attach(r)
+            drive(eng)
+        else:
+            for r in reqs:
+                eng.attach(r)
+                drive(eng)
+        assert all(r.outcome == "ok" for r in reqs)
+        return eng, [list(r.tokens) for r in reqs]
+
+    t0 = time.perf_counter()
+    g_eng, g_toks = run()                       # f32, gather programs
+    p_eng, p_toks = run(attn_impl="pallas", attn_interpret=True)
+    i_eng, i_toks = run(kv_dtype="int8")
+    _i_solo_eng, i_solo_toks = run(concurrent=False, kv_dtype="int8")
+    elapsed = time.perf_counter() - t0
+
+    # pin 1: paged kernel == gather programs, bit for bit, same
+    # dispatch/compile inventory (exactly two programs either way)
+    assert p_toks == g_toks, "pallas paged kernel changed decoded tokens"
+    for stat in ("dispatches", "compiles", "prefill_dispatches",
+                 "prefill_compiles"):
+        assert p_eng.stats[stat] == g_eng.stats[stat], \
+            (stat, p_eng.stats[stat], g_eng.stats[stat])
+    assert int(g_eng.stats["compiles"]) == 1
+    assert int(g_eng.stats["prefill_compiles"]) == 1
+    assert int(i_eng.stats["compiles"]) == 1
+    assert int(i_eng.stats["prefill_compiles"]) == 1
+
+    # pin 2: the deterministic bytes proxy and its int8 reduction
+    bpt_f32 = g_eng.kv_bytes_per_token
+    bpt_i8 = i_eng.kv_bytes_per_token
+    ratio = bpt_f32 / bpt_i8
+    assert ratio >= 3.5, f"int8 KV cut bytes only {ratio:.2f}x"
+    assert g_eng.stats["kv_bytes"] == \
+        g_eng.stats["decode_tokens"] * bpt_f32
+    assert i_eng.stats["kv_bytes"] == \
+        i_eng.stats["decode_tokens"] * bpt_i8
+
+    # pin 3: int8 row independence + bounded divergence from f32
+    assert i_toks == i_solo_toks, "int8 tokens depend on co-residents"
+    n_tok = sum(len(t) for t in g_toks)
+    agree = sum(a == b for A, B in zip(i_toks, g_toks)
+                for a, b in zip(A, B))
+    first_agree = sum(A[0] == B[0] for A, B in zip(i_toks, g_toks))
+    assert first_agree >= len(prompts) - 1, \
+        f"int8 first tokens diverged: {first_agree}/{len(prompts)}"
+    assert agree / n_tok >= 0.75, \
+        f"int8 token agreement {agree}/{n_tok} below bound"
+
+    return {
+        "model": "gpt-nano-f32", "slots": SLOTS, "page": PAGE,
+        "new_tokens": NEW_TOKENS,
+        "kv_bytes_per_token_f32": int(bpt_f32),
+        "kv_bytes_per_token_int8": int(bpt_i8),
+        "bytes_reduction_x": round(ratio, 3),
+        "kv_bytes_total_f32": int(g_eng.stats["kv_bytes"]),
+        "kv_bytes_total_int8": int(i_eng.stats["kv_bytes"]),
+        "pallas_tokens_bit_identical": True,
+        "pallas_dispatches": int(p_eng.stats["dispatches"]),
+        "gather_dispatches": int(g_eng.stats["dispatches"]),
+        "decode_compiles": int(p_eng.stats["compiles"]),
+        "prefill_compiles": int(p_eng.stats["prefill_compiles"]),
+        "int8_solo_vs_concurrent_bit_identical": True,
+        "int8_first_token_agreement": f"{first_agree}/{len(prompts)}",
+        "int8_token_agreement_pct": round(100.0 * agree / n_tok, 1),
+        "wall_s": round(elapsed, 3),
     }
 
 

@@ -386,6 +386,14 @@ def _render_top(doc: dict) -> str:
                 f"{_ms(latest.get('serve_ttft_queue_s'))}  prefill "
                 f"{_ms(latest.get('serve_ttft_prefill_s'))}  interleave "
                 f"{_ms(latest.get('serve_ttft_interleave_s'))}")
+        if latest.get("serve_kv_bytes_per_token") is not None:
+            # decode bandwidth pane: the deterministic per-token KV
+            # traffic proxy (page geometry x storage dtype, no timers)
+            # and which storage mode produced it
+            lines.append(
+                f"decode bw: "
+                f"{latest.get('serve_kv_bytes_per_token', 0):g} B/token  "
+                f"kv dtype {latest.get('serve_kv_dtype', 'f32')}")
         if latest.get("serve_engine_restarts") is not None:
             # fault pane: supervisor restarts, quarantined poisoners,
             # deadline expiries — all zero on a healthy replica
@@ -565,6 +573,7 @@ def cmd_serve(args):
                                serve_slots=args.serve_slots,
                                serve_queue_depth=args.serve_queue_depth,
                                serve_prefill_chunk=args.serve_prefill_chunk,
+                               serve_kv_dtype=args.serve_kv_dtype,
                                serve_prefix_cache=_prefix_cache_opt(args),
                                serve_drain_grace_s=args.serve_drain_grace_s,
                                serve_replicas_min=args.serve_replicas_min,
@@ -604,6 +613,7 @@ def cmd_serve(args):
                               serve_slots=args.serve_slots,
                               serve_queue_depth=args.serve_queue_depth,
                               serve_prefill_chunk=args.serve_prefill_chunk,
+                              serve_kv_dtype=args.serve_kv_dtype,
                               serve_prefix_cache=_prefix_cache_opt(args),
                               serve_drain_grace_s=args.serve_drain_grace_s,
                               serve_replicas_min=args.serve_replicas_min,
@@ -925,6 +935,13 @@ def build_parser() -> argparse.ArgumentParser:
                         "feeds prompts through the decode program one "
                         "token per dispatch "
                         "(KUBEML_SERVE_PREFILL_CHUNK, default 16)")
+    s.add_argument("--serve-kv-dtype", choices=("f32", "int8"),
+                   default=None,
+                   help="KV-page storage for served models: f32 keeps "
+                        "pages in the model dtype (bit-identity "
+                        "baseline), int8 quantizes pages on write with "
+                        "per-page scales, cutting decode HBM traffic "
+                        "~4x (KUBEML_SERVE_KV_DTYPE, default f32)")
     s.add_argument("--serve-prefix-cache", choices=("on", "off"),
                    default=None,
                    help="share full prompt pages across /generate "

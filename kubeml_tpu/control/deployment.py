@@ -72,6 +72,7 @@ def start_deployment(mesh=None, controller_port: int = 0,
                      serve_slots: Optional[int] = None,
                      serve_queue_depth: Optional[int] = None,
                      serve_prefill_chunk: Optional[int] = None,
+                     serve_kv_dtype: Optional[str] = None,
                      serve_prefix_cache: Optional[bool] = None,
                      serve_drain_grace_s: Optional[float] = None,
                      serve_replicas_min: Optional[int] = None,
@@ -111,6 +112,7 @@ def start_deployment(mesh=None, controller_port: int = 0,
                          serve_slots=serve_slots,
                          serve_queue_depth=serve_queue_depth,
                          serve_prefill_chunk=serve_prefill_chunk,
+                         serve_kv_dtype=serve_kv_dtype,
                          serve_prefix_cache=serve_prefix_cache,
                          serve_drain_grace_s=serve_drain_grace_s,
                          serve_replicas_min=serve_replicas_min,

@@ -339,6 +339,10 @@ _SAMPLE_FIELDS = ("train_loss", "validation_loss", "accuracy",
                   # serve_crash_loop rule, the rest the top faults line
                   "serve_engine_restarts", "serve_poisoned_total",
                   "serve_deadline_total",
+                  # decode bandwidth (PR 15): KV storage mode + the
+                  # deterministic bytes-per-token proxy for the top
+                  # "decode bw" line
+                  "serve_kv_dtype", "serve_kv_bytes_per_token",
                   # serving-fleet telemetry (serve/fleet.py): replica
                   # count + router/autoscaler counters ride the merged
                   # serve:<model> sample; the per-replica prefix

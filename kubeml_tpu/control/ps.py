@@ -281,6 +281,7 @@ class ParameterServer(JsonService):
                  serve_page_tokens: Optional[int] = None,
                  serve_hbm_budget_mb: Optional[float] = None,
                  serve_prefill_chunk: Optional[int] = None,
+                 serve_kv_dtype: Optional[str] = None,
                  serve_prefix_cache: Optional[bool] = None,
                  serve_drain_grace_s: Optional[float] = None,
                  serve_replicas_min: Optional[int] = None,
@@ -346,6 +347,12 @@ class ParameterServer(JsonService):
         self.serve_prefill_chunk = int(
             serve_prefill_chunk if serve_prefill_chunk is not None
             else os.environ.get("KUBEML_SERVE_PREFILL_CHUNK", "16"))
+        # decode bandwidth (PR 15): KV page storage mode — "f32" keeps
+        # the model dtype (bit-identity baseline), "int8" quantizes
+        # pages on write with per-page scales (engine/pager validate)
+        self.serve_kv_dtype = str(
+            serve_kv_dtype if serve_kv_dtype is not None
+            else os.environ.get("KUBEML_SERVE_KV_DTYPE", "f32"))
         if serve_prefix_cache is None:
             serve_prefix_cache = os.environ.get(
                 "KUBEML_SERVE_PREFIX_CACHE", "on").lower() \
@@ -859,6 +866,7 @@ class ParameterServer(JsonService):
                         page=self.serve_page_tokens,
                         max_len=module.max_len),
                     prefill_chunk=self.serve_prefill_chunk,
+                    kv_dtype=self.serve_kv_dtype,
                     prefix_cache=self.serve_prefix_cache,
                     # production posture: a pager invariant violation
                     # is logged and counted

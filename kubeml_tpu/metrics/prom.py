@@ -535,6 +535,14 @@ class MetricsRegistry:
             "kubeml_serve_page_leaks_total",
             "KV pager invariant violations detected on release or "
             "recovery, by served model", "model")
+        # decode bandwidth (PR 15): deterministic HBM bytes the decode
+        # program moved through the paged KV cache (page geometry x
+        # storage dtype per decoded token — a comm proxy, not a timer),
+        # the observable the int8-KV mode exists to shrink
+        self.serve_kv_bytes_total = Counter(
+            "kubeml_serve_kv_bytes_total",
+            "KV-cache bytes moved by decode dispatches (deterministic "
+            "geometry-based proxy), by served model", "model")
         # continual plane (PR 10): the weight generation new admissions
         # attach to (advances on every zero-downtime hot-swap), and the
         # continual job's data freshness — dataset generation trained
@@ -708,6 +716,7 @@ class MetricsRegistry:
                                 self.serve_engine_restarts_total,
                                 self.serve_poisoned_total,
                                 self.serve_page_leaks_total,
+                                self.serve_kv_bytes_total,
                                 self.serve_fleet_spills_total,
                                 self.serve_fleet_router_retries_total,
                                 self.serve_fleet_cold_starts_total,
@@ -871,6 +880,9 @@ class MetricsRegistry:
     def note_serve_page_leaks(self, model: str, n: int) -> None:
         self.serve_page_leaks_total.inc(model, n)
 
+    def note_serve_kv_bytes(self, model: str, n: int) -> None:
+        self.serve_kv_bytes_total.inc(model, n)
+
     def observe_serve_ttft_breakdown(self, model: str, queue: float,
                                      prefill: float,
                                      interleave: float) -> None:
@@ -960,6 +972,7 @@ class MetricsRegistry:
                   self.serve_engine_restarts_total,
                   self.serve_poisoned_total,
                   self.serve_page_leaks_total,
+                  self.serve_kv_bytes_total,
                   self.serve_fleet_spills_total,
                   self.serve_fleet_router_retries_total,
                   self.serve_fleet_cold_starts_total,

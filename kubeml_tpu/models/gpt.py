@@ -425,7 +425,77 @@ def _prompt_lengths(window: np.ndarray) -> np.ndarray:
                     Tp - np.argmax(real[:, ::-1], axis=1), 0)
 
 
-def build_paged_decode_step(module: GPTModule):
+# serving KV storage modes (mirrors serve/pager.py KV_DTYPES): "f32"
+# keeps pages in the module dtype — the bit-identity baseline; "int8"
+# quantizes pages on write with per-page symmetric scales
+_KV_DTYPES = ("f32", "int8")
+
+
+def _int8_write_decode(pages, scales, layer, rows, write_page, write_off):
+    """Quantize-on-write for one layer's decode rows [S, H, Dh] (f32)
+    into int8 pages with PER-PAGE symmetric scales (the PR-7 EFInt8
+    convention from parallel/merge.py: scale = amax/127, value =
+    q * scale, zero-amax rows quantize to 0).
+
+    A page's scale is the running amax of its written rows: each write
+    maxes the row's amax into the stored scale and REQUANTIZES the
+    page's existing rows under the new scale (factor = old/new <= 1 —
+    exact when the scale is unchanged, bounded rounding otherwise, at
+    most G-1 rescales per page). write_off == 0 RESETS the scale first:
+    pages always fill from row 0 (decode, prefill, and CoW all write
+    monotonically; a CoW split never lands on offset 0), so offset 0
+    means first-ever write — which is also what makes a reused
+    (evicted/retired) page's stale scale vanish without any host-side
+    device work. old == 0 makes the factor 0, wiping stale int8 bytes
+    in the same pass. Inactive lanes point at null page 0 with offset
+    0, so their garbage resets/requants land identical zeros there —
+    order-free, deterministic, never attended."""
+    old = scales[layer, write_page]
+    old = jnp.where(write_off == 0, 0.0, old)
+    amax = jnp.max(jnp.abs(rows), axis=(1, 2))
+    new = jnp.maximum(old, amax / 127.0)
+    safe = jnp.where(new > 0, new, 1.0)
+    factor = jnp.where(new > 0, old / safe, 0.0)
+    requant = jnp.round(pages[layer, write_page].astype(jnp.float32)
+                        * factor[:, None, None, None])
+    pages = pages.at[layer, write_page].set(requant.astype(jnp.int8))
+    qrow = jnp.clip(jnp.round(rows / safe[:, None, None]), -127, 127)
+    pages = pages.at[layer, write_page, write_off].set(qrow.astype(jnp.int8))
+    scales = scales.at[layer, write_page].set(new)
+    return pages, scales
+
+
+def _int8_write_prefill(pages, scales, layer, rows, write_pages,
+                        write_offs, in_chunk):
+    """Chunked twin of _int8_write_decode: C rows [C, H, Dh] (f32)
+    land across up to two pages per chunk. Per-page amaxes accumulate
+    with scatter-max (duplicate page indices reduce associatively —
+    deterministic); the reset rule is the same, applied per page when
+    any row in the chunk writes its offset 0. The requant scatter
+    writes IDENTICAL bytes for duplicate page indices (the factor is a
+    function of the page alone), so it too is order-free."""
+    base = scales[layer]
+    reset = jnp.zeros_like(base).at[write_pages].max(
+        (write_offs == 0).astype(jnp.float32) * in_chunk)
+    base = jnp.where(reset > 0, 0.0, base)
+    amax = jnp.max(jnp.abs(rows), axis=(1, 2)) * in_chunk
+    new = base.at[write_pages].max(amax / 127.0)
+    safe = jnp.where(new > 0, new, 1.0)
+    factor = jnp.where(new > 0, base / safe, 0.0)
+    requant = jnp.round(pages[layer, write_pages].astype(jnp.float32)
+                        * factor[write_pages][:, None, None, None])
+    pages = pages.at[layer, write_pages].set(requant.astype(jnp.int8))
+    qrows = jnp.clip(jnp.round(rows / safe[write_pages][:, None, None]),
+                     -127, 127)
+    pages = pages.at[layer, write_pages, write_offs].set(
+        qrows.astype(jnp.int8))
+    scales = scales.at[layer].set(new)
+    return pages, scales
+
+
+def build_paged_decode_step(module: GPTModule, kv_dtype: str = "f32",
+                            attn_impl: str = "auto",
+                            attn_interpret: bool = False):
     """One-token-per-slot decode step over a PAGED KV cache — the
     serving plane's persistent program (serve/engine.py).
 
@@ -437,11 +507,24 @@ def build_paged_decode_step(module: GPTModule):
     parameter subtrees, the same NEG_INF bias convention, the same
     f32-softmax attention primitive) as a single fixed-shape step:
 
-      step(params, k_pages, v_pages, valid_pages,
+      step(params, k_pages, v_pages, k_scales, v_scales, valid_pages,
            tokens[S], pos[S], page_tables[S, Pmax],
            write_page[S], write_off[S], active[S], temps[S],
            key_data[S, 2], copy_src[S], copy_dst[S], poison[S])
-        -> (next_tokens[S], bad[S], k_pages, v_pages, valid_pages)
+        -> (next_tokens[S], bad[S], k_pages, v_pages, k_scales,
+            v_scales, valid_pages)
+
+    kv_dtype selects the page storage mode (serve/pager.py KV_DTYPES):
+    "f32" keeps pages in the module dtype and the step is IEEE-identical
+    to the pre-scale program (the scale lanes ride along untouched, so
+    the step signature — and the two-compile pin — is uniform across
+    modes); "int8" quantizes K/V rows on write with per-page symmetric
+    scales (_int8_write_decode) and the attention dequantizes inside the
+    kernel. attn_impl/attn_interpret forward to ops/pallas
+    paged_attention — the context read streams pages through the page
+    table on TPU instead of materializing a contiguous [S, C, H, D]
+    gather, which is the decode bandwidth attack this builder exists
+    for; the 'gather' fallback is the old chain verbatim.
 
     Every per-request quantity is DATA (the kavg worker-mask trick), so
     slot membership changes never recompile. Inactive slots compute
@@ -484,10 +567,15 @@ def build_paged_decode_step(module: GPTModule):
         raise ValueError(
             "paged decode serves dense GPT modules only (no MoE, "
             "sequence-parallel, or manual-TP variants)")
+    if kv_dtype not in _KV_DTYPES:
+        raise ValueError(
+            f"kv_dtype must be one of {_KV_DTYPES}, got {kv_dtype!r}")
+    quantized = kv_dtype == "int8"
     heads, hidden = module.heads, module.hidden
     head_dim = hidden // heads
     dtype = module.dtype
-    from kubeml_tpu.ops.attention import NEG_INF, multi_head_attention
+    from kubeml_tpu.ops.attention import NEG_INF
+    from kubeml_tpu.ops.pallas.paged_attention import paged_attention
     tok_embed = nn.Embed(module.vocab_size, hidden, dtype=dtype)
     pos_embed = nn.Embed(module.max_len, hidden, dtype=dtype)
     ln = nn.LayerNorm(dtype=jnp.float32)
@@ -496,9 +584,9 @@ def build_paged_decode_step(module: GPTModule):
     ffn_in = nn.Dense(module.ffn, dtype=dtype)
     ffn_out = nn.Dense(hidden, dtype=dtype)
 
-    def step(params, k_pages, v_pages, valid_pages, tokens, pos,
-             page_tables, write_page, write_off, active, temps, key_data,
-             copy_src, copy_dst, poison):
+    def step(params, k_pages, v_pages, k_scales, v_scales, valid_pages,
+             tokens, pos, page_tables, write_page, write_off, active,
+             temps, key_data, copy_src, copy_dst, poison):
         S = tokens.shape[0]
         G = valid_pages.shape[1]
         C = page_tables.shape[1] * G
@@ -506,8 +594,11 @@ def build_paged_decode_step(module: GPTModule):
         # happens before any scatter in this dispatch (functional
         # update semantics), so splitting a page and reusing its id are
         # safe in the same step. 0 -> 0 rows are null-page no-ops.
+        # Scales are page metadata and split with their page.
         k_pages = k_pages.at[:, copy_dst].set(k_pages[:, copy_src])
         v_pages = v_pages.at[:, copy_dst].set(v_pages[:, copy_src])
+        k_scales = k_scales.at[:, copy_dst].set(k_scales[:, copy_src])
+        v_scales = v_scales.at[:, copy_dst].set(v_scales[:, copy_src])
         valid_pages = valid_pages.at[copy_dst].set(valid_pages[copy_src])
         h = tok_embed.apply({"params": params["tok_embed"]}, tokens[:, None])
         h = h + pos_embed.apply({"params": params["pos_embed"]},
@@ -527,13 +618,23 @@ def build_paged_decode_step(module: GPTModule):
             q = qkv.apply({"params": p["q"]}, x)
             k = qkv.apply({"params": p["k"]}, x)
             v = qkv.apply({"params": p["v"]}, x)
-            k_pages = k_pages.at[i, write_page, write_off].set(
-                k[:, 0].astype(dtype))
-            v_pages = v_pages.at[i, write_page, write_off].set(
-                v[:, 0].astype(dtype))
-            ck = k_pages[i][page_tables].reshape(S, C, heads, head_dim)
-            cv = v_pages[i][page_tables].reshape(S, C, heads, head_dim)
-            attn = multi_head_attention(q, ck, cv, bias)
+            if quantized:
+                k_pages, k_scales = _int8_write_decode(
+                    k_pages, k_scales, i, k[:, 0].astype(jnp.float32),
+                    write_page, write_off)
+                v_pages, v_scales = _int8_write_decode(
+                    v_pages, v_scales, i, v[:, 0].astype(jnp.float32),
+                    write_page, write_off)
+            else:
+                k_pages = k_pages.at[i, write_page, write_off].set(
+                    k[:, 0].astype(dtype))
+                v_pages = v_pages.at[i, write_page, write_off].set(
+                    v[:, 0].astype(dtype))
+            attn = paged_attention(
+                q, k_pages[i], v_pages[i], k_scales[i], v_scales[i],
+                page_tables, bias, quantized=quantized,
+                compute_dtype=dtype, impl=attn_impl,
+                interpret=attn_interpret)
             attn = out_proj.apply({"params": p["out"]}, attn)
             h = h + attn
             x = ln.apply({"params": p["LayerNorm_1"]}, h)
@@ -568,12 +669,15 @@ def build_paged_decode_step(module: GPTModule):
 
         nxt = jax.vmap(pick_one)(key_data, logits, temps)
         nxt = jnp.where(bad > 0, 0, nxt)
-        return nxt, bad, k_pages, v_pages, valid_pages
+        return nxt, bad, k_pages, v_pages, k_scales, v_scales, valid_pages
 
     return step
 
 
-def build_paged_prefill_step(module: GPTModule, chunk: int):
+def build_paged_prefill_step(module: GPTModule, chunk: int,
+                             kv_dtype: str = "f32",
+                             attn_impl: str = "auto",
+                             attn_interpret: bool = False):
     """Chunked prefill over the paged KV cache: C prompt tokens for ONE
     slot per dispatch — the serving plane's second (and last) persistent
     program (serve/engine.py).
@@ -583,10 +687,16 @@ def build_paged_prefill_step(module: GPTModule, chunk: int):
     sampled token, and every co-resident stream pays the queueing. This
     program bulk-writes a fixed-size chunk of prompt KV instead:
 
-      prefill(params, k_pages, v_pages, valid_pages,
+      prefill(params, k_pages, v_pages, k_scales, v_scales, valid_pages,
               tokens[C], pos[C], page_table[Pmax],
               write_pages[C], write_offs[C], in_chunk[C])
-        -> (k_pages, v_pages, valid_pages)
+        -> (k_pages, v_pages, k_scales, v_scales, valid_pages)
+
+    kv_dtype / attn_impl / attn_interpret mirror
+    build_paged_decode_step: "int8" quantizes chunk rows on write
+    (_int8_write_prefill) and the paged-attention context read
+    dequantizes them; "f32" leaves the scale lanes inert and the
+    program IEEE-identical to the pre-scale one.
 
     The chunk size C is static (one compile, amortized forever); real
     chunk length is DATA — prompts shorter than C pad the tail with
@@ -614,10 +724,15 @@ def build_paged_prefill_step(module: GPTModule, chunk: int):
         raise ValueError(
             "paged prefill serves dense GPT modules only (no MoE, "
             "sequence-parallel, or manual-TP variants)")
+    if kv_dtype not in _KV_DTYPES:
+        raise ValueError(
+            f"kv_dtype must be one of {_KV_DTYPES}, got {kv_dtype!r}")
+    quantized = kv_dtype == "int8"
     heads, hidden = module.heads, module.hidden
     head_dim = hidden // heads
     dtype = module.dtype
-    from kubeml_tpu.ops.attention import NEG_INF, multi_head_attention
+    from kubeml_tpu.ops.attention import NEG_INF
+    from kubeml_tpu.ops.pallas.paged_attention import paged_attention
     tok_embed = nn.Embed(module.vocab_size, hidden, dtype=dtype)
     pos_embed = nn.Embed(module.max_len, hidden, dtype=dtype)
     ln = nn.LayerNorm(dtype=jnp.float32)
@@ -626,8 +741,9 @@ def build_paged_prefill_step(module: GPTModule, chunk: int):
     ffn_in = nn.Dense(module.ffn, dtype=dtype)
     ffn_out = nn.Dense(hidden, dtype=dtype)
 
-    def prefill(params, k_pages, v_pages, valid_pages, tokens, pos,
-                page_table, write_pages, write_offs, in_chunk):
+    def prefill(params, k_pages, v_pages, k_scales, v_scales,
+                valid_pages, tokens, pos, page_table, write_pages,
+                write_offs, in_chunk):
         G = valid_pages.shape[1]
         C = page_table.shape[0] * G
         h = tok_embed.apply({"params": params["tok_embed"]}, tokens[None, :])
@@ -647,13 +763,23 @@ def build_paged_prefill_step(module: GPTModule, chunk: int):
             q = qkv.apply({"params": p["q"]}, x)
             k = qkv.apply({"params": p["k"]}, x)
             v = qkv.apply({"params": p["v"]}, x)
-            k_pages = k_pages.at[i, write_pages, write_offs].set(
-                k[0].astype(dtype))
-            v_pages = v_pages.at[i, write_pages, write_offs].set(
-                v[0].astype(dtype))
-            ck = k_pages[i][page_table].reshape(1, C, heads, head_dim)
-            cv = v_pages[i][page_table].reshape(1, C, heads, head_dim)
-            attn = multi_head_attention(q, ck, cv, bias)
+            if quantized:
+                k_pages, k_scales = _int8_write_prefill(
+                    k_pages, k_scales, i, k[0].astype(jnp.float32),
+                    write_pages, write_offs, in_chunk)
+                v_pages, v_scales = _int8_write_prefill(
+                    v_pages, v_scales, i, v[0].astype(jnp.float32),
+                    write_pages, write_offs, in_chunk)
+            else:
+                k_pages = k_pages.at[i, write_pages, write_offs].set(
+                    k[0].astype(dtype))
+                v_pages = v_pages.at[i, write_pages, write_offs].set(
+                    v[0].astype(dtype))
+            attn = paged_attention(
+                q, k_pages[i], v_pages[i], k_scales[i], v_scales[i],
+                page_table[None], bias, quantized=quantized,
+                compute_dtype=dtype, impl=attn_impl,
+                interpret=attn_interpret)
             attn = out_proj.apply({"params": p["out"]}, attn)
             h = h + attn
             x = ln.apply({"params": p["LayerNorm_1"]}, h)
@@ -661,7 +787,7 @@ def build_paged_prefill_step(module: GPTModule, chunk: int):
             x = nn.gelu(x)
             x = ffn_out.apply({"params": p["Dense_1"]}, x)
             h = h + x
-        return k_pages, v_pages, valid_pages
+        return k_pages, v_pages, k_scales, v_scales, valid_pages
 
     return prefill
 

@@ -88,8 +88,8 @@ def ring_flash_eligible(T_local: int) -> bool:
     'auto', evaluated on the LOCAL sequence block (the per-device ring
     block is what the kernel runs on). Differentiable since round 4, so
     training and inference share one rule."""
-    return jax.default_backend() == "tpu" and _flash_tiles(T_local) \
-        and _flash_safe_context()
+    from kubeml_tpu.ops.pallas.gate import use_pallas
+    return _flash_tiles(T_local) and use_pallas(None)
 
 
 def masked_attention(q: jax.Array, k: jax.Array, v: jax.Array,
@@ -106,8 +106,9 @@ def masked_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     """
     T = q.shape[1]
     if impl == "auto":
-        impl = "flash" if jax.default_backend() == "tpu" \
-            and _flash_tiles(T) and _flash_safe_context() else "reference"
+        from kubeml_tpu.ops.pallas.gate import use_pallas
+        impl = "flash" if _flash_tiles(T) and use_pallas(None) \
+            else "reference"
     if impl == "flash":
         from kubeml_tpu.ops.pallas.flash_attention import flash_attention
         return flash_attention(q, k, v, pad_mask, causal,

@@ -35,6 +35,7 @@ from jax.experimental.pallas import tpu as pltpu
 
 from kubeml_tpu import compat
 from kubeml_tpu.ops.attention import NEG_INF
+from kubeml_tpu.ops.pallas import gate
 
 # Measured on v5e at T=16384 (B*H=8, D=64): 128x128 blocks run at ~4
 # effective TF/s, 512x512 ~10, 1024x1024 ~11.5 with a plateau beyond —
@@ -154,13 +155,12 @@ def _fit_block(block: int, T: int) -> int:
     return b
 
 
-def _out_vma(*xs) -> frozenset:
-    """Varying-manual-axes for the kernel outputs: under a
-    check_vma=True shard_map (the K-avg engine's sequence-parallel
-    round) pallas_call requires an explicit `vma` on every out_shape;
-    the outputs vary over exactly the union of the inputs' axes.
-    Outside shard_map this is frozenset() — equivalent to the default."""
-    return frozenset().union(*(compat.typeof_vma(x) for x in xs))
+# Varying-manual-axes for the kernel outputs: under a check_vma=True
+# shard_map (the K-avg engine's sequence-parallel round) pallas_call
+# requires an explicit `vma` on every out_shape; the outputs vary over
+# exactly the union of the inputs' axes. Shared via gate.py with the
+# other kernels in this package.
+_out_vma = gate.out_vma
 
 
 def _to_bh(x, B, H, T, D):

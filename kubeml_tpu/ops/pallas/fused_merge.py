@@ -30,35 +30,16 @@ import jax.numpy as jnp
 from jax import lax
 
 from kubeml_tpu import compat
+from kubeml_tpu.ops.pallas import gate
+from kubeml_tpu.ops.pallas.gate import (HAS_PALLAS, LANES as _LANES,
+                                        SUBLANES as _SUBLANES, pl, pltpu)
 
-try:  # pallas is present on every supported JAX; guard for stripped builds
-    from jax.experimental import pallas as pl
-    from jax.experimental.pallas import tpu as pltpu
-    HAS_PALLAS = True
-except Exception:  # pragma: no cover - exercised only on stripped installs
-    pl = None
-    pltpu = None
-    HAS_PALLAS = False
-
-_LANES = 128       # f32 native lane width
-_SUBLANES = 8      # f32 sublane minimum
 _BLOCK_ROWS = 256  # rows per grid step (256*128*4B = 128 KiB per operand)
 
-
-def _out_vma(*xs) -> frozenset:
-    """Union of the inputs' varying-manual-axes: under a check_vma=True
-    shard_map round pallas_call requires an explicit `vma` on every
-    out_shape; elsewhere this is the empty set and a no-op."""
-    return frozenset().union(*(compat.typeof_vma(x) for x in xs))
-
-
-def _use_pallas(interpret: Optional[bool]) -> bool:
-    if not HAS_PALLAS:
-        return False
-    if interpret:
-        return True
-    return (jax.default_backend() == "tpu"
-            and compat.flash_safe_context())
+# gate.py owns the shared auto-gate + vma helpers (kept as module-level
+# names here: tests and the merge engine monkeypatch/introspect them)
+_out_vma = gate.out_vma
+_use_pallas = gate.use_pallas
 
 
 def _lax_apply(mode: str, s, ref, count, raw_count, lr):

@@ -1,0 +1,215 @@
+"""Paged attention — pallas TPU kernel for the serving decode hot path.
+
+The serving programs (models/gpt.py build_paged_decode_step /
+build_paged_prefill_step) used to materialize each slot's WHOLE context
+before attending:
+
+    ck = k_pages[page_tables].reshape(S, C, H, D)
+
+On TPU that gather is a full contiguous copy of every referenced KV
+page through HBM, per layer, per dispatch — for single-token decode the
+copied bytes dominate the dispatch (decode is bandwidth-bound: the v5e
+sweep in results/text-bench-v5e.jsonl). This kernel is the
+PagedAttention treatment (Kwon et al., 2023): the page table rides as a
+scalar-prefetch operand, the BlockSpec index map walks it, and each KV
+page streams HBM -> VMEM exactly once — no contiguous KV tensor ever
+exists in HBM.
+
+Math contract: the kernel's op chain is EXACTLY the reference path's —
+same f32-score matmul, the same `1/sqrt(D)` scale expression, the same
+additive-bias convention, `jax.nn.softmax` in f32, the same
+cast-weights-then-matmul finish — so the serving bit-identity suite can
+assert_array_equal the kernel (interpret mode) against the gather
+programs instead of settling for allclose. One (slot, head) owns a grid
+point; pages land in a [C, D] VMEM scratch tile (C = Pmax*G tokens,
+e.g. 512x64 bf16 = 64 KiB — far below the ~16 MB/core budget), and the
+softmax runs once over the full masked context exactly like the
+reference, preserving the engine's masking/determinism contract.
+
+int8 KV pages (serve/pager.py kv_dtype="int8") dequantize INSIDE the
+kernel: pages are int8 with one symmetric f32 scale per page riding as
+a second scalar-prefetch operand, so HBM traffic per context token
+drops ~4x (1 byte + 4/G bytes of scale vs 4) and the f32 values are
+reconstructed in VMEM. The gather fallback dequantizes with the same
+expression before the same op chain, keeping both paths one math.
+
+Dispatch follows the package contract (gate.py): Mosaic on TPU in
+Mosaic-partitionable contexts, the IEEE-identical gather fallback
+everywhere else, `interpret=True` for CPU kernel tests.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from kubeml_tpu import compat
+from kubeml_tpu.ops.attention import multi_head_attention
+from kubeml_tpu.ops.pallas import gate
+from kubeml_tpu.ops.pallas.gate import SUBLANES, pl, pltpu
+
+IMPLS = ("auto", "pallas", "gather")
+
+
+def _dequant(pages: jax.Array, scale: jax.Array, dtype) -> jax.Array:
+    """Per-page symmetric int8 -> compute-dtype: THE dequant expression,
+    shared verbatim by the kernel body and the gather fallback (the
+    quantize side lives in models/gpt.py next to the page writes)."""
+    return (pages.astype(jnp.float32)
+            * scale[(...,) + (None,) * (pages.ndim - scale.ndim)]
+            ).astype(dtype)
+
+
+def paged_eligible(page: int) -> bool:
+    """Geometry gate for the Mosaic kernel: page rows are the sublane
+    dimension of the KV block DMA, so they must be sublane-aligned.
+    Ineligible geometries fall back to the gather path under 'auto'."""
+    return page % SUBLANES == 0
+
+
+def _pa_kernel(tables_ref, kscale_ref, vscale_ref, q_ref, k_ref, v_ref,
+               bias_ref, out_ref, k_scr, v_scr, *, n_pages: int,
+               page: int, quantized: bool):
+    """One (slot, page) grid point.
+
+    The page loop is the LAST grid dimension (sequential per core): each
+    step lands one KV page — fetched straight from its slab position via
+    the page-table index map, dequantized here if int8 — into the
+    [C, H, D] VMEM scratch, and the final step runs the full-context
+    attention for this slot. Heads stay INSIDE the block (not a grid
+    dimension): the einsums below then carry the reference path's exact
+    head-batched contraction shapes, which is what keeps the kernel
+    bit-identical to multi_head_attention rather than merely allclose —
+    per-head 2D dots reassociate the same sums differently.
+    q_ref [1, T, H, D]; k_ref/v_ref [1, G, H, D]; bias_ref [1, 1, T, C];
+    out_ref [1, T, H, D].
+    """
+    s = pl.program_id(0)
+    j = pl.program_id(1)
+    k_blk = k_ref[0]
+    v_blk = v_ref[0]
+    if quantized:
+        pid = tables_ref[s, j]
+        k_blk = _dequant(k_blk, kscale_ref[pid], k_scr.dtype)
+        v_blk = _dequant(v_blk, vscale_ref[pid], v_scr.dtype)
+    k_scr[pl.ds(j * page, page), :, :] = k_blk
+    v_scr[pl.ds(j * page, page), :, :] = v_blk
+
+    @pl.when(j == n_pages - 1)
+    def _compute():
+        q = q_ref[0]                                         # [T, H, D]
+        d = q.shape[-1]
+        # the reference chain, verbatim (ops/attention.py
+        # multi_head_attention): f32-accumulated scores, the identical
+        # scale expression, additive bias, f32 softmax, cast-then-matmul
+        scores = jnp.einsum("qhd,khd->hqk", q, k_scr[...],
+                            preferred_element_type=jnp.float32)
+        scores = scores * (1.0 / jnp.sqrt(jnp.float32(d)))
+        scores = scores + bias_ref[0].astype(jnp.float32)    # [H, T, C]
+        weights = jax.nn.softmax(scores, axis=-1)
+        out = jnp.einsum("hqk,khd->qhd", weights.astype(q.dtype),
+                         v_scr[...])
+        out_ref[0] = out.astype(out_ref.dtype)
+
+
+def _pa_pallas(q, k_pages, v_pages, k_scale, v_scale, page_tables, bias,
+               quantized: bool, compute_dtype, interpret: bool):
+    S, T, H, D = q.shape
+    _, G, _, _ = k_pages.shape
+    Pmax = page_tables.shape[1]
+    C = Pmax * G
+    vma = gate.out_vma(q, k_pages, v_pages, page_tables, bias)
+    kv_spec = pl.BlockSpec(
+        (1, G, H, D),
+        lambda s, j, tables, ks, vs: (tables[s, j], 0, 0, 0),
+        memory_space=pltpu.VMEM)
+    q_spec = pl.BlockSpec((1, T, H, D),
+                          lambda s, j, tables, ks, vs: (s, 0, 0, 0),
+                          memory_space=pltpu.VMEM)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=3,   # page_tables, k_scale, v_scale
+        grid=(S, Pmax),
+        in_specs=[
+            q_spec,
+            kv_spec,
+            kv_spec,
+            pl.BlockSpec((1, 1, T, C),
+                         lambda s, j, tables, ks, vs: (s, 0, 0, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=q_spec,
+        scratch_shapes=[
+            pltpu.VMEM((C, H, D), compute_dtype),
+            pltpu.VMEM((C, H, D), compute_dtype),
+        ],
+    )
+    return pl.pallas_call(
+        functools.partial(_pa_kernel, n_pages=Pmax, page=G,
+                          quantized=quantized),
+        grid_spec=grid_spec,
+        out_shape=compat.shape_dtype_struct((S, T, H, D), q.dtype, vma=vma),
+        interpret=interpret,
+    )(page_tables, k_scale, v_scale, q, k_pages, v_pages,
+      jnp.broadcast_to(bias, (S, 1, T, C)))
+
+
+def _pa_gather(q, k_pages, v_pages, k_scale, v_scale, page_tables, bias,
+               quantized: bool, compute_dtype):
+    """The pre-kernel op chain, verbatim: materialize the contiguous
+    context with a page gather, then the shared attention primitive.
+    This IS the fallback (CPU tier, non-Mosaic mesh contexts) and the
+    bit-identity reference the kernel is asserted against."""
+    S, T, H, D = q.shape
+    G = k_pages.shape[1]
+    C = page_tables.shape[1] * G
+    if quantized:
+        k_pages = _dequant(k_pages, k_scale, compute_dtype)
+        v_pages = _dequant(v_pages, v_scale, compute_dtype)
+    ck = k_pages[page_tables].reshape(S, C, H, D)
+    cv = v_pages[page_tables].reshape(S, C, H, D)
+    return multi_head_attention(q, ck, cv, bias)
+
+
+def paged_attention(q: jax.Array, k_pages: jax.Array, v_pages: jax.Array,
+                    k_scale: jax.Array, v_scale: jax.Array,
+                    page_tables: jax.Array, bias: jax.Array, *,
+                    quantized: bool = False,
+                    compute_dtype=None,
+                    impl: str = "auto",
+                    interpret: bool = False) -> jax.Array:
+    """Attention of [S, T, H, D] queries over paged KV, through the
+    page table — one layer's context read of the serving programs.
+
+    k_pages/v_pages: [P, G, H, D] slab planes (compute dtype, or int8
+    with quantized=True); k_scale/v_scale: [P] f32 per-page symmetric
+    scales (ignored unless quantized); page_tables: [S, Pmax] int32
+    (tails point at the reserved null page 0); bias: additive f32 mask
+    broadcastable to [S, 1, T, C], C = Pmax*G — validity and causality
+    are entirely the caller's bias, exactly like multi_head_attention.
+
+    impl='auto' follows the package gate (Mosaic kernel on TPU when the
+    page size is sublane-aligned, gather fallback elsewhere); 'pallas'
+    and 'gather' force a path; interpret runs the forced kernel in the
+    pallas interpreter (CPU bit-identity tests).
+    """
+    if impl not in IMPLS:
+        raise ValueError(f"impl must be one of {IMPLS}, got {impl!r}")
+    G = k_pages.shape[1]
+    if compute_dtype is None:
+        compute_dtype = q.dtype
+    if impl == "auto":
+        impl = "pallas" if gate.use_pallas(interpret) \
+            and paged_eligible(G) else "gather"
+    if impl == "pallas":
+        if not paged_eligible(G):
+            raise ValueError(
+                f"page size {G} is not sublane-aligned "
+                f"({SUBLANES}); use impl='gather'")
+        return _pa_pallas(q, k_pages, v_pages, k_scale, v_scale,
+                          page_tables, bias, quantized, compute_dtype,
+                          interpret)
+    return _pa_gather(q, k_pages, v_pages, k_scale, v_scale, page_tables,
+                      bias, quantized, compute_dtype)

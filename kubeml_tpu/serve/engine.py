@@ -68,6 +68,8 @@ SERVE_PATH_VARIANTS = (
     "prefix_cache_miss",        # cold cache: pages written, then registered
     "prefix_cache_hit",         # warm cache: shared pages, prefill skipped
     "prefix_cow_split",         # write into a shared page copies it first
+    "pallas_paged",             # pallas paged-attention kernel vs gather
+    "int8_kv",                  # int8 KV pages: quantize-on-write path
 )
 
 # Every hot-swap path variant MUST have a quoted-name test in tests/
@@ -154,14 +156,25 @@ class DecodeEngine:
                  prefill_budget: Optional[int] = None,
                  tracer=None, flight_steps: int = 256,
                  decode_span_every: int = 16,
-                 fault_plan=None, strict_pager: bool = True):
+                 fault_plan=None, strict_pager: bool = True,
+                 kv_dtype: str = "f32", attn_impl: str = "auto",
+                 attn_interpret: bool = False):
         prefill_chunk = int(prefill_chunk)
         if prefill_chunk < 0:
             raise ValueError(
                 f"serve prefill chunk must be >= 0 (0 disables chunked "
                 f"prefill), got {prefill_chunk}")
         self.module = module
-        self._step_raw = build_paged_decode_step(module)  # validates module
+        # KV storage mode + attention dispatch (pager.py / ops/pallas
+        # paged_attention): both are knobs of the two persistent
+        # programs, so they live here and every derived engine
+        # (spawn_recovered, fleet re-spawn) must inherit them.
+        self.kv_dtype = kv_dtype
+        self.attn_impl = attn_impl
+        self.attn_interpret = bool(attn_interpret)
+        # validates module + kv_dtype + attn_impl
+        self._step_raw = build_paged_decode_step(
+            module, kv_dtype, attn_impl, self.attn_interpret)
         self.geom = geom or PageGeometry.for_module(
             slots=slots, page=page, max_len=module.max_len)
         self.clock = clock
@@ -174,16 +187,17 @@ class DecodeEngine:
                 f"prefill budget must be >= 1, got {self.prefill_budget}")
         head_dim = module.hidden // module.heads
         self.slab = KVPageSlab(self.geom, module.layers, module.heads,
-                               head_dim, module.dtype)
+                               head_dim, module.dtype, kv_dtype=kv_dtype)
         self.pager = PageAllocator(self.geom)
         # donating the slab buffers keeps HBM flat across steps; the CPU
         # backend warns (donation unimplemented), so gate on backend
-        donate = () if jax.default_backend() == "cpu" else (1, 2, 3)
+        donate = () if jax.default_backend() == "cpu" else (1, 2, 3, 4, 5)
         self._step = jax.jit(self._step_raw, donate_argnums=donate)
         self._prefill = None
         if prefill_chunk > 0:
             self._prefill = jax.jit(
-                build_paged_prefill_step(module, prefill_chunk),
+                build_paged_prefill_step(module, prefill_chunk, kv_dtype,
+                                         attn_impl, self.attn_interpret),
                 donate_argnums=donate)
         # weight generations: params are per-slot DATA, not program
         # state — every generation's params pytree has identical
@@ -238,6 +252,7 @@ class DecodeEngine:
             "prefix_hits": 0, "prefix_misses": 0, "cow_splits": 0,
             "weight_swaps": 0, "generations_retired": 0,
             "poisoned": 0, "deadline_expired": 0, "page_leaks": 0,
+            "kv_bytes": 0,
         }
 
     # ------------------------------------------------------------- capacity
@@ -253,6 +268,14 @@ class DecodeEngine:
 
     def kv_utilization(self) -> float:
         return self.pager.utilization()
+
+    @property
+    def kv_bytes_per_token(self) -> int:
+        """Deterministic HBM bytes one decoded token moves through the
+        KV cache (pager.py decode_bytes_per_token): pure page
+        geometry x dtype, never a timer — the decode-bandwidth proxy
+        the kv_bytes stat, /prom counter, and bench arm all share."""
+        return self.slab.decode_bytes_per_token
 
     def prefill_backlog_tokens(self) -> int:
         """Prompt tokens admitted to slots but not yet prefilled — the
@@ -556,9 +579,11 @@ class DecodeEngine:
             in_chunk[j] = 1.0
         before = self._prefill._cache_size()
         t0 = self.clock()
-        self.slab.k, self.slab.v, self.slab.valid = self._prefill(
+        (self.slab.k, self.slab.v, self.slab.k_scale, self.slab.v_scale,
+         self.slab.valid) = self._prefill(
             self._params_by_gen[slot.gen],
-            self.slab.k, self.slab.v, self.slab.valid,
+            self.slab.k, self.slab.v, self.slab.k_scale,
+            self.slab.v_scale, self.slab.valid,
             jnp.asarray(tokens), jnp.asarray(pos),
             jnp.asarray(self._tables[s]), jnp.asarray(write_pages),
             jnp.asarray(write_offs), jnp.asarray(in_chunk))
@@ -612,7 +637,9 @@ class DecodeEngine:
             flight_steps=self.flight.capacity if self.flight else 0,
             decode_span_every=self.decode_span_every,
             fault_plan=self.fault_plan,
-            strict_pager=self.strict_pager)
+            strict_pager=self.strict_pager,
+            kv_dtype=self.kv_dtype, attn_impl=self.attn_impl,
+            attn_interpret=self.attn_interpret)
         eng.weight_generation = self.weight_generation
         eng._params_by_gen = dict(self._params_by_gen)
         eng.check_pager()
@@ -853,10 +880,12 @@ class DecodeEngine:
 
             before = self._step._cache_size()
             t0 = self.clock()
-            nxt, bad, self.slab.k, self.slab.v, self.slab.valid = \
+            (nxt, bad, self.slab.k, self.slab.v, self.slab.k_scale,
+             self.slab.v_scale, self.slab.valid) = \
                 self._step(
                     self._params_by_gen[gen],
-                    self.slab.k, self.slab.v, self.slab.valid,
+                    self.slab.k, self.slab.v, self.slab.k_scale,
+                    self.slab.v_scale, self.slab.valid,
                     jnp.asarray(tokens), jnp.asarray(pos),
                     jnp.asarray(self._tables), jnp.asarray(write_page),
                     jnp.asarray(write_off), jnp.asarray(active),
@@ -871,6 +900,11 @@ class DecodeEngine:
             self.stats["compiles"] += int(compiled)
             self.stats["occupancy_sum"] += len(members)
             self.stats["decode_tokens"] += len(members)
+            # decode-bandwidth proxy: every decode-phase lane reads its
+            # whole paged context once per layer (geometry x dtype —
+            # deterministic, no timers)
+            self.stats["kv_bytes"] += \
+                len(members) * self.slab.decode_bytes_per_token
             nxt_host = np.asarray(nxt)
             bad_host = np.asarray(bad)
 

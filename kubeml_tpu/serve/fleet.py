@@ -1097,6 +1097,13 @@ class ServeFleet:
             + tot("serve_poisoned_total"),
             "serve_deadline_total": self._retired["deadline"]
             + tot("serve_deadline_total"),
+            # decode bandwidth: one engine config per fleet (the
+            # factory stamps every replica), so the mode and per-token
+            # proxy are representative, not summed
+            "serve_kv_dtype": next(
+                (snaps[i]["serve_kv_dtype"] for i in idxs), "f32"),
+            "serve_kv_bytes_per_token": next(
+                (snaps[i]["serve_kv_bytes_per_token"] for i in idxs), 0),
             # fleet routing / scaling surface
             "fleet_replicas": len(live),
             "fleet_replicas_min": self.replicas_min,

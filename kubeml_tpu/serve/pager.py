@@ -113,9 +113,17 @@ class PageGeometry:
                    pages=pages or slots * pps + 1, pages_per_slot=pps)
 
 
+# the serving KV storage dtypes the engine/CLI accept (--serve-kv-dtype):
+# "f32" is the full-precision leg — pages stay in the module's own KV
+# dtype (float32 models store f32, bfloat16 models bf16), the behavior
+# every PR-8..14 bit-identity test pins; "int8" quantizes pages with one
+# symmetric f32 scale per (layer, page) sidecar row.
+KV_DTYPES = ("f32", "int8")
+
+
 class KVPageSlab:
     """The device-resident arrays: K/V pages for every layer plus the
-    shared per-page validity plane.
+    shared per-page validity plane and (int8 mode) per-page scales.
 
     k/v: [L, P, G, H, Dh] in the module dtype — the jitted step scatters
     one token row per active slot per dispatch and gathers each slot's
@@ -123,19 +131,65 @@ class KVPageSlab:
     1.0 where a real (non-pad, active) token was written; multiplied
     into the attention bias so null/stale positions read as masked, not
     as garbage.
+
+    kv_dtype="int8" stores k/v as int8 with per-page SYMMETRIC scales
+    (the PR-7 EFInt8 convention: scale = amax/127, value = q * scale)
+    in k_scale/v_scale [L, P] float32 sidecars. The sidecars exist in
+    both modes (all-zero and inert under "f32") so the decode/prefill
+    step signatures — and therefore the two-compile pin — are identical
+    across kv dtypes. Scales ride every page lifecycle event with their
+    page: copy-on-write duplicates them in the same dispatch, prefix
+    hits share them (the page id indexes both slab and sidecar), and
+    eviction/drop_generation need no device work — a reused page's
+    first write (offset 0) resets its scale on device.
     """
 
     def __init__(self, geom: PageGeometry, layers: int, heads: int,
-                 head_dim: int, dtype=jnp.bfloat16):
+                 head_dim: int, dtype=jnp.bfloat16, kv_dtype: str = "f32"):
+        if kv_dtype not in KV_DTYPES:
+            raise ValueError(
+                f"serve kv_dtype must be one of {KV_DTYPES}, "
+                f"got {kv_dtype!r}")
         self.geom = geom
+        self.kv_dtype = kv_dtype
+        self.quantized = kv_dtype == "int8"
         shape = (layers, geom.pages, geom.page, heads, head_dim)
-        self.k = jnp.zeros(shape, dtype)
-        self.v = jnp.zeros(shape, dtype)
+        store = jnp.int8 if self.quantized else dtype
+        self.k = jnp.zeros(shape, store)
+        self.v = jnp.zeros(shape, store)
+        self.k_scale = jnp.zeros((layers, geom.pages), jnp.float32)
+        self.v_scale = jnp.zeros((layers, geom.pages), jnp.float32)
         self.valid = jnp.zeros((geom.pages, geom.page), jnp.float32)
 
     @property
     def device_bytes(self) -> int:
-        return int(self.k.nbytes + self.v.nbytes + self.valid.nbytes)
+        return int(self.k.nbytes + self.v.nbytes + self.valid.nbytes
+                   + self.k_scale.nbytes + self.v_scale.nbytes)
+
+    @property
+    def decode_bytes_per_token(self) -> int:
+        """Deterministic HBM bytes-per-decoded-token proxy (the PR-7
+        comm-proxy discipline: computed from page geometry + dtype,
+        never timers, so decode-bandwidth regressions stay assertable
+        on the CPU tier with the accelerator relay down).
+
+        One decode dispatch row reads the slot's whole context through
+        the page table (K and V, every layer), writes one token row
+        back, and in int8 mode additionally moves the per-page scale
+        sidecars — so per decoded token:
+
+            L * (2*(C+1)*H*Dh*itemsize  [context read + row write]
+                 + int8? 2*4*(Pmax+1))  [scale reads + scale write]
+
+        The int8/f32 ratio is ~itemsize(f32)/1 (~4x for f32 models,
+        the bench arm's >= 3.5x self-assert).
+        """
+        L, _, _, H, Dh = self.k.shape
+        per_layer = 2 * (self.geom.context + 1) * H * Dh \
+            * self.k.dtype.itemsize
+        if self.quantized:
+            per_layer += 2 * 4 * (self.geom.pages_per_slot + 1)
+        return int(L * per_layer)
 
 
 class PageAllocator:

@@ -879,6 +879,10 @@ class ServeService:
             "serve_engine_restarts": self.restarts_total,
             "serve_poisoned_total": self.poisoned_total,
             "serve_deadline_total": self.deadline_total,
+            # decode bandwidth: KV storage mode + the deterministic
+            # bytes-per-token proxy (geometry x dtype) for `kubeml top`
+            "serve_kv_dtype": self.engine.kv_dtype,
+            "serve_kv_bytes_per_token": self.engine.kv_bytes_per_token,
         }
 
     def _publish(self) -> None:
@@ -900,7 +904,8 @@ class ServeService:
                     ("prefix_hits", self.metrics.note_serve_prefix_hits),
                     ("prefix_misses",
                      self.metrics.note_serve_prefix_misses),
-                    ("page_leaks", self.metrics.note_serve_page_leaks)):
+                    ("page_leaks", self.metrics.note_serve_page_leaks),
+                    ("kv_bytes", self.metrics.note_serve_kv_bytes)):
                 cur = int(self.engine.stats[stat])
                 delta = cur - self._counters_seen.get(stat, 0)
                 if delta > 0:

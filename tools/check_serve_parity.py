@@ -3,8 +3,9 @@
 
 The serving engine (kubeml_tpu/serve/engine.py) runs one logical
 decode contract over several physical paths: token-by-token prefill,
-the chunked-prefill program, prefix-cache hits and misses, and
-copy-on-write page splits. Each is a throughput lever that promises
+the chunked-prefill program, prefix-cache hits and misses,
+copy-on-write page splits, the Pallas paged-attention kernel
+(pallas_paged), and int8 KV pages (int8_kv). Each is a lever that promises
 TOKEN-FOR-TOKEN identical output to the others — a path without a test
 making that claim is an unverified fast path. So this lint walks the
 SERVE_PATH_VARIANTS tuple in engine.py and fails unless each name
