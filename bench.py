@@ -418,6 +418,7 @@ def main():
     serving_fleet = _measure_serving_fleet_arm()
     serving_fleet_faulted = _measure_serving_fleet_faulted_arm()
     serving_decode_bw = _measure_serving_decode_bw_arm()
+    serving_spec = _measure_serving_spec_arm()
     cluster = _measure_cluster_arm()
     continual = _measure_continual_arm()
 
@@ -595,6 +596,16 @@ def main():
         # independent (solo == concurrent), and int8-vs-f32 greedy
         # divergence bounded.
         "serving_decode_bw": serving_decode_bw,
+        # decode-amortization arm (models/gpt.py multi-step scan +
+        # spec verify, serve/engine.py steady-state scheduler): decode
+        # launch cost measured with the deterministic dispatch proxies
+        # (dispatches_per_token, accepted_per_dispatch — counters,
+        # never timers). Self-asserts: the K-step fused program lands
+        # dispatches_per_token == 1/K EXACTLY with tokens bit-identical
+        # to K single steps, self-draft speculation clears > 1 accepted
+        # token per verify dispatch while staying bit-identical to the
+        # plain engine, and each leg's program inventory compiles once.
+        "serving_spec": serving_spec,
         # cluster-allocator arm (control/cluster.py): a deterministic
         # fake-clock saturation replay — three wide priority-0 batch
         # gangs fill the pool, four narrow priority-1 prod jobs burst
@@ -1282,6 +1293,120 @@ def _measure_serving_decode_bw_arm() -> dict:
         "int8_solo_vs_concurrent_bit_identical": True,
         "int8_first_token_agreement": f"{first_agree}/{len(prompts)}",
         "int8_token_agreement_pct": round(100.0 * agree / n_tok, 1),
+        "wall_s": round(elapsed, 3),
+    }
+
+
+def _measure_serving_spec_arm() -> dict:
+    """Decode-amortization arm (PR 16): multi-step decode scan + draft
+    speculation, measured with the DETERMINISTIC dispatch proxies
+    (engine.dispatches_per_token / engine.accepted_per_dispatch —
+    pure counters), never a timer, so every number is exact on the CPU
+    tier. Self-asserted pins:
+
+    - multi-step leg: a stream that is in the all-decode steady state
+      from its first step (one-token prompt: nothing to prefill)
+      emits EVERY token from the fused scan, so
+      dispatches_per_token == 1/K exactly, tokens BIT-IDENTICAL to
+      the K=1 engine, and the only program that ever compiles is the
+      multi-step scan;
+    - speculative leg: a self-draft on a repetitive greedy corpus
+      accepts its whole window, clearing > 1.0 accepted tokens per
+      verify dispatch and < 1.0 dispatches per token, with tokens
+      BIT-IDENTICAL to the plain engine and a one-compile-per-program
+      {prefill, decode, verify} inventory."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from kubeml_tpu.models.gpt import GPTMini, GPTModule
+    from kubeml_tpu.serve.engine import DecodeEngine
+    from kubeml_tpu.serve.slots import GenerateRequest
+
+    PAGE, NEW_TOKENS, K = 16, 16, 4
+
+    class F32GPT(GPTMini):
+        """gpt-nano-sized blocks in f32 (see the decode-bw arm)."""
+
+        def build(self):
+            return GPTModule(vocab_size=512, max_len=128, hidden=32,
+                             layers=2, heads=2, ffn=64, dropout=0.0,
+                             dtype=jnp.float32)
+
+    model = F32GPT()
+    module = model.module
+    variables = model.init_variables(
+        jax.random.PRNGKey(0),
+        {"x": np.ones((1, module.max_len), np.int32)})
+    # multi-step leg: a one-token prompt has nothing to prefill, so
+    # the stream is in the all-decode steady state from its first
+    # step and EVERY token comes out of the fused scan. (A longer
+    # prompt's first continuation token rides the single-step program
+    # in the same engine step its prefill chunk lands, which is
+    # correct scheduling but off the exact 1/K floor.)
+    # spec leg: a strongly periodic prompt keeps the greedy
+    # continuation predictable for the draft.
+    steady_prompt = [7]
+    repetitive_prompt = [7, 8, 9] * 3
+
+    def run(prompt, **kw):
+        eng = DecodeEngine(module, variables, slots=2, page=PAGE,
+                           prefill_chunk=PAGE, **kw)
+        req = GenerateRequest(list(prompt), max_new_tokens=NEW_TOKENS,
+                              temperature=0.0, seed=0)
+        eng.attach(req)
+        while eng.active():
+            eng.step()
+        assert req.outcome == "ok"
+        return eng, list(req.tokens)
+
+    t0 = time.perf_counter()
+    b_eng, b_toks = run(steady_prompt)           # K=1 baseline
+    m_eng, m_toks = run(steady_prompt, decode_steps=K)
+    r_eng, r_toks = run(repetitive_prompt)       # spec baseline
+    s_eng, s_toks = run(repetitive_prompt, draft_module=module,
+                        draft_variables=variables)
+    elapsed = time.perf_counter() - t0
+
+    # pin 1: the fused scan is the ONLY decode program that ran —
+    # dispatches_per_token hits the 1/K floor exactly, bit-identically
+    assert m_toks == b_toks, "multi-step scan changed decoded tokens"
+    np.testing.assert_array_equal(np.asarray(m_toks), np.asarray(b_toks))
+    assert m_eng.stats["multi_step_dispatches"] == NEW_TOKENS // K
+    assert m_eng.stats["compiles"] == 0          # single-step never ran
+    assert m_eng.stats["prefill_dispatches"] == 0
+    assert m_eng.stats["multi_step_compiles"] == 1
+    assert m_eng.dispatches_per_token == 1.0 / K, \
+        f"dispatches/token {m_eng.dispatches_per_token} != 1/{K}"
+    assert b_eng.dispatches_per_token == 1.0
+
+    # pin 2: speculation amortizes > 1 token per verify dispatch and
+    # never changes what the target would have said
+    assert s_toks == r_toks, "speculative decode changed tokens"
+    np.testing.assert_array_equal(np.asarray(s_toks), np.asarray(r_toks))
+    assert s_eng.stats["verify_dispatches"] > 0
+    assert s_eng.accepted_per_dispatch > 1.0, \
+        f"accepted/dispatch {s_eng.accepted_per_dispatch} <= 1"
+    assert s_eng.dispatches_per_token < 1.0
+    assert s_eng.stats["compiles"] <= 1
+    assert s_eng.stats["verify_compiles"] == 1
+    assert s_eng.stats["prefill_compiles"] == 1
+
+    return {
+        "model": "gpt-nano-f32", "page": PAGE,
+        "new_tokens": NEW_TOKENS, "decode_steps": K,
+        "spec_steps": int(s_eng.spec_steps),
+        "baseline_dispatches_per_token": 1.0,
+        "multi_step_dispatches_per_token": m_eng.dispatches_per_token,
+        "multi_step_tokens_bit_identical": True,
+        "spec_dispatches_per_token": round(
+            s_eng.dispatches_per_token, 4),
+        "spec_accepted_per_dispatch": round(
+            s_eng.accepted_per_dispatch, 4),
+        "spec_draft_tokens": int(s_eng.stats["draft_tokens"]),
+        "spec_accepted_tokens": int(s_eng.stats["accepted_tokens"]),
+        "spec_rejected_tokens": int(s_eng.stats["rejected_tokens"]),
+        "spec_tokens_bit_identical": True,
         "wall_s": round(elapsed, 3),
     }
 

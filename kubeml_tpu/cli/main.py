@@ -394,6 +394,19 @@ def _render_top(doc: dict) -> str:
                 f"decode bw: "
                 f"{latest.get('serve_kv_bytes_per_token', 0):g} B/token  "
                 f"kv dtype {latest.get('serve_kv_dtype', 'f32')}")
+        if latest.get("serve_dispatches_per_token") is not None:
+            # decode amortization pane: dispatches per emitted token
+            # (1.0 = one program launch per token; <1.0 means multi-step
+            # or speculative decode is amortizing launches) plus the
+            # speculative accept rate when a verify program is live
+            amort = (f"decode amortization: "
+                     f"{latest.get('serve_dispatches_per_token', 0):g} "
+                     f"dispatches/token")
+            if latest.get("serve_accepted_per_dispatch"):
+                amort += (f"  accepted "
+                          f"{latest.get('serve_accepted_per_dispatch', 0):g}"
+                          f"/verify")
+            lines.append(amort)
         if latest.get("serve_engine_restarts") is not None:
             # fault pane: supervisor restarts, quarantined poisoners,
             # deadline expiries — all zero on a healthy replica
@@ -574,6 +587,8 @@ def cmd_serve(args):
                                serve_queue_depth=args.serve_queue_depth,
                                serve_prefill_chunk=args.serve_prefill_chunk,
                                serve_kv_dtype=args.serve_kv_dtype,
+                               serve_decode_steps=args.serve_decode_steps,
+                               serve_draft_model=args.serve_draft_model,
                                serve_prefix_cache=_prefix_cache_opt(args),
                                serve_drain_grace_s=args.serve_drain_grace_s,
                                serve_replicas_min=args.serve_replicas_min,
@@ -614,6 +629,8 @@ def cmd_serve(args):
                               serve_queue_depth=args.serve_queue_depth,
                               serve_prefill_chunk=args.serve_prefill_chunk,
                               serve_kv_dtype=args.serve_kv_dtype,
+                              serve_decode_steps=args.serve_decode_steps,
+                              serve_draft_model=args.serve_draft_model,
                               serve_prefix_cache=_prefix_cache_opt(args),
                               serve_drain_grace_s=args.serve_drain_grace_s,
                               serve_replicas_min=args.serve_replicas_min,
@@ -942,6 +959,20 @@ def build_parser() -> argparse.ArgumentParser:
                         "baseline), int8 quantizes pages on write with "
                         "per-page scales, cutting decode HBM traffic "
                         "~4x (KUBEML_SERVE_KV_DTYPE, default f32)")
+    s.add_argument("--serve-decode-steps", type=int, default=None,
+                   metavar="K",
+                   help="fused decode steps per dispatch in the all-"
+                        "decode steady state: K>1 compiles a scan-over-K"
+                        " decode program that emits K tokens per "
+                        "dispatch, bit-identical to K single steps "
+                        "(KUBEML_SERVE_DECODE_STEPS, default 1)")
+    s.add_argument("--serve-draft-model", default=None, metavar="NAME",
+                   help="registered model used as the speculative-decode"
+                        " draft: it proposes tokens that one target "
+                        "verify dispatch scores, amortizing dispatches "
+                        "per token; emitted tokens stay bit-identical "
+                        "to the target model alone "
+                        "(KUBEML_SERVE_DRAFT_MODEL, default off)")
     s.add_argument("--serve-prefix-cache", choices=("on", "off"),
                    default=None,
                    help="share full prompt pages across /generate "

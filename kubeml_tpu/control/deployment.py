@@ -73,6 +73,8 @@ def start_deployment(mesh=None, controller_port: int = 0,
                      serve_queue_depth: Optional[int] = None,
                      serve_prefill_chunk: Optional[int] = None,
                      serve_kv_dtype: Optional[str] = None,
+                     serve_decode_steps: Optional[int] = None,
+                     serve_draft_model: Optional[str] = None,
                      serve_prefix_cache: Optional[bool] = None,
                      serve_drain_grace_s: Optional[float] = None,
                      serve_replicas_min: Optional[int] = None,
@@ -113,6 +115,8 @@ def start_deployment(mesh=None, controller_port: int = 0,
                          serve_queue_depth=serve_queue_depth,
                          serve_prefill_chunk=serve_prefill_chunk,
                          serve_kv_dtype=serve_kv_dtype,
+                         serve_decode_steps=serve_decode_steps,
+                         serve_draft_model=serve_draft_model,
                          serve_prefix_cache=serve_prefix_cache,
                          serve_drain_grace_s=serve_drain_grace_s,
                          serve_replicas_min=serve_replicas_min,

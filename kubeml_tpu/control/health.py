@@ -343,6 +343,11 @@ _SAMPLE_FIELDS = ("train_loss", "validation_loss", "accuracy",
                   # deterministic bytes-per-token proxy for the top
                   # "decode bw" line
                   "serve_kv_dtype", "serve_kv_bytes_per_token",
+                  # decode amortization (PR 16): deterministic
+                  # dispatch-count proxies for multi-step / speculative
+                  # decode — the top "decode amortization" line
+                  "serve_dispatches_per_token",
+                  "serve_accepted_per_dispatch",
                   # serving-fleet telemetry (serve/fleet.py): replica
                   # count + router/autoscaler counters ride the merged
                   # serve:<model> sample; the per-replica prefix

@@ -282,6 +282,8 @@ class ParameterServer(JsonService):
                  serve_hbm_budget_mb: Optional[float] = None,
                  serve_prefill_chunk: Optional[int] = None,
                  serve_kv_dtype: Optional[str] = None,
+                 serve_decode_steps: Optional[int] = None,
+                 serve_draft_model: Optional[str] = None,
                  serve_prefix_cache: Optional[bool] = None,
                  serve_drain_grace_s: Optional[float] = None,
                  serve_replicas_min: Optional[int] = None,
@@ -353,6 +355,15 @@ class ParameterServer(JsonService):
         self.serve_kv_dtype = str(
             serve_kv_dtype if serve_kv_dtype is not None
             else os.environ.get("KUBEML_SERVE_KV_DTYPE", "f32"))
+        # decode latency (PR 16): K fused decode steps per dispatch
+        # (1 = single-step), and an optional draft model id enabling
+        # speculative decoding (engine builds the verify program)
+        self.serve_decode_steps = int(
+            serve_decode_steps if serve_decode_steps is not None
+            else os.environ.get("KUBEML_SERVE_DECODE_STEPS", "1"))
+        self.serve_draft_model = str(
+            serve_draft_model if serve_draft_model is not None
+            else os.environ.get("KUBEML_SERVE_DRAFT_MODEL", ""))
         if serve_prefix_cache is None:
             serve_prefix_cache = os.environ.get(
                 "KUBEML_SERVE_PREFIX_CACHE", "on").lower() \
@@ -847,10 +858,12 @@ class ParameterServer(JsonService):
     def _serve_replica_factory(self, model_id: str):
         """Replica builder for the model's fleet (serve/fleet.py): one
         call builds one UNSTARTED ServeService over a fresh DecodeEngine
-        — exactly two jitted programs per replica. Called at fleet
-        start, on autoscaler grows, and on cold starts from zero, so it
-        re-reads the checkpoint cache each time (a replica born after a
-        hot-swap starts on the newest weights)."""
+        — the exact documented program inventory per replica (decode,
+        prefill, plus multi-step and/or verify when those knobs are
+        set). Called at fleet start, on autoscaler grows, and on cold
+        starts from zero, so it re-reads the checkpoint cache each time
+        (a replica born after a hot-swap starts on the newest
+        weights)."""
         from kubeml_tpu.serve.engine import DecodeEngine
         from kubeml_tpu.serve.pager import PageGeometry
         from kubeml_tpu.serve.service import ServeService
@@ -859,6 +872,14 @@ class ParameterServer(JsonService):
             model, variables = self._load_for_infer(model_id)
             module = getattr(model, "module", None)
             try:
+                draft_module = draft_variables = None
+                if self.serve_draft_model:
+                    # a missing/broken draft checkpoint or an
+                    # incompatible draft trunk is a client error like
+                    # any other bad serve knob, hence inside this try
+                    draft, draft_variables = self._load_for_infer(
+                        self.serve_draft_model)
+                    draft_module = getattr(draft, "module", None)
                 engine = DecodeEngine(
                     module, variables,
                     geom=PageGeometry.for_module(
@@ -867,6 +888,9 @@ class ParameterServer(JsonService):
                         max_len=module.max_len),
                     prefill_chunk=self.serve_prefill_chunk,
                     kv_dtype=self.serve_kv_dtype,
+                    decode_steps=self.serve_decode_steps,
+                    draft_module=draft_module,
+                    draft_variables=draft_variables,
                     prefix_cache=self.serve_prefix_cache,
                     # production posture: a pager invariant violation
                     # is logged and counted

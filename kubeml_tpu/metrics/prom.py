@@ -543,6 +543,24 @@ class MetricsRegistry:
             "kubeml_serve_kv_bytes_total",
             "KV-cache bytes moved by decode dispatches (deterministic "
             "geometry-based proxy), by served model", "model")
+        # decode latency (PR 16): speculative-decoding token flow —
+        # draft proposals in, verifier-accepted tokens out (accepted
+        # prefix + bonus pick per dispatch), proposals rolled back.
+        # Counters, never timers: accepted/verify_dispatches is the
+        # accepted_tokens_per_dispatch proxy the bench pins.
+        self.serve_draft_tokens_total = Counter(
+            "kubeml_serve_draft_tokens_total",
+            "Tokens proposed by the speculative draft model, by served "
+            "model", "model")
+        self.serve_accepted_tokens_total = Counter(
+            "kubeml_serve_accepted_tokens_total",
+            "Speculative tokens kept per verify dispatch (accepted "
+            "prefix plus the bonus target pick), by served model",
+            "model")
+        self.serve_rejected_tokens_total = Counter(
+            "kubeml_serve_rejected_tokens_total",
+            "Draft proposals rejected by the verifier and rolled back "
+            "as data, by served model", "model")
         # continual plane (PR 10): the weight generation new admissions
         # attach to (advances on every zero-downtime hot-swap), and the
         # continual job's data freshness — dataset generation trained
@@ -717,6 +735,9 @@ class MetricsRegistry:
                                 self.serve_poisoned_total,
                                 self.serve_page_leaks_total,
                                 self.serve_kv_bytes_total,
+                                self.serve_draft_tokens_total,
+                                self.serve_accepted_tokens_total,
+                                self.serve_rejected_tokens_total,
                                 self.serve_fleet_spills_total,
                                 self.serve_fleet_router_retries_total,
                                 self.serve_fleet_cold_starts_total,
@@ -883,6 +904,15 @@ class MetricsRegistry:
     def note_serve_kv_bytes(self, model: str, n: int) -> None:
         self.serve_kv_bytes_total.inc(model, n)
 
+    def note_serve_draft_tokens(self, model: str, n: int) -> None:
+        self.serve_draft_tokens_total.inc(model, n)
+
+    def note_serve_accepted_tokens(self, model: str, n: int) -> None:
+        self.serve_accepted_tokens_total.inc(model, n)
+
+    def note_serve_rejected_tokens(self, model: str, n: int) -> None:
+        self.serve_rejected_tokens_total.inc(model, n)
+
     def observe_serve_ttft_breakdown(self, model: str, queue: float,
                                      prefill: float,
                                      interleave: float) -> None:
@@ -973,6 +1003,9 @@ class MetricsRegistry:
                   self.serve_poisoned_total,
                   self.serve_page_leaks_total,
                   self.serve_kv_bytes_total,
+                  self.serve_draft_tokens_total,
+                  self.serve_accepted_tokens_total,
+                  self.serve_rejected_tokens_total,
                   self.serve_fleet_spills_total,
                   self.serve_fleet_router_retries_total,
                   self.serve_fleet_cold_starts_total,

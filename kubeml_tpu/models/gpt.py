@@ -792,6 +792,264 @@ def build_paged_prefill_step(module: GPTModule, chunk: int,
     return prefill
 
 
+def build_paged_multi_step_decode(module: GPTModule, steps: int,
+                                  kv_dtype: str = "f32",
+                                  attn_impl: str = "auto",
+                                  attn_interpret: bool = False):
+    """K decode step bodies fused into ONE jitted dispatch — the
+    serving plane's third persistent program (serve/engine.py), used
+    only in the all-decode steady state.
+
+    PR 15 cut decode HBM traffic; the dominant remaining per-token cost
+    is the host round-trip per dispatch. This builder lax.scans the
+    EXACT step function from build_paged_decode_step K times inside one
+    program, so the steady state pays one dispatch per K tokens
+    (dispatches_per_token == 1/K) with zero change to the math:
+
+      multi(params, k_pages, v_pages, k_scales, v_scales, valid_pages,
+            tokens[S], pos[S], page_tables[S, Pmax], live[S],
+            temps[S], seeds[S], eos_ids[S], budgets[S])
+        -> (out_tokens[K, S], out_bad[K, S], k_pages, v_pages,
+            k_scales, v_scales, valid_pages)
+
+    Bit-identity with K single dispatches is BY CONSTRUCTION, not by
+    argument: each scan iteration calls the single-step function with
+    inputs computed exactly as the engine's host loop computes them —
+    write_page/write_off from the page table and position, the
+    per-(seed, position) sampling key from seeds and the running pos —
+    and a lane that finishes mid-window (EOS, token budget, or the
+    non-finite guard) flips its `live` flag to 0, after which every
+    subsequent iteration masks its row to the same all-zeros inputs an
+    UNOCCUPIED slot presents (tokens 0, pos 0, null-page write, temp 0,
+    zero key). Early exit is data, so K never recompiles per finish
+    pattern. eos_ids carries -1 for requests without an EOS id;
+    budgets carries each lane's REMAINING token budget. The host walks
+    out_tokens row by row and stops at each lane's terminal condition
+    — discarded tail picks are garbage-by-design, exactly like an
+    inactive slot's pick in the single-step program.
+
+    Joins, CoW splits, chunked-prefill progress, multi-generation
+    drains, and fault injection all fall back to the single-step
+    program in the scheduler — this program never sees them.
+    """
+    steps = int(steps)
+    if steps < 2:
+        raise ValueError(
+            f"multi-step decode needs steps >= 2 (1 is the single-step "
+            f"program), got {steps}")
+    step = build_paged_decode_step(module, kv_dtype, attn_impl,
+                                   attn_interpret)
+
+    def multi(params, k_pages, v_pages, k_scales, v_scales, valid_pages,
+              tokens, pos, page_tables, live, temps, seeds, eos_ids,
+              budgets):
+        S = tokens.shape[0]
+        G = valid_pages.shape[1]
+        Pmax = page_tables.shape[1]
+        rows = jnp.arange(S)
+        zero_i = jnp.zeros(S, jnp.int32)
+        zero_f = jnp.zeros(S, jnp.float32)
+
+        def body(carry, _):
+            (tokens, pos, live, emitted,
+             k_pages, v_pages, k_scales, v_scales, valid_pages) = carry
+            on = live > 0
+            pi = jnp.clip(pos // G, 0, Pmax - 1)
+            write_page = jnp.where(on, page_tables[rows, pi], 0)
+            write_off = jnp.where(on, pos % G, 0)
+            # the engine's per-(request, position) key, built on device:
+            # uint32(seed), uint32(pos) — byte-identical to the host's
+            key_data = jnp.where(
+                on[:, None],
+                jnp.stack([seeds, pos.astype(jnp.uint32)], axis=1),
+                jnp.zeros((S, 2), jnp.uint32))
+            (nxt, bad, k_pages, v_pages, k_scales, v_scales,
+             valid_pages) = step(
+                params, k_pages, v_pages, k_scales, v_scales,
+                valid_pages,
+                jnp.where(on, tokens, 0), jnp.where(on, pos, 0),
+                page_tables, write_page, write_off,
+                live.astype(jnp.float32), jnp.where(on, temps, 0.0),
+                key_data, zero_i, zero_i, zero_f)
+            emitted = emitted + live
+            done = (((nxt == eos_ids) & (eos_ids >= 0))
+                    | (emitted >= budgets)
+                    | (bad > 0)).astype(jnp.int32)
+            tokens = jnp.where(on, nxt, tokens)
+            pos = jnp.where(on, pos + 1, pos)
+            live = live * (1 - done)
+            return (tokens, pos, live, emitted, k_pages, v_pages,
+                    k_scales, v_scales, valid_pages), (nxt, bad)
+
+        init = (tokens, pos, live, jnp.zeros(S, jnp.int32),
+                k_pages, v_pages, k_scales, v_scales, valid_pages)
+        carry, (out_tokens, out_bad) = lax.scan(
+            body, init, None, length=steps)
+        (_, _, _, _, k_pages, v_pages, k_scales, v_scales,
+         valid_pages) = carry
+        return (out_tokens, out_bad, k_pages, v_pages, k_scales,
+                v_scales, valid_pages)
+
+    return multi
+
+
+def build_paged_spec_verify_step(module: GPTModule,
+                                 draft_module: GPTModule,
+                                 steps: int, window: int,
+                                 kv_dtype: str = "f32",
+                                 attn_impl: str = "auto",
+                                 attn_interpret: bool = False):
+    """Draft-propose + target-verify + rollback-replay in ONE jitted
+    dispatch — the serving plane's fourth (and last) persistent
+    program (serve/engine.py).
+
+    Speculative decoding (Leviathan et al. 2023): a small DRAFT model
+    proposes K tokens per slot; the TARGET model scores all K+1
+    positions teacher-forced (the chunked-prefill trick — proposed
+    tokens are a chunk whose logits we keep); the accepted run is the
+    longest prefix where the target's own pick matches the proposal,
+    and the position after it gets the target's pick as a free bonus
+    token. Emitted tokens are therefore ALWAYS the target's picks under
+    the engine's per-(seed, position) keys — acceptance only decides
+    how many survive per dispatch, so output is bit-identical to the
+    non-speculative program at ANY temperature, not just greedy.
+
+      verify(params, draft_params, k_pages, v_pages, k_scales,
+             v_scales, valid_pages, window_toks[S, W], pos[S],
+             page_tables[S, Pmax], live[S], temps[S], seeds[S],
+             wlen[S])
+        -> (picks[K+1, S], bads[K+1, S], accepted[S], k_pages,
+            v_pages, k_scales, v_scales, valid_pages)
+
+    window_toks[s, :pos[s]+1] is slot s's full context (prompt +
+    emitted tokens); wlen[s] = min(K+1, remaining budget) caps how many
+    verify steps lane s may run. The STATELESS draft re-forwards the
+    whole window per proposed token (greedy, PAD masked) — no draft KV
+    cache, so the draft needs no pager, no catch-up after rejection,
+    and no fifth program.
+
+    Rollback is DATA, in the same dispatch, as two passes over the same
+    single-step function the decode program uses. Pass 1 teacher-forces
+    all wlen steps from the INPUT slab, committing every write (within-
+    window attention needs them) and keeping the picks; the resulting
+    slab is discarded. Pass 2 re-scans from the input slab with the
+    write mask narrowed to steps <= accepted — so the returned slab
+    holds exactly the writes a never-proposed run would have made.
+    Exactness (including int8 page scales, which requantize
+    sequentially on write and so cannot be row-restored) follows
+    because acceptance is a PREFIX and the causal mask hides positions
+    beyond a step's own: every accepted step sees the identical context
+    in both passes, hence writes identical bytes, hence the sequential
+    int8 requant chain replays exactly. The 2x target compute on
+    accepted steps is the price of bit-exact rollback; rejected steps'
+    pass-2 lanes mask to the null page like unoccupied slots.
+    """
+    steps = int(steps)
+    window = int(window)
+    if steps < 1:
+        raise ValueError(f"speculative decode needs steps >= 1, "
+                         f"got {steps}")
+    if draft_module.n_experts or draft_module.seq_axis is not None \
+            or draft_module.tp_axis is not None:
+        raise ValueError(
+            "speculative draft must be a dense GPT module (no MoE, "
+            "sequence-parallel, or manual-TP variants)")
+    if draft_module.vocab_size != module.vocab_size:
+        raise ValueError(
+            f"draft vocab ({draft_module.vocab_size}) must match the "
+            f"target vocab ({module.vocab_size})")
+    if window < 2 or window > module.max_len \
+            or window > draft_module.max_len:
+        raise ValueError(
+            f"verify window must be in [2, min(target max_len "
+            f"{module.max_len}, draft max_len {draft_module.max_len})], "
+            f"got {window}")
+    step = build_paged_decode_step(module, kv_dtype, attn_impl,
+                                   attn_interpret)
+
+    def verify(params, draft_params, k_pages, v_pages, k_scales,
+               v_scales, valid_pages, window_toks, pos, page_tables,
+               live, temps, seeds, wlen):
+        S, W = window_toks.shape
+        G = valid_pages.shape[1]
+        Pmax = page_tables.shape[1]
+        rows = jnp.arange(S)
+        zero_i = jnp.zeros(S, jnp.int32)
+        zero_f = jnp.zeros(S, jnp.float32)
+
+        # ---- draft proposes K tokens (greedy, full window re-forward
+        # per token — stateless, so rejection needs no draft rollback)
+        win = window_toks
+        props = []
+        for i in range(1, steps + 1):
+            lg = draft_module.apply({"params": draft_params}, win)
+            lg = lg[rows, jnp.clip(pos + i - 1, 0, W - 1)]
+            lg = lg.at[:, PAD_ID].set(-jnp.inf)
+            d = jnp.argmax(lg, axis=-1).astype(jnp.int32)
+            win = win.at[rows, jnp.clip(pos + i, 0, W - 1)].set(d)
+            props.append(d)
+        proposals = jnp.stack(props)                       # [K, S]
+
+        # teacher-forced inputs: the real token at pos, then proposals
+        inputs = jnp.concatenate(
+            [window_toks[rows, jnp.clip(pos, 0, W - 1)][None],
+             proposals], axis=0)                           # [K+1, S]
+
+        def run_pass(slabs, keep):
+            # keep(j) -> int32 [S]: lane liveness at verify step j; a
+            # masked lane presents the unoccupied-slot inputs, so its
+            # writes land on the null page
+            def body(carry, xs):
+                (k_pages, v_pages, k_scales, v_scales,
+                 valid_pages) = carry
+                tok, j = xs
+                on_i = keep(j)
+                on = on_i > 0
+                posj = pos + j
+                pi = jnp.clip(posj // G, 0, Pmax - 1)
+                write_page = jnp.where(on, page_tables[rows, pi], 0)
+                write_off = jnp.where(on, posj % G, 0)
+                key_data = jnp.where(
+                    on[:, None],
+                    jnp.stack([seeds, posj.astype(jnp.uint32)], axis=1),
+                    jnp.zeros((S, 2), jnp.uint32))
+                (nxt, bad, k_pages, v_pages, k_scales, v_scales,
+                 valid_pages) = step(
+                    params, k_pages, v_pages, k_scales, v_scales,
+                    valid_pages,
+                    jnp.where(on, tok, 0), jnp.where(on, posj, 0),
+                    page_tables, write_page, write_off,
+                    on_i.astype(jnp.float32),
+                    jnp.where(on, temps, 0.0),
+                    key_data, zero_i, zero_i, zero_f)
+                return (k_pages, v_pages, k_scales, v_scales,
+                        valid_pages), (nxt, bad)
+            return lax.scan(body, slabs,
+                            (inputs, jnp.arange(steps + 1)))
+
+        slabs0 = (k_pages, v_pages, k_scales, v_scales, valid_pages)
+        # pass 1: verify — all wlen steps write, picks kept, slab dropped
+        _, (picks, bads) = run_pass(
+            slabs0, lambda j: live * (j < wlen).astype(jnp.int32))
+
+        ok = ((picks[:steps] == proposals)
+              & (bads[:steps] == 0)).astype(jnp.int32)     # [K, S]
+        accepted = jnp.cumprod(ok, axis=0).sum(axis=0)
+        accepted = jnp.maximum(
+            jnp.minimum(accepted, wlen - 1), 0) * live
+
+        # pass 2: rollback-as-replay — only steps <= accepted write
+        final, _ = run_pass(
+            slabs0,
+            lambda j: live * ((j < wlen)
+                              & (j <= accepted)).astype(jnp.int32))
+        k_pages, v_pages, k_scales, v_scales, valid_pages = final
+        return (picks, bads, accepted, k_pages, v_pages, k_scales,
+                v_scales, valid_pages)
+
+    return verify
+
+
 def _lm_per_example(logits: jax.Array, x: jax.Array) -> jax.Array:
     """Per-sequence mean next-token cross-entropy [B] — THE LM loss
     definition shared by the dense and MoE model classes."""

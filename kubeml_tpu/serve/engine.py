@@ -1,13 +1,33 @@
 """Continuous-batching decode engine: slot state + the persistent steps.
 
-TWO jitted programs serve every stream (compile count pinned at exactly
-two by tests/test_serving.py, no matter how requests churn):
+An EXACT, documented inventory of jitted programs serves every stream
+(compile count pinned by tests/test_serving.py and
+tests/test_spec_decode.py, no matter how requests churn):
 
-  decode   — every dispatch advances every active slot by one token
-             (its own feedback, or its final prompt token);
-  prefill  — one slot per dispatch, C prompt tokens bulk-written into
-             its KV pages (fixed chunk size, padded + masked, so prompt
-             lengths never recompile).
+  decode     — every dispatch advances every active slot by one token
+               (its own feedback, or its final prompt token); always
+               built.
+  prefill    — one slot per dispatch, C prompt tokens bulk-written into
+               its KV pages (fixed chunk size, padded + masked, so
+               prompt lengths never recompile); built when
+               prefill_chunk > 0.
+  multi-step — K decode step bodies lax.scanned into one dispatch
+               (models/gpt.py build_paged_multi_step_decode); built
+               when decode_steps > 1 and selected only in the
+               all-decode steady state, where it cuts the host
+               round-trip cost to dispatches_per_token == 1/K with
+               bit-identical output.
+  verify     — draft-propose + target-verify + rollback-replay
+               (build_paged_spec_verify_step); built when a draft
+               module is configured. One dispatch emits the accepted
+               prefix plus one bonus token; rejected tokens roll back
+               positions, page-table cursors, and int8 page scales as
+               data inside the same dispatch.
+
+Any join, CoW split, pending prefill chunk, deadline reap, fault hook,
+or hot-swap drain falls back to the single-step decode program — the
+accelerated programs only ever see the steady state they were compiled
+for, so the inventory above is exhaustive and recompilation-free.
 
 A token-budget scheduler in step() interleaves the two: each engine
 step spends at most `prefill_budget` prompt tokens on prefill chunks
@@ -49,7 +69,9 @@ import numpy as np
 from kubeml_tpu.metrics.runtime import JitCompileTracker
 from kubeml_tpu.models.base import InferenceInputError
 from kubeml_tpu.models.gpt import (PAD_ID, build_paged_decode_step,
-                                   build_paged_prefill_step)
+                                   build_paged_multi_step_decode,
+                                   build_paged_prefill_step,
+                                   build_paged_spec_verify_step)
 from kubeml_tpu.serve.flight import FlightRecorder
 from kubeml_tpu.serve.pager import (KVPageSlab, PageAllocator, PageGeometry,
                                     chain_hash)
@@ -70,6 +92,10 @@ SERVE_PATH_VARIANTS = (
     "prefix_cow_split",         # write into a shared page copies it first
     "pallas_paged",             # pallas paged-attention kernel vs gather
     "int8_kv",                  # int8 KV pages: quantize-on-write path
+    "multi_step",               # K-step scan program vs K single steps
+    "spec_verify",              # speculative accept path vs generate
+    "spec_rollback",            # rejected tokens: pager state == never-
+                                # proposed run: cursors, free list, scales
 )
 
 # Every hot-swap path variant MUST have a quoted-name test in tests/
@@ -158,7 +184,9 @@ class DecodeEngine:
                  decode_span_every: int = 16,
                  fault_plan=None, strict_pager: bool = True,
                  kv_dtype: str = "f32", attn_impl: str = "auto",
-                 attn_interpret: bool = False):
+                 attn_interpret: bool = False,
+                 decode_steps: int = 1,
+                 draft_module=None, draft_variables=None):
         prefill_chunk = int(prefill_chunk)
         if prefill_chunk < 0:
             raise ValueError(
@@ -199,6 +227,48 @@ class DecodeEngine:
                 build_paged_prefill_step(module, prefill_chunk, kv_dtype,
                                          attn_impl, self.attn_interpret),
                 donate_argnums=donate)
+        # decode accelerators: the multi-step scan program and the
+        # speculative verify program — OPTIONAL members of the exact
+        # program inventory documented at the top of this module. The
+        # scheduler selects them only in the all-decode steady state;
+        # every other event keeps the single-step path.
+        decode_steps = int(decode_steps)
+        if decode_steps < 1:
+            raise ValueError(
+                f"serve decode steps must be >= 1, got {decode_steps}")
+        self.decode_steps = decode_steps
+        self._multi = None
+        if decode_steps > 1:
+            self._multi = jax.jit(
+                build_paged_multi_step_decode(
+                    module, decode_steps, kv_dtype, attn_impl,
+                    self.attn_interpret),
+                donate_argnums=donate)
+        # speculation depth K: decode_steps when raised past 1, else 4
+        # proposals per dispatch; the verify window is the largest
+        # context both trunks (and the page slab) can hold — slots
+        # whose cursor outruns it fall back to multi/single-step
+        self.draft_module = draft_module
+        self._verify = None
+        self._draft_params = None
+        self.spec_steps = 0
+        self.spec_window = 0
+        if draft_module is not None:
+            if draft_variables is None:
+                raise ValueError(
+                    "serving with a draft module needs draft_variables")
+            self.spec_steps = decode_steps if decode_steps > 1 else 4
+            self.spec_window = min(module.max_len, draft_module.max_len,
+                                   self.geom.context)
+            verify_donate = () if jax.default_backend() == "cpu" \
+                else (2, 3, 4, 5, 6)
+            self._verify = jax.jit(
+                build_paged_spec_verify_step(
+                    module, draft_module, self.spec_steps,
+                    self.spec_window, kv_dtype, attn_impl,
+                    self.attn_interpret),
+                donate_argnums=verify_donate)
+            self._draft_params = jax.device_put(draft_variables["params"])
         # weight generations: params are per-slot DATA, not program
         # state — every generation's params pytree has identical
         # shapes/dtypes, so dispatching different generations reuses the
@@ -242,8 +312,11 @@ class DecodeEngine:
         self._step_count = 0
         self._dispatch_wall_s = 0.0   # cumulative prefill+decode wall time
         self._shed_count = 0          # KV-exhaustion sheds (flight 'kind')
-        # "dispatches"/"compiles" are DECODE-only (the PR-6 meaning the
-        # bench and pinning tests rely on); prefill has its own lane
+        # "dispatches" counts EVERY decode-lane dispatch (single-step,
+        # multi-step, and verify — the denominator of
+        # dispatches_per_token); "compiles" stays single-step-program
+        # only (the PR-6 meaning the pinning tests rely on) — the
+        # accelerator programs have their own compile lanes below.
         self.stats: Dict[str, float] = {
             "dispatches": 0, "generated_tokens": 0, "occupancy_sum": 0,
             "stalls": 0, "compiles": 0,
@@ -253,6 +326,10 @@ class DecodeEngine:
             "weight_swaps": 0, "generations_retired": 0,
             "poisoned": 0, "deadline_expired": 0, "page_leaks": 0,
             "kv_bytes": 0,
+            "multi_step_dispatches": 0, "multi_step_compiles": 0,
+            "verify_dispatches": 0, "verify_compiles": 0,
+            "draft_tokens": 0, "accepted_tokens": 0,
+            "rejected_tokens": 0,
         }
 
     # ------------------------------------------------------------- capacity
@@ -276,6 +353,25 @@ class DecodeEngine:
         geometry x dtype, never a timer — the decode-bandwidth proxy
         the kv_bytes stat, /prom counter, and bench arm all share."""
         return self.slab.decode_bytes_per_token
+
+    @property
+    def dispatches_per_token(self) -> float:
+        """Decode dispatches per generated token — the host round-trip
+        amortization proxy. Counters only, never timers: 1.0 for pure
+        single-step decode, exactly 1/K in the multi-step steady state,
+        below 1/(K+1) when speculation accepts well. 0.0 before the
+        first generated token."""
+        toks = self.stats["generated_tokens"]
+        return (self.stats["dispatches"] / toks) if toks else 0.0
+
+    @property
+    def accepted_per_dispatch(self) -> float:
+        """Tokens emitted per speculative verify dispatch (accepted
+        prefix + the bonus target pick) — deterministic from counters.
+        > 1.0 means speculation is paying for itself; 0.0 before the
+        first verify dispatch."""
+        vd = self.stats["verify_dispatches"]
+        return (self.stats["accepted_tokens"] / vd) if vd else 0.0
 
     def prefill_backlog_tokens(self) -> int:
         """Prompt tokens admitted to slots but not yet prefilled — the
@@ -639,7 +735,12 @@ class DecodeEngine:
             fault_plan=self.fault_plan,
             strict_pager=self.strict_pager,
             kv_dtype=self.kv_dtype, attn_impl=self.attn_impl,
-            attn_interpret=self.attn_interpret)
+            attn_interpret=self.attn_interpret,
+            decode_steps=self.decode_steps,
+            draft_module=self.draft_module,
+            draft_variables=(
+                {"params": self._draft_params}
+                if self.draft_module is not None else None))
         eng.weight_generation = self.weight_generation
         eng._params_by_gen = dict(self._params_by_gen)
         eng.check_pager()
@@ -697,7 +798,11 @@ class DecodeEngine:
             "prefill_backlog": self.prefill_backlog_tokens(),
             "kv_pages": self.pager.in_use,
             "cow_splits": int(self.stats["cow_splits"] - c0),
-            "dispatches": pf + de,
+            # v2 schema (flight.FLIGHT_SCHEMA_VERSION): the lanes stay
+            # split — one multi-step/verify dispatch emits many tokens,
+            # so a prefill+decode sum would be uninterpretable
+            "prefill_dispatches": pf,
+            "decode_dispatches": de,
             "dispatch_s": round(self._dispatch_wall_s - w0, 9),
             "tokens": int(self.stats["generated_tokens"] - g0),
             "weight_generation": self.weight_generation,
@@ -722,6 +827,246 @@ class DecodeEngine:
                 "interleave": ttft - queue - prefill}
             args = dict(ttft=ttft, **req.ttft_breakdown)
         self._instant("first_token", t1, req, **args)
+
+    # --------------------------------------- multi-step / speculative
+    def _grant_range(self, s: int, start: int,
+                     count: int) -> Optional[List[int]]:
+        """Pre-grant the pages covering positions [start, start+count)
+        for slot s — the accelerated programs write up to K positions
+        ahead in one dispatch, so their page needs are known up front.
+        Returns the page-table indices newly granted, or None when the
+        pool ran dry (already rolled back — freeing re-sorts the pool,
+        so the free list matches never having tried)."""
+        G = self.geom.page
+        granted: List[int] = []
+        for pi in range(start // G, (start + count - 1) // G + 1):
+            if pi >= self.geom.pages_per_slot:
+                break
+            if self._tables[s, pi] == 0:
+                pid = self.pager.alloc()
+                if pid is None:
+                    self._ungrant(s, granted)
+                    return None
+                self._tables[s, pi] = pid
+                granted.append(pi)
+        return granted
+
+    def _ungrant(self, s: int, granted: List[int]) -> None:
+        for pi in granted:
+            self.pager.free([int(self._tables[s, pi])])
+            self._tables[s, pi] = 0
+
+    def _walk_emitted(self, s: int, toks, bads, k_max: int,
+                      t0: float, t1: float, finished) -> None:
+        """Host-side mirror of the device's per-lane early exit: emit
+        this lane's picks row by row until its own terminal condition
+        (non-finite guard, EOS, token budget), advancing pos exactly as
+        k_max single-step dispatches would have. toks/bads are the
+        lane's [k_max] device outputs; rows past the break are
+        garbage-by-design, like an inactive slot's pick."""
+        slot = self._slots[s]
+        live_steps = 0
+        released = False
+        for k in range(k_max):
+            p = slot.pos
+            slot.pos = p + 1
+            live_steps += 1
+            if bads[k] > 0:
+                req = slot.req
+                self.stats["poisoned"] += 1
+                self.release(s, "error",
+                             "non-finite logits at position "
+                             f"{p}; request poisoned and isolated")
+                finished.append(req)
+                released = True
+                break
+            if p <= slot.n_prompt - 1:
+                # the first fused step computed prompt context (the
+                # first-token step) — TTFT prefill-compute term
+                slot.prefill_s += t1 - t0
+            if self.prefix_cache:
+                self._register_full_pages(s, slot)
+            tok = int(toks[k])
+            if slot.req.first_token_at is None:
+                slot.req.first_token_at = t1
+                self._note_first_token(slot, t1)
+            slot.req.emit_token(tok)
+            self.stats["generated_tokens"] += 1
+            n_out = len(slot.req.tokens)
+            if self.tracer is not None and n_out > 1 \
+                    and n_out % self.decode_span_every == 0:
+                self._span("decode", t0, t1, slot.req, pos=p,
+                           token_index=n_out, cow=0)
+            if (slot.req.eos_id is not None
+                    and tok == slot.req.eos_id) \
+                    or len(slot.req.tokens) >= slot.req.max_new_tokens:
+                self.release(s, "ok")
+                finished.append(slot.req)
+                released = True
+                break
+        # retained decode work only: kv_bytes stays exactly
+        # decode_tokens x decode_bytes_per_token across every program
+        self.stats["decode_tokens"] += live_steps
+        self.stats["kv_bytes"] += \
+            live_steps * self.slab.decode_bytes_per_token
+
+    def _dispatch_multi(self, members: List[int], finished) -> bool:
+        """One multi-step dispatch covering every ready slot: K fused
+        decode steps, one host round-trip, bit-identical output.
+        Returns False (page grant rolled back, no other side effects)
+        when any slot cannot pre-grant its K-step page window — the
+        caller falls through to the single-step path for this round."""
+        K = self.decode_steps
+        S = self.geom.slots
+        grants: Dict[int, List[int]] = {}
+        for s in members:
+            slot = self._slots[s]
+            budget = slot.req.max_new_tokens - len(slot.req.tokens)
+            g = self._grant_range(s, slot.pos, min(K, max(budget, 1)))
+            if g is None:
+                for gs, gl in grants.items():
+                    self._ungrant(gs, gl)
+                return False
+            grants[s] = g
+        tokens = np.zeros(S, np.int32)
+        pos = np.zeros(S, np.int32)
+        live = np.zeros(S, np.int32)
+        temps = np.zeros(S, np.float32)
+        seeds = np.zeros(S, np.uint32)
+        eos_ids = np.full(S, -1, np.int32)
+        budgets = np.zeros(S, np.int32)
+        for s in members:
+            slot = self._slots[s]
+            live[s] = 1
+            tokens[s] = slot.prompt[slot.pos] \
+                if slot.pos < slot.n_prompt else slot.req.tokens[-1]
+            pos[s] = slot.pos
+            temps[s] = slot.req.temperature
+            seeds[s] = np.uint32(slot.req.seed & 0xFFFFFFFF)
+            if slot.req.eos_id is not None:
+                eos_ids[s] = slot.req.eos_id
+            budgets[s] = slot.req.max_new_tokens - len(slot.req.tokens)
+        before = self._multi._cache_size()
+        t0 = self.clock()
+        (toks, bads, self.slab.k, self.slab.v, self.slab.k_scale,
+         self.slab.v_scale, self.slab.valid) = self._multi(
+            self._params_by_gen[self.weight_generation],
+            self.slab.k, self.slab.v, self.slab.k_scale,
+            self.slab.v_scale, self.slab.valid,
+            jnp.asarray(tokens), jnp.asarray(pos),
+            jnp.asarray(self._tables), jnp.asarray(live),
+            jnp.asarray(temps), jnp.asarray(seeds),
+            jnp.asarray(eos_ids), jnp.asarray(budgets))
+        compiled = self._multi._cache_size() > before
+        t1 = self.clock()
+        self.compile_tracker.note(compiled, t1 - t0)
+        self._dispatch_wall_s += t1 - t0
+        self.stats["dispatches"] += 1
+        self.stats["multi_step_dispatches"] += 1
+        self.stats["multi_step_compiles"] += int(compiled)
+        self.stats["occupancy_sum"] += len(members)
+        toks_host = np.asarray(toks)
+        bads_host = np.asarray(bads)
+        for s in members:
+            self._walk_emitted(s, toks_host[:, s], bads_host[:, s], K,
+                               t0, t1, finished)
+        return True
+
+    def _dispatch_spec(self, members: List[int], finished) -> bool:
+        """One speculative verify dispatch covering every ready slot:
+        the draft proposes K tokens per lane, the target scores them
+        all teacher-forced, and the accepted prefix plus one bonus
+        target pick emits. Rejected tokens were already rolled back ON
+        DEVICE by the replay pass (KV bytes, validity, int8 scales), so
+        this method only rewinds the host cursors: pos stops at the
+        kept prefix and the speculative page grant is trimmed back to
+        it — freeing re-sorts the pool, so allocator state matches a
+        run that never proposed past the accepted point. Returns False
+        (grant rolled back) when any lane's window or page grant does
+        not fit; the caller falls back to multi/single-step."""
+        K = self.spec_steps
+        W = self.spec_window
+        G = self.geom.page
+        S = self.geom.slots
+        wlens: Dict[int, int] = {}
+        for s in members:
+            slot = self._slots[s]
+            # the draft scatters proposals into window rows pos+1 ..
+            # pos+K; a lane whose cursor outruns the window falls back
+            if slot.pos + K + 1 > W:
+                return False
+            budget = slot.req.max_new_tokens - len(slot.req.tokens)
+            wlens[s] = min(K + 1, max(budget, 1))
+        grants: Dict[int, List[int]] = {}
+        for s in members:
+            g = self._grant_range(s, self._slots[s].pos, wlens[s])
+            if g is None:
+                for gs, gl in grants.items():
+                    self._ungrant(gs, gl)
+                return False
+            grants[s] = g
+        window = np.zeros((S, W), np.int32)
+        pos = np.zeros(S, np.int32)
+        live = np.zeros(S, np.int32)
+        temps = np.zeros(S, np.float32)
+        seeds = np.zeros(S, np.uint32)
+        wlen_arr = np.zeros(S, np.int32)
+        for s in members:
+            slot = self._slots[s]
+            # full context = prompt + emitted tokens; in the steady
+            # state its length is exactly pos+1
+            ctx = slot.prompt + [int(t) for t in slot.req.tokens]
+            live[s] = 1
+            pos[s] = slot.pos
+            window[s, :slot.pos + 1] = ctx[:slot.pos + 1]
+            temps[s] = slot.req.temperature
+            seeds[s] = np.uint32(slot.req.seed & 0xFFFFFFFF)
+            wlen_arr[s] = wlens[s]
+        before = self._verify._cache_size()
+        t0 = self.clock()
+        (picks, bads, acc, self.slab.k, self.slab.v, self.slab.k_scale,
+         self.slab.v_scale, self.slab.valid) = self._verify(
+            self._params_by_gen[self.weight_generation],
+            self._draft_params,
+            self.slab.k, self.slab.v, self.slab.k_scale,
+            self.slab.v_scale, self.slab.valid,
+            jnp.asarray(window), jnp.asarray(pos),
+            jnp.asarray(self._tables), jnp.asarray(live),
+            jnp.asarray(temps), jnp.asarray(seeds),
+            jnp.asarray(wlen_arr))
+        compiled = self._verify._cache_size() > before
+        t1 = self.clock()
+        self.compile_tracker.note(compiled, t1 - t0)
+        self._dispatch_wall_s += t1 - t0
+        self.stats["dispatches"] += 1
+        self.stats["verify_dispatches"] += 1
+        self.stats["verify_compiles"] += int(compiled)
+        self.stats["occupancy_sum"] += len(members)
+        picks_host = np.asarray(picks)
+        bads_host = np.asarray(bads)
+        acc_host = np.asarray(acc)
+        for s in members:
+            slot = self._slots[s]
+            a = int(acc_host[s])
+            p_start = slot.pos
+            self.stats["draft_tokens"] += K
+            # accepted prefix + the bonus pick (what the verifier kept;
+            # emission may still stop earlier at EOS)
+            self.stats["accepted_tokens"] += a + 1
+            self.stats["rejected_tokens"] += K - a
+            self._walk_emitted(s, picks_host[:a + 1, s],
+                               bads_host[:a + 1, s], a + 1, t0, t1,
+                               finished)
+            if self._slots[s] is None:
+                continue   # released: its pages were freed wholesale
+            keep_pi = (slot.pos - 1) // G
+            for pi in range(keep_pi + 1,
+                            (p_start + wlens[s] - 1) // G + 1):
+                pid = int(self._tables[s, pi])
+                if pid:
+                    self.pager.free([pid])
+                    self._tables[s, pi] = 0
+        return True
 
     def _step_inner(self, exclude: frozenset = frozenset()
                     ) -> List[GenerateRequest]:
@@ -846,6 +1191,30 @@ class DecodeEngine:
         # generation's dispatch may finish-and-release its members, and
         # re-reading self._slots for the next generation would hit None
         gen_of = {s: self._slots[s].gen for s in ready}
+
+        # all-decode steady state: every ready slot is past its prompt,
+        # nothing prefilled/stalled/CoW-split this round, no fault
+        # hooks, no masked lanes, and a single resident weight
+        # generation — the ONLY regime the accelerated programs were
+        # compiled for. Speculative verify gets first claim, then the
+        # multi-step scan; any ineligibility (including a failed page
+        # grant, rolled back inside the dispatch method) falls through
+        # to the single-step loop below.
+        if (not exclude and not stalled and not cow and not progressed
+                and not finished and self.fault_plan is None
+                and (self._verify is not None or self._multi is not None)
+                and len(self._params_by_gen) == 1
+                and not any(sl is not None and self._in_prefill(sl)
+                            for sl in self._slots)
+                and all(self._slots[s].pos >= self._slots[s].n_prompt - 1
+                        for s in ready)):
+            if self._verify is not None \
+                    and self._dispatch_spec(ready, finished):
+                return finished
+            if self._multi is not None \
+                    and self._dispatch_multi(ready, finished):
+                return finished
+
         for gen in sorted(set(gen_of.values())):
             members = [s for s in ready if gen_of[s] == gen]
             tokens = np.zeros(S, np.int32)

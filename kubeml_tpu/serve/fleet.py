@@ -1068,6 +1068,16 @@ class ServeFleet:
             miss_deltas[str(i)] = m - pm
             self._prefix_seen[i] = (epoch, h, m)
         util = [snaps[i]["serve_kv_page_utilization"] for i in idxs]
+        # decode amortization: RATIOS merge from the underlying engine
+        # counters (sums of sums), not by averaging per-replica ratios
+        # — a busy replica must weigh more than an idle one
+        disp = toks = acc = vdisp = 0
+        for i in idxs:
+            st = self._replicas[i].engine.stats
+            disp += int(st["dispatches"])
+            toks += int(st["generated_tokens"])
+            acc += int(st["accepted_tokens"])
+            vdisp += int(st["verify_dispatches"])
         return {
             "job_id": f"serve:{self.model_id}",
             "serve_active_slots": tot("serve_active_slots"),
@@ -1104,6 +1114,10 @@ class ServeFleet:
                 (snaps[i]["serve_kv_dtype"] for i in idxs), "f32"),
             "serve_kv_bytes_per_token": next(
                 (snaps[i]["serve_kv_bytes_per_token"] for i in idxs), 0),
+            "serve_dispatches_per_token": round(disp / toks, 6)
+            if toks else 0.0,
+            "serve_accepted_per_dispatch": round(acc / vdisp, 6)
+            if vdisp else 0.0,
             # fleet routing / scaling surface
             "fleet_replicas": len(live),
             "fleet_replicas_min": self.replicas_min,

@@ -20,23 +20,32 @@ from __future__ import annotations
 import threading
 from typing import List, Optional
 
+# Record schema version. v2 split the old merged "dispatches" field
+# into prefill_dispatches/decode_dispatches: once multi-step and
+# speculative-verify dispatches exist, one decode dispatch can emit
+# many tokens, so a prefill+decode sum is uninterpretable — consumers
+# key on this constant to know which shape they are reading.
+FLIGHT_SCHEMA_VERSION = 2
+
 # One record per engine step; every field is host-side and O(1) to
 # read. docs/observability.md documents the semantics; tests assert
 # the schema so drift there is a test failure, not a doc lie.
 FLIGHT_FIELDS = (
-    "step",               # monotone engine step counter
-    "ts",                 # engine clock at record time (service timebase)
-    "kind",               # prefill | decode | mixed | idle | shed
-    "active_slots",       # occupied decode slots after the step
-    "prefill_backlog",    # prompt tokens admitted but not yet prefilled
-    "kv_pages",           # KV cache pages referenced or cached
-    "cow_splits",         # copy-on-write page splits this step
-    "dispatches",         # device dispatches this step (prefill + decode)
-    "dispatch_s",         # wall time spent inside dispatch calls
-    "tokens",             # generated tokens emitted this step
-    "weight_generation",  # generation new admissions attach to
-    "generations",        # weight generations resident (swap drain depth)
-    "deadlines",          # requests reaped by deadline expiry this step
+    "step",                # monotone engine step counter
+    "ts",                  # engine clock at record time (service timebase)
+    "kind",                # prefill | decode | mixed | idle | shed
+    "active_slots",        # occupied decode slots after the step
+    "prefill_backlog",     # prompt tokens admitted but not yet prefilled
+    "kv_pages",            # KV cache pages referenced or cached
+    "cow_splits",          # copy-on-write page splits this step
+    "prefill_dispatches",  # prefill-program dispatches this step
+    "decode_dispatches",   # decode dispatches this step (single-step,
+                           # multi-step, and speculative-verify programs)
+    "dispatch_s",          # wall time spent inside dispatch calls
+    "tokens",              # generated tokens emitted this step
+    "weight_generation",   # generation new admissions attach to
+    "generations",         # weight generations resident (swap drain depth)
+    "deadlines",           # requests reaped by deadline expiry this step
 )
 
 

@@ -243,7 +243,14 @@ class PageAllocator:
         """Drop ONE reference per listed page. A page whose refcount
         reaches 0 returns to the free list — unless it is registered in
         the prefix cache, in which case it parks in the LRU with its
-        contents intact, awaiting a hit or eviction."""
+        contents intact, awaiting a hit or eviction.
+
+        The re-sort below makes alloc/free an exact involution:
+        granting N pages and freeing them back restores the free list
+        bit-for-bit, order included. The speculative-decode rollback
+        (engine._dispatch_spec) leans on this — pre-granting a verify
+        window's page tail and trimming the rejected part leaves the
+        allocator exactly where a never-proposed run leaves it."""
         released = False
         for pid in page_ids:
             pid = int(pid)

@@ -883,6 +883,14 @@ class ServeService:
             # bytes-per-token proxy (geometry x dtype) for `kubeml top`
             "serve_kv_dtype": self.engine.kv_dtype,
             "serve_kv_bytes_per_token": self.engine.kv_bytes_per_token,
+            # decode amortization: dispatches per generated token (1.0
+            # single-step, 1/K multi-step, lower still when speculation
+            # accepts) and accepted tokens per verify dispatch — both
+            # counter-derived, never timers
+            "serve_dispatches_per_token": round(
+                self.engine.dispatches_per_token, 6),
+            "serve_accepted_per_dispatch": round(
+                self.engine.accepted_per_dispatch, 6),
         }
 
     def _publish(self) -> None:
@@ -905,7 +913,13 @@ class ServeService:
                     ("prefix_misses",
                      self.metrics.note_serve_prefix_misses),
                     ("page_leaks", self.metrics.note_serve_page_leaks),
-                    ("kv_bytes", self.metrics.note_serve_kv_bytes)):
+                    ("kv_bytes", self.metrics.note_serve_kv_bytes),
+                    ("draft_tokens",
+                     self.metrics.note_serve_draft_tokens),
+                    ("accepted_tokens",
+                     self.metrics.note_serve_accepted_tokens),
+                    ("rejected_tokens",
+                     self.metrics.note_serve_rejected_tokens)):
                 cur = int(self.engine.stats[stat])
                 delta = cur - self._counters_seen.get(stat, 0)
                 if delta > 0:

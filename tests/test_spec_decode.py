@@ -1,0 +1,501 @@
+"""Decode-latency tests (PR 16): multi-step decode scan + speculative
+decoding with a draft model.
+
+The three new serving-path variants registered in
+engine.SERVE_PATH_VARIANTS are pinned here, quoted, next to exactness
+assertions (tools/check_serve_parity.py enforces this coupling):
+
+  * 'multi_step' — the scan-over-K decode program (decode_steps > 1)
+    emits K tokens per dispatch BIT-IDENTICAL to K single-step
+    dispatches, across concurrent slots, mid-stream EOS, budget
+    boundaries, int8 KV pages, and a weight hot-swap (which falls the
+    engine back to single-step until the old generation drains).
+  * 'spec_verify' — draft-proposed tokens scored by one target verify
+    dispatch change NOTHING observable: emitted tokens are always the
+    target model's own picks under the engine's (seed, pos) keys, so
+    greedy speculation equals model.generate() and sampled speculation
+    equals the plain engine exactly, at any acceptance rate.
+  * 'spec_rollback' — rejected proposals roll back INSIDE the dispatch
+    (the verify program re-scans from the pre-dispatch slab writing
+    only accepted steps) and the host trims the over-granted pages, so
+    the pager free list, refcounts, and int8 page scales end exactly
+    where a never-proposed run ends.
+
+Plus the deterministic decode-amortization proxies
+(dispatches_per_token, accepted_per_dispatch — counters, never
+timers): engine stat / snapshot / metric family / top line all agree.
+"""
+
+import numpy as np
+import pytest
+
+pytestmark = pytest.mark.serving
+
+
+def _nano(seed=0):
+    import jax
+
+    from kubeml_tpu.models import get_builtin
+    model = get_builtin("gpt-nano")()
+    module = model.module
+    variables = model.init_variables(
+        jax.random.PRNGKey(seed),
+        {"x": np.ones((1, module.max_len), np.int32)})
+    return model, module, variables
+
+
+def _drive(engine, limit=10_000):
+    finished = []
+    while engine.active():
+        finished.extend(engine.step())
+        limit -= 1
+        assert limit > 0, "engine failed to drain"
+    return finished
+
+
+# greedy + two sampled lanes; 7 new tokens is deliberately not a
+# multiple of any tested K, so the budget mask trims the last window
+SPECS = [([5, 6, 7], 6, 0.0, 0),
+         ([9, 10, 11, 12], 8, 0.7, 1),
+         ([3], 7, 1.3, 7)]
+
+
+def _make(specs=SPECS, eos=None):
+    from kubeml_tpu.serve.slots import GenerateRequest
+    return [GenerateRequest(list(p), max_new_tokens=n, temperature=t,
+                            seed=s, eos_id=eos) for p, n, t, s in specs]
+
+
+def _run(module, variables, reqs, **kw):
+    from kubeml_tpu.serve.engine import DecodeEngine
+    eng = DecodeEngine(module, variables, slots=4, page=8,
+                       prefill_chunk=8, **kw)
+    for r in reqs:
+        eng.attach(r)
+    _drive(eng)
+    return eng
+
+
+def _same_tokens(reqs_a, reqs_b):
+    for a, b in zip(reqs_a, reqs_b):
+        np.testing.assert_array_equal(np.asarray(a.tokens),
+                                      np.asarray(b.tokens))
+
+
+# ------------------------------------------------------- multi-step scan
+
+def test_multi_step_bit_identical_across_k():
+    """'multi_step' K in {2, 4, 8}: K fused steps per dispatch emit the
+    SAME tokens as K single-step dispatches — greedy and sampled lanes,
+    concurrent slots, budgets not divisible by K — while cutting
+    dispatches_per_token toward 1/K."""
+    _model, module, variables = _nano()
+    base_reqs = _make()
+    base = _run(module, variables, base_reqs)
+    for K in (2, 4, 8):
+        reqs = _make()
+        eng = _run(module, variables, reqs, decode_steps=K)
+        assert all(r.outcome == "ok" for r in reqs)
+        _same_tokens(base_reqs, reqs)
+        assert eng.stats["multi_step_dispatches"] > 0
+        assert eng.stats["multi_step_compiles"] == 1
+        assert eng.stats["generated_tokens"] == \
+            base.stats["generated_tokens"]
+        # fewer program launches for the same tokens
+        assert eng.stats["dispatches"] < base.stats["dispatches"]
+        assert eng.dispatches_per_token < base.dispatches_per_token
+        # the bytes proxy stays tied to tokens, not dispatches
+        assert eng.stats["kv_bytes"] == \
+            eng.stats["decode_tokens"] * eng.kv_bytes_per_token
+        eng.check_pager()
+
+
+def test_multi_step_mid_stream_eos_bit_identical():
+    """A lane that hits EOS mid-window goes dead as DATA (masked null
+    writes) — tokens still end exactly where single-step ends, and no
+    pages leak from the dead lane's unused window tail."""
+    _model, module, variables = _nano()
+    probe = _make()
+    _run(module, variables, probe)
+    # pick an eos that actually appears mid-stream in the greedy lane
+    eos = probe[0].tokens[2]
+    base_reqs = _make(eos=eos)
+    _run(module, variables, base_reqs)
+    assert any(len(r.tokens) < r.max_new_tokens for r in base_reqs)
+    for K in (4, 8):
+        reqs = _make(eos=eos)
+        eng = _run(module, variables, reqs, decode_steps=K)
+        _same_tokens(base_reqs, reqs)
+        eng.check_pager()
+
+
+def test_multi_step_int8_kv_bit_identical():
+    """'multi_step' composes with int8 KV pages: the scan body reuses
+    the SAME quantize-on-write step, so tokens match single-step int8
+    exactly."""
+    _model, module, variables = _nano()
+    base_reqs = _make()
+    _run(module, variables, base_reqs, kv_dtype="int8")
+    reqs = _make()
+    eng = _run(module, variables, reqs, decode_steps=4, kv_dtype="int8")
+    _same_tokens(base_reqs, reqs)
+    assert eng.stats["multi_step_dispatches"] > 0
+    eng.check_pager()
+
+
+def test_multi_step_hot_swap_falls_back_bit_identical():
+    """A weight hot-swap mid-flight leaves the engine multi-generation;
+    the scheduler falls back to single-step until the old generation
+    drains, and every stream's tokens still match the single-step
+    engine running the identical attach/swap sequence."""
+    from kubeml_tpu.serve.engine import DecodeEngine
+    from kubeml_tpu.serve.slots import GenerateRequest
+
+    _model, module, variables = _nano()
+    _m2, _mod2, variables2 = _nano(seed=1)   # genuinely different weights
+
+    def lifecycle(**kw):
+        eng = DecodeEngine(module, variables, slots=4, page=8,
+                           prefill_chunk=8, **kw)
+        a = GenerateRequest([5, 6, 7, 8], max_new_tokens=12,
+                            temperature=0.0, seed=0)
+        eng.attach(a)
+        for _ in range(3):
+            eng.step()
+        eng.install_weights(variables2)      # a stays pinned to gen 0
+        b = GenerateRequest([9, 10, 11], max_new_tokens=8,
+                            temperature=0.8, seed=2)
+        eng.attach(b)                        # b decodes under gen 1
+        _drive(eng)
+        eng.check_pager()
+        return eng, [a, b]
+
+    base_eng, base_reqs = lifecycle()
+    eng, reqs = lifecycle(decode_steps=4)
+    assert all(r.outcome == "ok" for r in base_reqs + reqs)
+    _same_tokens(base_reqs, reqs)
+    assert eng.stats["generations_retired"] >= 1
+    # the swap really forced single-step work in the multi engine
+    assert eng.stats["compiles"] == 1
+    assert eng.stats["dispatches"] > eng.stats["multi_step_dispatches"]
+
+
+def test_multi_step_program_validates():
+    from kubeml_tpu.models.gpt import build_paged_multi_step_decode
+    from kubeml_tpu.serve.engine import DecodeEngine
+
+    _model, module, variables = _nano()
+    with pytest.raises(ValueError, match="steps"):
+        build_paged_multi_step_decode(module, 1)
+    with pytest.raises(ValueError, match="decode steps"):
+        DecodeEngine(module, variables, decode_steps=0)
+
+
+# ------------------------------------------------- speculative decoding
+
+def test_spec_greedy_matches_generate():
+    """'spec_verify' against the model's own generate(): a self-draft
+    proposes K tokens, one verify dispatch scores them, and the greedy
+    stream's tokens are BIT-IDENTICAL to non-speculative KV-cache
+    generation. Self-drafting also proves the acceptance upside:
+    accepted_per_dispatch > 1 token per program launch."""
+    model, module, variables = _nano()
+    prompt = [5, 6, 7, 8]
+    n_new = 12
+    ref = model.generate(variables, np.asarray([prompt], np.int32),
+                         max_new_tokens=n_new, temperature=0.0)
+    from kubeml_tpu.serve.slots import GenerateRequest
+    req = GenerateRequest(list(prompt), max_new_tokens=n_new)
+    eng = _run(module, variables, [req], draft_module=module,
+               draft_variables=variables)
+    assert req.outcome == "ok"
+    np.testing.assert_array_equal(
+        np.asarray(req.tokens), np.asarray(ref[0, len(prompt):]))
+    assert eng.stats["verify_dispatches"] > 0
+    assert eng.stats["draft_tokens"] > 0
+    # a greedy self-draft agrees with its own target: > 1 token/dispatch
+    assert eng.accepted_per_dispatch > 1.0
+    assert eng.dispatches_per_token < 1.0
+    eng.check_pager()
+
+
+def test_spec_sampled_concurrent_bit_identical():
+    """Speculation never changes emitted tokens — they are ALWAYS the
+    target's picks under the engine's (seed, pos) keys; the draft only
+    gates how many commit per dispatch. Sampled lanes at three
+    temperatures match the plain engine exactly, even under a
+    deliberately disagreeing draft (different init)."""
+    _model, module, variables = _nano()
+    _m2, draft_mod, draft_vars = _nano(seed=3)
+    base_reqs = _make()
+    _run(module, variables, base_reqs)
+    for dm, dv in ((module, variables), (draft_mod, draft_vars)):
+        reqs = _make()
+        eng = _run(module, variables, reqs, draft_module=dm,
+                   draft_variables=dv)
+        assert all(r.outcome == "ok" for r in reqs)
+        _same_tokens(base_reqs, reqs)
+        assert eng.stats["verify_dispatches"] > 0
+        # counter sanity: every drafted token lands in one bucket, and
+        # accepted additionally counts each window's bonus target pick
+        assert eng.stats["draft_tokens"] > 0
+        assert eng.stats["rejected_tokens"] <= eng.stats["draft_tokens"]
+        assert eng.stats["accepted_tokens"] + \
+            eng.stats["rejected_tokens"] >= eng.stats["draft_tokens"]
+        eng.check_pager()
+
+
+def test_spec_rollback_restores_pager_state_exactly():
+    """'spec_rollback' with int8 KV: a disagreeing draft forces
+    rejections every window; the verify program's second pass re-scans
+    from the pre-dispatch slab writing only accepted steps, and the
+    host ungrants the unused page tail. After draining, the free list
+    (ORDER included), refcounts, held-page int8 payloads and per-page
+    scales are EXACTLY the never-proposed engine's — and a follow-up
+    stream decodes identical tokens from that state."""
+    import jax.numpy as jnp
+
+    from kubeml_tpu.serve.engine import DecodeEngine
+    from kubeml_tpu.serve.slots import GenerateRequest
+
+    _model, module, variables = _nano()
+    _m2, draft_mod, draft_vars = _nano(seed=9)
+
+    def run_one(**kw):
+        eng = DecodeEngine(module, variables, slots=2, page=8,
+                           prefill_chunk=8, kv_dtype="int8", **kw)
+        # 10 prompt tokens = one FULL page (prefix-cached after
+        # release) + a partial: held pages survive the drain
+        req = GenerateRequest(list(range(5, 15)), max_new_tokens=14,
+                              temperature=0.0, seed=0)
+        eng.attach(req)
+        _drive(eng)
+        return eng, req
+
+    base, base_req = run_one()
+    spec, spec_req = run_one(draft_module=draft_mod,
+                             draft_variables=draft_vars)
+    assert spec.stats["rejected_tokens"] > 0   # rollback was exercised
+    np.testing.assert_array_equal(np.asarray(base_req.tokens),
+                                  np.asarray(spec_req.tokens))
+    # pager state: identical free-list ORDER and identical refcounts
+    assert spec.pager._free == base.pager._free
+    assert spec.pager._refs == base.pager._refs
+    # held pages (referenced or prefix-cached — everything not on the
+    # free list) carry bit-identical int8 payloads and scales: the
+    # replay pass plus trim leaves no trace of rejected writes (freed
+    # pages may hold garbage; only held ones matter)
+    held = sorted(set(range(1, base.geom.pages)) - set(base.pager._free))
+    assert held                                 # prefix pages survive
+    assert spec.slab.k.dtype == jnp.int8
+    for name in ("k", "v", "k_scale", "v_scale"):
+        a = np.asarray(getattr(base.slab, name))[:, held]
+        b = np.asarray(getattr(spec.slab, name))[:, held]
+        np.testing.assert_array_equal(a, b)
+    # behavioral closure: a fresh stream on each engine (allocating out
+    # of the supposedly-identical free lists) decodes identical tokens
+    nxt_a = GenerateRequest([20, 21, 22], max_new_tokens=6,
+                            temperature=0.9, seed=4)
+    nxt_b = GenerateRequest([20, 21, 22], max_new_tokens=6,
+                            temperature=0.9, seed=4)
+    base.attach(nxt_a)
+    spec.attach(nxt_b)
+    _drive(base)
+    _drive(spec)
+    np.testing.assert_array_equal(np.asarray(nxt_a.tokens),
+                                  np.asarray(nxt_b.tokens))
+    base.check_pager()
+    spec.check_pager()
+
+
+def test_spec_verify_program_validates():
+    from kubeml_tpu.models.gpt import build_paged_spec_verify_step
+    from kubeml_tpu.serve.engine import DecodeEngine
+
+    _model, module, variables = _nano()
+    with pytest.raises(ValueError, match="draft_variables"):
+        DecodeEngine(module, variables, draft_module=module)
+    with pytest.raises(ValueError, match="window"):
+        build_paged_spec_verify_step(module, module, 4, 1)
+    with pytest.raises(ValueError, match="window"):
+        build_paged_spec_verify_step(module, module, 4,
+                                     module.max_len + 1)
+
+
+# --------------------------------------------- program inventory pinning
+
+def test_program_inventory_compile_counts_pinned():
+    """The program inventory is EXACT and compile-once: a multi-step
+    engine holds {prefill, decode, multi-step}, a speculative engine
+    holds {prefill, decode, verify}, each compiled exactly once, and a
+    second wave of joins/leaves adds dispatches but ZERO compiles."""
+    from kubeml_tpu.serve.engine import DecodeEngine
+
+    _model, module, variables = _nano()
+
+    def churn(eng):
+        for r in _make():
+            eng.attach(r)
+        _drive(eng)
+
+    multi = DecodeEngine(module, variables, slots=4, page=8,
+                         prefill_chunk=8, decode_steps=4)
+    spec = DecodeEngine(module, variables, slots=4, page=8,
+                        prefill_chunk=8, draft_module=module,
+                        draft_variables=variables)
+    for eng, extra in ((multi, "multi_step_compiles"),
+                       (spec, "verify_compiles")):
+        churn(eng)
+        pinned = (eng.stats["compiles"], eng.stats["prefill_compiles"],
+                  eng.stats[extra])
+        assert pinned == (1, 1, 1)
+        assert eng.compile_tracker.compiles == 3
+        disp = eng.stats["dispatches"]
+        churn(eng)                              # second wave: data only
+        assert eng.stats["dispatches"] > disp
+        assert (eng.stats["compiles"], eng.stats["prefill_compiles"],
+                eng.stats[extra]) == pinned
+        assert eng.compile_tracker.compiles == 3
+        # every decode-lane dispatch (single, fused, verify) is tracked
+        assert eng.compile_tracker.dispatches == \
+            eng.stats["dispatches"] + eng.stats["prefill_dispatches"]
+
+
+# ------------------------------------------- flight recorder schema v2
+
+def test_flight_schema_v2_splits_dispatch_lanes():
+    """FLIGHT_FIELDS v2 splits 'dispatches' into prefill/decode lanes
+    so amortization regressions are visible per step; records sum back
+    to the engine's cumulative dispatch stats."""
+    from kubeml_tpu.serve.flight import (FLIGHT_FIELDS,
+                                         FLIGHT_SCHEMA_VERSION)
+
+    assert FLIGHT_SCHEMA_VERSION == 2
+    assert "prefill_dispatches" in FLIGHT_FIELDS
+    assert "decode_dispatches" in FLIGHT_FIELDS
+    assert "dispatches" not in FLIGHT_FIELDS
+
+    _model, module, variables = _nano()
+    reqs = _make()
+    eng = _run(module, variables, reqs, decode_steps=4)
+    recs = eng.flight.snapshot()
+    assert all(set(FLIGHT_FIELDS) <= set(r) for r in recs)
+    assert sum(r["prefill_dispatches"] for r in recs) == \
+        eng.stats["prefill_dispatches"]
+    assert sum(r["decode_dispatches"] for r in recs) == \
+        eng.stats["dispatches"]
+
+
+# ------------------------------------- metrics / snapshot / CLI / knobs
+
+def test_spec_metric_families_and_snapshot():
+    """The three speculation counter families pass the metrics lint,
+    the service delta-advances them from cumulative engine stats (no
+    double counting), and the snapshot carries both amortization
+    proxies for health/top."""
+    from kubeml_tpu.metrics.prom import MetricsRegistry
+    from kubeml_tpu.serve.engine import DecodeEngine
+    from kubeml_tpu.serve.service import ServeService
+    from tools.check_metrics import validate_exposition
+
+    m = MetricsRegistry()
+    m.note_serve_draft_tokens("m1", 40)
+    m.note_serve_accepted_tokens("m1", 25)
+    m.note_serve_rejected_tokens("m1", 15)
+    text = m.exposition()
+    assert validate_exposition(text) == []
+    assert 'kubeml_serve_draft_tokens_total{model="m1"} 40' in text
+    assert 'kubeml_serve_accepted_tokens_total{model="m1"} 25' in text
+    assert 'kubeml_serve_rejected_tokens_total{model="m1"} 15' in text
+    m.clear_serve("m1")
+    assert 'model="m1"' not in m.exposition()
+
+    _model, module, variables = _nano()
+    engine = DecodeEngine(module, variables, slots=2, page=8,
+                          draft_module=module, draft_variables=variables)
+    m2 = MetricsRegistry()
+    svc = ServeService("m2", engine, max_queue=1, metrics=m2)  # no loop
+    engine.stats["draft_tokens"] = 40
+    engine.stats["accepted_tokens"] = 25
+    engine.stats["rejected_tokens"] = 15
+    engine.stats["generated_tokens"] = 40
+    engine.stats["dispatches"] = 10
+    engine.stats["verify_dispatches"] = 10
+    svc._publish()
+    svc._publish()   # same cumulative values: no double count
+    text = m2.exposition()
+    assert 'kubeml_serve_draft_tokens_total{model="m2"} 40' in text
+    assert 'kubeml_serve_accepted_tokens_total{model="m2"} 25' in text
+    assert 'kubeml_serve_rejected_tokens_total{model="m2"} 15' in text
+    snap = svc.snapshot()
+    assert snap["serve_dispatches_per_token"] == pytest.approx(0.25)
+    assert snap["serve_accepted_per_dispatch"] == pytest.approx(2.5)
+
+
+def test_top_renders_decode_amortization_line():
+    from kubeml_tpu.cli.main import _render_top
+
+    doc = {"id": "serve:m1", "state": "healthy", "reasons": [],
+           "latest": {"serve_active_slots": 1, "serve_slot_cap": 4,
+                      "serve_queue_depth": 0, "serve_queue_cap": 8,
+                      "serve_kv_page_utilization": 0.5,
+                      "serve_dispatches_per_token": 0.25,
+                      "serve_accepted_per_dispatch": 3.2}}
+    out = _render_top(doc)
+    assert "decode amortization: 0.25 dispatches/token" in out
+    assert "accepted 3.2/verify" in out
+    # without a verify program the accept clause stays off the line
+    doc["latest"]["serve_accepted_per_dispatch"] = 0.0
+    assert "accepted" not in _render_top(doc)
+
+
+def test_spec_knob_threading(monkeypatch):
+    """--serve-decode-steps / --serve-draft-model and their env twins
+    reach the PS; explicit constructor args win over env."""
+    from kubeml_tpu.cli.main import build_parser
+    from kubeml_tpu.control.ps import ParameterServer
+
+    args = build_parser().parse_args(
+        ["serve", "--role", "ps", "--serve-decode-steps", "4",
+         "--serve-draft-model", "tiny-draft"])
+    assert args.serve_decode_steps == 4
+    assert args.serve_draft_model == "tiny-draft"
+    monkeypatch.setenv("KUBEML_SERVE_DECODE_STEPS", "8")
+    monkeypatch.setenv("KUBEML_SERVE_DRAFT_MODEL", "env-draft")
+    ps = ParameterServer(port=0)
+    assert ps.serve_decode_steps == 8
+    assert ps.serve_draft_model == "env-draft"
+    ps2 = ParameterServer(port=0, serve_decode_steps=2,
+                          serve_draft_model="flag-draft")
+    assert ps2.serve_decode_steps == 2
+    assert ps2.serve_draft_model == "flag-draft"
+
+
+def test_fleet_snapshot_merges_amortization_from_counters():
+    """The fleet snapshot derives its ratios from SUMMED engine
+    counters across replicas, not by averaging per-replica ratios."""
+    from kubeml_tpu.serve.engine import DecodeEngine
+    from kubeml_tpu.serve.fleet import ServeFleet
+    from kubeml_tpu.serve.service import ServeService
+
+    _model, module, variables = _nano()
+
+    def factory(index):
+        engine = DecodeEngine(module, variables, slots=2, page=8)
+        return ServeService("m1", engine, max_queue=2, supervise=False)
+
+    fleet = ServeFleet("m1", factory, replicas_min=2, replicas_max=2,
+                       autoscale_interval_s=0.0)
+    fleet.start()
+    try:
+        engines = [svc.engine for svc in fleet._replicas.values()]
+        engines[0].stats.update(dispatches=4, generated_tokens=16,
+                                accepted_tokens=12, verify_dispatches=4)
+        engines[1].stats.update(dispatches=6, generated_tokens=4,
+                                accepted_tokens=0, verify_dispatches=0)
+        snap = fleet.snapshot()
+        # 10 dispatches / 20 tokens — NOT mean(0.25, 1.5)
+        assert snap["serve_dispatches_per_token"] == pytest.approx(0.5)
+        assert snap["serve_accepted_per_dispatch"] == pytest.approx(3.0)
+    finally:
+        fleet.stop()
