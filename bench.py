@@ -50,6 +50,7 @@ docs/performance.md context.
 import json
 import math
 import time
+import zlib
 
 BATCH = 256           # per-step batch per worker
 STEPS_PER_ROUND = 8   # K local steps per sync round
@@ -420,6 +421,7 @@ def main():
     serving_decode_bw = _measure_serving_decode_bw_arm()
     serving_spec = _measure_serving_spec_arm()
     cluster = _measure_cluster_arm()
+    control_chaos = _measure_control_chaos_arm()
     continual = _measure_continual_arm()
 
     per_chip, cache_phases, cache_runtime = measure(
@@ -617,6 +619,16 @@ def main():
         # number is exact: the replay is a pure function of the job
         # table, self-asserted inside the arm.
         "cluster": cluster,
+        # control-chaos arm (control/journal.py + control/cluster.py):
+        # the durable control plane killed twice mid-schedule under a
+        # mixed train+serve workload — a crash after a durable append
+        # and a torn write that loses the in-flight op — then recovered
+        # from snapshot+journal across a compaction boundary. Self-
+        # asserted inside the arm: zero lost jobs, zero lost streams,
+        # zero double-granted lanes (both stale pre-crash epochs
+        # 409'd), the torn tail dropped exactly once, and the final
+        # training weights BIT-identical to the uncrashed run.
+        "control_chaos": control_chaos,
         # continual-plane arm (streaming ingest -> sliding-window
         # training -> zero-downtime hot-swap): a closed-loop producer
         # appends a chunk per published epoch, every MetricUpdate rides
@@ -1945,6 +1957,213 @@ def _measure_cluster_arm() -> dict:
         # the drain-and-requeue path is the platform displacing the
         # job, never a crash: max_restarts is untouched by design
         "restart_budget_spent": 0,
+    }
+
+
+def _measure_control_chaos_arm() -> dict:
+    """Control-plane chaos arm: kill the control plane mid-schedule
+    under a mixed training + serving workload and prove recovery is
+    lossless — deterministic, in-process, fake-clock.
+
+    The same 11-op workload (train gangs placing/queuing/resizing/
+    releasing alongside two serving gangs on one 6-lane pool) runs
+    twice through a journaled ClusterAllocator: once uncrashed, once
+    with a ControlFaultPlan injecting control_crash after the t-b
+    submit's durable append, control_torn_write mid-append on the t-c
+    submit (a partial frame on disk, the op lost), and a
+    control_slow_recover replay dilation. Each ControlCrash abandons
+    the in-memory allocator and recovers a fresh one from
+    snapshot+journal (compact_every=4, so recovery crosses a
+    compaction boundary), bumps the fencing epoch, re-grants the
+    survivors, and presents one stale pre-crash epoch — which MUST be
+    409'd.
+
+    A deterministic SGD loop folds the grant schedule into weights
+    (one step per granted train lane per op, data keyed by job id +
+    global step), so the weights are a pure function of the grant
+    history: a lost job, a re-grant at the wrong width, or a
+    double-granted lane would perturb them. Self-asserted: zero lost
+    jobs (pool drains empty), zero lost streams (both serving gangs
+    survive both crashes), zero double-granted lanes (in-use never
+    exceeds the pool; fencing rejections == 2 exactly), the torn tail
+    dropped once, the journal round-trips, and the final weights are
+    BIT-identical to the uncrashed run."""
+    import shutil
+    import tempfile
+
+    import numpy as np
+
+    from kubeml_tpu.api.errors import StaleGrantError
+    from kubeml_tpu.control.cluster import (ClusterAllocator,
+                                            verify_journal_roundtrip)
+    from kubeml_tpu.control.journal import DecisionJournal
+    from kubeml_tpu.faults import ControlCrash, ControlFaultPlan
+
+    POOL = 6
+    WEIGHTS = {"batch": 1.0, "svc": 2.0}
+    # (op, kwargs) — journal indices 0..10 in the uncrashed run
+    OPS = [
+        ("submit", dict(job_id="t-a", tenant="batch", lanes=3)),
+        ("submit", dict(job_id="serve:m0", tenant="svc", lanes=2,
+                        kind="serving")),
+        ("submit", dict(job_id="t-b", tenant="batch", lanes=2)),
+        ("resize", dict(job_id="t-a", requested=2)),
+        ("submit", dict(job_id="serve:m1", tenant="svc", lanes=1,
+                        kind="serving")),
+        ("release", dict(job_id="t-a")),
+        ("submit", dict(job_id="t-c", tenant="batch", lanes=2)),
+        ("release", dict(job_id="t-b")),
+        ("release", dict(job_id="t-c")),
+        ("release", dict(job_id="serve:m0")),
+        ("release", dict(job_id="serve:m1")),
+    ]
+
+    def fold_weights(grant_log):
+        """Deterministic SGD over the grant schedule: one step per
+        granted train lane per workload op; the batch is a pure
+        function of (job id, global step). float32 numpy, so equality
+        below is bit-equality."""
+        w = np.zeros(8, dtype=np.float32)
+        step = 0
+        for entry in grant_log:
+            for job, lanes in entry:
+                seed = zlib.crc32(job.encode()) % 997
+                for _ in range(lanes):
+                    x = np.sin(np.arange(8, dtype=np.float32) * 0.5
+                               + np.float32(seed + step) * 0.37)
+                    g = (np.dot(w, x) - np.float32(1.0)) * x
+                    w = (w - np.float32(0.05) * g).astype(np.float32)
+                    step += 1
+        return w
+
+    def train_entry(alloc):
+        return tuple(sorted((j, l) for j, l in alloc.running_jobs()
+                            .items() if not j.startswith("serve:")))
+
+    def run(fault_plan):
+        tmp = tempfile.mkdtemp(prefix="kubeml-control-chaos-")
+        now = [0.0]
+        clock = lambda: now[0]  # noqa: E731
+
+        def fresh(journal):
+            return ClusterAllocator(
+                POOL, tenant_weights=WEIGHTS, clock=clock,
+                aging_s=1000.0, journal=journal, compact_every=4)
+
+        try:
+            alloc = fresh(DecisionJournal(tmp, fault_plan=fault_plan))
+            grant_log, recoveries, recovery_s = [], 0, []
+            rejections, max_in_use = 0, 0
+            grant_serves = []  # serving gangs live after the last op
+            for op, kw in OPS:
+                now[0] += 1.0
+                for attempt in (0, 1):
+                    try:
+                        getattr(alloc, op)(**kw)
+                        break
+                    except ControlCrash:
+                        # the control plane died; recover a fresh
+                        # incarnation from snapshot + journal
+                        t0 = time.perf_counter()
+                        alloc = ClusterAllocator.recover(
+                            DecisionJournal(tmp, fault_plan=fault_plan),
+                            POOL, tenant_weights=WEIGHTS, clock=clock,
+                            aging_s=1000.0, compact_every=4)
+                        recovery_s.append(time.perf_counter() - t0)
+                        recoveries += 1
+                        # every pre-crash serving gang must have
+                        # survived recovery: zero lost streams
+                        live = set(alloc.running_jobs())
+                        assert {j for j in live
+                                if j.startswith("serve:")} == \
+                            {j for j, _ in grant_serves}, (live,
+                                                           grant_serves)
+                        survivors = sorted(live)
+                        old = {j: alloc.grant_epoch(j)
+                               for j in survivors}
+                        alloc.mark_recovered()
+                        for j in survivors:
+                            lanes, epoch = alloc.regrant(j)
+                            assert epoch == alloc.fencing_epoch
+                        # split-brain drill: a pre-crash worker
+                        # presents its old epoch and must be 409'd
+                        if survivors:
+                            victim = survivors[0]
+                            try:
+                                alloc.fence_check(victim, old[victim])
+                                raise AssertionError(
+                                    "stale epoch accepted")
+                            except StaleGrantError:
+                                rejections += 1
+                        # did the crashed op land before the crash?
+                        # control_crash fires AFTER the durable append
+                        # (op kept), control_torn_write before (op
+                        # lost — retry it)
+                        jid = kw["job_id"]
+                        admitted = jid in alloc.running_jobs() \
+                            or jid in alloc.pending_jobs()
+                        landed = admitted if op != "release" \
+                            else not admitted
+                        if landed:
+                            break
+                        assert attempt == 0, (op, kw)
+                in_use = sum(alloc.running_jobs().values())
+                assert in_use <= POOL, (in_use, POOL)
+                max_in_use = max(max_in_use, in_use)
+                grant_log.append(train_entry(alloc))
+                grant_serves = [(j, l) for j, l
+                                in alloc.running_jobs().items()
+                                if j.startswith("serve:")]
+            snap = alloc.snapshot()
+            assert snap["cluster_queue_depth"] == 0, snap
+            assert snap["cluster_lanes_in_use"] == 0, snap
+            verify_journal_roundtrip(alloc)
+            return {
+                "weights": fold_weights(grant_log),
+                "recoveries": recoveries,
+                "recovery_s": recovery_s,
+                "rejections": rejections,
+                "max_in_use": max_in_use,
+                "torn_drops": snap["cluster_journal_torn_drops_total"],
+                "snap": snap,
+            }
+        finally:
+            shutil.rmtree(tmp, ignore_errors=True)
+
+    base = run(None)
+    plan = ControlFaultPlan.parse([
+        {"kind": "control_crash", "index": 2},
+        {"kind": "control_torn_write", "index": 10},
+        {"kind": "control_slow_recover", "duration_s": 0.005},
+    ])
+    chaos = run(plan)
+    # pinned: the chaos run converged to the uncrashed history exactly
+    assert base["recoveries"] == 0 and chaos["recoveries"] == 2
+    assert chaos["rejections"] == 2, chaos["rejections"]
+    assert chaos["torn_drops"] == 1, chaos["torn_drops"]
+    assert chaos["max_in_use"] <= POOL
+    assert plan.injected["control_crash"] == 1, plan.injected
+    assert plan.injected["control_torn_write"] == 1, plan.injected
+    assert plan.injected["control_slow_recover"] == 1, plan.injected
+    assert np.array_equal(base["weights"], chaos["weights"]), \
+        (base["weights"], chaos["weights"])
+    snap = chaos["snap"]
+    return {
+        "pool_lanes": POOL,
+        "workload_ops": len(OPS),
+        "control_crashes": 2,
+        "recoveries": chaos["recoveries"],
+        "recovery_s": [round(s, 6) for s in chaos["recovery_s"]],
+        "fencing_epoch_final": snap["cluster_fencing_epoch"],
+        "fencing_rejections": chaos["rejections"],
+        "journal_records": snap["cluster_journal_records_total"],
+        "journal_compactions":
+            snap["cluster_journal_compactions_total"],
+        "torn_tail_drops": chaos["torn_drops"],
+        "lost_jobs": 0,
+        "lost_streams": 0,
+        "max_lanes_in_use": chaos["max_in_use"],
+        "weights_bit_identical": True,
     }
 
 

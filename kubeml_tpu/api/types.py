@@ -338,6 +338,12 @@ class TrainTask:
     # parameters (control/cluster.py; defaults keep old payloads valid)
     priority: int = 0
     tenant: str = ""
+    # fencing epoch of the lane grant this task runs under
+    # (control/cluster.py). Stamped by the scheduler at dispatch and
+    # echoed back on every /job re-parallelize ask; a recovered
+    # allocator rejects stale epochs with 409 so a pre-crash worker can
+    # never double-book lanes. 0 = unfenced (legacy / non-cluster mode)
+    grant_epoch: int = 0
 
     def to_dict(self) -> dict:
         return {
@@ -351,6 +357,7 @@ class TrainTask:
             "preemptions": self.preemptions,
             "priority": self.priority,
             "tenant": self.tenant,
+            "grant_epoch": self.grant_epoch,
         }
 
     @classmethod
@@ -366,6 +373,7 @@ class TrainTask:
             preemptions=int(d.get("preemptions", 0)),
             priority=int(d.get("priority", 0)),
             tenant=d.get("tenant", ""),
+            grant_epoch=int(d.get("grant_epoch", 0)),
         )
 
 

@@ -484,6 +484,22 @@ def _render_top(doc: dict) -> str:
                 f"  tenant {tname:<12} lanes {tenant_lanes[tname]:g}"
                 f"/{quota if quota is not None else pool:g} "
                 f"share {share:.0%}")
+        # control pane: durable-control-plane counters ride the same
+        # snapshot once the allocator journals (zero records = the
+        # durability layer is off, keep the pane quiet)
+        if float(latest.get("cluster_journal_records_total", 0) or 0) > 0 \
+                or float(latest.get("cluster_recoveries_total", 0) or 0) > 0:
+            lines.append(
+                f"control: epoch "
+                f"{latest.get('cluster_fencing_epoch', 0):g}  "
+                f"recoveries "
+                f"{latest.get('cluster_recoveries_total', 0):g}  journal "
+                f"{latest.get('cluster_journal_records_total', 0):g} rec/"
+                f"{latest.get('cluster_journal_compactions_total', 0):g} "
+                f"compactions  torn "
+                f"{latest.get('cluster_journal_torn_drops_total', 0):g}  "
+                f"fence rejects "
+                f"{latest.get('cluster_fencing_rejections_total', 0):g}")
     worker_losses = latest.get("worker_losses") or []
     grad_norms = latest.get("grad_norms") or []
     update_ratios = latest.get("update_ratios") or []
@@ -600,7 +616,9 @@ def cmd_serve(args):
                                serve_hedge_after_s=args.serve_hedge_after_s,
                                cluster_lanes=args.cluster_lanes,
                                cluster_tenants=args.cluster_tenant,
-                               cluster_aging_s=args.cluster_aging_s)
+                               cluster_aging_s=args.cluster_aging_s,
+                               control_durable=args.control_durable,
+                               control_dir=args.control_dir)
         print(f"controller: {svc.controller.url}")
         print(f"scheduler:  {svc.scheduler.url}")
         print(f"ps:         {svc.ps.url}  (metrics at {svc.ps.url}/metrics)")
@@ -1043,6 +1061,16 @@ def build_parser() -> argparse.ArgumentParser:
                         "effective priority level per S seconds waited "
                         "so low-priority gangs cannot starve "
                         "(default 30; <= 0 disables aging)")
+    s.add_argument("--control-durable", action="store_true",
+                   help="durable control plane: journal every allocator "
+                        "decision and mirror scheduler/PS registries to "
+                        "state files so a restart RECOVERS (re-adopting "
+                        "surviving children, rebuilding serving fleets) "
+                        "instead of starting cold")
+    s.add_argument("--control-dir", default=None, metavar="DIR",
+                   help="state directory for --control-durable "
+                        "(default $KUBEML_HOME/control/); giving a DIR "
+                        "implies --control-durable")
     s.set_defaults(fn=cmd_serve)
     return p
 

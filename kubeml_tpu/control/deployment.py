@@ -11,22 +11,39 @@ unchanged.
 from __future__ import annotations
 
 import dataclasses
+import os
 from typing import Optional
 
 from kubeml_tpu.api import const
 from kubeml_tpu.control.cluster import ClusterAllocator, parse_tenant_spec
 from kubeml_tpu.control.controller import Controller
+from kubeml_tpu.control.journal import DecisionJournal
 from kubeml_tpu.control.ps import ParameterServer
 from kubeml_tpu.control.scheduler import Scheduler
 from kubeml_tpu.control.storage import StorageService
 
+# compaction cadence for the allocator's decision journal when the
+# durable control plane is on: fold state into the snapshot every N
+# journaled operations so replay length stays bounded
+CONTROL_COMPACT_EVERY = 256
+
+
+def control_state_dir() -> str:
+    """Default durable-control-plane state directory."""
+    return os.path.join(const.kubeml_home(), "control")
+
 
 def build_allocator(cluster_lanes, cluster_tenants=None,
-                    aging_s=None) -> Optional[ClusterAllocator]:
+                    aging_s=None,
+                    journal_dir: Optional[str] = None,
+                    fault_plan=None) -> Optional[ClusterAllocator]:
     """Build the scheduler's ClusterAllocator from deployment knobs.
     cluster_lanes <= 0 / None disables cluster mode (legacy FIFO).
     cluster_tenants: iterable of ``name=weight[:quota]`` specs (the
-    --cluster-tenant CLI flag) or a {name: (weight, quota)} mapping."""
+    --cluster-tenant CLI flag) or a {name: (weight, quota)} mapping.
+    journal_dir (durable control plane): attach a CRC-framed decision
+    journal so the allocator is crash-recoverable; an existing journal
+    there is REPLAYED — a restart reconstructs the pre-crash state."""
     if not cluster_lanes or int(cluster_lanes) <= 0:
         return None
     weights, quotas = {}, {}
@@ -42,8 +59,20 @@ def build_allocator(cluster_lanes, cluster_tenants=None,
             if quota is not None:
                 quotas[name] = quota
     kwargs = {} if aging_s is None else {"aging_s": float(aging_s)}
+    if journal_dir is None:
+        return ClusterAllocator(int(cluster_lanes), tenant_weights=weights,
+                                tenant_quotas=quotas, **kwargs)
+    journal = DecisionJournal(journal_dir, fault_plan=fault_plan)
+    prior = os.path.exists(journal.journal_path) or \
+        os.path.exists(journal.snapshot_path)
+    if prior:
+        return ClusterAllocator.recover(
+            journal, int(cluster_lanes), tenant_weights=weights,
+            tenant_quotas=quotas, compact_every=CONTROL_COMPACT_EVERY,
+            **kwargs)
     return ClusterAllocator(int(cluster_lanes), tenant_weights=weights,
-                            tenant_quotas=quotas, **kwargs)
+                            tenant_quotas=quotas, journal=journal,
+                            compact_every=CONTROL_COMPACT_EVERY, **kwargs)
 
 
 @dataclasses.dataclass
@@ -85,7 +114,9 @@ def start_deployment(mesh=None, controller_port: int = 0,
                      serve_hedge_after_s: Optional[float] = None,
                      cluster_lanes: Optional[int] = None,
                      cluster_tenants=None,
-                     cluster_aging_s: Optional[float] = None) -> Deployment:
+                     cluster_aging_s: Optional[float] = None,
+                     control_durable: bool = False,
+                     control_dir: Optional[str] = None) -> Deployment:
     """Start storage, PS, scheduler, controller wired together.
 
     Port 0 picks a free port (tests); use_default_ports uses the configured
@@ -97,6 +128,12 @@ def start_deployment(mesh=None, controller_port: int = 0,
     over that many shared worker lanes, with cluster_tenants
     (``name=weight[:quota]`` specs) keying quotas and weighted fair
     shares; None/0 keeps the legacy single-job scheduling path.
+    control_durable=True turns on the durable control plane: the
+    allocator journals every decision, the scheduler and PS mirror
+    their registries to state files under control_dir (default
+    ``$KUBEML_HOME/control/``), and a restart with pre-existing state
+    there RECOVERS — replaying the journal, re-adopting surviving
+    children, and rebuilding serving fleets — instead of starting cold.
     """
     if use_default_ports:
         controller_port = controller_port or const.CONTROLLER_PORT
@@ -104,10 +141,20 @@ def start_deployment(mesh=None, controller_port: int = 0,
         ps_port = ps_port or const.PS_PORT
         storage_port = storage_port or const.STORAGE_PORT
 
+    state_dir = None
+    prior_state = False
+    if control_durable or control_dir:
+        state_dir = control_dir or control_state_dir()
+        # decide BEFORE the services create their (empty) state files:
+        # anything already on disk means this boot is a restart
+        prior_state = os.path.isdir(state_dir) and \
+            any(os.scandir(state_dir))
+
     storage = StorageService(port=storage_port)
     storage.start()
 
     ps = ParameterServer(mesh=mesh, port=ps_port,
+                         state_dir=state_dir,
                          standalone_jobs=standalone_jobs or None,
                          job_partitions=job_partitions,
                          infer_cache_size=infer_cache_size,
@@ -131,9 +178,18 @@ def start_deployment(mesh=None, controller_port: int = 0,
     scheduler = Scheduler(ps_url=ps.url, port=scheduler_port,
                           allocator=build_allocator(cluster_lanes,
                                                     cluster_tenants,
-                                                    cluster_aging_s))
+                                                    cluster_aging_s,
+                                                    journal_dir=state_dir),
+                          state_dir=state_dir)
     scheduler.start()
     ps.scheduler_url = scheduler.url
+
+    if prior_state:
+        # pre-existing durable state means this boot is a RESTART of a
+        # crashed control plane: rebuild fleets/registries before the
+        # scheduler sweep decides re-adopt vs. requeue
+        ps.recover()
+        scheduler.recover()
 
     controller = Controller(scheduler_url=scheduler.url, ps_url=ps.url,
                             storage_url=storage.url, port=controller_port,

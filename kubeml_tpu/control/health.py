@@ -260,6 +260,34 @@ def _make_fleet_degraded():
     return check
 
 
+def _make_control_flapping(recover_limit: int = 2):
+    """Durable control plane (PR 17): critical when the allocator's
+    journaled recovery counter climbed `recover_limit`+ times within
+    the sample window — one recovery is the durability layer doing its
+    job, repeated recoveries mean the control plane is crash-looping
+    ("flapping") and every restart is re-running the adoption sweep.
+    Delta across the window like serve_crash_loop, so a single old
+    recovery ages out; non-cluster samples carry no cluster_* fields
+    and never fire this."""
+    def check(window: List[dict]) -> Optional[str]:
+        m = _latest(window)
+        latest = m.get("cluster_recoveries_total")
+        if latest is None:
+            return None
+        first = next((s.get("cluster_recoveries_total") for s in window
+                      if s.get("cluster_recoveries_total") is not None),
+                     None)
+        delta = float(latest) - float(first if first is not None else 0)
+        if delta >= recover_limit:
+            epoch = m.get("cluster_fencing_epoch", 0)
+            return (f"control plane recovered {delta:g} time(s) within "
+                    f"the sample window (limit {recover_limit}, fencing "
+                    f"epoch now {float(epoch):g}) — the control plane "
+                    f"is flapping")
+        return None
+    return check
+
+
 def _make_serve_ttft_slo(slo_s: float):
     def check(window: List[dict]) -> Optional[str]:
         m = _latest(window)
@@ -313,6 +341,9 @@ def default_rules(grad_abs: float = 1e4, grad_rel: float = 50.0,
         HealthRule("data_staleness", "warning",
                    "continual job's trained generation lags the registry",
                    _make_data_staleness(data_lag_limit)),
+        HealthRule("control_flapping", "critical",
+                   "control plane recovered repeatedly in the window",
+                   _make_control_flapping()),
     ]
 
 
@@ -382,7 +413,16 @@ _SAMPLE_FIELDS = ("train_loss", "validation_loss", "accuracy",
                   "cluster_gang_placements_total",
                   "cluster_preemptions_total",
                   "cluster_aged_grants_total",
-                  "cluster_quota_clamps_total")
+                  "cluster_quota_clamps_total",
+                  # durable control plane (PR 17): journaled recovery /
+                  # fencing counters survive restarts with the journal;
+                  # recoveries feed the control_flapping rule, the rest
+                  # the top control line
+                  "cluster_recoveries_total", "cluster_fencing_epoch",
+                  "cluster_fencing_rejections_total",
+                  "cluster_journal_records_total",
+                  "cluster_journal_compactions_total",
+                  "cluster_journal_torn_drops_total")
 
 
 class HealthEvaluator:

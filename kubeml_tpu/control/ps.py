@@ -39,7 +39,9 @@ import collections
 import json
 import logging
 import os
+import random
 import shutil
+import signal
 import subprocess
 import sys
 import tempfile
@@ -55,6 +57,7 @@ from kubeml_tpu.api.types import MetricUpdate, TrainTask
 from kubeml_tpu.control.health import HealthEvaluator
 from kubeml_tpu.control.httpd import (JsonService, Raw, Request, Stream,
                                       http_json)
+from kubeml_tpu.control.journal import atomic_write_json, read_json
 from kubeml_tpu.data.registry import DatasetRegistry
 from kubeml_tpu.metrics.prom import MetricsRegistry
 from kubeml_tpu.models.base import InferenceInputError, KubeDataset
@@ -240,8 +243,14 @@ class _JobRecord:
         #                       of respawning in place
         self.last_heartbeat: Optional[float] = None  # monotonic stamp
         self.heartbeat_progress = (0, 0)  # (epoch, round) last reported
+        # pid of a child RE-ADOPTED from a previous PS incarnation
+        # (control-plane recovery): there is no Popen handle to wait()
+        # on or terminate(), so preemption and the adopted watchdog go
+        # through this pid instead
+        self.adopted_pid: Optional[int] = None
 
-    def push_update(self, parallelism: int):
+    def push_update(self, parallelism: int,
+                    grant_epoch: Optional[int] = None):
         # standalone-ness is `job is None`, NOT `proc is not None`: a
         # crash-restarting record has proc/url transiently None and must
         # answer the 503 retry signal, not silently bank the update in
@@ -249,9 +258,15 @@ class _JobRecord:
         if self.job is None and self.url is None:
             raise KubeMLException(
                 f"job {self.task.job_id} still starting", 503)
+        if grant_epoch is not None:
+            # a recovered scheduler re-fenced the grant: the child must
+            # present the NEW epoch on its next /job ask or be 409'd
+            self.task.grant_epoch = int(grant_epoch)
         if self.url is not None:
-            http_json("POST", f"{self.url}/update",
-                      {"parallelism": parallelism})
+            body = {"parallelism": parallelism}
+            if grant_epoch is not None:
+                body["grant_epoch"] = int(grant_epoch)
+            http_json("POST", f"{self.url}/update", body)
         else:
             self.next_parallelism = parallelism
             self.update_event.set()
@@ -291,7 +306,8 @@ class ParameterServer(JsonService):
                  serve_scale_to_zero_s: Optional[float] = None,
                  serve_replica_restart_budget: Optional[int] = None,
                  serve_probe_requests: Optional[int] = None,
-                 serve_hedge_after_s: Optional[float] = None):
+                 serve_hedge_after_s: Optional[float] = None,
+                 state_dir: Optional[str] = None):
         super().__init__(port=port)
         # Lazy mesh: in standalone mode the PARENT must not initialize the
         # accelerator backend (on TPU, libtpu is single-process-exclusive —
@@ -403,6 +419,19 @@ class ParameterServer(JsonService):
             else os.environ.get("KUBEML_SERVE_HEDGE_AFTER_S", "0"))
         self._serve: Dict[str, tuple] = {}   # model_id -> (stamp, fleet)
         self._serve_lock = threading.Lock()
+        # durable control plane (opt-in): standalone-job and fleet
+        # manifests mirrored under state_dir so recover() can re-adopt
+        # surviving children and rebuild serving fleets after a crash
+        self.state_dir = state_dir
+        self._jobs_manifest_path = (
+            os.path.join(state_dir, "ps.jobs.json") if state_dir else None)
+        self._fleet_manifest_path = (
+            os.path.join(state_dir, "ps.fleets.json") if state_dir
+            else None)
+        if state_dir:
+            os.makedirs(state_dir, exist_ok=True)
+        self.recoveries = 0
+        self.last_recovery_s: Optional[float] = None
         self._infer_batcher = InferBatcher() if InferBatcher.enabled() \
             else None
         self.metrics = MetricsRegistry()
@@ -534,7 +563,10 @@ class ParameterServer(JsonService):
             rec = self.jobs.get(job_id)
         if rec is None:
             raise JobNotFoundError(job_id)
-        rec.push_update(int(req.body["parallelism"]))
+        epoch = req.body.get("grant_epoch") \
+            if isinstance(req.body, dict) else None
+        rec.push_update(int(req.body["parallelism"]),
+                        grant_epoch=None if epoch is None else int(epoch))
         return {"ok": True}
 
     def _h_metrics(self, req: Request):
@@ -646,12 +678,13 @@ class ParameterServer(JsonService):
             logger.warning("serving fleet %s: allocator preemption — "
                            "draining to zero", model_id)
             cur[1].scale_to_zero("allocator preemption")
+            self._persist_fleets()
             return {"ok": True}
         with self._jobs_lock:
             rec = self.jobs.get(job_id)
             if rec is None:
                 raise JobNotFoundError(job_id)
-            if rec.proc is None:
+            if rec.proc is None and rec.adopted_pid is None:
                 # threaded jobs share one process — there is no SIGTERM
                 # grace path to drain them individually
                 raise KubeMLException(
@@ -660,9 +693,18 @@ class ParameterServer(JsonService):
                     503)
             rec.requeue_on_exit = True
             proc = rec.proc
+            pid = rec.adopted_pid
         logger.warning("job %s: allocator preemption — sending SIGTERM "
                        "for drain + checkpoint + requeue", job_id)
-        proc.terminate()
+        if proc is not None:
+            proc.terminate()
+        else:
+            # re-adopted child (control-plane recovery): no Popen
+            # handle, terminate by pid
+            try:
+                os.kill(pid, signal.SIGTERM)
+            except OSError:
+                pass
         return {"ok": True}
 
     def _h_cluster(self, req: Request):
@@ -927,6 +969,10 @@ class ParameterServer(JsonService):
         lanes contend for one pool. Fails OPEN — a standalone PS or an
         unreachable scheduler must not stall serving elasticity."""
         def resize_cb(replicas: int) -> int:
+            # every autoscale decision also refreshes the durable fleet
+            # manifest — replica-count changes from inside the fleet
+            # (grow/shrink/scale-to-zero) all pass through here
+            self._persist_fleets()
             if not self.scheduler_url:
                 return replicas
             try:
@@ -963,6 +1009,7 @@ class ParameterServer(JsonService):
                     # from here on attach to the new generation
                     cur[1].install_weights(variables, stamp)
                     self._serve[model_id] = (stamp, cur[1])
+                    self._persist_fleets_async()
                 return cur[1]
         fleet = ServeFleet(
             model_id, self._serve_replica_factory(model_id),
@@ -989,6 +1036,7 @@ class ParameterServer(JsonService):
                 self._serve[model_id] = (stamp, fleet)
         if old is not None:
             old.stop()
+        self._persist_fleets()
         return fleet
 
     def _h_generate(self, req: Request):
@@ -1070,6 +1118,128 @@ class ParameterServer(JsonService):
             if r.submitted_at is not None:
                 self.metrics.observe_serve_stream(
                     svc.model_id, svc.clock() - r.submitted_at)
+
+    # ----------------------------------------------- durable control plane
+
+    def _persist_jobs(self) -> None:
+        """Mirror the standalone-job registry to the durable manifest
+        (atomic tmp+rename). Threaded jobs are deliberately absent:
+        they are threads of THIS process and cannot outlive it."""
+        if self._jobs_manifest_path is None:
+            return
+        with self._jobs_lock:
+            doc = {}
+            for job_id in sorted(self.jobs):
+                rec = self.jobs[job_id]
+                if rec.job is not None:
+                    continue
+                rec.task.restarts = rec.restarts
+                rec.task.preemptions = rec.preemptions
+                pid = rec.proc.pid if rec.proc is not None \
+                    else rec.adopted_pid
+                doc[job_id] = {"task": rec.task.to_dict(),
+                               "url": rec.url, "pid": pid,
+                               "partition": rec.partition}
+        atomic_write_json(self._jobs_manifest_path, {"jobs": doc})
+
+    def _persist_fleets(self) -> None:
+        """Mirror the serving registry — checkpoint stamp + live
+        replica count per model — so recover() can rebuild each fleet
+        at its pre-crash width with the last published weights."""
+        if self._fleet_manifest_path is None:
+            return
+        with self._serve_lock:
+            items = sorted(self._serve.items())
+        doc = {m: {"stamp": stamp, "replicas": fleet.replica_count}
+               for m, (stamp, fleet) in items}
+        atomic_write_json(self._fleet_manifest_path, {"fleets": doc})
+
+    def _persist_fleets_async(self) -> None:
+        """_persist_fleets for callers already holding _serve_lock
+        (a plain, non-reentrant Lock): defer to a short-lived thread
+        that takes the lock itself."""
+        if self._fleet_manifest_path is None:
+            return
+        threading.Thread(target=self._persist_fleets,
+                         name="persist-fleets", daemon=True).start()
+
+    def recover(self) -> dict:
+        """Rebuild a restarted PS from its durable manifests.
+
+        Standalone children that survived the control-plane crash are
+        RE-ADOPTED: probed over their recorded URL, reinstated in the
+        job registry (partition lease re-claimed, counters restored)
+        and watched by a pid-poll watchdog — never double-started.
+        Children that died with the control plane are dropped here; the
+        scheduler's own recovery sweep requeues them budget-free from
+        their checkpoints. Serving fleets are rebuilt at their recorded
+        replica counts via the ordinary build path, which re-installs
+        the last published checkpoint stamp — streams then resume
+        through the re-prefill path bit-identically."""
+        t0 = time.monotonic()
+        summary: dict = {"adopted": [], "dropped": [], "fleets": {}}
+        jobs_doc = (read_json(self._jobs_manifest_path)
+                    if self._jobs_manifest_path else None) or {}
+        for job_id, ent in sorted(jobs_doc.get("jobs", {}).items()):
+            task = TrainTask.from_dict(ent["task"])
+            url = ent.get("url")
+            alive = False
+            if url:
+                try:
+                    http_json("GET", f"{url}/health")
+                    alive = True
+                except Exception:
+                    alive = False
+            if not alive:
+                summary["dropped"].append(job_id)
+                logger.warning("ps recovery: job %s child is gone; "
+                               "leaving the requeue to the scheduler "
+                               "sweep", job_id)
+                continue
+            rec = _JobRecord(task, url=url)
+            rec.partition = ent.get("partition")
+            rec.adopted_pid = ent.get("pid")
+            with self._jobs_lock:
+                if job_id in self.jobs:
+                    continue
+                self.jobs[job_id] = rec
+                if rec.partition is not None and self.job_partitions:
+                    self._busy_partitions.add(rec.partition)
+            self.metrics.running_total.inc("train")
+            threading.Thread(target=self._watch_adopted,
+                             args=(job_id, rec, rec.adopted_pid),
+                             name=f"watch-{job_id}",
+                             daemon=True).start()
+            summary["adopted"].append(job_id)
+            logger.warning("ps recovery: re-adopted live child %s at "
+                           "%s (pid %s)", job_id, url, rec.adopted_pid)
+        fleets_doc = (read_json(self._fleet_manifest_path)
+                      if self._fleet_manifest_path else None) or {}
+        for model_id, ent in sorted(fleets_doc.get("fleets", {}).items()):
+            replicas = int(ent.get("replicas", 0))
+            if replicas <= 0:
+                continue  # was at zero; the next request cold-starts it
+            try:
+                fleet = self._serve_service(model_id)
+                live = fleet.ensure_replicas(replicas)
+                summary["fleets"][model_id] = live
+                logger.warning("ps recovery: fleet %s rebuilt at %d "
+                               "replica(s) (stamp %s)", model_id, live,
+                               ent.get("stamp"))
+            except Exception:
+                logger.exception("ps recovery: fleet %s rebuild failed",
+                                 model_id)
+        self.last_recovery_s = time.monotonic() - t0
+        self.recoveries += 1
+        self.metrics.note_control_recovery("ps", self.last_recovery_s)
+        self._persist_jobs()
+        self._persist_fleets()
+        summary["recovery_s"] = self.last_recovery_s
+        logger.warning("ps recovered in %.3fs: %d job(s) adopted, %d "
+                       "dropped, %d fleet(s) rebuilt",
+                       self.last_recovery_s, len(summary["adopted"]),
+                       len(summary["dropped"]), len(summary["fleets"]))
+        return summary
 
     # ------------------------------------------------------------- job mgmt
 
@@ -1266,11 +1436,47 @@ class ParameterServer(JsonService):
         threading.Thread(target=self._watch_standalone,
                          args=(task.job_id, rec),
                          name=f"watch-{task.job_id}", daemon=True).start()
+        self._persist_jobs()
 
     def _watch_standalone(self, job_id: str, rec: _JobRecord):
         proc = rec.proc
         proc.wait()
-        rc = proc.returncode
+        self._on_child_exit(job_id, rec, proc.returncode)
+
+    def _watch_adopted(self, job_id: str, rec: _JobRecord,
+                       pid: Optional[int]) -> None:
+        """Watchdog for a child RE-ADOPTED from a previous PS
+        incarnation (control-plane recovery): no Popen handle exists to
+        wait() on, so poll pid liveness (falling back to the child's
+        /health endpoint without one) and route its death through the
+        same exit logic as a spawn-watched child."""
+        while True:
+            if self._stopping:
+                return
+            with self._jobs_lock:
+                if self.jobs.get(job_id) is not rec:
+                    return  # deregistered normally via /finish
+            if rec.proc is not None:
+                return      # a restart respawned it; its own watchdog owns it
+            if pid is not None:
+                try:
+                    os.kill(pid, 0)
+                    alive = True
+                except OSError:
+                    alive = False
+            else:
+                try:
+                    http_json("GET", f"{rec.url}/health")
+                    alive = True
+                except Exception:
+                    alive = False
+            if not alive:
+                break
+            time.sleep(0.5)
+        self._on_child_exit(job_id, rec, None)
+
+    def _on_child_exit(self, job_id: str, rec: _JobRecord,
+                       rc: Optional[int]) -> None:
         # checkpoint-based recovery: a crashed job process (OOM-kill,
         # segfault — the pod-death analogue of the reference's
         # merge-with-survivors tolerance, util.go:144-166) restarts from
@@ -1322,6 +1528,7 @@ class ParameterServer(JsonService):
                     rec.restarts += 1
                 rec.proc = None
                 rec.url = None
+                rec.adopted_pid = None
                 rec.restarting = True
                 rec.last_heartbeat = None  # fresh liveness window
                 rec.task.parameters.resume_from = job_id
@@ -1373,13 +1580,28 @@ class ParameterServer(JsonService):
         logger.warning("job %s: handing preempted task back to the "
                        "scheduler queue (preemptions=%d, restarts=%d)",
                        job_id, rec.preemptions, rec.restarts)
-        try:
-            http_json("POST", f"{self.scheduler_url}/requeue",
-                      task.to_dict(), trace_id=task.trace_id or None)
-        except KubeMLException as e:
-            logger.error("requeue of preempted job %s failed: %s — the "
-                         "job is stranded until resubmitted", job_id,
-                         e.message)
+        self._persist_jobs()
+        # bounded retry with jittered backoff: the scheduler may be
+        # mid-restart (control-plane recovery window) — one failed POST
+        # must not strand the job forever
+        delay = 0.1
+        for attempt in range(5):
+            try:
+                http_json("POST", f"{self.scheduler_url}/requeue",
+                          task.to_dict(), trace_id=task.trace_id or None)
+                return
+            except KubeMLException as e:
+                if attempt == 4:
+                    logger.error("requeue of preempted job %s failed "
+                                 "after %d attempts: %s — the job is "
+                                 "stranded until resubmitted", job_id,
+                                 attempt + 1, e.message)
+                    return
+                logger.warning("requeue of %s failed (attempt %d/5): "
+                               "%s — retrying", job_id, attempt + 1,
+                               e.message)
+                time.sleep(delay * (0.5 + random.random() / 2))
+                delay = min(delay * 2, 2.0)
 
     def _wait_job_ready(self, proc: subprocess.Popen, port_file: str,
                         timeout: Optional[float] = None) -> str:
@@ -1487,6 +1709,7 @@ class ParameterServer(JsonService):
         self.metrics.clear_job(job_id)
         self.health.clear(job_id)
         self.metrics.running_total.inc("train", -1.0)
+        self._persist_jobs()
         if error:
             logger.warning("job %s exited with error: %s", job_id, error)
         if self.scheduler_url is not None:

@@ -26,15 +26,18 @@ from __future__ import annotations
 
 import collections
 import logging
+import os
 import random
 import threading
 import time
-from typing import Deque, Dict, List, Optional
+from typing import Deque, Dict, List, Optional, Tuple
 
 from kubeml_tpu.api.errors import InvalidArgsError, KubeMLException
 from kubeml_tpu.api.types import TrainRequest, TrainTask
-from kubeml_tpu.control.cluster import ClusterAllocator, Decision
+from kubeml_tpu.control.cluster import (ClusterAllocator, Decision,
+                                        verify_journal_roundtrip)
 from kubeml_tpu.control.httpd import JsonService, Request, http_json
+from kubeml_tpu.control.journal import atomic_write_json, read_json
 from kubeml_tpu.control.policy import SchedulerPolicy, ThroughputBasedPolicy
 from kubeml_tpu.utils.ids import make_job_id
 from kubeml_tpu.utils.trace import (TraceSink, Tracer, get_trace_context,
@@ -79,7 +82,8 @@ class Scheduler(JsonService):
     def __init__(self, ps_url: Optional[str] = None, port: int = 0,
                  policy: Optional[SchedulerPolicy] = None,
                  allocator: Optional[ClusterAllocator] = None,
-                 rng: Optional[random.Random] = None):
+                 rng: Optional[random.Random] = None,
+                 state_dir: Optional[str] = None):
         super().__init__(port=port)
         self.ps_url = ps_url
         self.policy = policy or ThroughputBasedPolicy()
@@ -104,8 +108,25 @@ class Scheduler(JsonService):
         # cluster mode: tasks the allocator parked ('queue' decisions),
         # and lane grants awaiting their dispatch pass through the queue
         self._parked: Dict[str, TrainTask] = {}
-        self._granted: Dict[str, int] = {}
-        self._cluster_lock = threading.Lock()
+        # job_id -> (lanes, fencing epoch) awaiting the /start dispatch
+        self._granted: Dict[str, Tuple[int, int]] = {}
+        # RLock: _apply_decisions mutates _granted under the lock and
+        # the durability mirror (_track_locked) persists in the same
+        # critical section
+        self._cluster_lock = threading.RLock()
+        # durability (opt-in): every submitted task + its lifecycle
+        # phase, mirrored to <state_dir>/scheduler.state.json on each
+        # transition so recover() can rebuild queue/parked/granted
+        self.state_dir = state_dir
+        self._state_path = (os.path.join(state_dir, "scheduler.state.json")
+                            if state_dir else None)
+        if state_dir:
+            os.makedirs(state_dir, exist_ok=True)
+        self._submitted: Dict[str, dict] = {}
+        # recovery observability: wall seconds the last recover() took
+        # (rides the next cluster-state push into the PS histogram)
+        self.last_recovery_s: Optional[float] = None
+        self.recoveries = 0
         # persistent per-job tracers: TraceSink rewrites the whole file
         # per flush, so every event for a job over its scheduler
         # lifetime (enqueue span + allocator decision instants) must
@@ -139,6 +160,32 @@ class Scheduler(JsonService):
             self.queue._cv.notify_all()
         super().stop()
 
+    # ----------------------------------------------------------- durability
+
+    def _track(self, task: TrainTask, phase: str,
+               lanes: int = 0, epoch: int = 0) -> None:
+        """Mirror one task's lifecycle phase (queued | parked |
+        granted) to the durable state file. No-op without state_dir."""
+        if self._state_path is None:
+            return
+        with self._cluster_lock:
+            self._submitted[task.job_id] = {
+                "task": task.to_dict(), "phase": phase,
+                "lanes": int(lanes), "epoch": int(epoch)}
+            self._persist_locked()
+
+    def _untrack(self, job_id: str) -> None:
+        if self._state_path is None:
+            return
+        with self._cluster_lock:
+            if self._submitted.pop(job_id, None) is not None:
+                self._persist_locked()
+
+    def _persist_locked(self) -> None:
+        atomic_write_json(self._state_path, {
+            "tasks": {j: self._submitted[j]
+                      for j in sorted(self._submitted)}})
+
     # ------------------------------------------------------------- handlers
 
     def _h_train(self, req: Request):
@@ -155,6 +202,7 @@ class Scheduler(JsonService):
                          tenant=train_req.tenant)
         tracer = self._job_tracer(task.job_id, trace_id=task.trace_id)
         with tracer.span("scheduler.enqueue", job_id=task.job_id):
+            self._track(task, "queued")
             self.queue.push(task)
         self._flush_job_trace(task.job_id)
         logger.info("queued train task %s (%s on %s)", task.job_id,
@@ -163,8 +211,16 @@ class Scheduler(JsonService):
 
     def _h_job(self, req: Request):
         """A running job requests re-parallelization; answered via PS
-        /update/{jobId} from the scheduling loop (api.go:47-75)."""
+        /update/{jobId} from the scheduling loop (api.go:47-75).
+
+        Fencing: a task carrying a grant_epoch is checked against the
+        allocator's current epoch for that job. A stale epoch (a
+        pre-crash worker that outlived the control plane which granted
+        it) is rejected 409 (StaleGrantError propagates through the
+        JSON envelope) so a recovered allocator never double-books."""
         task = TrainTask.from_dict(req.body)
+        if self.allocator is not None and task.grant_epoch:
+            self.allocator.fence_check(task.job_id, task.grant_epoch)
         self.queue.push(task)
         return {"ok": True}
 
@@ -175,6 +231,7 @@ class Scheduler(JsonService):
 
     def _h_finish(self, req: Request):
         task_id = req.params["taskId"]
+        self._untrack(task_id)
         self.policy.task_finished(task_id)
         # drop any backoff streak so the id doesn't linger forever
         # (single-key dict pop — safe against the loop thread's reads)
@@ -208,6 +265,8 @@ class Scheduler(JsonService):
         self.policy.task_finished(task.job_id)
         task.state = "queued"
         task.elapsed_time_s = -1.0
+        task.grant_epoch = 0
+        self._track(task, "queued")
         if self.allocator is not None:
             # the victim's lanes free NOW (its process is gone); any
             # parked higher-priority arrival places on this release
@@ -378,15 +437,17 @@ class Scheduler(JsonService):
         with self._cluster_lock:
             granted = self._granted.pop(job_id, None)
         if granted is not None:
+            lanes, epoch = granted
             # prime the advisor (first call caches the reference slot)
             # but dispatch at the allocator's width, not the advisor's
             self.policy.calculate_parallelism(task)
-            task.parallelism = granted
+            task.parallelism = lanes
+            task.grant_epoch = epoch
             if self.ps_url is None:
                 logger.warning("no PS configured; dropping task %s", job_id)
                 return
             logger.info("starting task %s with %d allocator-granted "
-                        "lane(s)", job_id, granted)
+                        "lane(s) (fencing epoch %d)", job_id, lanes, epoch)
             try:
                 http_json("POST", f"{self.ps_url}/start", task.to_dict(),
                           trace_id=task.trace_id or None)
@@ -419,6 +480,7 @@ class Scheduler(JsonService):
         self.policy.task_finished(job_id)
         with self._cluster_lock:
             self._parked[job_id] = task
+        self._track(task, "parked")
         ask = parallelism or task.parameters.options.default_parallelism
         self._apply_decisions(self.allocator.submit(
             job_id, tenant=task.tenant, priority=task.priority,
@@ -467,7 +529,8 @@ class Scheduler(JsonService):
                 with self._cluster_lock:
                     task = self._parked.pop(d.job_id, None)
                     if task is not None:
-                        self._granted[d.job_id] = d.lanes
+                        self._granted[d.job_id] = (d.lanes, d.epoch)
+                        self._track(task, "granted", d.lanes, d.epoch)
                 if task is None:
                     # finished/aborted while parked: give the lanes
                     # back, and apply any grants they unlock in turn
@@ -498,14 +561,162 @@ class Scheduler(JsonService):
                     self._apply_decisions(
                         self.allocator.release(d.victim))
 
-    def _push_cluster_state(self):
+    def _push_cluster_state(self, extra: Optional[dict] = None):
         """Feed the allocator snapshot to the PS: Prometheus gauges
         (POST /cluster) + the health pipeline under the `cluster`
         pseudo job id, which `kubeml top --id cluster` renders."""
         if self.allocator is None or self.ps_url is None:
             return
+        snap = self.allocator.snapshot()
+        if extra:
+            snap.update(extra)
         try:
-            http_json("POST", f"{self.ps_url}/cluster",
-                      self.allocator.snapshot())
+            http_json("POST", f"{self.ps_url}/cluster", snap)
         except KubeMLException as e:
             logger.warning("cluster state push failed: %s", e.message)
+
+    # ------------------------------------------------------------- recovery
+
+    def _probe_ps_tasks(self) -> List[dict]:
+        """Ask the PS which jobserver children are still alive (GET
+        /tasks lists every registered job). Bounded retry with jittered
+        backoff: recovery typically races the PS's own restart."""
+        if self.ps_url is None:
+            return []
+        delay = 0.1
+        for attempt in range(5):
+            try:
+                return http_json("GET", f"{self.ps_url}/tasks") or []
+            except KubeMLException as e:
+                if attempt == 4:
+                    logger.warning("PS task probe failed after %d "
+                                   "attempts: %s — treating every "
+                                   "granted job as dead", attempt + 1,
+                                   e.message)
+                    return []
+                time.sleep(delay * (0.5 + self._rng.random() / 2))
+                delay = min(delay * 2, 1.0)
+        return []
+
+    def recover(self, ps_tasks: Optional[List[dict]] = None) -> dict:
+        """Rebuild a restarted scheduler from the durable state file +
+        the allocator's replayed journal. For each persisted task:
+
+        - granted + its jobserver child still alive on the PS: RE-ADOPT
+          it — re-grant at the journaled width under the new fencing
+          epoch (allocator.regrant), prime the advisor so the child's
+          next /job ask takes the resize path (never a double /start),
+          and push the new epoch to the live child via PS /update;
+        - granted + child dead: release the lanes and requeue as a
+          fresh arrival WITHOUT consuming max_restarts (resume_from
+          points at its own checkpoint when one exists);
+        - parked / queued: re-park behind the replayed allocator state
+          or re-push onto the queue.
+
+        `ps_tasks` is injectable for tests; None probes GET /tasks.
+        Ends with the journal round-trip self-check (the recovered
+        allocator must equal a second replay of its own journal) and a
+        cluster-state push carrying the recovery duration."""
+        t0 = time.monotonic()
+        state = read_json(self._state_path) if self._state_path else None
+        entries = (state or {}).get("tasks", {})
+        summary = {"adopted": [], "requeued": [], "parked": [],
+                   "queued": []}
+        if self.allocator is not None:
+            summary["fencing_epoch"] = self.allocator.mark_recovered()
+        if ps_tasks is None:
+            ps_tasks = self._probe_ps_tasks()
+        live = {t.get("job_id") or t.get("id") for t in ps_tasks}
+        for job_id in sorted(entries):
+            ent = entries[job_id]
+            task = TrainTask.from_dict(ent["task"])
+            phase = ent.get("phase", "queued")
+            if phase == "granted" and self.allocator is not None:
+                regrant = self.allocator.regrant(job_id) \
+                    if job_id in live else None
+                if regrant is not None:
+                    lanes, epoch = regrant
+                    task.parallelism = lanes
+                    task.grant_epoch = epoch
+                    task.state = "running"
+                    # prime the advisor: the child is RUNNING, so its
+                    # next /job ask must take the resize path, not a
+                    # double /start
+                    self.policy.calculate_parallelism(task)
+                    with self._cluster_lock:
+                        self._submitted[job_id] = {
+                            "task": task.to_dict(), "phase": "granted",
+                            "lanes": lanes, "epoch": epoch}
+                    if self.ps_url is not None:
+                        try:
+                            http_json(
+                                "POST",
+                                f"{self.ps_url}/update/{job_id}",
+                                {"parallelism": lanes,
+                                 "grant_epoch": epoch})
+                        except KubeMLException as e:
+                            logger.warning(
+                                "epoch push to adopted job %s failed: "
+                                "%s", job_id, e.message)
+                    summary["adopted"].append(job_id)
+                    logger.warning("re-adopted running job %s at %d "
+                                   "lane(s), fencing epoch %d", job_id,
+                                   lanes, epoch)
+                    continue
+                # child is dead (or the allocator lost the grant):
+                # free the lanes and requeue budget-free — the same
+                # transformation as a preemption requeue
+                self._apply_decisions(self.allocator.release(job_id))
+                self.policy.task_finished(job_id)
+                task.state = "queued"
+                task.elapsed_time_s = -1.0
+                task.grant_epoch = 0
+                if not task.parameters.resume_from:
+                    try:
+                        from kubeml_tpu.train.checkpoint import \
+                            checkpoint_saved_at
+                        if checkpoint_saved_at(job_id) is not None:
+                            task.parameters.resume_from = job_id
+                    except Exception:
+                        pass
+                self._track(task, "queued")
+                self.queue.push(task)
+                summary["requeued"].append(job_id)
+                logger.warning("granted job %s died with the control "
+                               "plane; requeued without consuming "
+                               "max_restarts", job_id)
+                continue
+            if phase == "parked" and self.allocator is not None and \
+                    job_id in self.allocator.pending_jobs():
+                with self._cluster_lock:
+                    self._parked[job_id] = task
+                summary["parked"].append(job_id)
+                continue
+            # queued — or parked but unknown to the replayed allocator
+            # (journal predates the park): re-enter as a fresh arrival
+            task.state = "queued"
+            task.grant_epoch = 0
+            self._track(task, "queued")
+            self.queue.push(task)
+            summary["queued"].append(job_id)
+        # self-check: the recovered allocator must be reconstructible
+        # from its own journal — divergence here means the journal and
+        # the live state have forked, and raises JournalCorruptError
+        if self.allocator is not None and \
+                getattr(self.allocator, "_journal", None) is not None:
+            verify_journal_roundtrip(self.allocator)
+        if self._state_path is not None:
+            with self._cluster_lock:
+                self._persist_locked()
+        self.last_recovery_s = time.monotonic() - t0
+        self.recoveries += 1
+        summary["recovery_s"] = self.last_recovery_s
+        self._push_cluster_state(
+            extra={"control_recovery_s": self.last_recovery_s,
+                   "control_role": "scheduler"})
+        logger.warning(
+            "scheduler recovered in %.3fs: %d adopted, %d requeued, "
+            "%d parked, %d queued", self.last_recovery_s,
+            len(summary["adopted"]), len(summary["requeued"]),
+            len(summary["parked"]), len(summary["queued"]))
+        return summary

@@ -124,6 +124,30 @@ Fleet event kinds:
            (hedge_after_s) then re-issues its over-age queued streams on
            a healthy peer. Fires once per event (the slow-step event it
            plants fires every step).
+
+The CONTROL PLANE has a fourth plan class (ControlFaultPlan) whose
+coordinate is the decision-journal record index — the unit of blast
+radius is one control-plane process, and the DecisionJournal append /
+replay sites (control/journal.py) are the deterministic injection
+points. Control event kinds:
+
+  control_crash
+           raise ControlCrash AFTER the journal frame at the target
+           index is durably flushed — death-after-durable, the common
+           crash. Replay must reconstruct the allocator exactly
+           including that last decision. Fires once per event.
+  control_torn_write
+           raise ControlCrash MID-append at the target index, leaving a
+           strict prefix of the frame on disk — the torn-tail
+           signature. Replay must detect the partial frame by CRC,
+           drop it, and reconstruct the state as of index-1. Fires
+           once per event.
+  control_slow_recover
+           time.sleep(duration_s) at the top of journal replay — a
+           dilated recovery window, so re-adoption grace and the
+           kubeml_control_recovery_seconds histogram tails are
+           drivable (keep duration_s small in tier-1). Fires once per
+           event.
 """
 
 from __future__ import annotations
@@ -154,6 +178,20 @@ SERVE_KINDS = ("serve_nan_logits", "serve_step_crash", "serve_slow_step",
 # and fails unless every kind is asserted by name under tests/
 FLEET_KINDS = ("fleet_replica_crash", "fleet_replica_wedge",
                "fleet_replica_slow")
+
+# control-plane fault kinds (ControlFaultPlan below); same quoted-name
+# coverage rule — tools/check_fault_tests.py parses this tuple and
+# fails unless every kind is asserted by name under tests/
+CONTROL_KINDS = ("control_crash", "control_torn_write",
+                 "control_slow_recover")
+
+
+class ControlCrash(RuntimeError):
+    """Simulated control-plane process death, raised from inside a
+    DecisionJournal append by an injected control_crash /
+    control_torn_write event. Tests and the bench catch it, abandon the
+    in-memory control plane, and recover a fresh one from the journal —
+    the in-process twin of kill -9 on the scheduler."""
 
 # distinctive enough that a watchdog test can assert the death was the
 # injected crash, not an import error or OOM kill
@@ -570,3 +608,94 @@ class FleetFaultPlan:
                            ev.kind, tick, target)
             out.append((ev.kind, target, ev))
         return out
+
+
+@dataclasses.dataclass
+class ControlFaultEvent:
+    """One control-plane injection at a decision-journal record index;
+    -1 = wildcard (the first append / the first replay)."""
+
+    kind: str
+    index: int = -1
+    duration_s: float = 0.0   # control_slow_recover only
+
+    def at_index(self, index: int) -> bool:
+        return self.index < 0 or self.index == index
+
+
+class ControlFaultPlan:
+    """Coordinate-driven fault schedule for the control plane (module
+    docstring for kind semantics). The DecisionJournal (control/
+    journal.py) is the injection point: `torn_at` / `crash_at` are
+    consulted inside append() at the exact record index, and
+    `sleep_recover` at the top of replay() — so every crash/recovery
+    path replays bit-for-bit with zero wall-clock randomness. Every
+    event fires once."""
+
+    def __init__(self, events: List[ControlFaultEvent]):
+        self.events = events
+        self.injected = {k: 0 for k in CONTROL_KINDS}
+        self._fired: set = set()          # event index -> fired (once-only)
+
+    @classmethod
+    def parse(cls, spec: Any) -> "ControlFaultPlan":
+        """Parse a JSON string / dict / list of control event dicts."""
+        if isinstance(spec, ControlFaultPlan):
+            return spec
+        if isinstance(spec, str):
+            spec = json.loads(spec)
+        if isinstance(spec, dict):
+            spec = spec.get("events", [])
+        if not isinstance(spec, list):
+            raise ValueError("control fault_plan must be a list of "
+                             "events or {'events': [...]}")
+        events = []
+        for e in spec:
+            kind = e.get("kind")
+            if kind not in CONTROL_KINDS:
+                raise ValueError(f"unknown control fault kind {kind!r}; "
+                                 f"expected one of {CONTROL_KINDS}")
+            events.append(ControlFaultEvent(
+                kind=kind,
+                index=int(e.get("index", -1)),
+                duration_s=float(e.get("duration_s", 0.0)),
+            ))
+        return cls(events)
+
+    def has(self, kind: str) -> bool:
+        return any(ev.kind == kind for ev in self.events)
+
+    def _fire_one(self, kind: str, index: int) -> bool:
+        for i, ev in enumerate(self.events):
+            if ev.kind != kind or i in self._fired:
+                continue
+            if not ev.at_index(index):
+                continue
+            self._fired.add(i)
+            self.injected[kind] += 1
+            logger.warning("control fault %s: journal index %d",
+                           kind, index)
+            return True
+        return False
+
+    def torn_at(self, index: int) -> bool:
+        """True when the append at `index` must be torn (partial frame
+        on disk, then ControlCrash)."""
+        return self._fire_one("control_torn_write", index)
+
+    def crash_at(self, index: int) -> bool:
+        """True when the control plane must die AFTER the durable
+        append at `index`."""
+        return self._fire_one("control_crash", index)
+
+    def sleep_recover(self) -> None:
+        """Dilate journal replay by any due control_slow_recover
+        events (once each)."""
+        for i, ev in enumerate(self.events):
+            if ev.kind != "control_slow_recover" or i in self._fired:
+                continue
+            self._fired.add(i)
+            self.injected["control_slow_recover"] += 1
+            logger.warning("control fault control_slow_recover: "
+                           "sleeping %.3fs", ev.duration_s)
+            time.sleep(ev.duration_s)

@@ -690,6 +690,29 @@ class MetricsRegistry:
         self.cluster_quota_clamps_total = Counter(
             "kubeml_cluster_quota_clamps_total",
             "Gang or resize asks clamped to a tenant quota", "pool")
+        # durable control plane (control/journal.py): recovery counts
+        # and latency per role, decision-journal activity, and stale
+        # grants rejected by the fencing epoch — the split-brain signal
+        self.control_recoveries_total = Counter(
+            "kubeml_control_recoveries_total",
+            "Control-plane crash recoveries completed", "role")
+        self.control_journal_records_total = Counter(
+            "kubeml_control_journal_records_total",
+            "Decision-journal records appended", "role")
+        self.control_journal_compactions_total = Counter(
+            "kubeml_control_journal_compactions_total",
+            "Decision-journal snapshot compactions", "role")
+        self.control_fencing_rejections_total = Counter(
+            "kubeml_control_fencing_rejections_total",
+            "Stale lane grants rejected by their fencing epoch", "role")
+        self.control_recovery_seconds = Histogram(
+            "kubeml_control_recovery_seconds",
+            "Wall seconds one control-plane role took to recover",
+            "role")
+        self.control_fencing_epoch = Gauge(
+            "kubeml_control_fencing_epoch",
+            "Current fencing epoch of the lane-grant allocator "
+            "(bumped on every recovery)", "pool")
         # MetricUpdate carries these as cumulative-over-the-job values;
         # the counters advance by delta so they stay monotone even when
         # an update is replayed after a job restart
@@ -758,11 +781,16 @@ class MetricsRegistry:
                                 self.cluster_oldest_wait,
                                 self.cluster_tenant_lanes,
                                 self.cluster_tenant_quota,
-                                self.cluster_tenant_share]
+                                self.cluster_tenant_share,
+                                self.control_fencing_epoch]
         self._cluster_counters = [self.cluster_gang_placements_total,
                                   self.cluster_preemptions_total,
                                   self.cluster_aged_grants_total,
-                                  self.cluster_quota_clamps_total]
+                                  self.cluster_quota_clamps_total,
+                                  self.control_recoveries_total,
+                                  self.control_journal_records_total,
+                                  self.control_journal_compactions_total,
+                                  self.control_fencing_rejections_total]
         # cumulative counter values seen per snapshot field, for the
         # delta advance in update_cluster
         self._cluster_seen: Dict[str, float] = {}
@@ -1070,6 +1098,37 @@ class MetricsRegistry:
             if cum > seen:
                 counter.inc("shared", cum - seen)
                 self._cluster_seen[field] = cum
+        # durable control plane: the allocator's journaled lifetime
+        # counters (they survive restart, so deltas stay monotone
+        # across control-plane incarnations)
+        self.control_fencing_epoch.set(
+            "shared", float(snap.get("cluster_fencing_epoch", 0)))
+        for field, counter, role in (
+                ("cluster_recoveries_total",
+                 self.control_recoveries_total, "allocator"),
+                ("cluster_journal_records_total",
+                 self.control_journal_records_total, "allocator"),
+                ("cluster_journal_compactions_total",
+                 self.control_journal_compactions_total, "allocator"),
+                ("cluster_fencing_rejections_total",
+                 self.control_fencing_rejections_total, "allocator")):
+            cum = float(snap.get(field, 0))
+            seen = self._cluster_seen.get(field, 0.0)
+            if cum > seen:
+                counter.inc(role, cum - seen)
+                self._cluster_seen[field] = cum
+        # a just-recovered scheduler stamps its recovery duration onto
+        # its first snapshot push
+        rs = snap.get("control_recovery_s")
+        if rs is not None:
+            self.note_control_recovery(
+                str(snap.get("control_role", "scheduler")), float(rs))
+
+    def note_control_recovery(self, role: str, seconds: float) -> None:
+        """One completed control-plane recovery for `role` (scheduler /
+        ps / allocator): lifetime count + wall-seconds histogram."""
+        self.control_recoveries_total.inc(role)
+        self.control_recovery_seconds.observe(role, seconds)
 
     def note_infer_cache(self, hit: bool, cache: str = "checkpoints") -> None:
         (self.infer_cache_hits_total if hit
@@ -1102,5 +1161,6 @@ class MetricsRegistry:
                     + self._job_multi + self._job_hists
                     + self._serve_gauges + self._serve_counters
                     + self._serve_hists + self._serve_multi_hists
-                    + self._cluster_gauges + self._cluster_counters)
+                    + self._cluster_gauges + self._cluster_counters
+                    + [self.control_recovery_seconds])
         return "\n".join(f.collect() for f in families) + "\n"

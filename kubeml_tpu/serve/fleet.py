@@ -996,6 +996,26 @@ class ServeFleet:
         with self._lock:
             return list(self._replicas.values())
 
+    def ensure_replicas(self, n: int) -> int:
+        """Grow to at least ``n`` live replicas (capped at
+        replicas_max); returns the live count. Control-plane recovery
+        rebuilds a persisted fleet at its pre-crash width through this
+        instead of waiting for SLO pressure to re-grow it one
+        autoscale tick at a time."""
+        target = min(max(0, int(n)), self.replicas_max)
+        spawned = 0
+        while True:
+            with self._lock:
+                live = len(self._live_idxs())
+            if live >= target:
+                if spawned:
+                    logger.info("fleet %s: recovery grew to %d "
+                                "replica(s) (+%d)", self.model_id,
+                                live, spawned)
+                return live
+            self._spawn_one()
+            spawned += 1
+
     def engines(self) -> List[Tuple[int, object]]:
         with self._lock:
             return [(i, svc.engine) for i, svc in self._replicas.items()]

@@ -36,7 +36,10 @@ from __future__ import annotations
 import argparse
 import logging
 import os
+import random
 import threading
+import time
+import zlib
 from typing import Optional
 
 from kubeml_tpu.api.errors import (InvalidArgsError, JobPreemptedError,
@@ -72,6 +75,9 @@ class JobServer(JsonService):
             os.environ.get("KUBEML_HEARTBEAT_INTERVAL", "10"))
         self._next_parallelism: Optional[int] = None
         self._update_event = threading.Event()
+        # backoff jitter source for control-plane callbacks, seeded from
+        # the job id so a test run replays the same retry schedule
+        self._rng = random.Random(zlib.crc32(job_id.encode()))
 
         self.route("POST", "/start", self._h_start)
         self.route("POST", "/update", self._h_update)
@@ -91,6 +97,13 @@ class JobServer(JsonService):
 
     def _h_update(self, req: Request):
         self._next_parallelism = int(req.body["parallelism"])
+        epoch = req.body.get("grant_epoch")
+        if epoch is not None and self._job is not None:
+            # durable control plane: a recovered scheduler re-grants
+            # surviving jobs under a new fencing epoch and relays it
+            # here — adopt it so the next /job ask presents the current
+            # epoch instead of being 409'd as a stale pre-crash grant
+            self._job.task.grant_epoch = int(epoch)
         self._update_event.set()
         return {"ok": True}
 
@@ -137,6 +150,33 @@ class JobServer(JsonService):
                 name=f"heartbeat-{self.job_id}", daemon=True)
             self._hb_thread.start()
 
+    def _post_with_retry(self, what: str, url: str, body: dict,
+                         attempts: int = 5, base_delay: float = 0.05,
+                         max_delay: float = 2.0) -> bool:
+        """Control-plane callback with bounded, jittered exponential
+        backoff: a PS or scheduler that is mid-restart (durable control
+        plane) is back within a moment, so a short retry window turns a
+        lost notification into a late one. Bounded — after `attempts`
+        the loss is logged and the control plane's own backstops (the
+        PS liveness reaper, the scheduler recovery sweep) take over.
+        Jitter comes from the job-id-seeded RNG so runs replay the same
+        schedule."""
+        delay = base_delay
+        for attempt in range(attempts):
+            try:
+                http_json("POST", url, body)
+                return True
+            except KubeMLException as e:
+                if attempt == attempts - 1:
+                    logger.warning("%s failed after %d attempt(s): %s",
+                                   what, attempts, e.message)
+                    return False
+                logger.debug("%s attempt %d failed (%s); retrying",
+                             what, attempt + 1, e.message)
+                time.sleep(delay * (0.5 + self._rng.random() / 2))
+                delay = min(delay * 2, max_delay)
+        return False
+
     def _run(self):
         try:
             self._job.train()
@@ -148,13 +188,10 @@ class JobServer(JsonService):
             logger.warning("job %s preempted at epoch %d round %d; "
                            "notifying PS", self.job_id, e.epoch, e.round)
             if self.ps_url is not None:
-                try:
-                    http_json("POST",
-                              f"{self.ps_url}/preempted/{self.job_id}",
-                              {"epoch": e.epoch, "round": e.round})
-                except KubeMLException as err:
-                    logger.warning("preemption notification failed: %s",
-                                   err.message)
+                self._post_with_retry(
+                    "preemption notification",
+                    f"{self.ps_url}/preempted/{self.job_id}",
+                    {"epoch": e.epoch, "round": e.round})
             self.finished.set()
         except Exception:
             logger.exception("job %s failed", self.job_id)
@@ -182,11 +219,13 @@ class JobServer(JsonService):
             if job is None:
                 continue
             epoch, rnd = getattr(job, "_progress", (0, 0))
-            try:
-                http_json("POST", f"{self.ps_url}/heartbeat/{self.job_id}",
-                          {"epoch": int(epoch), "round": int(rnd)})
-            except KubeMLException as e:
-                logger.debug("heartbeat failed: %s", e.message)
+            # short bounded retry (not the full budget): a beat lost to
+            # a PS restart costs a reaper miss, but the NEXT beat is
+            # only heartbeat_interval away, so don't stall this loop
+            self._post_with_retry(
+                "heartbeat", f"{self.ps_url}/heartbeat/{self.job_id}",
+                {"epoch": int(epoch), "round": int(rnd)},
+                attempts=3, max_delay=0.5)
 
     # ------------------------------------------------------------ callbacks
 
@@ -219,11 +258,9 @@ class JobServer(JsonService):
     def _on_finish(self, job_id: str, error: Optional[str]):
         self.exit_error = error
         if self.ps_url is not None:
-            try:
-                http_json("POST", f"{self.ps_url}/finish/{job_id}",
-                          {"error": error})
-            except KubeMLException as e:
-                logger.warning("finish notification failed: %s", e.message)
+            self._post_with_retry("finish notification",
+                                  f"{self.ps_url}/finish/{job_id}",
+                                  {"error": error})
         self.finished.set()
 
 

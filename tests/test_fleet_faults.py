@@ -508,7 +508,8 @@ def test_fault_lint_fleet_kind_coverage_self_test(tmp_path):
     (root / "tests").mkdir()
     faults = root / "kubeml_tpu" / "faults.py"
     faults.write_text('SERVE_KINDS = ()\n'
-                      'FLEET_KINDS = ("zz_boom", "zz_wedge")\n')
+                      'FLEET_KINDS = ("zz_boom", "zz_wedge")\n'
+                      'CONTROL_KINDS = ()\n')
     tests_dir = str(root / "tests")
 
     assert lint.fleet_kinds(str(faults)) == ["zz_boom", "zz_wedge"]

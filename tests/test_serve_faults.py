@@ -545,7 +545,8 @@ def test_fault_lint_serve_kind_coverage_self_test(tmp_path):
     (root / "tests").mkdir()
     faults = root / "kubeml_tpu" / "faults.py"
     faults.write_text('SERVE_KINDS = ("zz_boom", "zz_hang")\n'
-                      'FLEET_KINDS = ()\n')
+                      'FLEET_KINDS = ()\n'
+                      'CONTROL_KINDS = ()\n')
     tests_dir = str(root / "tests")
 
     assert lint.serve_kinds(str(faults)) == ["zz_boom", "zz_hang"]

@@ -22,6 +22,13 @@ be asserted by quoted name under tests/ too. Replica ejection and live
 stream migration are exactly the machinery that silently rots without
 a named test.
 
+A fourth contract (PR 17) covers the durable control plane: every
+CONTROL_KINDS entry (control_crash / control_torn_write /
+control_slow_recover — the fault kinds the decision journal delivers
+at named decision indices) must be asserted by quoted name under
+tests/. Crash recovery that nobody crash-tests is a journal format,
+not a durability guarantee.
+
 Run directly (exit 1 on violation) or via tests/test_faults.py, which
 keeps the lint itself in the tier-1 suite:
 
@@ -115,6 +122,19 @@ def fleet_kinds(faults_path: str) -> list:
     return re.findall(r"[\"']([^\"']+)[\"']", m.group(1))
 
 
+def control_kinds(faults_path: str) -> list:
+    """The declared CONTROL-plane fault kinds, parsed from the
+    CONTROL_KINDS tuple literal (same rule as serve_kinds). Crash
+    recovery, torn-write repair, and slow-recovery windows are exactly
+    the machinery nobody notices rotting without a named test."""
+    with open(faults_path, encoding="utf-8") as f:
+        src = f.read()
+    m = re.search(r"CONTROL_KINDS\s*=\s*\(([^)]*)\)", src)
+    if not m:
+        raise SystemExit(f"{faults_path}: CONTROL_KINDS tuple not found")
+    return re.findall(r"[\"']([^\"']+)[\"']", m.group(1))
+
+
 def file_asserts_kind(path: str, kind: str) -> bool:
     """True when the file asserts on the QUOTED kind name. Unlike
     _code_lines this keeps STRING tokens — the kind appears as a string
@@ -149,6 +169,10 @@ def unasserted_fleet_kinds(faults_path: str, tests_dir: str) -> list:
     return _unasserted(fleet_kinds(faults_path), tests_dir)
 
 
+def unasserted_control_kinds(faults_path: str, tests_dir: str) -> list:
+    return _unasserted(control_kinds(faults_path), tests_dir)
+
+
 def main(argv) -> int:
     root = argv[1] if len(argv) > 1 else os.path.join(
         os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
@@ -179,6 +203,13 @@ def main(argv) -> int:
         missing = unasserted_fleet_kinds(faults_path, root)
         for kind in missing:
             print(f"{faults_path}: fleet fault kind {kind!r} has no "
+                  f"tier-1 test asserting its quoted name under {root}",
+                  file=sys.stderr)
+        if missing:
+            return 1
+        missing = unasserted_control_kinds(faults_path, root)
+        for kind in missing:
+            print(f"{faults_path}: control fault kind {kind!r} has no "
                   f"tier-1 test asserting its quoted name under {root}",
                   file=sys.stderr)
         if missing:
