@@ -418,6 +418,7 @@ def main():
     serving_faulted = _measure_serving_faulted_arm()
     serving_fleet = _measure_serving_fleet_arm()
     serving_fleet_faulted = _measure_serving_fleet_faulted_arm()
+    serving_openloop = _measure_serving_openloop_arm()
     serving_decode_bw = _measure_serving_decode_bw_arm()
     serving_spec = _measure_serving_spec_arm()
     cluster = _measure_cluster_arm()
@@ -588,6 +589,17 @@ def main():
         # exactly one ejection + one probe-rejoin in the
         # kubeml_serve_fleet_* counters.
         "serving_fleet_faulted": serving_fleet_faulted,
+        # open-loop traffic arm (serve/slo.py + metrics/sketch.py): a
+        # seeded Poisson-thinning arrival process (steady / burst /
+        # recovery phases) drives a 4-replica fleet whose SLO plane
+        # classifies every finished request against a calibrated TTFT
+        # objective. Self-asserts: arrivals replay bit-identically from
+        # the seed, the burst's burn-rate alert fires and triggers
+        # exactly one autoscaler grow, the steady phase meets the SLO
+        # target, no admitted stream is lost across an injected replica
+        # crash, and every sampled request's merged trace is one
+        # connected tree spanning the crash.
+        "serving_openloop": serving_openloop,
         # decode-bandwidth arm (ops/pallas/paged_attention.py +
         # serve/pager.py int8 pages): KV traffic measured with the
         # deterministic bytes-per-token proxy (page geometry x dtype,
@@ -1794,6 +1806,440 @@ def _measure_serving_fleet_faulted_arm() -> dict:
     }
 
 
+def _openloop_arrivals(seed, phases):
+    """Deterministic open-loop arrival schedule via Poisson thinning.
+
+    ``phases`` is a list of ``(name, duration_s, rate_rps)``. A single
+    homogeneous Poisson process runs at ``lam_max = max(rate)`` —
+    exponential gaps from a seeded ``random.Random`` — and each
+    candidate is ACCEPTED with probability ``rate(t) / lam_max``
+    (classic thinning), which keeps the schedule a true Poisson process
+    within each phase while the rate profile steps through diurnal
+    steady / burst / recovery shapes. Pure function of (seed, phases):
+    the bench regenerates it to assert the replay is bit-identical.
+
+    Returns ``[(t_arrival_s, phase_name), ...]`` sorted by time."""
+    import random as _random
+
+    rng = _random.Random(seed)
+    lam_max = max(r for _n, _d, r in phases)
+    total = sum(d for _n, d, _r in phases)
+
+    def phase_at(t):
+        acc = 0.0
+        for name, dur, rate in phases:
+            acc += dur
+            if t < acc:
+                return name, rate
+        return phases[-1][0], phases[-1][2]
+
+    out = []
+    t = 0.0
+    while True:
+        t += rng.expovariate(lam_max)
+        if t >= total:
+            return out
+        name, rate = phase_at(t)
+        if rng.random() < rate / lam_max:
+            out.append((t, name))
+
+
+def _measure_serving_openloop_arm() -> dict:
+    """Open-loop traffic arm (serve/slo.py + metrics/sketch.py +
+    fleet tracing): a seeded Poisson-thinning arrival process — calm
+    steady state, a diurnal-peak burst at ~3x fleet capacity with a
+    replica crash injected mid-burst, then recovery — drives a
+    4-replica fleet. Unlike the closed-loop arms, clients do NOT wait
+    for capacity: arrivals fire on schedule regardless of backlog, so
+    overload shows up as queue-inflated TTFT (SLO-bad requests) and
+    sheds instead of silently slowing the offered load.
+
+    The fleet's own SLO plane does the judging: every finished request
+    is classified good/bad against a TTFT objective calibrated from
+    warm solo latency, the autoscaler ticks the multi-window burn-rate
+    engine, and the burst must push BOTH windows past 1.0.
+
+    Self-asserted invariants (the PR's acceptance bar):
+      * deterministic arrivals — regenerating the schedule from the
+        same seed reproduces it bit-identically
+      * the burst's burn-rate alert fires (serve_slo_alerts_total >= 1)
+        and the autoscaler grows EXACTLY once (4 -> 5 replicas; the
+        replacement replica after the crash is failover, not a grow)
+      * the steady phase meets the SLO target (fleet-reported
+        attainment at steady end >= target)
+      * zero admitted streams lost across the injected crash
+      * every sampled request's merged trace (fleet + all replicas,
+        dead and surviving) is a single connected tree: one "generate"
+        root per trace_id, every other event parented to it
+
+    KUBEML_BENCH_OPENLOOP_ARRIVALS scales the arrival budget (default
+    600)."""
+    import os
+    import queue as _queue
+    import sys
+    import tempfile
+    import threading
+
+    import jax
+    import numpy as np
+
+    from kubeml_tpu.models import get_builtin
+    from kubeml_tpu.serve.engine import DecodeEngine
+    from kubeml_tpu.serve.fleet import ServeFleet
+    from kubeml_tpu.serve.service import ServeService
+    from kubeml_tpu.serve.slots import ServeDraining, ServeSaturated
+    from kubeml_tpu.utils.trace import Tracer, TraceSink, merge_job_trace
+
+    PROMPT_LEN, NEW_TOKENS, PAGE = 32, 8, 16
+    PREFIX_GROUPS = 8
+    REPLICAS, SLOTS, QUEUE = 4, 8, 8
+    SLO_TARGET = 0.9
+    SEED = 20260806
+    ARRIVALS = int(os.environ.get(
+        "KUBEML_BENCH_OPENLOOP_ARRIVALS", "600"))
+    JOB = "bench-openloop"
+
+    model = get_builtin("gpt-nano")()
+    module = model.module
+    variables = model.init_variables(
+        jax.random.PRNGKey(0),
+        {"x": np.ones((1, module.max_len), np.int32)})
+    vocab = module.vocab_size - 1
+
+    def prompt(i):
+        g = i % PREFIX_GROUPS
+        head = [(g * 13 + j) % vocab + 1 for j in range(PAGE)]
+        tail = [(i * 7 + j) % vocab + 1
+                for j in range(PROMPT_LEN - PAGE)]
+        return head + tail
+
+    # -- calibrate THROUGH the serving stack at FULL FLEET SIZE: the
+    # service loop's scheduling dominates short streams on CPU, and the
+    # replicas share one process's cores — one replica's saturated
+    # throughput times N wildly overestimates the fleet (replica loops
+    # contend), and a steady phase sized from that overestimate is
+    # already overload. So both the SLO objective (sequential warm
+    # TTFT) and the offered rates (closed-loop saturated aggregate
+    # throughput) come from a same-shape fleet.
+    def drain(req):
+        for _ in req.events_iter(timeout=300.0):
+            pass
+        return req
+
+    cal = ServeFleet(
+        "bench-openloop-cal",
+        lambda index: ServeService(
+            "bench-openloop-cal",
+            DecodeEngine(module, variables, slots=SLOTS, page=PAGE),
+            max_queue=QUEUE, supervise=False),
+        replicas_min=REPLICAS, replicas_max=REPLICAS,
+        autoscale_interval_s=0.0, page_tokens=PAGE)
+    cal.start()
+    for svc in cal.replicas():          # compile every replica warm
+        drain(svc.submit(prompt(0), max_new_tokens=NEW_TOKENS))
+    seq = [drain(cal.submit(prompt(k + 1), max_new_tokens=NEW_TOKENS))
+           for k in range(4)]
+    ttft_seq = max(r.first_token_at - r.submitted_at for r in seq)
+    cal_budget = [6 * REPLICAS * SLOTS]
+    cal_lock = threading.Lock()
+    cal_done = []
+
+    def cal_client():
+        while True:
+            with cal_lock:
+                if cal_budget[0] <= 0:
+                    return
+                cal_budget[0] -= 1
+                i = cal_budget[0]
+            try:
+                r = drain(cal.submit(prompt(i),
+                                     max_new_tokens=NEW_TOKENS))
+            except (ServeSaturated, ServeDraining):
+                time.sleep(0.01)
+                with cal_lock:
+                    cal_budget[0] += 1
+                continue
+            with cal_lock:
+                cal_done.append(r)
+
+    tcal = time.perf_counter()
+    cal_threads = [threading.Thread(target=cal_client)
+                   for _ in range(2 * REPLICAS * SLOTS)]
+    for t in cal_threads:
+        t.start()
+    for t in cal_threads:
+        t.join()
+    cal_elapsed = time.perf_counter() - tcal
+    ttft_sat = sorted(r.first_token_at - r.submitted_at
+                      for r in cal_done)[len(cal_done) // 2]
+    cal.stop(grace_s=0.0)
+    capacity_rps = len(cal_done) / cal_elapsed
+    # generous vs warm sequential TTFT (steady must pass) yet under the
+    # saturated closed-loop median (queued burst traffic must fail)
+    slo_ttft_s = max(0.05, 4.0 * ttft_seq)
+    if slo_ttft_s >= 0.5 * ttft_sat:
+        slo_ttft_s = max(1.5 * ttft_seq, 0.5 * ttft_sat)
+    print(f"openloop cal: ttft_seq={ttft_seq * 1e3:.1f}ms "
+          f"ttft_sat={ttft_sat * 1e3:.1f}ms "
+          f"capacity={capacity_rps:.2f}rps "
+          f"slo_ttft={slo_ttft_s * 1e3:.1f}ms", file=sys.stderr)
+
+    # phase shapes sized in ARRIVALS with wall-time floors so every
+    # phase spans several autoscaler ticks: steady at half capacity,
+    # burst at 3x (provably over), recovery at a quarter
+    steady_rate = 0.5 * capacity_rps
+    burst_rate = 3.0 * capacity_rps
+    recovery_rate = 0.25 * capacity_rps
+    n_steady = ARRIVALS // 3
+    n_burst = ARRIVALS // 3
+    n_recovery = ARRIVALS - n_steady - n_burst
+    phases = [
+        ("steady", max(2.5, n_steady / steady_rate), steady_rate),
+        ("burst", max(2.0, n_burst / burst_rate), burst_rate),
+        ("recovery", max(2.0, n_recovery / recovery_rate),
+         recovery_rate)]
+    schedule = _openloop_arrivals(SEED, phases)
+    # invariant: the schedule is a pure function of (seed, phases)
+    assert schedule == _openloop_arrivals(SEED, phases), \
+        "arrival schedule is not deterministic"
+
+    home = tempfile.mkdtemp(prefix="kubeml-openloop-")
+
+    def factory(index):
+        eng = DecodeEngine(module, variables, slots=SLOTS, page=PAGE)
+        return ServeService(
+            JOB, eng, max_queue=QUEUE, supervise=False,
+            tracer=Tracer(), trace_sink=TraceSink(
+                JOB, f"serve-r{index}", home=home))
+
+    fleet = ServeFleet(
+        JOB, factory,
+        replicas_min=REPLICAS, replicas_max=REPLICAS + 1,
+        autoscale_interval_s=0.0, page_tokens=PAGE,
+        probe_requests=2,
+        slo_ttft_s=slo_ttft_s, slo_target=SLO_TARGET,
+        tracer=Tracer(),
+        trace_sink=TraceSink(JOB, "fleet", home=home),
+        fault_plan=[{"kind": "fleet_replica_crash", "replica": 0}])
+    fleet.start()
+    victim = fleet.replicas()[0]
+    # warm outside the timed window — and outside the SLO plane: the
+    # warm request pays the engine compile in its TTFT, and 4 bad /
+    # 0 good would read as burn-rate 10 on the very first autoscaler
+    # tick (a phantom steady-phase grow)
+    for svc in fleet.replicas():
+        svc.slo_ttft_s = 0.0
+    for svc in fleet.replicas():
+        req = svc.submit(prompt(0), max_new_tokens=NEW_TOKENS)
+        for _ in req.events_iter(timeout=300.0):
+            pass
+    for svc in fleet.replicas():
+        svc.slo_ttft_s = slo_ttft_s
+
+    # open-loop plumbing: the dispatcher fires arrivals on schedule
+    # into a worker pool; a full pool delays SUBMISSION, which is
+    # exactly what an overloaded frontend does, and the SLO plane sees
+    # the service-side queueing either way
+    records = []
+    rec_lock = threading.Lock()
+    work = _queue.Queue()
+    ticks = []
+
+    def worker():
+        while True:
+            item = work.get()
+            if item is None:
+                return
+            i, phase = item
+            tid = f"t-ol-{i}"
+            try:
+                req = fleet.submit(prompt(i),
+                                   max_new_tokens=NEW_TOKENS,
+                                   trace_id=tid)
+            except (ServeSaturated, ServeDraining):
+                # open-loop clients don't retry: a shed is a recorded
+                # outcome, not a backoff loop
+                with rec_lock:
+                    records.append({"i": i, "phase": phase,
+                                    "tid": tid, "outcome": "shed",
+                                    "migrations": 0})
+                continue
+            for _ in req.events_iter(timeout=300.0):
+                pass
+            with rec_lock:
+                records.append({"i": i, "phase": phase, "tid": tid,
+                                "outcome": req.outcome,
+                                "migrations": req.migrations,
+                                "error": req.error})
+
+    def supervisor():
+        # deliver the crash once the burst has begun and the victim is
+        # mid-decode, then keep reaping probes so the replacement can
+        # graduate
+        while not stop_evt.is_set():
+            if burst_started.is_set() and victim.engine.active() >= 1:
+                break
+            time.sleep(0.002)
+        while not stop_evt.is_set():
+            fleet.supervise_once()
+            time.sleep(0.02)
+
+    def autoscaler():
+        # steady cadence: each tick feeds the burn-rate engine the
+        # good/bad deltas and may act; ticks are wall-stamped and
+        # phase-labelled after the run against the dispatcher's
+        # recorded phase transitions
+        while not stop_evt.is_set():
+            action = fleet.autoscale_once()
+            snap = fleet.snapshot()
+            ticks.append({
+                "t": time.perf_counter(), "action": action,
+                "burn_fast": snap["serve_slo_burn_fast"],
+                "burn_slow": snap["serve_slo_burn_slow"],
+                "attainment": snap["serve_slo_attainment"],
+                "queue": snap.get("serve_queue_depth"),
+                "rejected": snap.get("serve_rejected_total")})
+            time.sleep(0.25)
+
+    stop_evt = threading.Event()
+    burst_started = threading.Event()
+    steady_snaps = []
+    phase_wall = {}
+    pool = [threading.Thread(target=worker) for _ in range(64)]
+    t0 = time.perf_counter()
+    sup = threading.Thread(target=supervisor)
+    aut = threading.Thread(target=autoscaler)
+    sup.start()
+    aut.start()
+    for t in pool:
+        t.start()
+    for i, (t_arr, phase) in enumerate(schedule):
+        delay = t0 + t_arr - time.perf_counter()
+        if delay > 0:
+            time.sleep(delay)
+        if phase not in phase_wall:
+            phase_wall[phase] = time.perf_counter()
+            if phase == "burst":
+                # fleet-reported attainment over the all-steady window,
+                # before the burst can dilute it
+                steady_snaps.append(fleet.snapshot())
+                _s = steady_snaps[0]
+                print(f"openloop steady: "
+                      f"ttft p50={_s['serve_ttft_p50'] * 1e3:.1f}ms "
+                      f"p99={_s['serve_ttft_p99'] * 1e3:.1f}ms "
+                      f"attainment={_s['serve_slo_attainment']:.3f} "
+                      f"good={_s['serve_slo_good_total']} "
+                      f"bad={_s['serve_slo_bad_total']}",
+                      file=sys.stderr)
+                burst_started.set()
+        work.put((i, phase))
+    for _ in pool:
+        work.put(None)
+    for t in pool:
+        t.join()
+    elapsed = time.perf_counter() - t0
+    # let the probe/rejoin cycle finish before stopping the loops
+    for _ in range(200):
+        if fleet.path_counts.get("probe_rejoin", 0) >= 1:
+            break
+        time.sleep(0.02)
+    stop_evt.set()
+    sup.join()
+    aut.join()
+    fleet.autoscale_once()                # absorb the final deltas
+    snap = fleet.snapshot()
+
+    # label each autoscaler tick with the phase the dispatcher was in
+    # when it fired (wall-clock transitions recorded at dispatch time)
+    def tick_phase(wall):
+        if wall >= phase_wall.get("recovery", float("inf")):
+            return "recovery"
+        if wall >= phase_wall.get("burst", float("inf")):
+            return "burst"
+        return "steady"
+
+    for tk in ticks:
+        tk["phase"] = tick_phase(tk["t"])
+
+    # -- invariants ---------------------------------------------------
+    finished = [r for r in records if r["outcome"] != "shed"]
+    lost = [r for r in finished if r["outcome"] != "ok"]
+    assert not lost, lost[:5]
+    assert snap["fleet_ejections_total"] == 1, snap
+    migrated = [r for r in finished if r["migrations"] > 0]
+    assert migrated, "crash fired but no stream was live-migrated"
+
+    # the burst burned both windows and the autoscaler grew exactly once
+    assert snap["serve_slo_alerts_total"] >= 1, snap
+    burst_burn = [tk for tk in ticks if tk["phase"] != "steady"
+                  and tk["burn_fast"] > 1.0 and tk["burn_slow"] > 1.0]
+    assert burst_burn, ticks
+    grows = [tk for tk in ticks if tk["action"] == "grow"]
+    assert snap["fleet_grows_total"] == 1, (snap["fleet_grows_total"],
+                                            [t_["phase"] for t_ in
+                                             grows],
+                                            list(fleet.decisions))
+    assert grows and grows[0]["phase"] != "steady", grows
+
+    # the steady phase met the SLO target (fleet-reported attainment)
+    assert steady_snaps, "steady phase ended before the probe point"
+    steady_attainment = steady_snaps[0]["serve_slo_attainment"]
+    assert steady_attainment >= SLO_TARGET, steady_attainment
+
+    # every sampled request's merged trace is one connected tree
+    fleet.flush_trace()
+    merged = merge_job_trace(JOB, home=home)
+    sample = ([r["tid"] for r in migrated[:4]]
+              + [r["tid"] for r in finished[:2]]
+              + [r["tid"] for r in finished[-2:]])
+    for tid in dict.fromkeys(sample):
+        evs = [e for e in merged["traceEvents"]
+               if e.get("args", {}).get("trace_id") == tid]
+        roots = [e for e in evs if e["name"] == "generate"]
+        assert len(roots) == 1, (tid, [e["name"] for e in evs])
+        for e in evs:
+            assert (e["name"] == "generate"
+                    or e["args"].get("parent") == "generate"), (tid, e)
+
+    per_phase = {}
+    for name, _d, rate in phases:
+        rows = [r for r in records if r["phase"] == name]
+        ok = [r for r in rows if r["outcome"] == "ok"]
+        per_phase[name] = {
+            "offered_rps": round(rate, 2),
+            "arrivals": len(rows),
+            "completed": len(ok),
+            "shed": len([r for r in rows if r["outcome"] == "shed"]),
+        }
+    fleet.stop(grace_s=0.0)
+
+    return {
+        "model": "gpt-nano",
+        "replicas": REPLICAS, "slots": SLOTS, "queue": QUEUE,
+        "prompt_tokens": PROMPT_LEN, "new_tokens": NEW_TOKENS,
+        "seed": SEED, "arrivals": len(schedule),
+        "elapsed_s": round(elapsed, 2),
+        "slo_ttft_ms": round(slo_ttft_s * 1000.0, 1),
+        "slo_target": SLO_TARGET,
+        "capacity_rps_estimate": round(capacity_rps, 2),
+        "phases": per_phase,
+        "steady_attainment": round(float(steady_attainment), 4),
+        "final_attainment": snap["serve_slo_attainment"],
+        "burn_alerts": int(snap["serve_slo_alerts_total"]),
+        "good_total": int(snap["serve_slo_good_total"]),
+        "bad_total": int(snap["serve_slo_bad_total"]),
+        "streams_migrated": len(migrated),
+        "assertions": {
+            "deterministic_arrivals": True,
+            "burst_burn_alerted": True,
+            "grow_events": 1,
+            "steady_attainment_met": True,
+            "streams_lost": 0,
+            "trace_trees_connected": len(dict.fromkeys(sample)),
+        },
+    }
+
+
 def _measure_cluster_arm() -> dict:
     """Cluster-allocator arm: a deterministic event-driven saturation
     replay over the REAL ClusterAllocator (control/cluster.py) with a
@@ -2342,5 +2788,31 @@ def _measure_continual_arm() -> dict:
             os.environ["KUBEML_TPU_HOME"] = prev_home
 
 
+ARMS = {
+    # standalone arms runnable alone via --arm <name>: each prints one
+    # JSON object {name: result} instead of the full bench line
+    "serving": _measure_serving_arm,
+    "serving_faulted": _measure_serving_faulted_arm,
+    "serving_prefill": _measure_prefill_arm,
+    "serving_decode_bw": _measure_serving_decode_bw_arm,
+    "serving_spec": _measure_serving_spec_arm,
+    "serving_fleet": _measure_serving_fleet_arm,
+    "serving_fleet_faulted": _measure_serving_fleet_faulted_arm,
+    "serving_openloop": _measure_serving_openloop_arm,
+    "cluster": _measure_cluster_arm,
+    "control_chaos": _measure_control_chaos_arm,
+    "continual": _measure_continual_arm,
+}
+
+
 if __name__ == "__main__":
-    main()
+    import sys as _sys
+    if len(_sys.argv) >= 3 and _sys.argv[1] == "--arm":
+        _name = _sys.argv[2]
+        if _name not in ARMS:
+            print(f"bench: unknown arm {_name!r}; one of "
+                  f"{sorted(ARMS)}", file=_sys.stderr)
+            _sys.exit(2)
+        print(json.dumps({_name: ARMS[_name]()}, sort_keys=True))
+    else:
+        main()

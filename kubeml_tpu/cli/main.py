@@ -431,6 +431,22 @@ def _render_top(doc: dict) -> str:
                 f"{latest.get('fleet_grows_total', 0):g}/"
                 f"{latest.get('fleet_shrinks_total', 0):g}/"
                 f"{latest.get('fleet_scale_to_zero_total', 0):g}")
+        if latest.get("serve_slo_attainment") is not None:
+            # SLO pane: windowed attainment against the configured
+            # target plus the fast/slow burn rates (>1.0 in both
+            # windows means the error budget is being spent too fast)
+            lines.append(
+                f"slo: attainment "
+                f"{float(latest.get('serve_slo_attainment', 1.0)):.1%}"
+                f" (target "
+                f"{float(latest.get('serve_slo_target', 0.0)):.0%})  "
+                f"burn fast "
+                f"{float(latest.get('serve_slo_burn_fast', 0.0)):.2f} "
+                f"slow "
+                f"{float(latest.get('serve_slo_burn_slow', 0.0)):.2f}  "
+                f"good/bad "
+                f"{latest.get('serve_slo_good_total', 0):g}/"
+                f"{latest.get('serve_slo_bad_total', 0):g}")
         if latest.get("fleet_ejections_total") is not None:
             # fleet fault pane: supervisor ejections / stream failover
             # activity plus the circuit-breaker state (replicas in
@@ -614,6 +630,9 @@ def cmd_serve(args):
                                    args.serve_replica_restart_budget),
                                serve_probe_requests=args.serve_probe_requests,
                                serve_hedge_after_s=args.serve_hedge_after_s,
+                               serve_slo_ttft_ms=args.serve_slo_ttft_ms,
+                               serve_slo_tpot_ms=args.serve_slo_tpot_ms,
+                               serve_slo_target=args.serve_slo_target,
                                cluster_lanes=args.cluster_lanes,
                                cluster_tenants=args.cluster_tenant,
                                cluster_aging_s=args.cluster_aging_s,
@@ -657,7 +676,10 @@ def cmd_serve(args):
                               serve_replica_restart_budget=(
                                   args.serve_replica_restart_budget),
                               serve_probe_requests=args.serve_probe_requests,
-                              serve_hedge_after_s=args.serve_hedge_after_s)
+                              serve_hedge_after_s=args.serve_hedge_after_s,
+                              serve_slo_ttft_ms=args.serve_slo_ttft_ms,
+                              serve_slo_tpot_ms=args.serve_slo_tpot_ms,
+                              serve_slo_target=args.serve_slo_target)
     else:  # storage
         from kubeml_tpu.control.storage import StorageService
         svc = StorageService(port=args.port or const.STORAGE_PORT)
@@ -1042,6 +1064,26 @@ def build_parser() -> argparse.ArgumentParser:
                         "replica is re-issued on the least-loaded peer; "
                         "0 disables (KUBEML_SERVE_HEDGE_AFTER_S, "
                         "default 0)")
+    s.add_argument("--serve-slo-ttft-ms", type=float, default=None,
+                   metavar="MS",
+                   help="TTFT objective in milliseconds for the serving "
+                        "SLO plane: a request whose first token takes "
+                        "longer counts against the error budget; 0 "
+                        "disables the TTFT objective "
+                        "(KUBEML_SERVE_SLO_TTFT_MS, default 0)")
+    s.add_argument("--serve-slo-tpot-ms", type=float, default=None,
+                   metavar="MS",
+                   help="per-output-token (TPOT) objective in "
+                        "milliseconds for the serving SLO plane; 0 "
+                        "disables the TPOT objective "
+                        "(KUBEML_SERVE_SLO_TPOT_MS, default 0)")
+    s.add_argument("--serve-slo-target", type=float, default=None,
+                   metavar="FRAC",
+                   help="SLO attainment target as a fraction; the burn "
+                        "rate is bad_fraction / (1 - target), so 1.0 "
+                        "means spending the error budget exactly at "
+                        "the sustainable rate "
+                        "(KUBEML_SERVE_SLO_TARGET, default 0.99)")
     s.add_argument("--cluster-lanes", type=int, default=None, metavar="N",
                    help="turn on the cluster allocator over N shared "
                         "worker lanes: gang placement, priority "

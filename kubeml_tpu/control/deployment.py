@@ -112,6 +112,9 @@ def start_deployment(mesh=None, controller_port: int = 0,
                      serve_replica_restart_budget: Optional[int] = None,
                      serve_probe_requests: Optional[int] = None,
                      serve_hedge_after_s: Optional[float] = None,
+                     serve_slo_ttft_ms: Optional[float] = None,
+                     serve_slo_tpot_ms: Optional[float] = None,
+                     serve_slo_target: Optional[float] = None,
                      cluster_lanes: Optional[int] = None,
                      cluster_tenants=None,
                      cluster_aging_s: Optional[float] = None,
@@ -172,7 +175,10 @@ def start_deployment(mesh=None, controller_port: int = 0,
                          serve_replica_restart_budget=(
                              serve_replica_restart_budget),
                          serve_probe_requests=serve_probe_requests,
-                         serve_hedge_after_s=serve_hedge_after_s)
+                         serve_hedge_after_s=serve_hedge_after_s,
+                         serve_slo_ttft_ms=serve_slo_ttft_ms,
+                         serve_slo_tpot_ms=serve_slo_tpot_ms,
+                         serve_slo_target=serve_slo_target)
     ps.start()
 
     scheduler = Scheduler(ps_url=ps.url, port=scheduler_port,

@@ -300,6 +300,30 @@ def _make_serve_ttft_slo(slo_s: float):
     return check
 
 
+def _make_slo_burn():
+    """Serving SLO plane (serve/slo.py): warn when BOTH burn windows
+    exceed 1.0 — the multi-window rule, so a single bad tick (fast
+    spike, slow still fine) never pages and a long-ago incident (slow
+    elevated, fast recovered) clears. Latest-value check: the burn
+    rates are already windowed by the SLO engine itself; solo-serve
+    and training samples carry no serve_slo_* fields and never fire."""
+    def check(window: List[dict]) -> Optional[str]:
+        m = _latest(window)
+        fast = m.get("serve_slo_burn_fast")
+        slow = m.get("serve_slo_burn_slow")
+        if fast is None or slow is None:
+            return None
+        if float(fast) > 1.0 and float(slow) > 1.0:
+            att = m.get("serve_slo_attainment", 1.0)
+            target = m.get("serve_slo_target", 0.0)
+            return (f"SLO error budget burning in both windows (fast "
+                    f"{float(fast):.3g}x, slow {float(slow):.3g}x): "
+                    f"attainment {float(att):.4g} vs target "
+                    f"{float(target):g}")
+        return None
+    return check
+
+
 def default_rules(grad_abs: float = 1e4, grad_rel: float = 50.0,
                   spread_rel: float = 0.75, stall_floor: float = 1e-7,
                   stall_epochs: int = 3, straggler_rel: float = 5.0,
@@ -329,6 +353,9 @@ def default_rules(grad_abs: float = 1e4, grad_rel: float = 50.0,
         HealthRule("serve_ttft_slo", "warning",
                    "serving p99 time-to-first-token above the SLO",
                    _make_serve_ttft_slo(serve_ttft_slo_s)),
+        HealthRule("slo_burn", "warning",
+                   "SLO burn rate above 1.0 in both fast and slow windows",
+                   _make_slo_burn()),
         HealthRule("serve_crash_loop", "critical",
                    "serving engine restarted repeatedly in the window",
                    _make_serve_crash_loop()),
@@ -379,6 +406,12 @@ _SAMPLE_FIELDS = ("train_loss", "validation_loss", "accuracy",
                   # decode — the top "decode amortization" line
                   "serve_dispatches_per_token",
                   "serve_accepted_per_dispatch",
+                  # SLO plane (PR 18, serve/slo.py): burn rates feed the
+                  # slo_burn rule, attainment/target the top "slo" line
+                  "serve_slo_target", "serve_slo_attainment",
+                  "serve_slo_burn_fast", "serve_slo_burn_slow",
+                  "serve_slo_good_total", "serve_slo_bad_total",
+                  "serve_slo_alerts_total",
                   # serving-fleet telemetry (serve/fleet.py): replica
                   # count + router/autoscaler counters ride the merged
                   # serve:<model> sample; the per-replica prefix

@@ -307,6 +307,9 @@ class ParameterServer(JsonService):
                  serve_replica_restart_budget: Optional[int] = None,
                  serve_probe_requests: Optional[int] = None,
                  serve_hedge_after_s: Optional[float] = None,
+                 serve_slo_ttft_ms: Optional[float] = None,
+                 serve_slo_tpot_ms: Optional[float] = None,
+                 serve_slo_target: Optional[float] = None,
                  state_dir: Optional[str] = None):
         super().__init__(port=port)
         # Lazy mesh: in standalone mode the PARENT must not initialize the
@@ -417,6 +420,19 @@ class ParameterServer(JsonService):
         self.serve_hedge_after_s = float(
             serve_hedge_after_s if serve_hedge_after_s is not None
             else os.environ.get("KUBEML_SERVE_HEDGE_AFTER_S", "0"))
+        # SLO plane (serve/slo.py): per-model latency objectives in ms
+        # (0 TTFT = inherit the health-rule ttft SLO; 0 TPOT = no TPOT
+        # objective) and the availability target the burn rate is
+        # measured against
+        self.serve_slo_ttft_ms = float(
+            serve_slo_ttft_ms if serve_slo_ttft_ms is not None
+            else os.environ.get("KUBEML_SERVE_SLO_TTFT_MS", "0"))
+        self.serve_slo_tpot_ms = float(
+            serve_slo_tpot_ms if serve_slo_tpot_ms is not None
+            else os.environ.get("KUBEML_SERVE_SLO_TPOT_MS", "0"))
+        self.serve_slo_target = float(
+            serve_slo_target if serve_slo_target is not None
+            else os.environ.get("KUBEML_SERVE_SLO_TARGET", "0.99"))
         self._serve: Dict[str, tuple] = {}   # model_id -> (stamp, fleet)
         self._serve_lock = threading.Lock()
         # durable control plane (opt-in): standalone-job and fleet
@@ -761,6 +777,13 @@ class ParameterServer(JsonService):
         job_id = req.query.get("id", "")
         if not job_id:
             raise InvalidArgsError("id query parameter required")
+        if job_id.startswith("serve:"):
+            # live serving tracers batch their unforced flushes; push
+            # the tail out so the merge sees up-to-the-request state
+            with self._serve_lock:
+                cur = self._serve.get(job_id[len("serve:"):])
+            if cur is not None:
+                cur[1].flush_trace()
         try:
             return merge_job_trace(job_id)
         except FileNotFoundError:
@@ -1026,7 +1049,16 @@ class ParameterServer(JsonService):
             resize_cb=self._serve_resize_cb(model_id),
             replica_restart_budget=self.serve_replica_restart_budget,
             probe_requests=self.serve_probe_requests,
-            hedge_after_s=self.serve_hedge_after_s).start()
+            hedge_after_s=self.serve_hedge_after_s,
+            # fleet-level spans (routing, migration, hedging) sink as
+            # their own process in the serve:<model> trace dir, so the
+            # merged document stitches one tree per request across the
+            # router and every replica it touched
+            tracer=Tracer(clock=time.perf_counter),
+            trace_sink=TraceSink(f"serve:{model_id}", "fleet"),
+            slo_ttft_s=self.serve_slo_ttft_ms / 1000.0,
+            slo_tpot_s=self.serve_slo_tpot_ms / 1000.0,
+            slo_target=self.serve_slo_target).start()
         old = None
         with self._serve_lock:
             cur = self._serve.get(model_id)

@@ -645,6 +645,30 @@ class MetricsRegistry:
         self.serve_fleet_hedges_total = Counter(
             "kubeml_serve_fleet_hedges_total",
             "Queued streams re-issued off a straggler replica", "model")
+        # serving SLO plane (serve/slo.py), fed by the fleet's merged
+        # snapshot: attainment and the fast/slow burn-rate windows as
+        # gauges (window rides a LABEL, not a family suffix), the
+        # good/bad classification and burn-alert onsets as counters
+        self.serve_slo_attainment = Gauge(
+            "kubeml_serve_slo_attainment",
+            "Fraction of finished requests meeting the model's latency "
+            "SLO over the slow burn window", "model")
+        self.serve_slo_burn_rate = MultiGauge(
+            "kubeml_serve_slo_burn_rate",
+            "SLO error-budget burn rate (bad fraction over 1-target), "
+            "by window (fast|slow)", ("model", "window"))
+        self.serve_slo_good_total = Counter(
+            "kubeml_serve_slo_good_total",
+            "Finished requests that met the model's latency SLO",
+            "model")
+        self.serve_slo_bad_total = Counter(
+            "kubeml_serve_slo_bad_total",
+            "Finished requests that missed the model's latency SLO "
+            "(slow, errored, or deadline-expired)", "model")
+        self.serve_slo_burn_alerts_total = Counter(
+            "kubeml_serve_slo_burn_alerts_total",
+            "Multi-window SLO burn alert onsets (fast AND slow burn "
+            "above 1.0)", "model")
         # cluster allocator (control/cluster.py), fed by the scheduler's
         # snapshot pushes (POST /cluster): pool occupancy, queue depth
         # by priority, per-tenant lanes vs quota/weighted share, and
@@ -740,7 +764,11 @@ class MetricsRegistry:
                               self.serve_prefill_backlog,
                               self.serve_weight_generation,
                               self.serve_fleet_replicas,
+                              self.serve_slo_attainment,
                               self.infer_cache_entries]
+        # (model, window)-labelled: cleared per window in clear_serve,
+        # so it stays out of the single-label _serve_gauges clear loop
+        self._serve_multi_gauges = [self.serve_slo_burn_rate]
         self._serve_hists = [self.serve_ttft_seconds,
                              self.serve_tpot_seconds,
                              self.serve_e2e_seconds,
@@ -772,6 +800,9 @@ class MetricsRegistry:
                                 self.serve_fleet_migrated_streams_total,
                                 self.serve_fleet_probes_total,
                                 self.serve_fleet_hedges_total,
+                                self.serve_slo_good_total,
+                                self.serve_slo_bad_total,
+                                self.serve_slo_burn_alerts_total,
                                 self.infer_cache_hits_total,
                                 self.infer_cache_misses_total]
         self._cluster_gauges = [self.cluster_pool_lanes,
@@ -974,6 +1005,14 @@ class MetricsRegistry:
         deltas and feed their counters directly."""
         self.serve_fleet_replicas.set(
             model, float(snap.get("fleet_replicas", 0)))
+        # SLO plane: attainment + burn windows mirror the snapshot
+        # (gauges), classification counters advance by delta
+        self.serve_slo_attainment.set(
+            model, float(snap.get("serve_slo_attainment", 1.0)))
+        self.serve_slo_burn_rate.set(
+            (model, "fast"), float(snap.get("serve_slo_burn_fast", 0.0)))
+        self.serve_slo_burn_rate.set(
+            (model, "slow"), float(snap.get("serve_slo_burn_slow", 0.0)))
         for field, counter in (
                 ("fleet_spills_total", self.serve_fleet_spills_total),
                 ("fleet_router_retries_total",
@@ -987,7 +1026,11 @@ class MetricsRegistry:
                 ("fleet_migrated_streams_total",
                  self.serve_fleet_migrated_streams_total),
                 ("fleet_probes_total", self.serve_fleet_probes_total),
-                ("fleet_hedges_total", self.serve_fleet_hedges_total)):
+                ("fleet_hedges_total", self.serve_fleet_hedges_total),
+                ("serve_slo_good_total", self.serve_slo_good_total),
+                ("serve_slo_bad_total", self.serve_slo_bad_total),
+                ("serve_slo_alerts_total",
+                 self.serve_slo_burn_alerts_total)):
             cum = float(snap.get(field, 0))
             seen = self._fleet_seen.get((model, field), 0.0)
             if cum > seen:
@@ -1016,8 +1059,10 @@ class MetricsRegistry:
         for g in (self.serve_active_slots, self.serve_queue_depth,
                   self.serve_kv_utilization, self.serve_prefill_backlog,
                   self.serve_weight_generation,
-                  self.serve_fleet_replicas):
+                  self.serve_fleet_replicas,
+                  self.serve_slo_attainment):
             g.clear(model)
+        self.serve_slo_burn_rate.clear_prefix(model)
         for h in self._serve_hists:
             h.clear(model)
         for comp in ("queue", "prefill", "interleave"):
@@ -1044,7 +1089,10 @@ class MetricsRegistry:
                   self.serve_fleet_failovers_total,
                   self.serve_fleet_migrated_streams_total,
                   self.serve_fleet_probes_total,
-                  self.serve_fleet_hedges_total):
+                  self.serve_fleet_hedges_total,
+                  self.serve_slo_good_total,
+                  self.serve_slo_bad_total,
+                  self.serve_slo_burn_alerts_total):
             c.clear_prefix(model)
         self.trace_dropped_total.clear_prefix(f"serve:{model}")
         self._trace_seen.pop(f"serve:{model}", None)
@@ -1159,7 +1207,8 @@ class MetricsRegistry:
                                         self.jit_compiles_total,
                                         self.trace_dropped_total]
                     + self._job_multi + self._job_hists
-                    + self._serve_gauges + self._serve_counters
+                    + self._serve_gauges + self._serve_multi_gauges
+                    + self._serve_counters
                     + self._serve_hists + self._serve_multi_hists
                     + self._cluster_gauges + self._cluster_counters
                     + [self.control_recovery_seconds])

@@ -117,7 +117,10 @@ SWAP_PATH_VARIANTS = (
 # tools/check_serve_spans.py, wired like check_serve_parity.py): the
 # span tree is an API — dashboards, `kubeml trace`, and the TTFT
 # attribution all parse these names, so an unasserted kind is a
-# rename-silently-breaks-consumers hazard.
+# rename-silently-breaks-consumers hazard. The fleet router keeps its
+# own registry under the same lint — FLEET_SPAN_KINDS in
+# serve/fleet.py — for the cross-replica events (routing, migration,
+# hedging) that stitch one request's tree across replicas.
 SERVE_SPAN_KINDS = (
     "generate",        # root span: submit -> terminal, one per request
     "queue_wait",      # submit -> slot attach (admission queue time)

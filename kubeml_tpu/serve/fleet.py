@@ -24,7 +24,8 @@ they can share one lock and one view of the replica set:
   a warm-up Retry-After).
 
 * **Autoscaling** — a policy tick reads the same SLO snapshot the
-  health rules consume (shed deltas, queue fraction, TTFT p99) and
+  health rules consume (shed deltas, queue fraction, multi-window SLO
+  burn rate — serve/slo.py) and
   grows toward ``replicas_max``; sustained idleness shrinks toward
   ``replicas_min``; ``scale_to_zero_s`` of no admissions drains the
   whole fleet away. Every resize is offered to the cluster allocator
@@ -74,8 +75,10 @@ from typing import Callable, Dict, List, Optional, Tuple
 
 from kubeml_tpu.faults import (FleetFaultPlan, ServeFaultEvent,
                                ServeFaultPlan)
+from kubeml_tpu.metrics.sketch import QuantileSketch
 from kubeml_tpu.serve.pager import routing_digest
-from kubeml_tpu.serve.service import ServeService
+from kubeml_tpu.serve.service import TRACE_FLUSH_EVERY, ServeService
+from kubeml_tpu.serve.slo import DEFAULT_SLO_TARGET, SLOEngine
 from kubeml_tpu.serve.slots import (GenerateRequest, ServeDraining,
                                     ServeSaturated)
 
@@ -96,6 +99,26 @@ FLEET_PATH_VARIANTS = (
     "failover_migrate",  # in-flight stream resumed on a survivor
     "probe_rejoin",   # probation passed; vnodes rejoined the ring
     "hedge",          # queued stream re-issued off a straggler replica
+)
+
+# Fleet-level span kinds on a request's trace timeline. Every routing
+# and failure-domain decision the fleet makes about a request lands on
+# the SAME X-KubeML-Trace-Id tree the replica engines populate (each
+# event parents to the request's "generate" root), so GET /trace merges
+# ONE connected tree per request spanning every replica it touched —
+# including migration off a dead replica, where the tree used to end.
+# Linted by tools/check_serve_spans.py with the same rule as
+# SERVE_SPAN_KINDS: every kind needs a quoted-name assertion in tests/.
+# Keep this a flat tuple of plain strings.
+FLEET_SPAN_KINDS = (
+    "route",            # router entry -> admission, with replica + path
+    "affine_hit",       # admitted on the consistent-hash owner
+    "spill",            # owner saturated/missing; a peer admitted
+    "retry",            # a replica shed; the router retried a peer
+    "cold_start_wait",  # request waited on the cold-start build
+    "migrate",          # stream resumed on a survivor after ejection
+    "hedge",            # queued stream re-issued off a straggler
+    "probe",            # half-open probe routed to a probationer
 )
 
 # ring points per replica: enough that removing one replica moves only
@@ -151,6 +174,10 @@ class ServeFleet:
                  probe_requests: int = 2,
                  hedge_after_s: float = 0.0,
                  fault_plan=None,
+                 tracer=None, trace_sink=None,
+                 slo_ttft_s: float = 0.0,
+                 slo_tpot_s: float = 0.0,
+                 slo_target: float = DEFAULT_SLO_TARGET,
                  clock=time.perf_counter):
         if routing not in ("affine", "random"):
             raise ValueError(f"routing must be 'affine' or 'random', "
@@ -178,6 +205,26 @@ class ServeFleet:
         self.hedge_after_s = float(hedge_after_s)
         self.fault_plan = None if fault_plan is None \
             else FleetFaultPlan.parse(fault_plan)
+        # fleet-level tracing: routing / failure-domain decisions land
+        # on the request's trace timeline (FLEET_SPAN_KINDS above). The
+        # fleet has its own tracer + sink file in the serve:<model>
+        # trace dir; merge_job_trace stitches it with the replicas'.
+        self.tracer = tracer
+        self.trace_sink = trace_sink
+        self._events_flushed = 0
+        self._trace_dirty = False
+        # SLO plane: objectives stamped on every replica (good/bad
+        # classification happens where the request finishes), burn-rate
+        # windows ticked by the autoscaler from cumulative good/bad
+        # deltas. An unset TTFT objective inherits ttft_slo_s so the
+        # burn-rate signal always has teeth.
+        self.slo_ttft_s = float(slo_ttft_s) if slo_ttft_s > 0 \
+            else self.ttft_slo_s
+        self.slo_tpot_s = float(slo_tpot_s)
+        self._slo = SLOEngine(self.slo_ttft_s, self.slo_tpot_s,
+                              target=slo_target)
+        self._slo_good_seen = 0
+        self._slo_bad_seen = 0
 
         self._lock = threading.Lock()
         self._replicas: "collections.OrderedDict[int, ServeService]" = \
@@ -263,6 +310,10 @@ class ServeFleet:
         svc.publish_state_gauges = False
         svc.health_cb = (lambda snap, _i=idx:
                          self._on_replica_publish(_i, snap))
+        # SLO objectives ride on the replica: good/bad classification
+        # happens where the request reaches its terminal state
+        svc.slo_ttft_s = self.slo_ttft_s
+        svc.slo_tpot_s = self.slo_tpot_s
         svc.start()
         with self._lock:
             self._replicas[idx] = svc
@@ -309,6 +360,8 @@ class ServeFleet:
         self._retired["restarts"] += svc.restarts_total
         self._retired["poisoned"] += svc.poisoned_total
         self._retired["deadline"] += svc.deadline_total
+        self._retired["slo_good"] += svc.slo_good_total
+        self._retired["slo_bad"] += svc.slo_bad_total
         self._retired["prefix_hits"] += int(st["prefix_hits"])
         self._retired["prefix_misses"] += int(st["prefix_misses"])
         self._prefix_seen.pop(idx, None)
@@ -338,6 +391,7 @@ class ServeFleet:
             svc.stop(timeout=timeout, grace_s=grace_s)
         if self._autoscale_thread.is_alive():
             self._autoscale_thread.join(timeout)
+        self._flush_trace(force=True)
 
     def scale_to_zero(self, reason: str = "requested") -> None:
         """Drain every live replica away (preemption / idle budget).
@@ -448,11 +502,13 @@ class ServeFleet:
                 return peer, "spill"
         return owner, "affine_hit"
 
-    def _ensure_capacity(self) -> None:
+    def _ensure_capacity(self, trace_id: Optional[str] = None) -> None:
         """Cold start from zero: the first thread against an empty
         fleet builds replica 0 synchronously and then SERVES its
         request; concurrent arrivals shed 429 with the remaining warm
-        estimate so clients back off instead of dogpiling the build."""
+        estimate so clients back off instead of dogpiling the build.
+        The building request's trace gets a ``cold_start_wait`` span
+        covering the build it waited on."""
         build = False
         with self._lock:
             self._last_submit = self.clock()
@@ -489,8 +545,11 @@ class ServeFleet:
             # zero grant: a model with live traffic holds a serving
             # floor of one replica — the allocator can preempt it later
             # through /preempt (which scales the fleet back to zero)
+            t0 = self.clock()
             self._resize_grant(1)
-            self._spawn_one(path="cold_start")
+            idx = self._spawn_one(path="cold_start")
+            self._span("cold_start_wait", t0, self.clock(),
+                       trace_id=trace_id, replica=idx)
             with self._lock:
                 self.cold_starts_total += 1
                 self.grows_total += 1
@@ -512,7 +571,8 @@ class ServeFleet:
         the affine replica is retried ONCE against the least-loaded
         peer before the fleet surfaces it, and a surfaced shed carries
         the fleet-minimum Retry-After (not the first replica's)."""
-        self._ensure_capacity()
+        self._ensure_capacity(trace_id=trace_id)
+        t_route = self.clock()
         digest = routing_digest(list(prompt), self.page_tokens)
         attempted: set = set()
         sheds: List[Exception] = []
@@ -536,8 +596,20 @@ class ServeFleet:
                              if i not in attempted]:
                         break       # retried once already, or no peer
                     self.router_retries_total += 1
+                self._instant("retry", trace_id=trace_id,
+                              shed_replica=idx)
                 continue
             req.fleet_replica = idx     # cancel() routes on this
+            # the routing decision on the request's timeline: router
+            # entry -> admission, plus the per-path instant the span
+            # kind lint pins ("affine_hit" / "spill" / "probe")
+            now = self.clock()
+            self._span("route", t_route, now, rid=req.rid,
+                       trace_id=trace_id, replica=idx,
+                       path=path or self.routing)
+            if path in ("affine_hit", "spill", "probe"):
+                self._instant(path, ts=now, rid=req.rid,
+                              trace_id=trace_id, replica=idx)
             with self._lock:
                 if path == "probe":
                     st = self._probation.get(idx)
@@ -601,6 +673,59 @@ class ServeFleet:
             svcs = list(self._replicas.values())
         for svc in svcs:
             svc.install_weights(variables, stamp)
+
+    # -------------------------------------------------------------- tracing
+    # FLEET_SPAN_KINDS emission. Every event parents to the request's
+    # "generate" root and carries its trace_id, so the merged document
+    # is one connected tree per request even when the request crossed
+    # replicas. None-valued args are dropped (a request without a
+    # client trace id still gets fleet spans, they just float free).
+    def _span(self, name: str, start: float, end: float, **args) -> None:
+        if self.tracer is None:
+            return
+        self.tracer.add_span(
+            name, start, end, parent="generate",
+            **{k: v for k, v in args.items() if v is not None})
+        self._trace_dirty = True
+
+    def _instant(self, name: str, ts: Optional[float] = None,
+                 **args) -> None:
+        if self.tracer is None:
+            return
+        self.tracer.instant(
+            name, ts=self.clock() if ts is None else ts,
+            parent="generate",
+            **{k: v for k, v in args.items() if v is not None})
+        self._trace_dirty = True
+
+    def _flush_trace(self, force: bool = False) -> None:
+        # batched: the sink rewrites the WHOLE file each flush, so a
+        # flush-per-event on the publish path is quadratic and starves
+        # the replica loops under load. Unforced flushes wait for a
+        # batch; stop()/eject flush with force=True so nothing is lost
+        # where it matters.
+        if self.trace_sink is None or self.tracer is None:
+            return
+        n = self.tracer.event_count()
+        if not force and n - self._events_flushed < TRACE_FLUSH_EVERY:
+            return
+        try:
+            self.trace_sink.write(self.tracer)
+            self._events_flushed = n
+        except OSError:
+            logger.exception("fleet trace flush failed for %s",
+                             self.model_id)
+
+    def flush_trace(self) -> None:
+        """Force the fleet's and every replica's buffered trace events
+        to their sinks. `/trace` calls this before merging: unforced
+        flushes are batched, so without it a freshly finished request
+        could be missing from the merged document."""
+        with self._lock:
+            svcs = list(self._replicas.values())
+        for svc in svcs:
+            svc.flush_trace()
+        self._flush_trace(force=True)
 
     # ------------------------------------------------------ failure domains
     def _all_ejected_error(self) -> ServeDraining:
@@ -713,13 +838,17 @@ class ServeFleet:
         # harvest OUTSIDE the fleet lock (eject_streams takes the
         # replica's _cv); the pager audit runs inside the evacuation
         streams = svc.eject_streams()
+        # the dead replica's tracer still buffers spans emitted before
+        # it died — force them to its sink file now, or the migrated
+        # requests' merged trees lose their first half
+        svc.flush_trace()
         with self._lock:
             self._fold_retired(svc, idx)
         svc.stop(grace_s=0.0)
         if streams:
             with self._lock:
                 self.failovers_total += 1
-            moved = self._migrate(streams)
+            moved = self._migrate(streams, from_idx=idx)
             actions.append("failover_migrate")
             logger.warning("fleet %s: %d/%d stream(s) live-migrated off "
                            "replica %d", self.model_id, moved,
@@ -729,14 +858,18 @@ class ServeFleet:
         self._publish_merged()
         return actions
 
-    def _migrate(self, streams: List[GenerateRequest]) -> int:
+    def _migrate(self, streams: List[GenerateRequest],
+                 from_idx: Optional[int] = None) -> int:
         """Resume harvested streams on survivors. Routing goes through
         _pick like a fresh submit — the digest is a pure function of
         the prompt, so migration preserves prefix affinity on the
         SHRUNK ring — but unlike submit it tries every survivor before
         giving up (losing a stream is worse than a cold route). Each
         move is charged one migration; past MIGRATION_BUDGET the stream
-        fails with an attributable error instead of ping-ponging."""
+        fails with an attributable error instead of ping-ponging. The
+        request object (and its trace_id) survives the move, and a
+        ``migrate`` event with ``resumed_from=<dead replica>`` stitches
+        the two replicas' span trees into one."""
         moved = 0
         for req in streams:
             req.migrations += 1
@@ -772,6 +905,10 @@ class ServeFleet:
                         if st is not None:
                             self.probes_total += 1
                             st["probes"].append(req)
+                self._instant("migrate", rid=req.rid,
+                              trace_id=req.trace_id,
+                              resumed_from=from_idx, replica=idx,
+                              emitted_tokens=len(req.tokens))
                 moved += 1
                 break
             if not placed:
@@ -868,6 +1005,9 @@ class ServeFleet:
                         f"stream {req.rid} queued "
                         f"{now - req.submitted_at:.2f}s on replica "
                         f"{idx}; re-issued on {peer}")
+                self._instant("hedge", rid=req.rid,
+                              trace_id=req.trace_id,
+                              resumed_from=idx, replica=peer)
                 return ["hedge"]
         return []
 
@@ -885,10 +1025,11 @@ class ServeFleet:
 
     def autoscale_once(self, now: Optional[float] = None) -> Optional[str]:
         """One policy tick. Reads the per-replica SLO signals (shed
-        delta since the last tick, queue fraction, worst TTFT p99) and
-        returns the action taken: 'grow', 'shrink', 'scale_to_zero' or
-        None. Public and deterministic so tests drive it directly; the
-        background thread just calls it on a cadence."""
+        delta since the last tick, queue fraction, multi-window SLO
+        burn rate) and returns the action taken: 'grow', 'shrink',
+        'scale_to_zero' or None. Public and deterministic so tests
+        drive it directly; the background thread just calls it on a
+        cadence."""
         now = self.clock() if now is None else now
         with self._lock:
             if self._stopped or self._warming:
@@ -903,17 +1044,45 @@ class ServeFleet:
             self._rejected_seen = rejected
             queue = sum(s["serve_queue_depth"] for s in snaps)
             qcap = sum(s["serve_queue_cap"] for s in snaps)
-            p99 = max((s["serve_ttft_p99"] for s in snaps), default=0.0)
+            # SLO burn tick: diff the fleet's cumulative good/bad
+            # classification (retired replicas folded in) into the
+            # fast/slow burn windows. Latency pressure is the BURN
+            # RATE, not an instantaneous p99: an idle fleet's windows
+            # drain to zero burn on their own, so the old "stale p99
+            # over an idle fleet" guard (inflight > 0) is gone — the
+            # signal expires instead of being special-cased.
+            good = self._retired["slo_good"] + sum(
+                s["serve_slo_good_total"] for s in snaps)
+            bad = self._retired["slo_bad"] + sum(
+                s["serve_slo_bad_total"] for s in snaps)
+            good_delta = max(0, good - self._slo_good_seen)
+            bad_delta = max(0, bad - self._slo_bad_seen)
+            self._slo_good_seen = good
+            self._slo_bad_seen = bad
+            was_alerting = self._slo.alerting
+            if self._slo.tick(good_delta, bad_delta):
+                self._note_decision(
+                    "slo_burn",
+                    f"burn fast={self._slo.burn_fast:.3g} "
+                    f"slow={self._slo.burn_slow:.3g} over "
+                    f"target={self._slo.target:g}")
+            # burn/attainment only move on THIS tick, but replicas
+            # publish only while active: without a push on an alert
+            # flip, a fleet that goes idle right after its bad requests
+            # leaves /health and /metrics frozen at the pre-tick SLO
+            # values (bad counted, burn still zero) until the next
+            # request arrives. Publish ONLY on the flip — a full merged
+            # publish every tick would contend with the router for the
+            # fleet lock under load.
+            slo_changed = self._slo.alerting != was_alerting
             idle = inflight == 0 and queue == 0 and shed_delta == 0
             idle_for = now - self._last_submit
             # grow needs LIVE pressure: a shed since the last tick, a
-            # half-full admission queue, or an SLO-busting p99 WITH
-            # work in flight — a stale p99 over an idle fleet (e.g.
-            # the one compile-priced request that woke it) must not
-            # grow replicas nobody is waiting on
+            # half-full admission queue, or both SLO burn windows
+            # above 1.0 (fast = recent pain, slow = sustained pain)
             pressured = (shed_delta > 0
                          or (qcap > 0 and queue / qcap >= 0.5)
-                         or (p99 > self.ttft_slo_s and inflight > 0))
+                         or self._slo.alerting)
             # probationers count against the cap: they are live
             # processes about to rejoin, so pressure while one probes
             # must not over-provision past replicas_max
@@ -925,7 +1094,11 @@ class ServeFleet:
                 self._idle_ticks += 1
             elif not idle:
                 self._idle_ticks = 0
-            shrink = (idle and not to_zero
+            # a tick can be idle (no inflight/queue/shed) while the
+            # burn alert is still inside its fast window; retiring
+            # capacity there would flap (shrink now, burn-grow next
+            # tick), so shrink waits for the alert to expire too
+            shrink = (idle and not pressured and not to_zero
                       and self._idle_ticks >= SHRINK_IDLE_TICKS
                       and n > max(1, self.replicas_min))
             victim = None
@@ -941,6 +1114,8 @@ class ServeFleet:
         if grow:
             granted = self._resize_grant(n + 1)
             if granted <= n:
+                if slo_changed:
+                    self._publish_merged()
                 return None     # allocator said no; try again next tick
             self._spawn_one()
             with self._lock:
@@ -948,7 +1123,8 @@ class ServeFleet:
                 self._idle_ticks = 0
                 self._note_decision(
                     "grow", f"shed_delta={shed_delta} queue={queue}/"
-                            f"{qcap} p99={p99:.3g}s -> {n + 1}")
+                            f"{qcap} burn_fast="
+                            f"{self._slo.burn_fast:.3g} -> {n + 1}")
             self._publish_merged()
             return "grow"
         if shrink and victim is not None:
@@ -962,6 +1138,8 @@ class ServeFleet:
                               f"-> {n - 1}")
             self._publish_merged()
             return "shrink"
+        if slo_changed:
+            self._publish_merged()
         return None
 
     def _resize_grant(self, replicas: int) -> int:
@@ -1087,6 +1265,21 @@ class ServeFleet:
             hit_deltas[str(i)] = h - ph
             miss_deltas[str(i)] = m - pm
             self._prefix_seen[i] = (epoch, h, m)
+        # fleet percentiles come from the EXACT merge of per-replica
+        # windowed sketches (bucket-count addition): the fleet p99 is
+        # the p99 of the pooled samples, not the worst replica's
+        sketches: Dict[str, QuantileSketch] = {}
+        for i in idxs:
+            for kind, st in snaps[i].get(
+                    "serve_latency_sketches", {}).items():
+                part = QuantileSketch.from_state(st)
+                if kind in sketches:
+                    sketches[kind].merge(part)
+                else:
+                    sketches[kind] = part
+        ttft_sk = sketches.get("ttft", QuantileSketch())
+        slo_good = self._retired["slo_good"] + tot("serve_slo_good_total")
+        slo_bad = self._retired["slo_bad"] + tot("serve_slo_bad_total")
         util = [snaps[i]["serve_kv_page_utilization"] for i in idxs]
         # decode amortization: RATIOS merge from the underlying engine
         # counters (sums of sums), not by averaging per-replica ratios
@@ -1109,8 +1302,10 @@ class ServeFleet:
             "serve_rejected_total": self._retired["rejected"]
             + self._router_rejected_total
             + tot("serve_rejected_total"),
-            "serve_ttft_p50": worst("serve_ttft_p50"),
-            "serve_ttft_p99": worst("serve_ttft_p99"),
+            "serve_ttft_p50": round(ttft_sk.quantile(0.50), 6),
+            "serve_ttft_p99": round(ttft_sk.quantile(0.99), 6),
+            "serve_latency_sketches": {
+                kind: sk.state() for kind, sk in sketches.items()},
             "serve_ttft_queue_s": worst("serve_ttft_queue_s"),
             "serve_ttft_prefill_s": worst("serve_ttft_prefill_s"),
             "serve_ttft_interleave_s": worst("serve_ttft_interleave_s"),
@@ -1138,6 +1333,15 @@ class ServeFleet:
             if toks else 0.0,
             "serve_accepted_per_dispatch": round(acc / vdisp, 6)
             if vdisp else 0.0,
+            # SLO plane: objectives, attainment, and the fast/slow
+            # burn-rate windows the autoscaler + slo_burn rule read
+            "serve_slo_target": self._slo.target,
+            "serve_slo_attainment": round(self._slo.attainment, 6),
+            "serve_slo_burn_fast": round(self._slo.burn_fast, 6),
+            "serve_slo_burn_slow": round(self._slo.burn_slow, 6),
+            "serve_slo_good_total": slo_good,
+            "serve_slo_bad_total": slo_bad,
+            "serve_slo_alerts_total": self._slo.alerts_total,
             # fleet routing / scaling surface
             "fleet_replicas": len(live),
             "fleet_replicas_min": self.replicas_min,
@@ -1179,6 +1383,9 @@ class ServeFleet:
             update = getattr(self.metrics, "update_fleet", None)
             if update is not None:
                 update(self.model_id, merged)
+        if self._trace_dirty:
+            self._trace_dirty = False
+            self._flush_trace()
         if self.health_cb is not None:
             try:
                 self.health_cb(merged)

@@ -23,6 +23,7 @@ import threading
 import time
 from typing import Callable, Deque, List, Optional
 
+from kubeml_tpu.metrics.sketch import WindowedSketch
 from kubeml_tpu.models.base import InferenceInputError
 from kubeml_tpu.serve.engine import DecodeEngine
 from kubeml_tpu.serve.slots import (GenerateRequest, ServeDraining,
@@ -36,21 +37,28 @@ logger = logging.getLogger("kubeml_tpu.serve.service")
 # page state — but a pathological exception could fail every retry)
 BISECT_MAX_SUSPECTS = 8
 
-# recent-TTFT window for the host-side p50/p99 the health rules consume
+# recent-window size for the TTFT-breakdown means `kubeml top` shows
 TTFT_WINDOW = 128
+
+# latency sketch window: TTFT/TPOT/e2e land in windowed log-bucket
+# sketches (metrics/sketch.py) on the service clock — percentiles age
+# out with traffic instead of pinning the last sorted list forever,
+# and the fleet merges replica sketches EXACTLY (bucket addition)
+SKETCH_WINDOW_S = 60.0
+SKETCH_SUBWINDOWS = 6
+
+# unforced trace flushes batch this many events before rewriting the
+# trace file: the sink serialises the WHOLE tracer per write, so a
+# flush-per-publish turns the loop thread into an O(n^2) JSON writer
+# under sustained traffic. Forced flushes (stop, eject, flight
+# snapshots, explicit flush_trace()) always write immediately.
+TRACE_FLUSH_EVERY = 256
 
 # Retry-After sizing for the prefill backlog: a conservative host-tier
 # prompt-loading rate. The hint only needs the right ORDER — a client
 # told to come back after the backlog drains stops hammering a server
 # that is mid-way through loading long prompts.
 PREFILL_DRAIN_TOKENS_PER_S = 256.0
-
-
-def _percentile(sorted_vals: List[float], q: float) -> float:
-    if not sorted_vals:
-        return 0.0
-    idx = min(len(sorted_vals) - 1, int(q * (len(sorted_vals) - 1) + 0.5))
-    return sorted_vals[idx]
 
 
 class ServeService:
@@ -133,7 +141,21 @@ class ServeService:
         self.weight_stamp: Optional[float] = None
         self.rejected_total = 0
         self._counters_seen: dict = {}   # engine stat -> last published
-        self._ttfts: Deque[float] = collections.deque(maxlen=TTFT_WINDOW)
+        # windowed latency sketches on the service clock; snapshot()
+        # ships their raw bucket state so the fleet can merge exactly
+        self._sketches = {
+            kind: WindowedSketch(window_s=SKETCH_WINDOW_S,
+                                 subwindows=SKETCH_SUBWINDOWS,
+                                 clock=self.clock)
+            for kind in ("ttft", "tpot", "e2e")}
+        # per-model SLO objectives (seconds; 0 = no objective). The
+        # fleet stamps these on each replica so _observe classifies
+        # finished requests good/bad; cumulative totals fold into the
+        # fleet's burn-rate windows (serve/slo.py)
+        self.slo_ttft_s = 0.0
+        self.slo_tpot_s = 0.0
+        self.slo_good_total = 0
+        self.slo_bad_total = 0
         self._breakdowns: Deque[dict] = collections.deque(
             maxlen=TTFT_WINDOW)
         self._thread = threading.Thread(
@@ -782,11 +804,21 @@ class ServeService:
                             records=fl.snapshot())
         self._flush_trace(force=True)
 
+    def flush_trace(self) -> None:
+        """Force the tracer's buffered events to the sink — the fleet
+        calls this while ejecting a dead replica so the spans it
+        emitted before dying still reach the merged trace (otherwise a
+        migrated request's tree would be missing its first half)."""
+        self._flush_trace(force=True)
+
     def _flush_trace(self, force: bool = False) -> None:
+        # batched (see ServeFleet._flush_trace): the sink rewrites the
+        # whole file per flush, so the publish path only flushes full
+        # batches; eject/stop/flight snapshots force the tail out.
         if self.trace_sink is None or self.tracer is None:
             return
         n = self.tracer.event_count()
-        if not force and n == self._events_flushed:
+        if not force and n - self._events_flushed < TRACE_FLUSH_EVERY:
             return
         try:
             self.trace_sink.write(self.tracer)
@@ -802,10 +834,32 @@ class ServeService:
 
     def _observe(self, req: GenerateRequest) -> None:
         self._note_outcome(req.outcome or "error")
+        ttft = tpot = None
         if req.first_token_at is not None and req.submitted_at is not None:
-            self._ttfts.append(req.first_token_at - req.submitted_at)
+            ttft = req.first_token_at - req.submitted_at
+            self._sketches["ttft"].add(ttft)
             if req.ttft_breakdown:
                 self._breakdowns.append(dict(req.ttft_breakdown))
+        if req.outcome == "ok" and ttft is not None \
+                and req.finished_at is not None:
+            decode = req.finished_at - req.first_token_at
+            tpot = decode / max(1, len(req.tokens) - 1)
+            self._sketches["tpot"].add(tpot)
+            self._sketches["e2e"].add(req.finished_at - req.submitted_at)
+        # SLO classification: ok within the latency objectives is good,
+        # errors and deadline misses are bad, a client cancellation is
+        # neither (the client walked away; the server kept its promise)
+        if req.outcome == "ok":
+            good = (self.slo_ttft_s <= 0.0 or ttft is None
+                    or ttft <= self.slo_ttft_s) and \
+                   (self.slo_tpot_s <= 0.0 or tpot is None
+                    or tpot <= self.slo_tpot_s)
+            if good:
+                self.slo_good_total += 1
+            else:
+                self.slo_bad_total += 1
+        elif req.outcome in ("error", "deadline"):
+            self.slo_bad_total += 1
         if self.metrics is None:
             return
         if req.tokens:
@@ -813,20 +867,15 @@ class ServeService:
         if req.ttft_breakdown:
             self.metrics.observe_serve_ttft_breakdown(
                 self.model_id, **req.ttft_breakdown)
-        if req.outcome == "ok" and req.submitted_at is not None \
-                and req.first_token_at is not None \
+        if req.outcome == "ok" and ttft is not None \
                 and req.finished_at is not None:
-            decode = req.finished_at - req.first_token_at
             self.metrics.observe_serve_latency(
-                self.model_id,
-                ttft=req.first_token_at - req.submitted_at,
-                tpot=decode / max(1, len(req.tokens) - 1),
+                self.model_id, ttft=ttft, tpot=tpot,
                 e2e=req.finished_at - req.submitted_at)
 
     def ttft_percentiles(self) -> dict:
-        vals = sorted(self._ttfts)
-        return {"p50": _percentile(vals, 0.50),
-                "p99": _percentile(vals, 0.99)}
+        sk = self._sketches["ttft"].merged()
+        return {"p50": sk.quantile(0.50), "p99": sk.quantile(0.99)}
 
     def _backlog_tokens(self) -> int:
         """Prompt tokens owed before new work gets its first token:
@@ -860,6 +909,16 @@ class ServeService:
             "serve_rejected_total": self.rejected_total,
             "serve_ttft_p50": round(p["p50"], 6),
             "serve_ttft_p99": round(p["p99"], 6),
+            # raw windowed-sketch state (JSON bucket counts): the fleet
+            # merges these EXACTLY across replicas, so fleet p50/p99 is
+            # the percentile of the pooled samples, not the worst
+            # replica's
+            "serve_latency_sketches": {
+                kind: sk.state() for kind, sk in self._sketches.items()},
+            # cumulative SLO classification for the fleet's burn-rate
+            # windows (serve/slo.py diffs these per autoscale tick)
+            "serve_slo_good_total": self.slo_good_total,
+            "serve_slo_bad_total": self.slo_bad_total,
             # additive TTFT attribution (recent-window means): queue +
             # prefill + interleave == TTFT per request by construction
             "serve_ttft_queue_s": round(bd["queue"], 6),

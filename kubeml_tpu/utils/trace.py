@@ -257,8 +257,16 @@ class TraceSink:
         self.dir = trace_dir(job_id, home)
         self.path = os.path.join(
             self.dir, f"{process}-{os.getpid()}.trace.json")
+        # concurrent flushers (autoscaler tick, supervisor, stop) share
+        # one pid-suffixed tmp name; serialize so a rename never races
+        # another writer's rename of the same tmp file
+        self._write_lock = threading.Lock()
 
     def write(self, tracer: Tracer) -> str:
+        with self._write_lock:
+            return self._write_locked(tracer)
+
+    def _write_locked(self, tracer: Tracer) -> str:
         pid = os.getpid()
         events = [{
             "name": "process_name", "ph": "M", "pid": pid, "tid": 0,

@@ -262,16 +262,25 @@ def test_shrink_drains_victim_without_losing_streams(nano):
         fleet.stop(grace_s=0.0)
 
 
-def test_autoscaler_shrinks_after_sustained_idle(nano):
-    """SHRINK_IDLE_TICKS consecutive idle ticks retire one replica
-    toward the floor; a lone completed request's compile-priced p99
-    must NOT read as pressure on an idle fleet."""
+@pytest.mark.slo
+def test_autoscaler_burn_rate_grow_and_idle_window_expiry(nano):
+    """The burn-rate autoscaler end to end: one SLO-bad request burns
+    both windows above 1.0 -> alert onset -> grow. While the alert is
+    still inside its fast window an idle fleet holds (no shrink/grow
+    flap); once the bad tick ages out, burn drains to zero ON ITS OWN
+    and sustained idleness shrinks back to the floor — after which an
+    idle fleet never grows again. The old instantaneous-p99 path (and
+    its `inflight > 0` staleness guard) is gone: the signal expires
+    with the window instead of being special-cased."""
     from kubeml_tpu.serve.fleet import SHRINK_IDLE_TICKS
+    from kubeml_tpu.serve.slo import FAST_WINDOW_TICKS
 
     _model, module, variables = nano
-    fleet = _fleet(module, variables, replicas_min=1, replicas_max=2)
+    # a TTFT objective no real decode can meet: every completed request
+    # classifies "bad", making the burn signal deterministic on CPU
+    fleet = _fleet(module, variables, replicas_min=1, replicas_max=2,
+                   slo_ttft_s=1e-9)
     fleet.start()
-    fleet._spawn_one()
     try:
         r = fleet.submit([5, 6, 7, 8], max_new_tokens=2)
         assert r.wait(120) and r.outcome == "ok"
@@ -281,15 +290,80 @@ def test_autoscaler_shrinks_after_sustained_idle(nano):
         while any(s.inflight for s in fleet.replicas()) \
                 and time.time() < deadline:
             time.sleep(0.01)
-        actions = [fleet.autoscale_once()
-                   for _ in range(SHRINK_IDLE_TICKS)]
-        assert actions == [None] * (SHRINK_IDLE_TICKS - 1) + ["shrink"]
+        # tick 1 folds the bad request into the windows: burn > 1.0 in
+        # BOTH -> alert onset -> burn-driven grow (not a shed in sight)
+        assert fleet.autoscale_once() == "grow"
+        assert fleet.replica_count == 2
+        assert fleet.grows_total == 1
+        assert any(d["action"] == "slo_burn" for d in fleet.decisions)
+        snap = fleet.snapshot()
+        assert snap["serve_slo_bad_total"] == 1
+        assert snap["serve_slo_good_total"] == 0
+        assert snap["serve_slo_alerts_total"] == 1
+        assert snap["serve_slo_burn_fast"] > 1.0
+        assert snap["serve_slo_burn_slow"] > 1.0
+        assert snap["serve_slo_attainment"] == 0.0
+        # while the bad tick is inside the fast window the idle fleet
+        # holds: at the cap so no grow, still alerting so no shrink
+        for _ in range(FAST_WINDOW_TICKS - 1):
+            assert fleet.autoscale_once() is None
+        assert fleet.replica_count == 2
+        # the window has EXPIRED: the fast burn is zero without any
+        # special-casing, and accumulated idleness shrinks to the floor
+        assert fleet.autoscale_once() == "shrink"
         assert fleet.replica_count == 1
         assert fleet.shrinks_total == 1
-        # at the floor: more idleness never shrinks below replicas_min
-        for _ in range(SHRINK_IDLE_TICKS + 1):
+        assert fleet.snapshot()["serve_slo_burn_fast"] == 0.0
+        # at the floor with expired windows: idleness never grows
+        for _ in range(FAST_WINDOW_TICKS + SHRINK_IDLE_TICKS):
             assert fleet.autoscale_once() is None
         assert fleet.replica_count == 1
+        assert fleet.grows_total == 1
+    finally:
+        fleet.stop(grace_s=0.0)
+
+
+def test_autoscale_tick_publishes_merged_snapshot_on_alert_flips(nano):
+    """Burn state moves only on the autoscale tick, and replicas publish
+    only while active — so the tick must push the merged snapshot when
+    the burn alert FLIPS, or a fleet that goes idle right after its bad
+    requests leaves the health/metrics surfaces frozen at the pre-tick
+    SLO values (bad counted, burn still zero) until the next request.
+    And ONLY on the flips: an every-tick merged publish contends with
+    the router for the fleet lock under load."""
+    from kubeml_tpu.serve.slo import FAST_WINDOW_TICKS
+
+    _model, module, variables = nano
+    fleet = _fleet(module, variables, replicas_min=1, replicas_max=1,
+                   slo_ttft_s=1e-9)
+    published = []
+    fleet.health_cb = published.append
+    fleet.start()
+    try:
+        r = fleet.submit([5, 6, 7, 8], max_new_tokens=2)
+        assert r.wait(120) and r.outcome == "ok"
+        deadline = time.time() + 30
+        while any(s.inflight for s in fleet.replicas()) \
+                and time.time() < deadline:
+            time.sleep(0.01)
+        published.clear()                 # drop the in-flight publishes
+        # onset tick: alert flips ON -> exactly one tick-driven publish
+        # carrying the tick-fresh burn state (at the cap, so no grow —
+        # the flip publish must not depend on a scale action happening)
+        assert fleet.autoscale_once() is None
+        assert [p["serve_slo_burn_fast"] for p in published] == [100.0]
+        assert published[0]["serve_slo_burn_slow"] == 100.0
+        assert published[0]["serve_slo_attainment"] == 0.0
+        assert published[0]["serve_slo_alerts_total"] == 1
+        published.clear()
+        # alert steady inside both windows: no flip, no publish
+        for _ in range(FAST_WINDOW_TICKS - 1):
+            assert fleet.autoscale_once() is None
+        assert published == []
+        # the fast window expires: alert flips OFF -> one recovery
+        # publish so the surfaces show the burn draining
+        assert fleet.autoscale_once() is None
+        assert [p["serve_slo_burn_fast"] for p in published] == [0.0]
     finally:
         fleet.stop(grace_s=0.0)
 
@@ -487,6 +561,150 @@ def test_fleet_snapshot_per_replica_prefix_deltas(nano):
         assert snap["job_id"] == "serve:fleet-m"
         assert snap["fleet_replicas"] == 2
         assert snap["serve_slot_cap"] == 4    # summed across replicas
+    finally:
+        fleet.stop(grace_s=0.0)
+
+
+def _events_by(tracer, name, trace_id=None):
+    return [e for e in tracer.events() if e["name"] == name
+            and (trace_id is None
+                 or e["args"].get("trace_id") == trace_id)]
+
+
+def _submit_when_free(svc, prompt, max_new_tokens, timeout_s=30.0):
+    """Direct-replica submit that tolerates the slot of a just-finished
+    request still draining in the serving loop."""
+    from kubeml_tpu.serve.slots import ServeSaturated
+
+    deadline = time.time() + timeout_s
+    while True:
+        try:
+            return svc.submit(prompt, max_new_tokens=max_new_tokens)
+        except ServeSaturated:
+            assert time.time() < deadline, "replica never freed a slot"
+            time.sleep(0.01)
+
+
+@pytest.mark.slo
+def test_fleet_router_stitches_routing_spans_onto_request_trace(nano):
+    """FLEET_SPAN_KINDS on the request timeline: every routing
+    decision the fleet makes lands on the request's trace parented to
+    its "generate" root and carrying the client trace_id — an affine
+    hit, a proactive spill around a saturated owner, and the
+    retry-after-shed instant when every replica sheds."""
+    from kubeml_tpu.serve.pager import routing_digest
+    from kubeml_tpu.serve.slots import ServeSaturated
+    from kubeml_tpu.utils.trace import Tracer
+
+    _model, module, variables = nano
+    tracer = Tracer()
+    fleet = _fleet(module, variables, replicas_min=2, replicas_max=2,
+                   slots=1, max_queue=0, tracer=tracer)
+    fleet.start()
+    try:
+        prompt = [5, 6, 7, 8, 9]
+        with fleet._lock:
+            owner = fleet._ring_owner(routing_digest(prompt, 4))
+        r1 = fleet.submit(prompt, max_new_tokens=2,
+                          trace_id="t-affine")
+        assert r1.wait(120) and r1.outcome == "ok"
+        (route,) = _events_by(tracer, "route", "t-affine")
+        assert route["args"]["parent"] == "generate"
+        assert route["args"]["replica"] == owner
+        assert route["args"]["path"] == "affine_hit"
+        assert route["args"]["rid"] == r1.rid
+        assert route["dur"] >= 0
+        hit = _events_by(tracer, "affine_hit", "t-affine")
+        assert hit, 'missing "affine_hit" instant'
+        assert hit[0]["args"]["replica"] == owner
+
+        # saturate the owner (capacity 1): the same prompt now spills
+        busy = _submit_when_free(fleet._replicas[owner], [9, 10, 11],
+                                 48)
+        r2 = fleet.submit(prompt, max_new_tokens=2, trace_id="t-spill")
+        assert r2.wait(120) and r2.outcome == "ok"
+        spill = _events_by(tracer, "spill", "t-spill")
+        assert spill and spill[0]["args"]["replica"] != owner
+        (route2,) = _events_by(tracer, "route", "t-spill")
+        assert route2["args"]["path"] == "spill"
+
+        # saturate BOTH replicas with freshly started streams (the
+        # first busy stream may have drained during r2's generate),
+        # then submit: the routed retry leaves its "retry" instant on
+        # the trace before the fleet surfaces the shed
+        assert busy.wait(120)
+        busy1 = _submit_when_free(fleet._replicas[0], [9, 10, 11], 48)
+        busy2 = _submit_when_free(fleet._replicas[1], [9, 10, 12], 48)
+        with pytest.raises(ServeSaturated):
+            fleet.submit(prompt, max_new_tokens=2, trace_id="t-shed")
+        retry = _events_by(tracer, "retry", "t-shed")
+        assert retry, 'missing "retry" instant'
+        assert retry[0]["args"]["parent"] == "generate"
+        assert retry[0]["args"]["shed_replica"] in (0, 1)
+        assert busy1.wait(120) and busy2.wait(120)
+    finally:
+        fleet.stop(grace_s=0.0)
+
+
+@pytest.mark.slo
+def test_cold_start_wait_span_covers_the_build(nano):
+    """A scale-from-zero submit's trace shows WHERE the latency went:
+    a "cold_start_wait" span covering the synchronous replica build,
+    parented to the same "generate" root as the route span."""
+    from kubeml_tpu.utils.trace import Tracer
+
+    _model, module, variables = nano
+    tracer = Tracer()
+    fleet = _fleet(module, variables, replicas_min=0, replicas_max=1,
+                   tracer=tracer)
+    fleet.start()
+    try:
+        assert fleet.replica_count == 0
+        r = fleet.submit([5, 6, 7, 8], max_new_tokens=2,
+                         trace_id="t-cold")
+        assert r.wait(120) and r.outcome == "ok"
+        (wait_span,) = _events_by(tracer, "cold_start_wait", "t-cold")
+        assert wait_span["name"] == "cold_start_wait"
+        assert wait_span["args"]["parent"] == "generate"
+        assert wait_span["args"]["replica"] == r.fleet_replica
+        assert wait_span["dur"] > 0          # the build took real time
+        (route,) = _events_by(tracer, "route", "t-cold")
+        assert route["ts"] >= wait_span["ts"]
+    finally:
+        fleet.stop(grace_s=0.0)
+
+
+@pytest.mark.slo
+def test_fleet_snapshot_merges_replica_sketches_exactly(nano):
+    """Fleet percentiles come from MERGED windowed sketches: the
+    snapshot's TTFT sketch equals — bucket for bucket — the merge of
+    the per-replica sketch states, and p50/p99 are read off that
+    merged sketch (not a worst-replica heuristic)."""
+    from kubeml_tpu.metrics.sketch import QuantileSketch
+
+    _model, module, variables = nano
+    fleet = _fleet(module, variables, replicas_min=2, replicas_max=2,
+                   routing="random")
+    fleet.start()
+    try:
+        reqs = [fleet.submit([5, 6, 7, 8, 9], max_new_tokens=2)
+                for _ in range(6)]
+        for r in reqs:
+            assert r.wait(120) and r.outcome == "ok"
+        deadline = time.time() + 30
+        while any(s.inflight for s in fleet.replicas()) \
+                and time.time() < deadline:
+            time.sleep(0.01)
+        pooled = QuantileSketch()
+        for svc in fleet.replicas():
+            state = svc.snapshot()["serve_latency_sketches"]["ttft"]
+            pooled.merge(QuantileSketch.from_state(state))
+        assert pooled.count == 6
+        snap = fleet.snapshot()
+        assert snap["serve_latency_sketches"]["ttft"] == pooled.state()
+        assert snap["serve_ttft_p50"] == round(pooled.quantile(0.50), 6)
+        assert snap["serve_ttft_p99"] == round(pooled.quantile(0.99), 6)
+        assert 0 < snap["serve_ttft_p50"] <= snap["serve_ttft_p99"]
     finally:
         fleet.stop(grace_s=0.0)
 

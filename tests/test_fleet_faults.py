@@ -240,10 +240,15 @@ def test_slow_replica_straggler_is_hedged(nano):
     """fleet_replica_slow plants serve_slow_step on the victim; a
     stream stuck QUEUED behind the straggler past hedge_after_s is
     stolen and re-admitted on a peer ("hedge") and still finishes
-    bit-identical to a solo engine."""
+    bit-identical to a solo engine; the steal leaves a "hedge" instant
+    with the stitch pointer on the fleet timeline."""
+    from kubeml_tpu.utils.trace import Tracer
+
     _model, module, variables = nano
+    tracer = Tracer()
     fleet = _fleet(module, variables, replicas_min=2, replicas_max=2,
                    hedge_after_s=0.05, slots=2, max_queue=4,
+                   tracer=tracer,
                    fault_plan=[{"kind": "fleet_replica_slow",
                                 "replica": 0, "duration_s": 0.2}])
     fleet.start()
@@ -257,6 +262,11 @@ def test_slow_replica_straggler_is_hedged(nano):
                      timeout_s=60.0, tick=0.05), "no hedge fired"
         assert fleet.path_counts["hedge"] >= 1
         assert fleet.snapshot()["fleet_hedges_total"] >= 1
+        hedge_evs = [e for e in tracer.events() if e["name"] == "hedge"]
+        assert hedge_evs, 'no "hedge" instant on the fleet timeline'
+        assert hedge_evs[0]["args"]["resumed_from"] == 0
+        assert hedge_evs[0]["args"]["replica"] != 0
+        assert hedge_evs[0]["args"]["parent"] == "generate"
         hedged = 0
         for p, r in zip(prompts, reqs):
             assert r.wait(180) and r.outcome == "ok", (r.outcome, r.error)
@@ -264,6 +274,133 @@ def test_slow_replica_straggler_is_hedged(nano):
             np.testing.assert_array_equal(
                 r.tokens, _solo_tokens(module, variables, p, 12))
         assert hedged >= 1
+    finally:
+        fleet.stop(grace_s=0.0)
+
+
+@pytest.mark.slo
+def test_crash_migration_preserves_trace_and_merges_one_tree(nano,
+                                                            tmp_path):
+    """Satellite: live migration must NOT lose the request's trace.
+    The ejected replica's buffered spans are flushed at eject time, the
+    re-submitted stream keeps its original trace_id, the fleet stamps a
+    "migrate" instant with resumed_from=<dead replica>, and the merged
+    trace document carries ONE connected tree per request with spans
+    from BOTH the dead and the surviving replica. The probationary
+    replacement's half-open traffic leaves a "probe" instant on the
+    same timeline."""
+    import json
+
+    from kubeml_tpu.serve.engine import DecodeEngine
+    from kubeml_tpu.serve.fleet import ServeFleet
+    from kubeml_tpu.serve.service import ServeService
+    from kubeml_tpu.utils.trace import (Tracer, TraceSink,
+                                        merge_job_trace)
+
+    _model, module, variables = nano
+    home = str(tmp_path)
+
+    def make(index):
+        engine = DecodeEngine(module, variables, slots=2, page=4)
+        return ServeService(
+            "fleet-m", engine, max_queue=4, supervise=False,
+            tracer=Tracer(),
+            trace_sink=TraceSink("fleet-m", f"serve-r{index}",
+                                 home=home))
+
+    fleet_tracer = Tracer()
+    fleet = ServeFleet(
+        "fleet-m", make, autoscale_interval_s=0.0, page_tokens=4,
+        replicas_min=2, replicas_max=2, probe_requests=1,
+        tracer=fleet_tracer,
+        trace_sink=TraceSink("fleet-m", "fleet", home=home),
+        fault_plan=[{"kind": "fleet_replica_crash", "replica": 0}])
+    fleet.start()
+    try:
+        victim = fleet._replicas[0]
+        prompts = _owned_prompts(fleet, 0, 2)
+        tids = [f"t-mig-{i}" for i in range(len(prompts))]
+        reqs = [fleet.submit(p, max_new_tokens=8, trace_id=t)
+                for p, t in zip(prompts, tids)]
+        assert all(r.fleet_replica == 0 for r in reqs)
+        assert _wait(lambda: victim.engine.active() >= 1)
+        actions = fleet.supervise_once()
+        assert "eject" in actions and "failover_migrate" in actions
+        for r in reqs:
+            assert r.wait(120) and r.outcome == "ok", (r.outcome,
+                                                       r.error)
+            assert r.fleet_replica != 0
+
+        # the fleet's own timeline: a "migrate" instant per stream,
+        # carrying the ORIGINAL trace_id and the stitch pointer
+        for r, tid in zip(reqs, tids):
+            (mig,) = [e for e in fleet_tracer.events()
+                      if e["name"] == "migrate"
+                      and e["args"].get("trace_id") == tid]
+            assert mig["args"]["resumed_from"] == 0
+            assert mig["args"]["replica"] == r.fleet_replica
+            assert mig["args"]["parent"] == "generate"
+
+        # probation: the replacement's half-open probe rides the same
+        # span plumbing
+        rp = fleet.submit(prompts[0], max_new_tokens=2,
+                          trace_id="t-probe")
+        assert rp.wait(120) and rp.outcome == "ok"
+        probe = [e for e in fleet_tracer.events()
+                 if e["name"] == "probe"
+                 and e["args"].get("trace_id") == "t-probe"]
+        assert probe, 'no "probe" instant on the fleet timeline'
+        assert probe[0]["args"]["parent"] == "generate"
+
+        # flush every surviving writer and merge: the dead replica's
+        # file was already forced out by the eject path
+        for svc in fleet.replicas():
+            svc.flush_trace()
+        fleet._flush_trace(force=True)
+        merged = merge_job_trace("fleet-m", home=home)
+        events = merged["traceEvents"]
+
+        # the dead replica's sink holds the first half of each tree
+        dead = [e for e in events
+                if e.get("args", {}).get("trace_id") in tids]
+        assert dead, "migrated requests left no merged events"
+        for tid in tids:
+            evs = [e for e in events
+                   if e.get("args", {}).get("trace_id") == tid]
+            names = {e["name"] for e in evs}
+            # spans from the DEAD replica (admission on replica 0
+            # happened before the kill)...
+            assert "queue_wait" in names or "admit" in names
+            # ...and from the SURVIVOR (the request went terminal
+            # there, emitting the tree's root)
+            assert "generate" in names
+            assert "finish" in names
+            assert "migrate" in names and "route" in names
+            # one connected tree: exactly one root, everything else
+            # parented to it
+            roots = [e for e in evs if e["name"] == "generate"]
+            assert len(roots) == 1
+            for e in evs:
+                assert e["name"] == "generate" \
+                    or e["args"].get("parent") == "generate", e
+
+        # both halves really came from different replica sink files
+        import glob
+        import os
+        r0_files = glob.glob(os.path.join(
+            home, "**", "serve-r0-*.trace.json"), recursive=True)
+        assert len(r0_files) == 1
+        with open(r0_files[0]) as f:
+            r0_events = json.load(f)["traceEvents"]
+        assert any(e.get("args", {}).get("trace_id") in tids
+                   for e in r0_events)
+        survivor_files = glob.glob(os.path.join(
+            home, "**", "serve-r1-*.trace.json"), recursive=True)
+        assert len(survivor_files) == 1
+        with open(survivor_files[0]) as f:
+            r1_events = json.load(f)["traceEvents"]
+        assert any(e.get("args", {}).get("trace_id") in tids
+                   for e in r1_events)
     finally:
         fleet.stop(grace_s=0.0)
 

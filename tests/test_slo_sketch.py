@@ -1,0 +1,361 @@
+"""SLO-plane unit tests: mergeable latency sketches
+(kubeml_tpu/metrics/sketch.py), the multi-window burn-rate engine
+(kubeml_tpu/serve/slo.py), and the wiring around them.
+
+The contracts pinned here:
+
+  * sketch identity — merging per-replica sketches equals sketching
+    the POOLED samples, bucket for bucket (exact state equality, in
+    any merge order); this is what makes fleet p99 the p99 of the
+    fleet, not of the worst replica
+  * sketch accuracy — every quantile of seeded data is within the
+    configured relative error of the sorted-list answer
+  * windowed expiry — sub-windows age out as a pure function of an
+    injectable clock: deterministic under a fake clock, empty after
+    window_s of silence (the property that made the autoscaler's
+    stale-p99 `inflight > 0` guard unnecessary)
+  * burn engine — burn = bad_fraction / (1 - target) per window; an
+    alert needs BOTH the fast and slow windows above 1.0, onsets are
+    counted once, and recovery clears
+  * wiring — the slo_burn health rule fires on the multi-window
+    condition only, the kubeml_serve_slo_* Prometheus families pass
+    the metrics lint and clear with the model, `kubeml top` renders
+    the slo line, and tools/check_serve_spans.py lints
+    FLEET_SPAN_KINDS with the same quoted-name rule (self-tested on
+    synthetic trees, including one WITHOUT fleet.py — the engine-only
+    lint fixtures must keep passing)
+"""
+
+import random
+
+import pytest
+
+pytestmark = [pytest.mark.serving, pytest.mark.slo]
+
+
+# ------------------------------------------------------------- sketches
+
+
+def test_sketch_merge_equals_pooled_exactly():
+    """The satellite identity: merge(per-part sketches) == sketch of
+    the pooled samples, as exact bucket-state equality, regardless of
+    partition or merge order."""
+    from kubeml_tpu.metrics.sketch import QuantileSketch
+
+    rng = random.Random(42)
+    samples = [rng.lognormvariate(-3.0, 1.0) for _ in range(4000)]
+    pooled = QuantileSketch()
+    parts = [QuantileSketch() for _ in range(3)]
+    for i, v in enumerate(samples):
+        pooled.add(v)
+        parts[i % 3].add(v)
+    forward = QuantileSketch()
+    for p in parts:
+        forward.merge(p)
+    backward = QuantileSketch()
+    for p in reversed(parts):
+        backward.merge(p)
+    assert forward.state() == pooled.state()
+    assert backward.state() == pooled.state()
+    assert forward.count == len(samples)
+    # and therefore every quantile agrees exactly, not approximately
+    for q in (0.0, 0.25, 0.5, 0.9, 0.99, 1.0):
+        assert forward.quantile(q) == pooled.quantile(q)
+
+
+def test_sketch_quantiles_within_relative_error_of_sorted_list():
+    """Accuracy contract vs the sorted-list percentile the sketch
+    replaced: every quantile is within alpha relative error of the
+    exact order statistic."""
+    from kubeml_tpu.metrics.sketch import QuantileSketch
+
+    alpha = 0.01
+    rng = random.Random(7)
+    samples = sorted(rng.uniform(0.0005, 3.0) for _ in range(5000))
+    sk = QuantileSketch(alpha=alpha)
+    for v in samples:
+        sk.add(v)
+    for q in (0.01, 0.1, 0.25, 0.5, 0.75, 0.9, 0.95, 0.99, 0.999):
+        exact = samples[int(q * (len(samples) - 1))]
+        est = sk.quantile(q)
+        assert abs(est - exact) <= alpha * exact * 1.0001, (q, est,
+                                                           exact)
+
+
+def test_sketch_edge_cases_and_state_round_trip():
+    from kubeml_tpu.metrics.sketch import QuantileSketch
+
+    sk = QuantileSketch()
+    assert sk.quantile(0.5) == 0.0          # empty
+    sk.add(-1.0)
+    sk.add(0.0)
+    sk.add(0.02)
+    assert sk.count == 3
+    assert sk.quantile(0.0) == 0.0          # clamped zero bucket
+    assert abs(sk.quantile(1.0) - 0.02) <= 0.01 * 0.02
+    # JSON round trip preserves the exact bucket state
+    import json
+    st = json.loads(json.dumps(sk.state()))
+    clone = QuantileSketch.from_state(st)
+    assert clone.state() == sk.state()
+    assert clone.quantile(1.0) == sk.quantile(1.0)
+    # guard rails
+    with pytest.raises(ValueError):
+        sk.quantile(1.5)
+    with pytest.raises(ValueError):
+        QuantileSketch(alpha=0.0)
+    with pytest.raises(ValueError):
+        sk.merge(QuantileSketch(alpha=0.02))
+
+
+def test_windowed_sketch_expiry_is_deterministic_under_fake_clock():
+    """Sub-windows age out as a pure function of the clock: samples
+    survive exactly window_s, partial expiry drops only the old
+    sub-windows, and two identically-fed rings agree state-for-state."""
+    from kubeml_tpu.metrics.sketch import WindowedSketch
+
+    t = [0.0]
+    mk = lambda: WindowedSketch(window_s=60.0, subwindows=6,  # noqa: E731
+                                clock=lambda: t[0])
+    a, b = mk(), mk()
+    for w in (a, b):
+        w.add(0.010)                      # tick 0
+    t[0] = 55.0
+    for w in (a, b):
+        w.add(0.020)                      # tick 5
+    assert a.count == 2
+    assert a.state() == b.state()         # deterministic
+    t[0] = 59.9                           # everything still live
+    assert a.count == 2
+    t[0] = 60.0                           # tick 6: tick 0 expires
+    assert a.count == 1
+    assert a.quantile(1.0) == pytest.approx(0.020, rel=0.011)
+    t[0] = 115.0                          # tick 11: tick 5 expires too
+    assert a.count == 0
+    assert a.quantile(0.99) == 0.0        # idle window drains to empty
+    assert a.state() == b.state()
+
+
+# ---------------------------------------------------------- burn engine
+
+
+def test_slo_engine_burn_math_and_multi_window_alert():
+    from kubeml_tpu.serve.slo import SLOEngine
+
+    e = SLOEngine(0.05, 0.01, target=0.99, fast_ticks=2, slow_ticks=6)
+    assert e.burn_fast == 0.0 and e.burn_slow == 0.0   # no traffic
+    assert e.attainment == 1.0
+    # 2% bad at a 1% budget: burn 2.0 in any window that saw it —
+    # both windows contain the same single tick, so the alert fires
+    # at onset immediately
+    assert e.tick(98, 2) is True
+    assert e.alerting
+    assert e.alerts_total == 1
+    assert e.burn_fast == pytest.approx(2.0)
+    assert e.burn_slow == pytest.approx(2.0)
+    assert e.attainment == pytest.approx(0.98)
+    # recovery: clean ticks push the bad tick out of the FAST window
+    # first — burn_slow stays elevated but the alert clears (no
+    # flapping on long memory)
+    e.tick(100, 0)
+    e.tick(100, 0)
+    assert e.burn_fast == 0.0
+    assert e.burn_slow > 0.0
+    assert not e.alerting
+    # re-onset counts again
+    onsets = [e.tick(0, 50) for _ in range(2)]
+    assert onsets.count(True) == 1 and e.alerts_total == 2
+    assert e.good_total == 298 and e.bad_total == 102
+
+    with pytest.raises(ValueError):
+        SLOEngine(0.05, 0.01, target=1.0)
+    with pytest.raises(ValueError):
+        SLOEngine(0.05, 0.01, fast_ticks=3, slow_ticks=2)
+
+
+def test_slo_engine_tick_onset_ordering_and_classify():
+    from kubeml_tpu.serve.slo import SLOEngine
+
+    e = SLOEngine(0.05, 0.01, target=0.9, fast_ticks=2, slow_ticks=4)
+    onsets = [e.tick(0, 5) for _ in range(4)]
+    # the alert ONSET is reported exactly once while the condition holds
+    assert onsets == [True, False, False, False]
+    assert e.alerts_total == 1
+    assert e.snapshot_fields()["serve_slo_alerts_total"] == 1
+    assert set(e.snapshot_fields()) == {
+        "serve_slo_target", "serve_slo_attainment",
+        "serve_slo_burn_fast", "serve_slo_burn_slow",
+        "serve_slo_good_total", "serve_slo_bad_total",
+        "serve_slo_alerts_total"}
+
+    # classification: ok within both objectives is good; a disabled
+    # objective (<= 0) never disqualifies; errors are always bad
+    assert e.classify("ok", ttft=0.04, tpot=0.005)
+    assert not e.classify("ok", ttft=0.06, tpot=0.005)
+    assert not e.classify("ok", ttft=0.04, tpot=0.02)
+    assert not e.classify("error", ttft=0.01, tpot=0.001)
+    assert not e.classify("deadline", ttft=0.01, tpot=0.001)
+    relaxed = SLOEngine(0.0, 0.0)
+    assert relaxed.classify("ok", ttft=99.0, tpot=99.0)
+
+
+# ---------------------------------------------------------------- wiring
+
+
+def test_slo_burn_health_rule_needs_both_windows():
+    """slo_burn fires only when BOTH burn windows exceed 1.0; samples
+    without serve_slo_* fields (training jobs, solo serve) never
+    fire."""
+    from kubeml_tpu.control.health import HealthEvaluator
+
+    ev = HealthEvaluator()
+    base = {"job_id": "serve:m", "serve_slo_target": 0.99,
+            "serve_slo_attainment": 0.97}
+    # fast spike alone: no page
+    assert not [f for f in ev.observe(dict(
+        base, serve_slo_burn_fast=3.0, serve_slo_burn_slow=0.4))
+        if f["rule"] == "slo_burn"]
+    # both windows burning: warning with the numbers in the detail
+    fired = [f for f in ev.observe(dict(
+        base, serve_slo_burn_fast=3.0, serve_slo_burn_slow=1.5))
+        if f["rule"] == "slo_burn"]
+    assert fired and fired[0]["severity"] == "warning"
+    assert "fast 3x" in fired[0]["detail"]
+    assert "slow 1.5x" in fired[0]["detail"]
+    assert "0.97" in fired[0]["detail"]
+    # recovery clears on the next sample
+    assert not [f for f in ev.observe(dict(
+        base, serve_slo_burn_fast=0.0, serve_slo_burn_slow=1.5))
+        if f["rule"] == "slo_burn"]
+
+    solo = HealthEvaluator()
+    assert not [f for f in solo.observe(
+        {"job_id": "train-1", "train_loss": 0.5})
+        if f["rule"] == "slo_burn"]
+
+
+def test_slo_metric_families_pass_lint_and_clear():
+    """The kubeml_serve_slo_* families: gauges mirror the snapshot
+    (burn windows via the `window` label), counters advance by delta
+    across republishes, the exposition is lint-clean, and clear_serve
+    removes every series."""
+    from kubeml_tpu.metrics.prom import MetricsRegistry
+    from tools.check_metrics import validate_exposition
+
+    reg = MetricsRegistry()
+    snap = {"fleet_replicas": 2, "serve_slo_target": 0.99,
+            "serve_slo_attainment": 0.985,
+            "serve_slo_burn_fast": 1.5, "serve_slo_burn_slow": 1.2,
+            "serve_slo_good_total": 197, "serve_slo_bad_total": 3,
+            "serve_slo_alerts_total": 1}
+    reg.update_fleet("m1", snap)
+    reg.update_fleet("m1", snap)          # republish: no double count
+    text = reg.exposition()
+    assert ('kubeml_serve_slo_attainment{model="m1"} 0.985') in text
+    assert ('kubeml_serve_slo_burn_rate'
+            '{model="m1",window="fast"} 1.5') in text
+    assert ('kubeml_serve_slo_burn_rate'
+            '{model="m1",window="slow"} 1.2') in text
+    assert 'kubeml_serve_slo_good_total{model="m1"} 197' in text
+    assert 'kubeml_serve_slo_bad_total{model="m1"} 3' in text
+    assert 'kubeml_serve_slo_burn_alerts_total{model="m1"} 1' in text
+    assert validate_exposition(text) == []
+    # counters advance by DELTA from the cumulative snapshot
+    reg.update_fleet("m1", dict(snap, serve_slo_good_total=250,
+                                serve_slo_bad_total=4))
+    text = reg.exposition()
+    assert 'kubeml_serve_slo_good_total{model="m1"} 250' in text
+    assert 'kubeml_serve_slo_bad_total{model="m1"} 4' in text
+    reg.clear_serve("m1")
+    assert 'model="m1"' not in reg.exposition()
+
+
+def test_top_renders_slo_line():
+    from kubeml_tpu.cli.main import _render_top
+
+    doc = {"id": "serve:m1", "state": "healthy", "reasons": [],
+           "latest": {"serve_active_slots": 1, "serve_slot_cap": 8,
+                      "serve_queue_depth": 0, "serve_queue_cap": 16,
+                      "serve_kv_page_utilization": 0.25,
+                      "serve_rejected_total": 0,
+                      "serve_slo_target": 0.99,
+                      "serve_slo_attainment": 0.985,
+                      "serve_slo_burn_fast": 1.5,
+                      "serve_slo_burn_slow": 1.23,
+                      "serve_slo_good_total": 197,
+                      "serve_slo_bad_total": 3}}
+    out = _render_top(doc)
+    assert "slo: attainment 98.5% (target 99%)" in out
+    assert "burn fast 1.50 slow 1.23" in out
+    assert "good/bad 197/3" in out
+    # snapshots without the SLO plane render no slo line
+    del doc["latest"]["serve_slo_attainment"]
+    assert "slo:" not in _render_top(doc)
+
+
+# ----------------------------------------------------------- span lint
+
+
+def _write_tree(root, engine_kinds, fleet_kinds, asserted):
+    """Synthetic repo tree for the span lint: registries + one test
+    file asserting `asserted` quoted."""
+    serve = root / "kubeml_tpu" / "serve"
+    serve.mkdir(parents=True)
+    engine_tuple = ", ".join(f'"{k}"' for k in engine_kinds)
+    (serve / "engine.py").write_text(
+        f"SERVE_SPAN_KINDS = ({engine_tuple},)\n")
+    if fleet_kinds is not None:
+        fleet_tuple = ", ".join(f'"{k}"' for k in fleet_kinds)
+        (serve / "fleet.py").write_text(
+            f"FLEET_SPAN_KINDS = ({fleet_tuple},)\n")
+    tests = root / "tests"
+    tests.mkdir()
+    lines = ["def test_kinds():"]
+    lines += [f'    assert "{k}" in kinds()' for k in asserted]
+    lines += ["", "", "def kinds():", "    return []"]
+    (tests / "test_spans.py").write_text("\n".join(lines) + "\n")
+
+
+def test_serve_span_lint_covers_fleet_kinds(tmp_path):
+    """The extended lint: a FLEET_SPAN_KINDS entry without a quoted
+    assert fails; asserting it passes; a tree WITHOUT fleet.py (the
+    engine-only self-test fixtures) checks just the engine registry."""
+    from tools import check_serve_spans as lint
+
+    covered = tmp_path / "covered"
+    covered.mkdir()
+    _write_tree(covered, ["alpha"], ["route_x", "migrate_x"],
+                ["alpha", "route_x", "migrate_x"])
+    assert lint.main(["check_serve_spans.py", str(covered)]) == 0
+
+    naked = tmp_path / "naked"
+    naked.mkdir()
+    _write_tree(naked, ["alpha"], ["route_x", "migrate_x"],
+                ["alpha", "route_x"])        # migrate_x unasserted
+    assert lint.main(["check_serve_spans.py", str(naked)]) == 1
+    assert lint.unasserted_fleet_kinds(
+        str(naked / "kubeml_tpu" / "serve" / "fleet.py"),
+        str(naked / "tests")) == ["migrate_x"]
+
+    engine_only = tmp_path / "engine_only"
+    engine_only.mkdir()
+    _write_tree(engine_only, ["alpha"], None, ["alpha"])
+    assert lint.main(["check_serve_spans.py", str(engine_only)]) == 0
+
+    # fleet.py present but the tuple missing: the lint is miswired
+    broken = tmp_path / "broken"
+    broken.mkdir()
+    _write_tree(broken, ["alpha"], None, ["alpha"])
+    (broken / "kubeml_tpu" / "serve" / "fleet.py").write_text(
+        "VNODES = 32\n")
+    assert lint.main(["check_serve_spans.py", str(broken)]) == 1
+
+
+def test_fleet_span_registry_matches_design():
+    """The eight cross-replica kinds from the design doc, pinned so a
+    rename shows up here AND in the per-kind behavioural asserts."""
+    from kubeml_tpu.serve.fleet import FLEET_SPAN_KINDS
+
+    assert set(FLEET_SPAN_KINDS) == {
+        "route", "affine_hit", "spill", "retry", "cold_start_wait",
+        "migrate", "hedge", "probe"}

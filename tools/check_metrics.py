@@ -468,6 +468,19 @@ def _live_exposition() -> str:
     reg.set_serve_state("lintmodel", active_slots=3, queue_depth=1,
                         kv_utilization=0.25)
     reg.note_serve_tokens("lintmodel", 17)
+    # fleet + SLO-plane families (serve/fleet.py merged snapshots feed
+    # these through update_fleet on the live PS)
+    reg.update_fleet("lintmodel", {
+        "fleet_replicas": 2, "fleet_spills_total": 1,
+        "fleet_router_retries_total": 1, "fleet_cold_starts_total": 1,
+        "fleet_ejections_total": 1, "fleet_failovers_total": 1,
+        "fleet_migrated_streams_total": 1, "fleet_probes_total": 1,
+        "fleet_hedges_total": 1, "fleet_grows_total": 1,
+        "fleet_shrinks_total": 1, "fleet_scale_to_zero_total": 1,
+        "serve_slo_target": 0.99, "serve_slo_attainment": 0.995,
+        "serve_slo_burn_fast": 0.5, "serve_slo_burn_slow": 0.25,
+        "serve_slo_good_total": 199, "serve_slo_bad_total": 1,
+        "serve_slo_alerts_total": 1})
     reg.note_infer_cache(True)
     reg.note_infer_cache(False)
     reg.set_infer_cache_entries(2)

@@ -7,8 +7,10 @@ the flight-recorder snapshot instant. Dashboards, the trace merger,
 and the TTFT-attribution tests all key on these literal names — a
 kind that can be renamed or dropped without failing a test is an
 observability contract nobody is holding. So this lint walks the
-SERVE_SPAN_KINDS tuple in engine.py and fails unless each name
-appears QUOTED on an assertion line (a code line containing
+SERVE_SPAN_KINDS tuple in engine.py — and the FLEET_SPAN_KINDS tuple
+in fleet.py, the cross-replica routing/migration/hedging events the
+fleet stitches onto the same request tree — and fails unless each
+name appears QUOTED on an assertion line (a code line containing
 ``assert``) in some tests/ file.
 
 Run directly (exit 1 on violation) or via
@@ -28,6 +30,8 @@ import tokenize
 
 _KINDS_RE = re.compile(
     r"SERVE_SPAN_KINDS\s*=\s*\(([^)]*)\)", re.DOTALL)
+_FLEET_KINDS_RE = re.compile(
+    r"FLEET_SPAN_KINDS\s*=\s*\(([^)]*)\)", re.DOTALL)
 _NAME_RE = re.compile(r"['\"]([A-Za-z0-9_]+)['\"]")
 
 
@@ -35,6 +39,17 @@ def span_kinds(engine_path: str) -> list:
     """Span-kind names declared in engine.py's SERVE_SPAN_KINDS."""
     with open(engine_path, encoding="utf-8") as f:
         m = _KINDS_RE.search(f.read())
+    if m is None:
+        return []
+    return _NAME_RE.findall(m.group(1))
+
+
+def fleet_span_kinds(fleet_path: str) -> list:
+    """Span-kind names declared in fleet.py's FLEET_SPAN_KINDS — the
+    cross-replica events (routing, migration, hedging) the fleet
+    router stitches onto each request's trace tree."""
+    with open(fleet_path, encoding="utf-8") as f:
+        m = _FLEET_KINDS_RE.search(f.read())
     if m is None:
         return []
     return _NAME_RE.findall(m.group(1))
@@ -73,21 +88,34 @@ def file_asserts_kind(path: str, name: str) -> bool:
     return False
 
 
-def unasserted_kinds(engine_path: str, tests_dir: str) -> list:
-    names = span_kinds(engine_path)
-    test_files = []
+def _test_files(tests_dir: str) -> list:
+    out = []
     for dirpath, _dirs, files in os.walk(tests_dir):
         for fname in sorted(files):
             if fname.startswith("test_") and fname.endswith(".py"):
-                test_files.append(os.path.join(dirpath, fname))
+                out.append(os.path.join(dirpath, fname))
+    return out
+
+
+def _unasserted(names: list, test_files: list) -> list:
     return [n for n in names
             if not any(file_asserts_kind(p, n) for p in test_files)]
+
+
+def unasserted_kinds(engine_path: str, tests_dir: str) -> list:
+    return _unasserted(span_kinds(engine_path), _test_files(tests_dir))
+
+
+def unasserted_fleet_kinds(fleet_path: str, tests_dir: str) -> list:
+    return _unasserted(fleet_span_kinds(fleet_path),
+                       _test_files(tests_dir))
 
 
 def main(argv) -> int:
     root = argv[1] if len(argv) > 1 else os.path.dirname(
         os.path.dirname(os.path.abspath(__file__)))
     engine_path = os.path.join(root, "kubeml_tpu", "serve", "engine.py")
+    fleet_path = os.path.join(root, "kubeml_tpu", "serve", "fleet.py")
     tests_dir = os.path.join(root, "tests")
     names = span_kinds(engine_path)
     if not names:
@@ -95,14 +123,25 @@ def main(argv) -> int:
               "miswired", file=sys.stderr)
         return 1
     missing = unasserted_kinds(engine_path, tests_dir)
+    registries = "kubeml_tpu/serve/engine.py SERVE_SPAN_KINDS"
+    # fleet registry: same contract, separate tuple. A tree without
+    # fleet.py (the lint's own self-test fixtures) only checks the
+    # engine registry; a tree WITH fleet.py but no tuple is miswired.
+    if os.path.exists(fleet_path):
+        if not fleet_span_kinds(fleet_path):
+            print(f"{fleet_path}: no FLEET_SPAN_KINDS found — lint is "
+                  "miswired", file=sys.stderr)
+            return 1
+        missing += unasserted_fleet_kinds(fleet_path, tests_dir)
+        registries += " / fleet.py FLEET_SPAN_KINDS"
     for n in missing:
         print(f"serving span kind {n!r} is unasserted: no tests/ file "
               f"carries an assert line naming it quoted", file=sys.stderr)
     if missing:
         print(f"\n{len(missing)} unasserted span kind"
               f"{'' if len(missing) == 1 else 's'}: every name in "
-              "kubeml_tpu/serve/engine.py SERVE_SPAN_KINDS needs a "
-              "quoted-name assertion in tests/", file=sys.stderr)
+              f"{registries} needs a quoted-name assertion in tests/",
+              file=sys.stderr)
         return 1
     return 0
 
