@@ -86,15 +86,23 @@ COMM_PROXY_LEVERS = {
 
 
 def comm_proxy_block(variables, rounds_per_epoch, dispatches_per_epoch,
-                     programs_compiled):
+                     programs_compiled, ledger=None):
     """Deterministic sync-round comm metrics for the bench JSON: per
     merge lever the payload bytes / bucket / dispatch counts one round
     costs on the cross-slice wire, plus the run's measured dispatch
     grouping and compiled-program count. Pure host arithmetic over the
-    parameter tree — identical on CPU and TPU tiers."""
+    parameter tree — identical on CPU and TPU tiers. With a cost
+    ledger, every lever is registered through register_merge_cost so
+    the `merge.<strategy>` ledger records and the proxy numbers are
+    reconciled EXACTLY (one source of truth; a drift raises)."""
     from kubeml_tpu.parallel import merge as merge_lib
-    block = {name: merge_lib.merge_comm_proxy(variables, **kw)
-             for name, kw in COMM_PROXY_LEVERS.items()}
+    if ledger is not None:
+        block = {name: merge_lib.register_merge_cost(
+                     ledger, variables, **kw)
+                 for name, kw in COMM_PROXY_LEVERS.items()}
+    else:
+        block = {name: merge_lib.merge_comm_proxy(variables, **kw)
+                 for name, kw in COMM_PROXY_LEVERS.items()}
     block["dispatches_per_round"] = round(
         dispatches_per_epoch / max(1, rounds_per_epoch), 4)
     block["programs_compiled"] = int(programs_compiled)
@@ -461,7 +469,15 @@ def main():
     comm_proxy = comm_proxy_block(
         proxy_vars, rounds_per_epoch,
         dispatches_per_epoch=groups + tail,
-        programs_compiled=engine.programs_compiled)
+        programs_compiled=engine.programs_compiled,
+        ledger=engine.ledger)
+    # analytic cost ledger (metrics/ledger.py): verify the replay
+    # invariant (totals == dispatches x per-dispatch cost for every
+    # stable program) BEFORE stamping the snapshot into the artifact —
+    # the cost block is only published when it replays
+    from kubeml_tpu.metrics.ledger import attributed_from_snapshot
+    engine.ledger.replay_check()
+    cost_snapshot = engine.ledger.snapshot()
     # extra keys (ignored by the driver parser) make the numbers
     # auditable from the artifact alone: both arms' absolutes are
     # recorded, so vs_baseline and the payload reduction can be
@@ -486,6 +502,15 @@ def main():
         # compiled-program count — comparable across tiers because the
         # wire plan is a pure function of the parameter tree.
         "comm_proxy": comm_proxy,
+        # analytic cost block: the train engine's cumulative ledger
+        # snapshot (flat per-program record + totals; replay-verified
+        # above) plus the per-plane amortized attribution. The
+        # merge.<strategy> entries are the SAME closed forms comm_proxy
+        # reports, reconciled exactly at registration.
+        "cost": {
+            "programs": cost_snapshot,
+            "attributed": attributed_from_snapshot(cost_snapshot),
+        },
         "timed_epochs": TIMED_EPOCHS,
         "host_timed_epochs": HOST_TIMED_EPOCHS,
         "baseline_timed_epochs": BASELINE_TIMED_EPOCHS,

@@ -498,6 +498,11 @@ class MetricUpdate:
     # and how many generations the registry is ahead of it
     dataset_generation: int = 0
     data_lag_generations: int = -1
+    # analytic cost ledger snapshot (metrics/ledger.py; optional on the
+    # wire): one flat dict per program (per-dispatch record fields +
+    # attributed totals), cumulative over the job's life — the PS
+    # stores the latest and delta-advances the kubeml_cost_* counters
+    cost_programs: Dict[str, dict] = field(default_factory=dict)
 
     def to_dict(self) -> dict:
         return _asdict(self)
@@ -527,7 +532,8 @@ class MetricUpdate:
                                                   0)),
                    dataset_generation=int(d.get("dataset_generation", 0)),
                    data_lag_generations=int(d.get("data_lag_generations",
-                                                  -1)))
+                                                  -1)),
+                   cost_programs=dict(d.get("cost_programs") or {}))
 
 
 @dataclass

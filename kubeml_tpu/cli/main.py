@@ -332,6 +332,60 @@ def cmd_trace(args):
         print(payload)
 
 
+# ---------------------------------------------------------------------- cost
+
+def _fmt_si(n) -> str:
+    n = float(n or 0)
+    for unit in ("", "K", "M", "G", "T"):
+        if abs(n) < 1000 or unit == "T":
+            return f"{n:.3g}{unit}"
+        n /= 1000
+    return f"{n:.3g}T"
+
+
+def cmd_cost(args):
+    """Per-program analytic cost table (GET /cost/{jobId}): the
+    deterministic FLOPs / HBM-byte attribution the cost ledger captured
+    at compile time (XLA cost_analysis or the closed-form fallback),
+    with the roofline arithmetic intensity (flops per HBM byte) per
+    program, plus the per-plane amortized cost — per sample trained,
+    per token generated."""
+    doc = _client(args).v1().cost().get(args.id)
+    if args.json:
+        print(json.dumps(doc, indent=2))
+        return
+    progs = doc.get("programs") or {}
+    print(f"cost {doc.get('id', '?')}  ({len(progs)} programs)")
+    print(f"{'PROGRAM':<26} {'PLANE':<7} {'DISP':>8} {'FLOPS/D':>9} "
+          f"{'BYTES/D':>10} {'AI':>7} {'FLOPS_TOT':>10} {'BYTES_TOT':>10} "
+          f"SRC")
+    for name in sorted(progs):
+        e = progs[name]
+        fl = float(e.get("flops", 0) or 0)
+        hb = float(e.get("hbm_bytes", 0) or 0)
+        # roofline arithmetic intensity: flops per HBM byte moved —
+        # low AI programs (decode) are bandwidth-bound, high AI
+        # programs (train matmuls) are compute-bound
+        ai = f"{fl / hb:.2f}" if hb else "-"
+        print(f"{name:<26} {e.get('plane', '?'):<7} "
+              f"{e.get('dispatches', 0):>8} {_fmt_si(fl):>9} "
+              f"{_fmt_bytes(hb):>10} {ai:>7} "
+              f"{_fmt_si(e.get('flops_total', 0)):>10} "
+              f"{_fmt_bytes(e.get('hbm_bytes_total', 0)):>10} "
+              f"{e.get('source', '?')}")
+    att = doc.get("attributed") or {}
+    tr = att.get("train") or {}
+    if tr.get("samples"):
+        print(f"train: {_fmt_si(tr.get('flops_per_sample'))} flops/sample  "
+              f"{_fmt_bytes(tr.get('bytes_per_sample'))}/sample  "
+              f"({tr['samples']:g} samples, {tr['dispatches']:g} dispatches)")
+    sv = att.get("serve") or {}
+    if sv.get("tokens"):
+        print(f"serve: {_fmt_si(sv.get('flops_per_token'))} flops/token  "
+              f"{_fmt_bytes(sv.get('bytes_per_token'))}/token  "
+              f"({sv['tokens']:g} tokens, {sv['dispatches']:g} dispatches)")
+
+
 # -------------------------------------------------------------------- health
 
 def cmd_health(args):
@@ -516,6 +570,28 @@ def _render_top(doc: dict) -> str:
                 f"{latest.get('cluster_journal_torn_drops_total', 0):g}  "
                 f"fence rejects "
                 f"{latest.get('cluster_fencing_rejections_total', 0):g}")
+    # cost pane: amortized analytic cost from the ledger snapshot that
+    # rode the latest sample — what one trained sample / one generated
+    # token costs in FLOPs and HBM traffic (kubeml cost has the full
+    # per-program roofline table)
+    cost_progs = dict(latest.get("cost_programs") or {})
+    cost_progs.update(latest.get("serve_cost_programs") or {})
+    if cost_progs:
+        from kubeml_tpu.metrics.ledger import attributed_from_snapshot
+        att = attributed_from_snapshot(cost_progs)
+        parts = []
+        tr = att.get("train") or {}
+        if tr.get("samples"):
+            parts.append(
+                f"train {_fmt_si(tr.get('flops_per_sample'))} flops/sample "
+                f"{_fmt_bytes(tr.get('bytes_per_sample'))}/sample")
+        sv = att.get("serve") or {}
+        if sv.get("tokens"):
+            parts.append(
+                f"serve {_fmt_si(sv.get('flops_per_token'))} flops/tok "
+                f"{_fmt_bytes(sv.get('bytes_per_token'))}/tok")
+        if parts:
+            lines.append("cost: " + " · ".join(parts))
     worker_losses = latest.get("worker_losses") or []
     grad_norms = latest.get("grad_norms") or []
     update_ratios = latest.get("update_ratios") or []
@@ -920,6 +996,17 @@ def build_parser() -> argparse.ArgumentParser:
     tr.add_argument("-o", "--out", default=None,
                     help="write the trace JSON here instead of stdout")
     tr.set_defaults(fn=cmd_trace)
+
+    co = sub.add_parser("cost",
+                        help="per-program analytic cost table (FLOPs, "
+                             "HBM bytes, roofline intensity, amortized "
+                             "per-sample/per-token cost)")
+    co.add_argument("--id", required=True,
+                    help="train job id or serve:<model>")
+    co.add_argument("--json", action="store_true",
+                    help="print the raw /cost document instead of the "
+                         "table")
+    co.set_defaults(fn=cmd_cost)
 
     he = sub.add_parser("health",
                         help="one-shot training-health verdict for a job "

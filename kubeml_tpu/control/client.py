@@ -214,6 +214,16 @@ class TracesClient:
         return _request("GET", f"{self.base}/trace/{job_id}")
 
 
+class CostClient:
+    def __init__(self, base: str):
+        self.base = base
+
+    def get(self, job_id: str) -> dict:
+        """Per-program analytic cost attribution for a job or serving
+        model (serve:<model>): {"id", "programs", "attributed"}."""
+        return _request("GET", f"{self.base}/cost/{job_id}")
+
+
 class HealthClient:
     def __init__(self, base: str):
         self.base = base
@@ -246,6 +256,9 @@ class V1:
 
     def traces(self) -> TracesClient:
         return TracesClient(self._base)
+
+    def cost(self) -> CostClient:
+        return CostClient(self._base)
 
     def health(self) -> HealthClient:
         return HealthClient(self._base)

@@ -57,6 +57,7 @@ class Controller(JsonService):
         self.route("DELETE", "/tasks/{jobId}", self._h_task_stop)
         self.route("GET", "/cluster", self._h_cluster)
         self.route("GET", "/trace/{jobId}", self._h_trace)
+        self.route("GET", "/cost/{jobId}", self._h_cost)
         # /health stays the gateway's own liveness probe; the job-health
         # verdict gets its own path segment
         self.route("GET", "/health/{jobId}", self._h_job_health)
@@ -148,6 +149,15 @@ class Controller(JsonService):
         return http_json(
             "GET",
             f"{self._need(self.ps_url, 'PS')}/trace"
+            f"?id={req.params['jobId']}")
+
+    def _h_cost(self, req: Request):
+        """Per-program analytic cost attribution, proxied to the PS
+        (which holds the latest ledger snapshots) so `kubeml cost --id`
+        needs only the gateway URL."""
+        return http_json(
+            "GET",
+            f"{self._need(self.ps_url, 'PS')}/cost"
             f"?id={req.params['jobId']}")
 
     def _h_job_health(self, req: Request):

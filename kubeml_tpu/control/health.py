@@ -455,7 +455,12 @@ _SAMPLE_FIELDS = ("train_loss", "validation_loss", "accuracy",
                   "cluster_fencing_rejections_total",
                   "cluster_journal_records_total",
                   "cluster_journal_compactions_total",
-                  "cluster_journal_torn_drops_total")
+                  "cluster_journal_torn_drops_total",
+                  # analytic cost ledger (PR 20, metrics/ledger.py):
+                  # cumulative per-program cost snapshots ride the
+                  # sample so `kubeml top` can render the attributed
+                  # flops/bytes per sample (train) and per token (serve)
+                  "cost_programs", "serve_cost_programs")
 
 
 class HealthEvaluator:

@@ -59,6 +59,7 @@ from kubeml_tpu.control.httpd import (JsonService, Raw, Request, Stream,
                                       http_json)
 from kubeml_tpu.control.journal import atomic_write_json, read_json
 from kubeml_tpu.data.registry import DatasetRegistry
+from kubeml_tpu.metrics.ledger import attributed_from_snapshot
 from kubeml_tpu.metrics.prom import MetricsRegistry
 from kubeml_tpu.models.base import InferenceInputError, KubeDataset
 from kubeml_tpu.parallel.distributed import CLUSTER_ENV_VARS
@@ -435,6 +436,11 @@ class ParameterServer(JsonService):
             else os.environ.get("KUBEML_SERVE_SLO_TARGET", "0.99"))
         self._serve: Dict[str, tuple] = {}   # model_id -> (stamp, fleet)
         self._serve_lock = threading.Lock()
+        # latest analytic cost-ledger snapshot per TRAIN job (pushed
+        # cumulatively on every MetricUpdate; serve-plane cost is read
+        # live from the service/fleet at request time). Plain dict —
+        # whole-value assignment per job id, reads tolerate staleness.
+        self._cost: Dict[str, dict] = {}
         # durable control plane (opt-in): standalone-job and fleet
         # manifests mirrored under state_dir so recover() can re-adopt
         # surviving children and rebuild serving fleets after a crash
@@ -479,6 +485,7 @@ class ParameterServer(JsonService):
         self.route("GET", "/tasks", self._h_tasks)
         self.route("GET", "/metrics", self._h_prom)
         self.route("GET", "/trace", self._h_trace)
+        self.route("GET", "/cost", self._h_cost)
         self.route("GET", "/flight", self._h_flight)
         # replaces the base liveness route: without ?id= it still
         # answers {"ok": true}, with ?id=<jobId> it serves the job's
@@ -588,6 +595,8 @@ class ParameterServer(JsonService):
     def _h_metrics(self, req: Request):
         m = MetricUpdate.from_dict(req.body)
         self.metrics.update_job(m)
+        if m.cost_programs:
+            self._cost[m.job_id] = m.cost_programs
         self._observe_health(m)
         return {"ok": True}
 
@@ -788,6 +797,30 @@ class ParameterServer(JsonService):
             return merge_job_trace(job_id)
         except FileNotFoundError:
             raise JobNotFoundError(f"{job_id} (no trace recorded)")
+
+    def _h_cost(self, req: Request):
+        """Per-program analytic cost for a job (?id=<jobId> or
+        ?id=serve:<model>): the ledger snapshot (flat per-program
+        record + attributed totals) plus the per-plane attribution
+        (flops/bytes per sample and per token). Train jobs serve the
+        latest MetricUpdate snapshot; serving models read the live
+        service/fleet snapshot, merged fleet-wide like /trace."""
+        job_id = req.query.get("id", "")
+        if not job_id:
+            raise InvalidArgsError("id query parameter required")
+        if job_id.startswith("serve:"):
+            with self._serve_lock:
+                cur = self._serve.get(job_id[len("serve:"):])
+            if cur is None:
+                raise JobNotFoundError(
+                    f"{job_id} (no serving service running)")
+            programs = cur[1].snapshot().get("serve_cost_programs") or {}
+        else:
+            programs = self._cost.get(job_id)
+            if programs is None:
+                raise JobNotFoundError(f"{job_id} (no cost recorded)")
+        return {"id": job_id, "programs": programs,
+                "attributed": attributed_from_snapshot(programs)}
 
     def _h_flight(self, req: Request):
         """Drain the serving engine's flight recorder
@@ -1700,7 +1733,12 @@ class ParameterServer(JsonService):
         return rec.next_parallelism
 
     def _publish_metrics(self, m: MetricUpdate):
+        # in-process twin of _h_metrics: thread jobs publish here
+        # instead of POST /metrics/{jobId}, so /cost has to stash the
+        # ledger snapshot on this path too
         self.metrics.update_job(m)
+        if m.cost_programs:
+            self._cost[m.job_id] = m.cost_programs
         self._observe_health(m)
 
     def _finish(self, job_id: str, error: Optional[str] = None):

@@ -1,5 +1,10 @@
+from kubeml_tpu.metrics.ledger import (CostLedger, CostReconciliationError,
+                                       ProgramCost, attributed_from_snapshot,
+                                       merge_cost_snapshots)
 from kubeml_tpu.metrics.prom import (Counter, Gauge, Histogram,
                                      HttpMetrics, MetricsRegistry)
 
 __all__ = ["Counter", "Gauge", "Histogram", "HttpMetrics",
-           "MetricsRegistry"]
+           "MetricsRegistry", "CostLedger", "CostReconciliationError",
+           "ProgramCost", "attributed_from_snapshot",
+           "merge_cost_snapshots"]

@@ -1,0 +1,412 @@
+"""Analytic cost ledger: per-program HLO cost attribution.
+
+Every jitted program the system runs — the kavg/syncdp round programs,
+the serve inventory {decode, prefill, multi-step, spec-verify}, and the
+closed-form kernel proxies (fused merge wire plan, paged-attention KV
+traffic) — gets one deterministic `ProgramCost` record: flops, HBM
+bytes accessed, transcendentals, peak temp memory.  Records come from
+XLA's own cost model (`lowered.compile().cost_analysis()` /
+`memory_analysis()` — the same numbers XProf attributes on hardware)
+captured AOT at first compile, with a caller-supplied closed-form
+fallback when a backend exposes no cost analysis.  The AOT path
+(`jitfn.lower(*args).compile()`) reads only avals, so donated buffers
+are safe, and it does NOT populate the jit fast-path cache — the
+compile-count-pinned tests stay exact (verified: `_cache_size()` is
+unchanged by an AOT lower+compile).
+
+The ledger then counts dispatches so cost is *attributed*, not just
+cataloged: flops/sample and bytes/sample on the train plane (samples
+merged across lanes by the engines), flops/token and bytes/token on the
+serve plane.  Attribution is the roofline question made assertable
+(Williams et al., CACM 2009): arithmetic intensity = flops / HBM bytes
+per program, a hardware-independent position that CI can gate on
+(tools/check_cost_budgets.py) because identical HLO yields bit-identical
+analysis on every run.
+
+Reconciliation is the anti-drift contract: the hand-derived proxies
+that predate the ledger (merge.py `comm_proxy`, pager.py
+`decode_bytes_per_token`, the bench arms' inline recomputations) are
+cross-checked against ledger records via `reconcile()` — exact for
+pure-counter fields, ±tolerance for XLA-derived fields — and a mismatch
+raises `CostReconciliationError` instead of silently drifting.
+
+Totals accumulate incrementally (`note_dispatch` adds the CURRENT
+record's per-dispatch cost), so with stable shapes the invariant
+`totals == dispatches x per-dispatch cost` replays exactly; a
+mid-run recapture (shape change) bumps `recaptures` so the replay
+check knows when the invariant is per-segment rather than global.
+
+Everything here is host-side bookkeeping: capture costs one extra AOT
+compile per program per process (disable with KUBEML_COST_LEDGER=0),
+`note_dispatch` is a few dict adds on the host, and nothing touches
+the device dispatch path.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+from dataclasses import asdict, dataclass
+from typing import Dict, Iterable, List, Optional
+
+PLANES = ("train", "serve", "kernel")
+
+# cost-analysis sources, strongest first: "xla" = HLO cost model,
+# "analytic" = exact closed-form counter (pure host arithmetic over
+# shapes — deterministic by construction), "fallback" = closed-form
+# estimate used because the backend exposed no cost analysis
+SOURCES = ("xla", "analytic", "fallback")
+
+# documented tolerance for reconciling an XLA-derived byte count
+# against a closed-form proxy: XLA counts every operand's traffic
+# (params, masks, indices) on top of the proxy's modeled payload, and
+# fusion can remove intermediate traffic the proxy counts, so the two
+# agree in magnitude, not bit-for-bit.  Pure-counter reconciliations
+# pass tol=0.0 and must match exactly.
+XLA_PROXY_TOLERANCE = 0.50
+
+
+def _enabled() -> bool:
+    return os.environ.get("KUBEML_COST_LEDGER", "1") != "0"
+
+
+class CostReconciliationError(AssertionError):
+    """A ledger record disagrees with the proxy it must reconcile with.
+
+    Raised loudly (not logged-and-ignored): the whole point of the
+    ledger is that the closed-form proxies and the HLO cost model can
+    never drift apart silently again."""
+
+
+@dataclass(frozen=True)
+class ProgramCost:
+    """One compiled program's deterministic per-dispatch cost record."""
+
+    program: str            # registry name, e.g. "kavg.train"
+    plane: str              # "train" | "serve" | "kernel"
+    flops: float            # HLO cost model flop count per dispatch
+    hbm_bytes: float        # total bytes accessed per dispatch
+    transcendentals: float  # exp/log/tanh… op count per dispatch
+    peak_temp_bytes: int    # XLA temp allocation high-water mark
+    argument_bytes: int = 0
+    output_bytes: int = 0
+    source: str = "xla"
+
+    def __post_init__(self):
+        if self.plane not in PLANES:
+            raise ValueError(f"unknown plane {self.plane!r}")
+        if self.source not in SOURCES:
+            raise ValueError(f"unknown source {self.source!r}")
+
+    @property
+    def arithmetic_intensity(self) -> float:
+        """Roofline x-coordinate: flops per HBM byte accessed."""
+        return self.flops / self.hbm_bytes if self.hbm_bytes else 0.0
+
+    def to_dict(self) -> dict:
+        return asdict(self)
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "ProgramCost":
+        return cls(program=str(d["program"]), plane=str(d["plane"]),
+                   flops=float(d["flops"]),
+                   hbm_bytes=float(d["hbm_bytes"]),
+                   transcendentals=float(d.get("transcendentals", 0.0)),
+                   peak_temp_bytes=int(d.get("peak_temp_bytes", 0)),
+                   argument_bytes=int(d.get("argument_bytes", 0)),
+                   output_bytes=int(d.get("output_bytes", 0)),
+                   source=str(d.get("source", "xla")))
+
+
+def extract_xla_cost(jitfn, *args, **kwargs) -> Optional[dict]:
+    """AOT-lower a jitted callable and read XLA's cost + memory
+    analysis. Returns the raw field dict, or None when the backend
+    exposes no usable analysis (the caller falls back to closed form).
+
+    `.lower()` reads only avals — safe to call with buffers the real
+    dispatch will donate — and the resulting executable is thrown away
+    (it never enters the jit fast-path cache)."""
+    try:
+        compiled = jitfn.lower(*args, **kwargs).compile()
+        ca = compiled.cost_analysis()
+        if isinstance(ca, (list, tuple)):
+            ca = ca[0] if ca else None
+        if not isinstance(ca, dict) or "flops" not in ca:
+            return None
+        fields = {
+            "flops": float(ca.get("flops", 0.0)),
+            "hbm_bytes": float(ca.get("bytes accessed", 0.0)),
+            "transcendentals": float(ca.get("transcendentals", 0.0)),
+        }
+        try:
+            mem = compiled.memory_analysis()
+            fields["peak_temp_bytes"] = int(
+                getattr(mem, "temp_size_in_bytes", 0))
+            fields["argument_bytes"] = int(
+                getattr(mem, "argument_size_in_bytes", 0))
+            fields["output_bytes"] = int(
+                getattr(mem, "output_size_in_bytes", 0))
+        except Exception:
+            fields.update(peak_temp_bytes=0, argument_bytes=0,
+                          output_bytes=0)
+        return fields
+    except Exception:
+        return None
+
+
+def _zero_totals() -> dict:
+    return {"dispatches": 0, "flops_total": 0.0, "hbm_bytes_total": 0.0,
+            "transcendentals_total": 0.0, "samples": 0, "tokens": 0,
+            "recaptures": 0}
+
+
+class CostLedger:
+    """Per-process program registry + dispatch-attributed cost totals.
+
+    Thread-safe: serve engines note dispatches from their loop thread
+    while the PS snapshots from HTTP handlers."""
+
+    def __init__(self, capture_enabled: Optional[bool] = None):
+        # capture_enabled pins the XLA-capture decision for this ledger
+        # regardless of KUBEML_COST_LEDGER (None = follow the env); the
+        # canonical budget inventory uses True so the gate's numbers
+        # never depend on ambient environment
+        self._lock = threading.Lock()
+        self._capture_enabled = capture_enabled
+        self._programs: Dict[str, ProgramCost] = {}
+        self._totals: Dict[str, dict] = {}
+
+    # ------------------------------------------------------------ capture
+
+    def capture(self, program: str, plane: str, jitfn, *args,
+                fallback: Optional[dict] = None, **kwargs) -> ProgramCost:
+        """Record `program`'s per-dispatch cost from XLA's analysis of
+        the jitted callable at the given example args (call at first
+        compile, with the concrete args about to be dispatched).  When
+        the backend has no cost analysis — or KUBEML_COST_LEDGER=0
+        skips the extra AOT compile — the closed-form `fallback` dict
+        ({"flops":, "hbm_bytes":, "transcendentals":}) stands in with
+        source="fallback".  Re-capturing an already-known program
+        (shape change → recompile) replaces the record and bumps
+        `recaptures` so replay checks can tell."""
+        enabled = self._capture_enabled \
+            if self._capture_enabled is not None else _enabled()
+        fields = extract_xla_cost(jitfn, *args, **kwargs) \
+            if enabled else None
+        if fields is not None:
+            rec = ProgramCost(program=program, plane=plane,
+                              source="xla", **fields)
+        else:
+            fb = fallback or {}
+            rec = ProgramCost(
+                program=program, plane=plane,
+                flops=float(fb.get("flops", 0.0)),
+                hbm_bytes=float(fb.get("hbm_bytes", 0.0)),
+                transcendentals=float(fb.get("transcendentals", 0.0)),
+                peak_temp_bytes=int(fb.get("peak_temp_bytes", 0)),
+                argument_bytes=int(fb.get("argument_bytes", 0)),
+                output_bytes=int(fb.get("output_bytes", 0)),
+                source="fallback")
+        self._install(rec)
+        return rec
+
+    def capture_analytic(self, program: str, plane: str, *,
+                         flops: float = 0.0, hbm_bytes: float = 0.0,
+                         transcendentals: float = 0.0,
+                         peak_temp_bytes: int = 0,
+                         argument_bytes: int = 0,
+                         output_bytes: int = 0) -> ProgramCost:
+        """Record a pure-counter program: exact closed-form host
+        arithmetic over shapes (merge wire plans, KV page traffic).
+        These reconcile exactly (tol=0) and budget exactly."""
+        rec = ProgramCost(program=program, plane=plane, flops=flops,
+                          hbm_bytes=hbm_bytes,
+                          transcendentals=transcendentals,
+                          peak_temp_bytes=peak_temp_bytes,
+                          argument_bytes=argument_bytes,
+                          output_bytes=output_bytes, source="analytic")
+        self._install(rec)
+        return rec
+
+    def _install(self, rec: ProgramCost) -> None:
+        with self._lock:
+            known = rec.program in self._programs
+            self._programs[rec.program] = rec
+            tot = self._totals.setdefault(rec.program, _zero_totals())
+            if known:
+                tot["recaptures"] += 1
+
+    # ----------------------------------------------------------- dispatch
+
+    def note_dispatch(self, program: str, n: int = 1, *,
+                      samples: int = 0, tokens: int = 0) -> None:
+        """Attribute `n` dispatches of `program` (and the samples /
+        tokens they produced) at the program's CURRENT per-dispatch
+        cost.  Unknown programs accumulate dispatch counts only — the
+        record may arrive later (fallback capture after first use)."""
+        if n <= 0 and samples <= 0 and tokens <= 0:
+            return
+        with self._lock:
+            rec = self._programs.get(program)
+            tot = self._totals.setdefault(program, _zero_totals())
+            tot["dispatches"] += int(n)
+            tot["samples"] += int(samples)
+            tot["tokens"] += int(tokens)
+            if rec is not None and n > 0:
+                tot["flops_total"] += n * rec.flops
+                tot["hbm_bytes_total"] += n * rec.hbm_bytes
+                tot["transcendentals_total"] += n * rec.transcendentals
+
+    # ------------------------------------------------------------- access
+
+    def programs(self) -> List[str]:
+        """Registry of known program names (JitCompileTracker keys its
+        per-program recompile windows on these)."""
+        with self._lock:
+            return sorted(self._programs)
+
+    def record(self, program: str) -> Optional[ProgramCost]:
+        with self._lock:
+            return self._programs.get(program)
+
+    def totals(self, program: str) -> dict:
+        with self._lock:
+            return dict(self._totals.get(program, _zero_totals()))
+
+    # -------------------------------------------------------- reconcile
+
+    def reconcile(self, program: str, field: str, expected: float,
+                  tolerance: float = 0.0) -> None:
+        """Assert a record field against an independent proxy value.
+        tol=0.0 → exact equality (pure-counter fields); tol>0 →
+        relative |rec - expected| <= tol * max(|expected|, 1).  A miss
+        raises CostReconciliationError — loud by design."""
+        rec = self.record(program)
+        if rec is None:
+            raise CostReconciliationError(
+                f"cost ledger has no record for {program!r} "
+                f"(reconciling {field})")
+        got = float(getattr(rec, field))
+        expected = float(expected)
+        if tolerance <= 0.0:
+            ok = got == expected
+        else:
+            ok = abs(got - expected) <= tolerance * max(abs(expected), 1.0)
+        if not ok:
+            raise CostReconciliationError(
+                f"cost ledger {program}.{field}={got!r} does not "
+                f"reconcile with proxy value {expected!r} "
+                f"(tolerance {tolerance:g}, source={rec.source})")
+
+    # ------------------------------------------------------ attribution
+
+    def attributed(self) -> dict:
+        """Per-plane attributed cost: train flops/sample + bytes/sample
+        (across both engines, lanes already merged into `samples` by
+        the callers), serve flops/token + bytes/token."""
+        with self._lock:
+            planes: Dict[str, dict] = {}
+            for name, rec in self._programs.items():
+                tot = self._totals.get(name, _zero_totals())
+                agg = planes.setdefault(rec.plane, {
+                    "flops_total": 0.0, "hbm_bytes_total": 0.0,
+                    "dispatches": 0, "samples": 0, "tokens": 0})
+                agg["flops_total"] += tot["flops_total"]
+                agg["hbm_bytes_total"] += tot["hbm_bytes_total"]
+                agg["dispatches"] += tot["dispatches"]
+                agg["samples"] += tot["samples"]
+                agg["tokens"] += tot["tokens"]
+        out = {}
+        for plane, agg in planes.items():
+            entry = dict(agg)
+            if agg["samples"]:
+                entry["flops_per_sample"] = agg["flops_total"] / agg["samples"]
+                entry["bytes_per_sample"] = (
+                    agg["hbm_bytes_total"] / agg["samples"])
+            if agg["tokens"]:
+                entry["flops_per_token"] = agg["flops_total"] / agg["tokens"]
+                entry["bytes_per_token"] = (
+                    agg["hbm_bytes_total"] / agg["tokens"])
+            out[plane] = entry
+        return out
+
+    # --------------------------------------------------------- snapshot
+
+    def snapshot(self) -> dict:
+        """JSON-safe mergeable state: one entry per program carrying
+        the record AND the attributed totals. This is what rides the
+        MetricUpdate wire, the serve snapshot, and `GET /cost`."""
+        with self._lock:
+            return {name: {**rec.to_dict(),
+                           **self._totals.get(name, _zero_totals())}
+                    for name, rec in self._programs.items()}
+
+    def replay_check(self) -> None:
+        """Assert the ledger invariant `totals == dispatches x
+        per-dispatch cost` for every stable (recapture-free) program —
+        the bench arms run this before stamping their cost block."""
+        snap = self.snapshot()
+        for name, e in snap.items():
+            if e["recaptures"]:
+                continue
+            for total_f, per_f in (("flops_total", "flops"),
+                                   ("hbm_bytes_total", "hbm_bytes")):
+                want = e["dispatches"] * e[per_f]
+                if e[total_f] != want:
+                    raise CostReconciliationError(
+                        f"cost ledger replay mismatch for {name}: "
+                        f"{total_f}={e[total_f]!r} != dispatches "
+                        f"({e['dispatches']}) x {per_f} ({e[per_f]!r})")
+
+
+def merge_cost_snapshots(snaps: Iterable[dict]) -> dict:
+    """Merge per-process/per-replica ledger snapshots the way the fleet
+    merges serve counters: totals SUM (a busy replica weighs more), the
+    per-dispatch record comes from the first snapshot that has one (one
+    engine config per fleet, so records agree across replicas)."""
+    merged: Dict[str, dict] = {}
+    for snap in snaps:
+        for name, entry in (snap or {}).items():
+            if name not in merged:
+                merged[name] = dict(entry)
+                continue
+            m = merged[name]
+            for k in ("dispatches", "samples", "tokens", "recaptures",
+                      "flops_total", "hbm_bytes_total",
+                      "transcendentals_total"):
+                m[k] = m.get(k, 0) + entry.get(k, 0)
+    return merged
+
+
+def attributed_from_snapshot(snap: dict) -> dict:
+    """Per-plane attribution over a (possibly merged) snapshot dict —
+    the endpoint/CLI-side twin of CostLedger.attributed()."""
+    planes: Dict[str, dict] = {}
+    for entry in (snap or {}).values():
+        agg = planes.setdefault(entry.get("plane", "kernel"), {
+            "flops_total": 0.0, "hbm_bytes_total": 0.0,
+            "dispatches": 0, "samples": 0, "tokens": 0})
+        agg["flops_total"] += float(entry.get("flops_total", 0.0))
+        agg["hbm_bytes_total"] += float(entry.get("hbm_bytes_total", 0.0))
+        agg["dispatches"] += int(entry.get("dispatches", 0))
+        agg["samples"] += int(entry.get("samples", 0))
+        agg["tokens"] += int(entry.get("tokens", 0))
+    out = {}
+    for plane, agg in planes.items():
+        entry = dict(agg)
+        if agg["samples"]:
+            entry["flops_per_sample"] = agg["flops_total"] / agg["samples"]
+            entry["bytes_per_sample"] = agg["hbm_bytes_total"] / agg["samples"]
+        if agg["tokens"]:
+            entry["flops_per_token"] = agg["flops_total"] / agg["tokens"]
+            entry["bytes_per_token"] = agg["hbm_bytes_total"] / agg["tokens"]
+        out[plane] = entry
+    return out
+
+
+def snapshot_to_json(snap: dict) -> str:
+    """Canonical serialization (sorted keys) so two processes that
+    captured the same HLO produce byte-identical documents — the
+    determinism contract tests/test_cost_ledger.py pins."""
+    return json.dumps(snap, sort_keys=True)

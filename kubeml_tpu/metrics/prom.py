@@ -714,6 +714,25 @@ class MetricsRegistry:
         self.cluster_quota_clamps_total = Counter(
             "kubeml_cluster_quota_clamps_total",
             "Gang or resize asks clamped to a tenant quota", "pool")
+        # analytic cost ledger (metrics/ledger.py): deterministic
+        # per-program cost attribution — FLOPs / HBM bytes / dispatch
+        # counts keyed by compiled program name and plane
+        # (train|serve|kernel). Values come from XLA cost_analysis at
+        # compile capture (or the closed-form fallback) times the
+        # dispatch count, so they are model-derived, never timers;
+        # cardinality is bounded by the fixed program registry.
+        self.cost_flops_total = Counter(
+            "kubeml_cost_flops_total",
+            "Analytic-ledger FLOPs dispatched, by compiled program and "
+            "plane", ("program", "plane"))
+        self.cost_hbm_bytes_total = Counter(
+            "kubeml_cost_hbm_bytes_total",
+            "Analytic-ledger HBM bytes moved, by compiled program and "
+            "plane", ("program", "plane"))
+        self.cost_dispatches_total = Counter(
+            "kubeml_cost_dispatches_total",
+            "Device dispatches counted by the analytic cost ledger, by "
+            "program and plane", ("program", "plane"))
         # durable control plane (control/journal.py): recovery counts
         # and latency per role, decision-journal activity, and stale
         # grants rejected by the fencing epoch — the split-brain signal
@@ -827,6 +846,10 @@ class MetricsRegistry:
         self._cluster_seen: Dict[str, float] = {}
         # (model, field) -> cumulative seen, for update_fleet's deltas
         self._fleet_seen: Dict[tuple, float] = {}
+        # (owner, program, field) -> cumulative seen, for update_cost's
+        # deltas; owner is a train job id or serve:<model> so two
+        # sources sharing a program name stay independently monotone
+        self._cost_seen: Dict[tuple, float] = {}
 
     def update_job(self, m) -> None:
         """Apply a MetricUpdate (ml/pkg/ps/metrics.go:90-99)."""
@@ -877,6 +900,28 @@ class MetricsRegistry:
             self.dataset_generation.set(
                 m.job_id, getattr(m, "dataset_generation", 0))
             self.data_lag_generations.set(m.job_id, lag)
+        self.update_cost(m.job_id, getattr(m, "cost_programs", None))
+
+    def update_cost(self, owner: str, cost_programs) -> None:
+        """Advance the kubeml_cost_* counters from one cumulative
+        ledger snapshot (CostLedger.snapshot(): one flat dict per
+        program carrying the per-dispatch record plus attributed
+        totals). `owner` scopes the seen-dict (a train job id or
+        serve:<model>) so replayed snapshots and restarts stay
+        monotone per source, while the exposed series aggregate by
+        (program, plane) only — program names are the identity, the
+        same decode program costs the same wherever it runs."""
+        for program, entry in (cost_programs or {}).items():
+            plane = str(entry.get("plane", "train"))
+            for field, counter in (
+                    ("flops_total", self.cost_flops_total),
+                    ("hbm_bytes_total", self.cost_hbm_bytes_total),
+                    ("dispatches", self.cost_dispatches_total)):
+                cum = float(entry.get(field, 0))
+                seen = self._cost_seen.get((owner, program, field), 0.0)
+                if cum > seen:
+                    counter.inc((program, plane), cum - seen)
+                    self._cost_seen[(owner, program, field)] = cum
 
     def note_restart(self, job_id: str) -> None:
         """One watchdog restart: bump the per-job gauge and the
@@ -1054,6 +1099,8 @@ class MetricsRegistry:
             for replica, n in (snap.get(field) or {}).items():
                 if n > 0:
                     counter.inc((model, str(replica)), float(n))
+        self.update_cost(f"serve:{model}",
+                         snap.get("serve_cost_programs"))
 
     def clear_serve(self, model: str) -> None:
         for g in (self.serve_active_slots, self.serve_queue_depth,
@@ -1098,6 +1145,9 @@ class MetricsRegistry:
         self._trace_seen.pop(f"serve:{model}", None)
         for key in [k for k in self._fleet_seen if k[0] == model]:
             del self._fleet_seen[key]
+        for key in [k for k in self._cost_seen
+                    if k[0] == f"serve:{model}"]:
+            del self._cost_seen[key]
 
     # ---------------------------------------------------- cluster allocator
 
@@ -1197,6 +1247,10 @@ class MetricsRegistry:
             c.clear_prefix(job_id)
         self._jit_seen.pop(job_id, None)
         self._trace_seen.pop(job_id, None)
+        # the (program, plane) cost series are PS-lifetime aggregates,
+        # not job series — only the per-owner seen baseline is dropped
+        for key in [k for k in self._cost_seen if k[0] == job_id]:
+            del self._cost_seen[key]
 
     def exposition(self) -> str:
         families = (self._job_gauges + [self.running_total,
@@ -1211,5 +1265,7 @@ class MetricsRegistry:
                     + self._serve_counters
                     + self._serve_hists + self._serve_multi_hists
                     + self._cluster_gauges + self._cluster_counters
-                    + [self.control_recovery_seconds])
+                    + [self.cost_flops_total, self.cost_hbm_bytes_total,
+                       self.cost_dispatches_total,
+                       self.control_recovery_seconds])
         return "\n".join(f.collect() for f in families) + "\n"

@@ -30,14 +30,28 @@ STORM_WINDOW = 8
 class JitCompileTracker:
     """Counts engine-program compiles and flags recompile storms.
 
-    The job calls `note(compiled, duration_s)` once per dispatch with the
-    engine's compile flag and the wall time of that dispatch (which, on a
-    compile, is dominated by tracing+XLA). A healthy job compiles a
-    handful of programs up front (one per distinct round shape) and never
-    again; `storm` goes True when >= `storm_compiles` of the trailing
-    `storm_window` dispatches compiled — the signature of shape drift
-    (e.g. a ragged tail round shape changing every epoch, or batch-size
-    churn defeating the program cache).
+    The job calls `note(compiled, duration_s, program=...)` once per
+    dispatch with the engine's compile flag and the wall time of that
+    dispatch (which, on a compile, is dominated by tracing+XLA). A
+    healthy job compiles a handful of programs up front (one per
+    distinct round shape) and never again; `storm` goes True when >=
+    `storm_compiles` of the trailing `storm_window` dispatches compiled
+    — the signature of shape drift (e.g. a ragged tail round shape
+    changing every epoch, or batch-size churn defeating the program
+    cache).
+
+    Storm windows are PER PROGRAM, keyed on the cost ledger's registry
+    names (metrics/ledger.py: "kavg.train", "serve.decode", …).  The
+    old single global window mixed unrelated programs: with several
+    jitted programs live in one process (a serve engine's four-program
+    inventory, or an engine plus an eval round), each program's
+    legitimate first compile landed in the same window and three
+    healthy one-time compiles read as a storm — while a real storm in
+    one program could hide behind a flood of healthy dispatches from
+    another.  Keying the window on the program name makes detection
+    exact and lets the storm log name the guilty program.  Un-named
+    notes share the "" window, preserving the old behaviour for
+    callers that predate program attribution.
     """
 
     def __init__(self, storm_compiles: int = STORM_COMPILES,
@@ -48,26 +62,35 @@ class JitCompileTracker:
         self.dispatches = 0
         self.compile_seconds = 0.0
         self.storms = 0
-        self.storm = False
-        self._recent: List[bool] = []
+        self.storm = False          # any program currently in storm
+        self._recent: Dict[str, List[bool]] = {}
+        self._storming: Dict[str, bool] = {}
+        self.storms_by_program: Dict[str, int] = {}
 
-    def note(self, compiled: bool, duration_s: float = 0.0) -> None:
-        """Record one dispatch; duration only accumulates on compiles."""
+    def note(self, compiled: bool, duration_s: float = 0.0,
+             program: str = "") -> None:
+        """Record one dispatch of `program`; duration only accumulates
+        on compiles."""
         self.dispatches += 1
-        self._recent.append(bool(compiled))
-        if len(self._recent) > self.storm_window:
-            self._recent.pop(0)
+        recent = self._recent.setdefault(program, [])
+        recent.append(bool(compiled))
+        if len(recent) > self.storm_window:
+            recent.pop(0)
         if compiled:
             self.compiles += 1
             self.compile_seconds += float(duration_s)
-        in_storm = sum(self._recent) >= self.storm_compiles
-        if in_storm and not self.storm:
+        in_storm = sum(recent) >= self.storm_compiles
+        if in_storm and not self._storming.get(program, False):
             self.storms += 1
+            self.storms_by_program[program] = \
+                self.storms_by_program.get(program, 0) + 1
             logger.warning(
-                "recompile storm: %d of the last %d dispatches compiled "
-                "(%d compiles total) — check for round-shape drift",
-                sum(self._recent), len(self._recent), self.compiles)
-        self.storm = in_storm
+                "recompile storm in program %r: %d of the last %d "
+                "dispatches compiled (%d compiles total) — check for "
+                "round-shape drift", program or "<unattributed>",
+                sum(recent), len(recent), self.compiles)
+        self._storming[program] = in_storm
+        self.storm = any(self._storming.values())
 
     def snapshot(self) -> Dict[str, float]:
         return {

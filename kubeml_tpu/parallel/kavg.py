@@ -51,6 +51,7 @@ import optax
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from kubeml_tpu.metrics.ledger import CostLedger
 from kubeml_tpu.parallel import merge as merge_lib
 from kubeml_tpu.parallel.mesh import DATA_AXIS, SEQ_AXIS
 
@@ -373,6 +374,11 @@ class KAvgEngine:
         self._ef_state: Optional[Dict[str, jax.Array]] = None
         self._train_cache: Dict[Any, Callable] = {}
         self._eval_cache: Dict[Any, Callable] = {}
+        # analytic cost ledger (metrics/ledger.py): every round program
+        # gets a ProgramCost captured AOT at compile time, dispatches
+        # attribute flops/sample + bytes/sample, and the merge wire
+        # plan is registered as an exact analytic kernel record
+        self.ledger = CostLedger()
 
     @property
     def merge_strategy(self) -> str:
@@ -705,15 +711,43 @@ class KAvgEngine:
             **self._shmap_kwargs())
         return jax.jit(sharded, donate_argnums=self._donate(8))
 
-    def _dispatch(self, fn: Callable, variables: PyTree, *args):
+    def _cost_fallback(self, variables: PyTree, samples: int) -> dict:
+        """Closed-form per-dispatch estimate for backends without XLA
+        cost analysis: ~6 flops per weight per sample (dense fwd+bwd+
+        update rule of thumb) over params read/written plus the merge
+        wire payload."""
+        nbytes = sum(int(getattr(a, "nbytes", 0))
+                     for a in jax.tree_util.tree_leaves(variables))
+        payload = self._merge.comm_proxy(variables)["merge_payload_bytes"]
+        return {"flops": 6.0 * (nbytes / 4.0) * max(samples, 1),
+                "hbm_bytes": float(3 * nbytes + payload)}
+
+    def _dispatch(self, fn: Callable, variables: PyTree, *args,
+                  program: str = "", compiled: bool = False,
+                  samples: int = 0):
         """Invoke a compiled round program, threading (and re-stashing)
-        the EF residual carry when the strategy keeps one."""
+        the EF residual carry when the strategy keeps one. On a compile
+        the program's ProgramCost is captured AOT first (aval-only
+        lowering over the exact args about to dispatch — donation-safe,
+        jit-cache-invisible), then every dispatch attributes its sample
+        count to the ledger."""
+        full = (variables, *args)
         if self._ef:
             resid = self._ef_residuals(variables)
-            avg, outs, new_resid = fn(variables, *args, resid)
+            full = full + (resid,)
+        if compiled and program:
+            self.ledger.capture(
+                program, "train", fn, *full,
+                fallback=self._cost_fallback(variables, samples))
+            merge_lib.register_strategy_cost(self.ledger, self._merge,
+                                             variables)
+        if program:
+            self.ledger.note_dispatch(program, samples=samples)
+        if self._ef:
+            avg, outs, new_resid = fn(*full)
             self._ef_state = new_resid
             return avg, outs
-        return fn(variables, *args)
+        return fn(*full)
 
     def train_rounds(self, variables: PyTree, batch: PyTree,
                      sample_mask: np.ndarray, step_mask: np.ndarray,
@@ -744,7 +778,9 @@ class KAvgEngine:
             jnp.asarray(step_mask, jnp.float32),
             jnp.asarray(worker_mask, jnp.float32),
             jnp.asarray(rngs, jnp.uint32),
-            jnp.float32(lr), jnp.int32(epoch))
+            jnp.float32(lr), jnp.int32(epoch),
+            program="kavg.train_multi", compiled=compiled,
+            samples=int(np.asarray(sample_mask).sum()))
         stats = RoundStats(
             loss_sum_device=loss_sums,
             step_count=np.asarray(step_mask).sum(axis=2),
@@ -787,7 +823,9 @@ class KAvgEngine:
             jnp.asarray(step_mask, jnp.float32),
             jnp.asarray(worker_mask, jnp.float32),
             jnp.asarray(rngs, jnp.uint32),
-            jnp.float32(lr), jnp.int32(epoch))
+            jnp.float32(lr), jnp.int32(epoch),
+            program="kavg.train", compiled=compiled,
+            samples=int(np.asarray(sample_mask).sum()))
         stats = RoundStats(
             loss_sum_device=loss_sums,
             step_count=np.asarray(step_mask).sum(axis=1),
@@ -920,7 +958,9 @@ class KAvgEngine:
             jnp.asarray(step_mask, jnp.float32),
             jnp.asarray(worker_mask, jnp.float32),
             jnp.asarray(rngs, jnp.uint32),
-            jnp.float32(lr), jnp.int32(epoch))
+            jnp.float32(lr), jnp.int32(epoch),
+            program="kavg.train_indexed", compiled=compiled,
+            samples=int(np.asarray(sample_mask).sum()))
         stats = RoundStats(
             loss_sum_device=loss_sums,
             step_count=np.asarray(step_mask).sum(axis=1),
@@ -961,7 +1001,9 @@ class KAvgEngine:
             jnp.asarray(step_mask, jnp.float32),
             jnp.asarray(worker_mask, jnp.float32),
             jnp.asarray(rngs, jnp.uint32),
-            jnp.float32(lr), jnp.int32(epoch))
+            jnp.float32(lr), jnp.int32(epoch),
+            program="kavg.train_multi_indexed", compiled=compiled,
+            samples=int(np.asarray(sample_mask).sum()))
         stats = RoundStats(
             loss_sum_device=loss_sums,
             step_count=np.asarray(step_mask).sum(axis=2),
@@ -1027,10 +1069,19 @@ class KAvgEngine:
         # in per-key in_specs from the batch template (same as train)
         key = (w_per_lane, tuple(lead.shape[1:3]), metric_names,
                jax.tree_util.tree_structure(batch))
-        if key not in self._eval_cache:
+        eval_compiled = key not in self._eval_cache
+        if eval_compiled:
             self._eval_cache[key] = self._build_eval_round(
                 w_per_lane, metric_names, batch_template=batch)
-        totals, n = self._eval_cache[key](
-            variables, batch, jnp.asarray(sample_mask, jnp.float32))
+        eval_args = (variables, batch,
+                     jnp.asarray(sample_mask, jnp.float32))
+        if eval_compiled:
+            self.ledger.capture(
+                "kavg.eval", "train", self._eval_cache[key], *eval_args,
+                fallback=self._cost_fallback(
+                    variables, int(np.asarray(sample_mask).sum())))
+        self.ledger.note_dispatch(
+            "kavg.eval", samples=int(np.asarray(sample_mask).sum()))
+        totals, n = self._eval_cache[key](*eval_args)
         n = float(n)
         return {k: float(v) / n for k, v in totals.items()} | {"n": n}

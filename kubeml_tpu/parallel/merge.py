@@ -474,3 +474,38 @@ def merge_comm_proxy(variables: PyTree, merge_dtype: Any = None,
     out = strategy.comm_proxy(variables)
     out["strategy"] = strategy.name
     return out
+
+
+def register_strategy_cost(ledger, strategy: "MergeStrategy",
+                           variables: PyTree) -> Dict[str, int]:
+    """Register a strategy's wire plan as an analytic cost-ledger
+    record (`merge.<strategy>`, kernel plane) built from the SAME
+    comm_proxy numbers bench reports, then reconcile the two EXACTLY
+    (metrics/ledger.py): the pure-counter payload bytes must match
+    bit-for-bit, so the proxy and the ledger can never drift apart.
+    Returns the proxy dict so callers keep the bucket/collective
+    counts."""
+    proxy = strategy.comm_proxy(variables)
+    proxy["strategy"] = strategy.name
+    program = f"merge.{strategy.name}"
+    ledger.capture_analytic(
+        program, "kernel",
+        hbm_bytes=float(proxy["merge_payload_bytes"]),
+        # one collective per bucket: the wire both reads and writes the
+        # payload once per round, and the bucket count rides along as
+        # the output-side descriptor so budgets pin it too
+        output_bytes=int(proxy["merge_payload_bytes"]),
+        argument_bytes=int(proxy["buckets_per_round"]))
+    ledger.reconcile(program, "hbm_bytes",
+                     proxy["merge_payload_bytes"], tolerance=0.0)
+    return proxy
+
+
+def register_merge_cost(ledger, variables: PyTree, merge_dtype: Any = None,
+                        bucket_mb: float = 0.0, compress: str = "none"
+                        ) -> Dict[str, int]:
+    """Knob-level twin of register_strategy_cost for callers (bench,
+    the budget lint) that hold engine knobs rather than a strategy."""
+    strategy = make_strategy(merge_dtype=merge_dtype, bucket_mb=bucket_mb,
+                             compress=compress)
+    return register_strategy_cost(ledger, strategy, variables)

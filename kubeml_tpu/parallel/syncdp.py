@@ -53,6 +53,7 @@ from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from kubeml_tpu import compat
+from kubeml_tpu.metrics.ledger import CostLedger
 from kubeml_tpu.parallel import merge as merge_lib
 from kubeml_tpu.parallel.kavg import (_select_tree, masked_scalar_loss,
                                       tree_all_finite, tree_sq_norm)
@@ -124,6 +125,9 @@ class SyncDPEngine:
             if merge_strategy is not None else None)
         self._ef = self._merge is not None and self._merge.needs_residual
         self._cache: Dict[Any, Callable] = {}
+        # analytic cost ledger (metrics/ledger.py): per-program
+        # ProgramCost captured AOT at compile, dispatches attributed
+        self.ledger = CostLedger()
         self._opt_specs: Optional[PyTree] = None
         self._param_specs: Optional[PyTree] = None
         # mirrors RoundStats.compiled (parallel/kavg.py): True when the
@@ -468,13 +472,37 @@ class SyncDPEngine:
                 out_shardings=(state_sh, rep, rep)
                 + ((rep,) if self.collect_stats else ()),
                 donate_argnums=(0,) if self.donate else ())
-        state, losses, skipped, *extra = self._cache[key](
+        dispatch_args = (
             state, batch, jnp.asarray(sample_mask, jnp.float32),
             jnp.asarray(rngs, jnp.uint32), jnp.float32(lr),
             jnp.int32(epoch))
+        self._ledger_note("syncdp.train", self._cache[key],
+                          dispatch_args, sample_mask)
+        state, losses, skipped, *extra = self._cache[key](*dispatch_args)
         self.last_skipped_device = skipped
         self.last_stats_device = extra[0] if extra else None
         return state, losses
+
+    def _ledger_note(self, program, fn, dispatch_args,
+                     sample_mask) -> None:
+        """Capture the program's ProgramCost on compile (AOT aval-only
+        lowering over the exact dispatch args — donation-safe) and
+        attribute this dispatch's real sample count. The merge wire
+        plan registers alongside as an exact analytic kernel record
+        when the engine merges explicitly."""
+        samples = int(np.asarray(sample_mask).sum())
+        if self.last_compiled:
+            params = dispatch_args[0]["params"]
+            nbytes = sum(int(getattr(a, "nbytes", 0))
+                         for a in jax.tree_util.tree_leaves(params))
+            self.ledger.capture(
+                program, "train", fn, *dispatch_args,
+                fallback={"flops": 6.0 * (nbytes / 4.0) * max(samples, 1),
+                          "hbm_bytes": float(3 * nbytes)})
+            if self._merge is not None:
+                merge_lib.register_strategy_cost(self.ledger, self._merge,
+                                                 params)
+        self.ledger.note_dispatch(program, samples=samples)
 
     # ------------------------------------------------------ index-fed train
 
@@ -535,11 +563,14 @@ class SyncDPEngine:
                 + ((rep,) if self.collect_stats else ()),
                 # donate only the state; the cache must outlive the job
                 donate_argnums=(0,) if self.donate else ())
-        state, losses, skipped, *extra = self._cache[key](
+        dispatch_args = (
             state, cache.arrays, jnp.asarray(idx, jnp.int32),
             jnp.asarray(sample_mask, jnp.float32),
             jnp.asarray(rngs, jnp.uint32), jnp.float32(lr),
             jnp.int32(epoch))
+        self._ledger_note("syncdp.train_indexed", self._cache[key],
+                          dispatch_args, sample_mask)
+        state, losses, skipped, *extra = self._cache[key](*dispatch_args)
         self.last_skipped_device = skipped
         self.last_stats_device = extra[0] if extra else None
         return state, losses

@@ -66,6 +66,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from kubeml_tpu.metrics.ledger import CostLedger
 from kubeml_tpu.metrics.runtime import JitCompileTracker
 from kubeml_tpu.models.base import InferenceInputError
 from kubeml_tpu.models.gpt import (PAD_ID, build_paged_decode_step,
@@ -286,6 +287,19 @@ class DecodeEngine:
         self._slots: List[Optional[_Slot]] = [None] * S
         self._seq = 0
         self.compile_tracker = JitCompileTracker()
+        # analytic cost ledger (metrics/ledger.py): one ProgramCost per
+        # serve program, captured AOT at each program's FIRST dispatch
+        # (aval-only lowering — donation-safe, jit-cache-invisible),
+        # plus the paged-attention KV proxy as an exact analytic record
+        # reconciled against pager.decode_bytes_per_token so the two
+        # sources can never drift apart (satellite of the cost ledger)
+        self.ledger = CostLedger()
+        self.ledger.capture_analytic(
+            "pager.decode_kv", "serve",
+            hbm_bytes=float(self.slab.decode_bytes_per_token))
+        self.ledger.reconcile("pager.decode_kv", "hbm_bytes",
+                              self.slab.decode_bytes_per_token,
+                              tolerance=0.0)
         # observability plane: spans go to an (optional, injectable)
         # Tracer with explicit timestamps from this engine's clock; the
         # flight recorder is ALWAYS on by default (flight_steps=0
@@ -375,6 +389,53 @@ class DecodeEngine:
         first verify dispatch."""
         vd = self.stats["verify_dispatches"]
         return (self.stats["accepted_tokens"] / vd) if vd else 0.0
+
+    # ------------------------------------------------------------ cost
+    def _cost_fallback(self, steps: int = 1) -> dict:
+        """Closed-form per-dispatch estimate for backends with no XLA
+        cost analysis: every decode-phase lane runs the model once per
+        fused step (~2 flops per weight per lane-step, dense forward
+        rule of thumb) over params read once plus each lane's paged KV
+        traffic. A coarse stand-in — budgets treat fallback-sourced
+        fields with the same tolerance as XLA fields."""
+        params = self._params_by_gen.get(self.weight_generation)
+        nbytes = sum(int(getattr(a, "nbytes", 0))
+                     for a in jax.tree_util.tree_leaves(params))
+        S = self.geom.slots
+        return {
+            "flops": 2.0 * (nbytes / 4.0) * S * steps,
+            "hbm_bytes": float(
+                nbytes + S * steps * self.slab.decode_bytes_per_token),
+        }
+
+    def _ledger_capture(self, program: str, jitfn, args,
+                        steps: int = 1) -> None:
+        """Capture `program`'s ProgramCost at its first dispatch (the
+        first dispatch is also the first compile — the compile-count
+        pins guarantee it). Called BEFORE the dispatch so the example
+        buffers are live even on donating backends; `.lower()` reads
+        only avals, so this never touches device data."""
+        if self.ledger.record(program) is not None:
+            return
+        rec = self.ledger.capture(program, "serve", jitfn, *args,
+                                  fallback=self._cost_fallback(steps))
+        if program == "serve.decode" and rec.source == "xla":
+            # reconcile XLA against the paged-attention proxy: one
+            # decode dispatch reads every live lane's paged context, so
+            # its modeled traffic must cover at least ONE token's KV
+            # proxy (ledger.XLA_PROXY_TOLERANCE slack). A violation
+            # means the proxy and the compiled program have drifted —
+            # fail loudly rather than publish irreconcilable numbers.
+            from kubeml_tpu.metrics.ledger import (CostReconciliationError,
+                                                   XLA_PROXY_TOLERANCE)
+            proxy = float(self.slab.decode_bytes_per_token)
+            if proxy > rec.hbm_bytes * (1.0 + XLA_PROXY_TOLERANCE):
+                raise CostReconciliationError(
+                    f"serve.decode XLA bytes/dispatch {rec.hbm_bytes:g} "
+                    f"cannot cover the KV proxy {proxy:g} B/token "
+                    f"(tolerance {XLA_PROXY_TOLERANCE:g}) — "
+                    f"decode_bytes_per_token and the compiled decode "
+                    f"program have drifted apart")
 
     def prefill_backlog_tokens(self) -> int:
         """Prompt tokens admitted to slots but not yet prefilled — the
@@ -676,19 +737,22 @@ class DecodeEngine:
             write_pages[j] = self._tables[s, p // G]
             write_offs[j] = p % G
             in_chunk[j] = 1.0
+        args = (self._params_by_gen[slot.gen],
+                self.slab.k, self.slab.v, self.slab.k_scale,
+                self.slab.v_scale, self.slab.valid,
+                jnp.asarray(tokens), jnp.asarray(pos),
+                jnp.asarray(self._tables[s]), jnp.asarray(write_pages),
+                jnp.asarray(write_offs), jnp.asarray(in_chunk))
+        self._ledger_capture("serve.prefill", self._prefill, args)
         before = self._prefill._cache_size()
         t0 = self.clock()
         (self.slab.k, self.slab.v, self.slab.k_scale, self.slab.v_scale,
-         self.slab.valid) = self._prefill(
-            self._params_by_gen[slot.gen],
-            self.slab.k, self.slab.v, self.slab.k_scale,
-            self.slab.v_scale, self.slab.valid,
-            jnp.asarray(tokens), jnp.asarray(pos),
-            jnp.asarray(self._tables[s]), jnp.asarray(write_pages),
-            jnp.asarray(write_offs), jnp.asarray(in_chunk))
+         self.slab.valid) = self._prefill(*args)
         compiled = self._prefill._cache_size() > before
         t1 = self.clock()
-        self.compile_tracker.note(compiled, t1 - t0)
+        self.compile_tracker.note(compiled, t1 - t0,
+                                  program="serve.prefill")
+        self.ledger.note_dispatch("serve.prefill")
         self.stats["prefill_dispatches"] += 1
         self.stats["prefill_compiles"] += int(compiled)
         self.stats["prefill_tokens"] += n
@@ -949,20 +1013,23 @@ class DecodeEngine:
             if slot.req.eos_id is not None:
                 eos_ids[s] = slot.req.eos_id
             budgets[s] = slot.req.max_new_tokens - len(slot.req.tokens)
+        args = (self._params_by_gen[self.weight_generation],
+                self.slab.k, self.slab.v, self.slab.k_scale,
+                self.slab.v_scale, self.slab.valid,
+                jnp.asarray(tokens), jnp.asarray(pos),
+                jnp.asarray(self._tables), jnp.asarray(live),
+                jnp.asarray(temps), jnp.asarray(seeds),
+                jnp.asarray(eos_ids), jnp.asarray(budgets))
+        self._ledger_capture("serve.multi_step", self._multi, args,
+                             steps=K)
         before = self._multi._cache_size()
         t0 = self.clock()
         (toks, bads, self.slab.k, self.slab.v, self.slab.k_scale,
-         self.slab.v_scale, self.slab.valid) = self._multi(
-            self._params_by_gen[self.weight_generation],
-            self.slab.k, self.slab.v, self.slab.k_scale,
-            self.slab.v_scale, self.slab.valid,
-            jnp.asarray(tokens), jnp.asarray(pos),
-            jnp.asarray(self._tables), jnp.asarray(live),
-            jnp.asarray(temps), jnp.asarray(seeds),
-            jnp.asarray(eos_ids), jnp.asarray(budgets))
+         self.slab.v_scale, self.slab.valid) = self._multi(*args)
         compiled = self._multi._cache_size() > before
         t1 = self.clock()
-        self.compile_tracker.note(compiled, t1 - t0)
+        self.compile_tracker.note(compiled, t1 - t0,
+                                  program="serve.multi_step")
         self._dispatch_wall_s += t1 - t0
         self.stats["dispatches"] += 1
         self.stats["multi_step_dispatches"] += 1
@@ -970,9 +1037,13 @@ class DecodeEngine:
         self.stats["occupancy_sum"] += len(members)
         toks_host = np.asarray(toks)
         bads_host = np.asarray(bads)
+        g0 = self.stats["generated_tokens"]
         for s in members:
             self._walk_emitted(s, toks_host[:, s], bads_host[:, s], K,
                                t0, t1, finished)
+        self.ledger.note_dispatch(
+            "serve.multi_step",
+            tokens=self.stats["generated_tokens"] - g0)
         return True
 
     def _dispatch_spec(self, members: List[int], finished) -> bool:
@@ -1025,21 +1096,24 @@ class DecodeEngine:
             temps[s] = slot.req.temperature
             seeds[s] = np.uint32(slot.req.seed & 0xFFFFFFFF)
             wlen_arr[s] = wlens[s]
+        args = (self._params_by_gen[self.weight_generation],
+                self._draft_params,
+                self.slab.k, self.slab.v, self.slab.k_scale,
+                self.slab.v_scale, self.slab.valid,
+                jnp.asarray(window), jnp.asarray(pos),
+                jnp.asarray(self._tables), jnp.asarray(live),
+                jnp.asarray(temps), jnp.asarray(seeds),
+                jnp.asarray(wlen_arr))
+        self._ledger_capture("serve.spec_verify", self._verify, args,
+                             steps=K + 1)
         before = self._verify._cache_size()
         t0 = self.clock()
         (picks, bads, acc, self.slab.k, self.slab.v, self.slab.k_scale,
-         self.slab.v_scale, self.slab.valid) = self._verify(
-            self._params_by_gen[self.weight_generation],
-            self._draft_params,
-            self.slab.k, self.slab.v, self.slab.k_scale,
-            self.slab.v_scale, self.slab.valid,
-            jnp.asarray(window), jnp.asarray(pos),
-            jnp.asarray(self._tables), jnp.asarray(live),
-            jnp.asarray(temps), jnp.asarray(seeds),
-            jnp.asarray(wlen_arr))
+         self.slab.v_scale, self.slab.valid) = self._verify(*args)
         compiled = self._verify._cache_size() > before
         t1 = self.clock()
-        self.compile_tracker.note(compiled, t1 - t0)
+        self.compile_tracker.note(compiled, t1 - t0,
+                                  program="serve.spec_verify")
         self._dispatch_wall_s += t1 - t0
         self.stats["dispatches"] += 1
         self.stats["verify_dispatches"] += 1
@@ -1048,6 +1122,7 @@ class DecodeEngine:
         picks_host = np.asarray(picks)
         bads_host = np.asarray(bads)
         acc_host = np.asarray(acc)
+        gen_before_walk = self.stats["generated_tokens"]
         for s in members:
             slot = self._slots[s]
             a = int(acc_host[s])
@@ -1069,6 +1144,9 @@ class DecodeEngine:
                 if pid:
                     self.pager.free([pid])
                     self._tables[s, pi] = 0
+        self.ledger.note_dispatch(
+            "serve.spec_verify",
+            tokens=self.stats["generated_tokens"] - gen_before_walk)
         return True
 
     def _step_inner(self, exclude: frozenset = frozenset()
@@ -1250,23 +1328,26 @@ class DecodeEngine:
                 if s in cow:
                     copy_src[s], copy_dst[s] = cow[s]
 
+            step_args = (
+                self._params_by_gen[gen],
+                self.slab.k, self.slab.v, self.slab.k_scale,
+                self.slab.v_scale, self.slab.valid,
+                jnp.asarray(tokens), jnp.asarray(pos),
+                jnp.asarray(self._tables), jnp.asarray(write_page),
+                jnp.asarray(write_off), jnp.asarray(active),
+                jnp.asarray(temps), jnp.asarray(key_data),
+                jnp.asarray(copy_src), jnp.asarray(copy_dst),
+                jnp.asarray(poison))
+            self._ledger_capture("serve.decode", self._step, step_args)
             before = self._step._cache_size()
             t0 = self.clock()
             (nxt, bad, self.slab.k, self.slab.v, self.slab.k_scale,
              self.slab.v_scale, self.slab.valid) = \
-                self._step(
-                    self._params_by_gen[gen],
-                    self.slab.k, self.slab.v, self.slab.k_scale,
-                    self.slab.v_scale, self.slab.valid,
-                    jnp.asarray(tokens), jnp.asarray(pos),
-                    jnp.asarray(self._tables), jnp.asarray(write_page),
-                    jnp.asarray(write_off), jnp.asarray(active),
-                    jnp.asarray(temps), jnp.asarray(key_data),
-                    jnp.asarray(copy_src), jnp.asarray(copy_dst),
-                    jnp.asarray(poison))
+                self._step(*step_args)
             compiled = self._step._cache_size() > before
             t1 = self.clock()
-            self.compile_tracker.note(compiled, t1 - t0)
+            self.compile_tracker.note(compiled, t1 - t0,
+                                      program="serve.decode")
             self._dispatch_wall_s += t1 - t0
             self.stats["dispatches"] += 1
             self.stats["compiles"] += int(compiled)
@@ -1280,6 +1361,7 @@ class DecodeEngine:
             nxt_host = np.asarray(nxt)
             bad_host = np.asarray(bad)
 
+            gen_before_emit = self.stats["generated_tokens"]
             for s in members:
                 slot = self._slots[s]
                 p = slot.pos
@@ -1326,4 +1408,7 @@ class DecodeEngine:
                         or len(slot.req.tokens) >= slot.req.max_new_tokens:
                     self.release(s, "ok")
                     finished.append(slot.req)
+            self.ledger.note_dispatch(
+                "serve.decode",
+                tokens=self.stats["generated_tokens"] - gen_before_emit)
         return finished

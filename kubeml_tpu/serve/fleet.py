@@ -75,6 +75,7 @@ from typing import Callable, Dict, List, Optional, Tuple
 
 from kubeml_tpu.faults import (FleetFaultPlan, ServeFaultEvent,
                                ServeFaultPlan)
+from kubeml_tpu.metrics.ledger import merge_cost_snapshots
 from kubeml_tpu.metrics.sketch import QuantileSketch
 from kubeml_tpu.serve.pager import routing_digest
 from kubeml_tpu.serve.service import TRACE_FLUSH_EVERY, ServeService
@@ -252,6 +253,10 @@ class ServeFleet:
         # totals folded in from retired replicas so fleet aggregates
         # stay monotone across shrink / scale-to-zero
         self._retired: Dict[str, int] = collections.defaultdict(int)
+        # retired replicas' cost-ledger totals (merged snapshot form)
+        # folded in like _retired so GET /cost and the kubeml_cost_*
+        # counters don't dip on shrink
+        self._retired_cost: Dict[str, dict] = {}
         # per-replica prefix hit/miss cursors for the delta fields the
         # fleet snapshot exposes (satellite: per-replica cache health).
         # Keyed by replica EPOCH (restarts_total) as well: a recovered
@@ -364,6 +369,8 @@ class ServeFleet:
         self._retired["slo_bad"] += svc.slo_bad_total
         self._retired["prefix_hits"] += int(st["prefix_hits"])
         self._retired["prefix_misses"] += int(st["prefix_misses"])
+        self._retired_cost = merge_cost_snapshots(
+            [self._retired_cost, svc.engine.ledger.snapshot()])
         self._prefix_seen.pop(idx, None)
 
     def drain(self, grace_s: float) -> bool:
@@ -1362,6 +1369,16 @@ class ServeFleet:
             "fleet_hedges_total": self.hedges_total,
             "fleet_replica_prefix_hits": hit_deltas,
             "fleet_replica_prefix_misses": miss_deltas,
+            # analytic cost ledger, merged EXACTLY across replicas
+            # (totals sum; per-dispatch records agree — one engine
+            # config per fleet) plus retired replicas' folded totals.
+            # An engine restart resets its replica ledger; the dip is
+            # absorbed by update_cost's monotone guard, bounded by one
+            # replica-life of dispatches.
+            "serve_cost_programs": merge_cost_snapshots(
+                [self._retired_cost]
+                + [snaps[i].get("serve_cost_programs") or {}
+                   for i in idxs]),
         }
 
     def _on_replica_publish(self, idx: int, snap: dict) -> None:

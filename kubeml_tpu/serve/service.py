@@ -950,6 +950,12 @@ class ServeService:
                 self.engine.dispatches_per_token, 6),
             "serve_accepted_per_dispatch": round(
                 self.engine.accepted_per_dispatch, 6),
+            # analytic cost ledger: cumulative per-program cost
+            # snapshot (flat record+totals per program) — the fleet
+            # merges these across replicas (totals sum, records agree
+            # because replicas compile identical programs) and the PS
+            # serves them on GET /cost and delta-advances kubeml_cost_*
+            "serve_cost_programs": self.engine.ledger.snapshot(),
         }
 
     def _publish(self) -> None:
@@ -990,6 +996,14 @@ class ServeService:
                 # jobs, under the serve:<model> pseudo-job id
                 self.metrics.note_serve_trace_dropped(
                     self.model_id, self.tracer.dropped_events)
+            # analytic cost counters: cumulative ledger snapshot,
+            # advanced by delta under the serve:<model> owner key.
+            # Gated on publish_state_gauges like the per-model gauges:
+            # fleet replicas must not race the fleet's MERGED advance
+            # under the same owner key (fleet.py _publish_merged)
+            if self.publish_state_gauges:
+                self.metrics.update_cost(f"serve:{self.model_id}",
+                                         snap.get("serve_cost_programs"))
         # shed-episode bookkeeping + trace flush ride the publish
         # cadence: a pass with no new sheds re-arms the onset snapshot,
         # a pass after terminal events rewrites the sink file

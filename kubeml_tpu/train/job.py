@@ -51,6 +51,7 @@ from kubeml_tpu.train.checkpoint import (AsyncCheckpointer,
                                          mark_checkpoint_completed,
                                          save_checkpoint)
 from kubeml_tpu.train.history import HistoryStore
+from kubeml_tpu.metrics.ledger import merge_cost_snapshots
 from kubeml_tpu.metrics.prom import PHASE_HISTOGRAMS
 from kubeml_tpu.metrics.runtime import HbmWatermark, JitCompileTracker
 from kubeml_tpu.utils.env import limit_parallelism
@@ -499,7 +500,12 @@ class TrainJob:
                     data_lag_generations=(
                         self._registry_generation
                         - self._trained_generation
-                        if continual else -1)))
+                        if continual else -1),
+                    # per-program analytic cost ledger: cumulative flat
+                    # record+totals per program — the PS stores the
+                    # latest snapshot for GET /cost and delta-advances
+                    # kubeml_cost_* counters (metrics/ledger.py)
+                    cost_programs=self._cost_snapshot()))
                 self._log("job %s epoch %d/%d loss=%.4f val=%.4f acc=%.2f "
                             "N=%d %.2fs [%s]", job_id, epoch + 1, epochs,
                             train_loss, val_loss, accuracy, used_parallelism,
@@ -1413,9 +1419,25 @@ class TrainJob:
                     yield rb
             round_no += 1
 
+    def _cost_snapshot(self) -> dict:
+        """Merged analytic-cost snapshot across whichever engines this
+        job instantiated (kavg always; syncdp when --engine syncdp).
+        Program names are disjoint between the two, so the merge is a
+        plain union; the PS keeps the latest snapshot per job for
+        GET /cost and advances the prom cost counters by delta."""
+        snaps = []
+        for eng in (getattr(self, "_engine", None),
+                    getattr(self, "_sync_engine", None)):
+            led = getattr(eng, "ledger", None)
+            if led is not None:
+                snaps.append(led.snapshot())
+        snaps = [s for s in snaps if s]
+        return merge_cost_snapshots(snaps) if snaps else {}
+
     def _note_round_times(self, round_times) -> None:
         """Derive this epoch's compile overhead from per-dispatch times
-        (dispatch seconds, rounds in the dispatch, compiled flag). XLA
+        (dispatch seconds, rounds in the dispatch, compiled flag,
+        program name). XLA
         compiles run synchronously inside the dispatch call, so a
         compiling dispatch's time ~= compile time; steady dispatches are
         ms. Times are normalized to PER-ROUND before the steady EMA —
@@ -1431,14 +1453,16 @@ class TrainJob:
         steady estimate carries over from earlier epochs via an EMA,
         which is sound because shape pinning makes every round of an
         elastic job the SAME program with the same per-round cost."""
-        for dt, _r, c in round_times:
+        for dt, _r, c, prog in round_times:
             # the runtime introspection tracker sees every dispatch: it
             # counts compiles and flags recompile storms (shape drift),
-            # feeding kubeml_jit_compiles_total (metrics/runtime.py)
-            self._jit_tracker.note(bool(c), dt if c else 0.0)
-        steady = [dt / r for dt, r, c in round_times if not c and r > 0]
-        spike_time = sum(dt for dt, r, c in round_times if c)
-        spike_rounds = sum(r for dt, r, c in round_times if c)
+            # feeding kubeml_jit_compiles_total (metrics/runtime.py);
+            # the program name keys the per-program storm window so a
+            # storm report says WHICH compiled program is churning
+            self._jit_tracker.note(bool(c), dt if c else 0.0, program=prog)
+        steady = [dt / r for dt, r, c, _p in round_times if not c and r > 0]
+        spike_time = sum(dt for dt, r, c, _p in round_times if c)
+        spike_rounds = sum(r for dt, r, c, _p in round_times if c)
         est = float(np.mean(steady)) if steady else self._steady_round_ema
         if spike_rounds:
             # with no steady sample anywhere yet (the job's very first
@@ -1483,7 +1507,7 @@ class TrainJob:
         dev_spread = []   # per-round cross-worker loss-spread scalars
         stat_rounds = 0   # rounds contributing to dev_spread
         step_counts = np.zeros(0)
-        round_times = []  # (dispatch seconds, rounds, compiled?) per dispatch
+        round_times = []  # (dispatch s, rounds, compiled?, program)/dispatch
         group = self._rounds_per_dispatch()
         opts = self.req.options
         transform = self._stage_group
@@ -1600,7 +1624,9 @@ class TrainJob:
                         self.variables, rb.batch, rb.sample_mask,
                         rb.step_mask, rb.worker_mask, rb.rngs,
                         lr=self.req.lr, epoch=epoch)
-                round_times.append((time.time() - t_r, 1, stats.compiled))
+                round_times.append((time.time() - t_r, 1, stats.compiled,
+                                    "kavg.train_indexed" if cache is not None
+                                    else "kavg.train"))
             if step_counts.size == 0:
                 step_counts = np.zeros(len(stats.step_count))
             # count only merged workers' steps: a masked-out worker (lost
@@ -1670,7 +1696,10 @@ class TrainJob:
                             rb.step_mask, rb.worker_mask, rb.rngs,
                             lr=self.req.lr, epoch=epoch)
                     round_times.append((time.time() - t_r, rb.rounds,
-                                        stats.compiled))
+                                        stats.compiled,
+                                        "kavg.train_multi_indexed"
+                                        if cache is not None
+                                        else "kavg.train_multi"))
                 if pending is not None:
                     with self.tracer.span("merge_overlap"):
                         note_group(*pending)
@@ -1900,7 +1929,10 @@ class TrainJob:
                         self._sync_state, rb.batch, smask_global,
                         rb.rngs[0], lr=self.req.lr, epoch=epoch)
                 round_times.append((time.time() - t_r, 1,
-                                    self._sync_engine.last_compiled))
+                                    self._sync_engine.last_compiled,
+                                    "syncdp.train_indexed"
+                                    if cache is not None
+                                    else "syncdp.train"))
             real_steps += int((smask_global.sum(axis=1) > 0).sum())
             dev_losses.append(losses)
             dev_skipped.append(self._sync_engine.last_skipped_device)

@@ -751,19 +751,21 @@ def test_policy_elapsed_excludes_compile(setup):
     # dispatch is ms), so the whole spike counts as compile; otherwise
     # the policy's prev==0.0 branch would record a compile-inflated
     # reference time and grant every later epoch a spurious +1
-    job._note_round_times([(5.0, 1, True)])
+    job._note_round_times([(5.0, 1, True, "kavg.train")])
     assert job._compile_overhead_s == 5.0
     # steady dispatches establish the EMA, normalized PER ROUND first:
     # a 2-round grouped dispatch at 0.04s is a 0.02s/round sample
-    job._note_round_times([(0.04, 2, False), (0.04, 1, False)])
+    job._note_round_times([(0.04, 2, False, "kavg.train_multi"),
+                           (0.04, 1, False, "kavg.train")])
     assert job._compile_overhead_s == 0.0
     assert abs(job._steady_round_ema - 0.03) < 1e-9
     # mixed epoch: spike minus the would-have-been steady cost of the
     # ROUNDS the compiling dispatch carried (2 here)
-    job._note_round_times([(4.0, 2, True), (0.03, 1, False)])
+    job._note_round_times([(4.0, 2, True, "kavg.train_multi"),
+                           (0.03, 1, False, "kavg.train")])
     assert abs(job._compile_overhead_s - (4.0 - 2 * 0.03)) < 1e-6
     # all-compiled epoch: the EMA stands in for the steady estimate
-    job._note_round_times([(2.0, 1, True)])
+    job._note_round_times([(2.0, 1, True, "kavg.train")])
     assert abs(job._compile_overhead_s - (2.0 - job._steady_round_ema)) \
         < 1e-6
 

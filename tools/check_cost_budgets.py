@@ -1,0 +1,202 @@
+#!/usr/bin/env python3
+"""Lint: per-program analytic costs must stay inside committed budgets.
+
+The cost ledger (kubeml_tpu/metrics/ledger.py) makes every compiled
+program's FLOPs / HBM bytes a deterministic, assertable number.  This
+tool rebuilds a CANONICAL ledger — fixed parameter tree, fixed page
+geometry, fixed tiny jitted programs, CPU backend — and compares each
+program's per-dispatch record against tools/cost_budgets.json:
+
+  * pure-counter programs (source=analytic: the merge.<strategy> wire
+    plans, pager.decode_kv) must match their budget EXACTLY — they are
+    closed-form host arithmetic, any drift is a real cost change
+  * compiler-derived programs (source=xla: the tiny train/decode lint
+    programs) match within the file's relative tolerance — XLA's
+    cost_analysis may shift slightly across jaxlib versions, but a
+    budget overrun beyond tolerance is a cost regression
+  * every canonical program must be budgeted (no silent new cost), and
+    every budgeted program must still exist (no stale budget lines)
+
+An intentional cost change regenerates the budget file:
+
+    python tools/check_cost_budgets.py --update
+
+Run directly (exit 1 on violation) or via tests/test_cost_ledger.py,
+which keeps the gate itself in the tier-1 suite (`cost` marker) and
+self-tests that a perturbed budget FAILS.
+
+    JAX_PLATFORMS=cpu python tools/check_cost_budgets.py [budgets.json]
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO_ROOT not in sys.path:
+    sys.path.insert(0, _REPO_ROOT)
+
+DEFAULT_BUDGETS = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                               "cost_budgets.json")
+
+# relative tolerance for compiler-derived (source=xla) fields; written
+# into the budget file so the gate and the artifact travel together
+XLA_TOLERANCE = 0.05
+
+# per-dispatch record fields the budget pins, in report order
+_FIELDS = ("flops", "hbm_bytes", "transcendentals")
+
+
+def build_canonical_ledger():
+    """The fixed program inventory the budget file pins.  Everything
+    here must be deterministic: fixed shapes, zero-filled parameters
+    (cost analysis reads avals, not values), CPU backend."""
+    import jax
+    import jax.numpy as jnp
+
+    from kubeml_tpu.metrics.ledger import CostLedger
+    from kubeml_tpu.parallel import merge as merge_lib
+    from kubeml_tpu.serve.pager import KVPageSlab, PageGeometry
+
+    # capture pinned ON: the budgets pin XLA-derived numbers, so the
+    # inventory must not inherit the test suite's KUBEML_COST_LEDGER=0
+    ledger = CostLedger(capture_enabled=True)
+
+    # merge wire plans over a fixed two-layer parameter tree: one
+    # record per lever, reconciled exactly against comm_proxy inside
+    # register_merge_cost
+    variables = {"params": {
+        "dense": {"kernel": jnp.zeros((64, 64), jnp.float32),
+                  "bias": jnp.zeros((64,), jnp.float32)},
+        "head": {"kernel": jnp.zeros((64, 10), jnp.float32),
+                 "bias": jnp.zeros((10,), jnp.float32)}}}
+    for kw in ({}, dict(bucket_mb=4.0), dict(compress="bf16"),
+               dict(compress="int8")):
+        merge_lib.register_merge_cost(ledger, variables, **kw)
+
+    # paged-KV decode traffic over a fixed geometry, one record per
+    # storage mode (the int8 sidecar traffic is part of the budget)
+    geom = PageGeometry(slots=4, page=16, pages=33, pages_per_slot=8)
+    for kv_dtype, program in (("f32", "pager.decode_kv"),
+                              ("int8", "pager.decode_kv_int8")):
+        slab = KVPageSlab(geom, layers=2, heads=4, head_dim=8,
+                          dtype=jnp.float32, kv_dtype=kv_dtype)
+        ledger.capture_analytic(program, "serve",
+                                hbm_bytes=float(slab.decode_bytes_per_token))
+        ledger.reconcile(program, "hbm_bytes",
+                         slab.decode_bytes_per_token, tolerance=0.0)
+
+    # tiny jitted programs standing in for the train/decode planes:
+    # small enough to compile in milliseconds on CPU, real enough that
+    # XLA's cost model sees a matmul + nonlinearity + reduction
+    @jax.jit
+    def lint_train(w, x, y):
+        h = jnp.tanh(x @ w)
+        loss = jnp.mean((h - y) ** 2)
+        return loss, jax.grad(lambda w_: jnp.mean(
+            (jnp.tanh(x @ w_) - y) ** 2))(w)
+
+    @jax.jit
+    def lint_decode(w, h):
+        return jax.nn.softmax(h @ w, axis=-1)
+
+    w = jnp.zeros((32, 32), jnp.float32)
+    x = jnp.zeros((8, 32), jnp.float32)
+    y = jnp.zeros((8, 32), jnp.float32)
+    h = jnp.zeros((4, 32), jnp.float32)
+    ledger.capture("lint.train", "train", lint_train, w, x, y,
+                   fallback={"flops": 0.0, "hbm_bytes": 0.0})
+    ledger.capture("lint.decode", "serve", lint_decode, w, h,
+                   fallback={"flops": 0.0, "hbm_bytes": 0.0})
+    return ledger
+
+
+def _check_field(name, field, got, want, tol, problems):
+    if tol <= 0.0:
+        if got != want:
+            problems.append(
+                f"{name}.{field}: {got!r} != budget {want!r} (exact)")
+    elif abs(got - want) > tol * max(abs(want), 1.0):
+        problems.append(
+            f"{name}.{field}: {got!r} outside ±{tol:.0%} of budget "
+            f"{want!r}")
+
+
+def check(budgets: dict) -> list:
+    """Return the list of violations (empty = pass)."""
+    ledger = build_canonical_ledger()
+    programs = {name: ledger.record(name).to_dict()
+                for name in ledger.programs()}
+    budgeted = budgets.get("programs") or {}
+    tol = float(budgets.get("xla_tolerance", XLA_TOLERANCE))
+    problems = []
+    for name in sorted(set(programs) - set(budgeted)):
+        problems.append(f"{name}: unbudgeted program (new cost — "
+                        f"regenerate with --update if intentional)")
+    for name in sorted(set(budgeted) - set(programs)):
+        problems.append(f"{name}: stale budget entry (program no "
+                        f"longer produced — regenerate with --update)")
+    for name in sorted(set(programs) & set(budgeted)):
+        rec, want = programs[name], budgeted[name]
+        if rec.get("source") != want.get("source"):
+            problems.append(
+                f"{name}.source: {rec.get('source')!r} != budget "
+                f"{want.get('source')!r}")
+            continue
+        # analytic records are exact closed forms; xla records get the
+        # file's relative tolerance
+        field_tol = 0.0 if rec.get("source") == "analytic" else tol
+        for field in _FIELDS:
+            _check_field(name, field, float(rec.get(field, 0.0)),
+                         float(want.get(field, 0.0)), field_tol,
+                         problems)
+    return problems
+
+
+def generate() -> dict:
+    ledger = build_canonical_ledger()
+    return {
+        "comment": "per-program cost budgets; regenerate with "
+                   "`python tools/check_cost_budgets.py --update`",
+        "xla_tolerance": XLA_TOLERANCE,
+        "programs": {
+            name: {"plane": ledger.record(name).plane,
+                   "source": ledger.record(name).source,
+                   **{f: getattr(ledger.record(name), f)
+                      for f in _FIELDS}}
+            for name in ledger.programs()},
+    }
+
+
+def main(argv) -> int:
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    args = [a for a in argv[1:] if not a.startswith("--")]
+    path = args[0] if args else DEFAULT_BUDGETS
+    if "--update" in argv:
+        doc = generate()
+        with open(path, "w") as f:
+            json.dump(doc, f, indent=2, sort_keys=True)
+            f.write("\n")
+        print(f"wrote {path}: {len(doc['programs'])} program budgets")
+        return 0
+    try:
+        with open(path) as f:
+            budgets = json.load(f)
+    except FileNotFoundError:
+        print(f"cost budgets file missing: {path} (generate with "
+              f"--update)", file=sys.stderr)
+        return 1
+    problems = check(budgets)
+    for p in problems:
+        print(f"cost budget violation: {p}", file=sys.stderr)
+    if problems:
+        return 1
+    n = len(budgets.get("programs") or {})
+    print(f"cost budgets OK: {n} programs within budget")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv))
